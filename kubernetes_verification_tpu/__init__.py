@@ -1,0 +1,77 @@
+"""kubernetes_verification_tpu — TPU-native Kubernetes NetworkPolicy verification.
+
+A from-scratch JAX/XLA framework with the capabilities of
+qiyueyao/Kubernetes-verification (see SURVEY.md): all-pairs pod reachability
+under NetworkPolicies, at two semantic levels (fast kano-style bit-vector
+matrices and faithful Datalog-style NetworkPolicy semantics), behind a
+``VerifierBackend`` plugin boundary with CPU-reference, single-device TPU and
+sharded multi-device backends.
+"""
+from .models.core import (
+    Cluster,
+    Container,
+    Expr,
+    IpBlock,
+    KanoPolicy,
+    Namespace,
+    NetworkPolicy,
+    Peer,
+    Pod,
+    PortSpec,
+    Rule,
+    Selector,
+    INGRESS,
+    EGRESS,
+)
+from .backends.base import (
+    PortAtom,
+    VerifierBackend,
+    VerifyConfig,
+    VerifyResult,
+    available_backends,
+    get_backend,
+    register_backend,
+    verify,
+    verify_kano,
+)
+
+# Importing backend modules registers them.
+from .backends import cpu as _cpu_backend  # noqa: F401
+
+try:  # JAX backends are optional at import time (e.g. docs builds)
+    from .backends import tpu as _tpu_backend  # noqa: F401
+except ImportError:  # pragma: no cover
+    pass
+try:
+    from .backends import sharded as _sharded_backend  # noqa: F401
+except ImportError:  # pragma: no cover
+    pass
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Cluster",
+    "Container",
+    "Expr",
+    "IpBlock",
+    "KanoPolicy",
+    "Namespace",
+    "NetworkPolicy",
+    "Peer",
+    "Pod",
+    "PortAtom",
+    "PortSpec",
+    "Rule",
+    "Selector",
+    "INGRESS",
+    "EGRESS",
+    "VerifierBackend",
+    "VerifyConfig",
+    "VerifyResult",
+    "available_backends",
+    "get_backend",
+    "register_backend",
+    "verify",
+    "verify_kano",
+    "__version__",
+]

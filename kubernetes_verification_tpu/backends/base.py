@@ -1,0 +1,210 @@
+"""The ``VerifierBackend`` plugin boundary.
+
+Every backend consumes the same model objects and produces the same
+``VerifyResult`` so backends can be differentially tested against each other
+(the rebuild's first-class version of the reference's implicit two-verifier
+cross-check, SURVEY.md §4). Registered backends:
+
+* ``cpu``     — object-level NumPy reference; semantics oracle (``backends/cpu.py``)
+* ``tpu``     — single-device JAX/XLA kernels (``backends/tpu.py``)
+* ``sharded`` — multi-device ``shard_map`` over a pod-axis mesh (``backends/sharded.py``)
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..models.core import Cluster, Container, KanoPolicy
+
+__all__ = [
+    "VerifyConfig",
+    "PortAtom",
+    "VerifyResult",
+    "VerifierBackend",
+    "register_backend",
+    "get_backend",
+    "available_backends",
+    "verify",
+    "verify_kano",
+]
+
+
+@dataclass(frozen=True)
+class VerifyConfig:
+    """Typed verification config — the single flag surface (SURVEY.md §5.6).
+
+    Semantic flags (k8s mode):
+
+    * ``self_traffic`` — treat every pod as reachable from itself regardless of
+      policy (the reference's ``check_self_ingress_traffic``,
+      ``kubesv/kubesv/constraint.py:12,193-194``; default True there and here).
+    * ``default_allow_unselected`` — pods selected by no policy in a direction
+      default to allow-all in that direction. This is real Kubernetes
+      semantics and our default; the reference gates it behind
+      ``check_select_by_no_policy`` (default False,
+      ``kubesv/kubesv/constraint.py:13,202-207``) — set it False to reproduce
+      the reference's "unselected pods are unreachable" behaviour.
+    * ``direction_aware_isolation`` — only policies whose
+      ``effective_policy_types`` include a direction isolate pods in that
+      direction (real k8s). The reference never consults policyTypes
+      (``kubesv/kubesv/model.py:522-545`` is dead code), so any selecting
+      policy isolates both directions; set False to reproduce that.
+
+    ``backend`` selects the execution engine; ``closure`` asks for the
+    transitive closure of the reachability graph (the generalisation of the
+    reference's ≤2-hop ``path``, ``kubesv/kubesv/constraint.py:233-237``).
+    """
+
+    backend: str = "cpu"
+    self_traffic: bool = True
+    default_allow_unselected: bool = True
+    direction_aware_isolation: bool = True
+    compute_ports: bool = True
+    closure: bool = False
+    #: extra, backend-specific options (e.g. mesh shape for ``sharded``)
+    backend_options: Tuple[Tuple[str, object], ...] = ()
+
+    def opt(self, key: str, default=None):
+        return dict(self.backend_options).get(key, default)
+
+
+@dataclass(frozen=True)
+class PortAtom:
+    """One equivalence class of (protocol, port) space: all ports in
+    ``[lo, hi]`` of ``protocol`` behave identically under every policy in the
+    cluster, so the port dimension of the reach tensor needs one slot per atom
+    instead of 65536×3. ``name`` is set for named-port atoms."""
+
+    protocol: str
+    lo: int
+    hi: int
+    name: Optional[str] = None
+
+    @property
+    def width(self) -> int:
+        return 1 if self.name is not None else self.hi - self.lo + 1
+
+
+@dataclass
+class VerifyResult:
+    """Backend-independent verification output.
+
+    ``reach[src, dst]`` — src can reach dst on *some* port (row = source, the
+    reference's matrix orientation, ``kano_py/kano/model.py:158-163``).
+    ``reach_ports[src, dst, q]`` — per port-atom reachability (k8s mode with
+    ``compute_ports``). ``src_sets``/``dst_sets`` are the per-policy
+    direction-swapped select/allow bitmaps the reference caches via
+    ``store_bcp`` (``kano_py/kano/model.py:119-121``) — queries and
+    incremental re-verify consume them.
+    """
+
+    n_pods: int
+    mode: str  # "kano" | "k8s"
+    backend: str
+    config: VerifyConfig
+    reach: np.ndarray  # bool [N, N]
+    reach_ports: Optional[np.ndarray] = None  # bool [N, N, Q]
+    port_atoms: List[PortAtom] = field(default_factory=list)
+    #: per policy: which pods are sources of its edges (kano working_select)
+    src_sets: Optional[np.ndarray] = None  # bool [P, N]
+    #: per policy: which pods are destinations of its edges (kano working_allow)
+    dst_sets: Optional[np.ndarray] = None  # bool [P, N]
+    #: k8s mode: pod selected by policy (podSelector ∧ namespace) [P, N]
+    selected: Optional[np.ndarray] = None
+    ingress_isolated: Optional[np.ndarray] = None  # bool [N]
+    egress_isolated: Optional[np.ndarray] = None  # bool [N]
+    closure: Optional[np.ndarray] = None  # bool [N, N] transitive closure
+    timings: Dict[str, float] = field(default_factory=dict)
+
+    # -- convenience views -------------------------------------------------
+    def reachable(self, src: int, dst: int) -> bool:
+        return bool(self.reach[src, dst])
+
+    def edges(self) -> List[Tuple[int, int]]:
+        """Reachable (src, dst) index pairs — the decoded form of the
+        reference's only result API (``kubesv/sample/__init__.py:14-25``)."""
+        s, d = np.nonzero(self.reach)
+        return list(zip(s.tolist(), d.tolist()))
+
+    # -- the six kano verification queries (kano_py/kano/algorithm.py) -----
+    def all_reachable(self) -> List[int]:
+        from ..ops.queries import all_reachable
+
+        return all_reachable(self.reach)
+
+    def all_isolated(self) -> List[int]:
+        from ..ops.queries import all_isolated
+
+        return all_isolated(self.reach)
+
+    def user_crosscheck(self, containers_or_pods, label: str) -> List[int]:
+        from ..ops.queries import user_crosscheck
+
+        return user_crosscheck(self.reach, containers_or_pods, label)
+
+    def system_isolation(self, idx: int) -> List[int]:
+        from ..ops.queries import system_isolation
+
+        return system_isolation(self.reach, idx)
+
+    def policy_shadow(self) -> List[Tuple[int, int]]:
+        from ..ops.queries import policy_shadow
+
+        return policy_shadow(self.src_sets, self.dst_sets)
+
+    def policy_conflict(self) -> List[Tuple[int, int]]:
+        from ..ops.queries import policy_conflict
+
+        return policy_conflict(self.src_sets, self.dst_sets)
+
+
+class VerifierBackend:
+    """Backend interface. Implementations provide one or both modes."""
+
+    name: str = "abstract"
+
+    def verify(self, cluster: Cluster, config: VerifyConfig) -> VerifyResult:
+        raise NotImplementedError
+
+    def verify_kano(
+        self,
+        containers: Sequence[Container],
+        policies: Sequence[KanoPolicy],
+        config: VerifyConfig,
+    ) -> VerifyResult:
+        raise NotImplementedError
+
+
+_REGISTRY: Dict[str, Callable[[], VerifierBackend]] = {}
+
+
+def register_backend(name: str, factory: Callable[[], VerifierBackend]) -> None:
+    _REGISTRY[name] = factory
+
+
+def available_backends() -> List[str]:
+    return sorted(_REGISTRY)
+
+
+def get_backend(name: str) -> VerifierBackend:
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown backend {name!r}; have {available_backends()}")
+    return _REGISTRY[name]()
+
+
+def verify(cluster: Cluster, config: Optional[VerifyConfig] = None) -> VerifyResult:
+    """Verify a k8s-level cluster with the configured backend."""
+    config = config or VerifyConfig()
+    return get_backend(config.backend).verify(cluster, config)
+
+
+def verify_kano(
+    containers: Sequence[Container],
+    policies: Sequence[KanoPolicy],
+    config: Optional[VerifyConfig] = None,
+) -> VerifyResult:
+    """Verify a kano-level scenario with the configured backend."""
+    config = config or VerifyConfig()
+    return get_backend(config.backend).verify_kano(containers, policies, config)

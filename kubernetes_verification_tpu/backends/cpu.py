@@ -1,0 +1,242 @@
+"""Object-level NumPy reference backend — the semantics oracle.
+
+This backend interprets the model objects directly (per-pod/per-policy Python
+loops + NumPy outer products), deliberately sharing no code with the tensorised
+encoder/kernels so differential tests between the two are meaningful. It plays
+the role of both reference verifiers:
+
+* ``verify_kano`` reproduces the bit-vector matrix build
+  (``kano_py/kano/model.py:124-165``) exactly, including the matcher quirk
+  that a selector key appearing on *no* container is ignored (the interaction
+  of the label-presence bitmap at ``kano_py/kano/model.py:142-147`` with the
+  value refinement loop at ``:150-154``).
+* ``verify`` implements full NetworkPolicy semantics, the role of the
+  Datalog program (``kubesv/kubesv/constraint.py:136-298``), with the
+  reference's two semantic flags plus correct policyTypes handling.
+
+At scale the hot loops here hand off to the native C++ bitset engine when it
+is built (``native/``); pure NumPy otherwise.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from ..encode.ports import compute_port_atoms, rule_port_mask
+from ..models.core import (
+    Cluster,
+    Container,
+    KanoPolicy,
+    NetworkPolicy,
+    Peer,
+    Pod,
+    Rule,
+)
+from .base import (
+    VerifierBackend,
+    VerifyConfig,
+    VerifyResult,
+    register_backend,
+)
+
+__all__ = ["CpuBackend"]
+
+
+def _kano_match(labels: Dict[str, str], rule: Dict[str, str], cluster_keys: Set[str]) -> bool:
+    """kano select/allow semantics: every rule key that exists *somewhere* in
+    the cluster must be present on the container with an equal value; rule
+    keys unknown to the whole cluster are ignored
+    (``kano_py/kano/model.py:142-154``)."""
+    for k, v in rule.items():
+        if k not in cluster_keys:
+            continue
+        if labels.get(k) != v:
+            return False
+    return True
+
+
+class CpuBackend(VerifierBackend):
+    name = "cpu"
+
+    # ------------------------------------------------------------------ kano
+    def verify_kano(
+        self,
+        containers: Sequence[Container],
+        policies: Sequence[KanoPolicy],
+        config: VerifyConfig,
+    ) -> VerifyResult:
+        n = len(containers)
+        cluster_keys: Set[str] = set()
+        for c in containers:
+            cluster_keys.update(c.labels)
+
+        reach = np.zeros((n, n), dtype=bool)
+        src_sets = np.zeros((len(policies), n), dtype=bool)
+        dst_sets = np.zeros((len(policies), n), dtype=bool)
+
+        for c in containers:  # rebuild the per-container policy indices
+            c.select_policies.clear()
+            c.allow_policies.clear()
+
+        for pi, pol in enumerate(policies):
+            for i, c in enumerate(containers):
+                src_sets[pi, i] = _kano_match(c.labels, pol.src_labels, cluster_keys)
+                dst_sets[pi, i] = _kano_match(c.labels, pol.dst_labels, cluster_keys)
+            # matrix[src] |= dst_set for every selected src
+            # (kano_py/kano/model.py:158-163)
+            reach |= np.outer(src_sets[pi], dst_sets[pi])
+            for i in range(n):
+                if src_sets[pi, i]:
+                    containers[i].select_policies.append(pi)
+                if dst_sets[pi, i]:
+                    containers[i].allow_policies.append(pi)
+
+        return VerifyResult(
+            n_pods=n,
+            mode="kano",
+            backend=self.name,
+            config=config,
+            reach=reach,
+            src_sets=src_sets,
+            dst_sets=dst_sets,
+            closure=_transitive_closure(reach) if config.closure else None,
+        )
+
+    # ------------------------------------------------------------------- k8s
+    def verify(self, cluster: Cluster, config: VerifyConfig) -> VerifyResult:
+        pods, policies, namespaces = cluster.pods, cluster.policies, cluster.namespaces
+        n, P = len(pods), len(policies)
+        ns_labels = {ns.name: ns.labels for ns in namespaces}
+
+        atoms = compute_port_atoms(policies) if config.compute_ports else None
+        Q = len(atoms) if atoms else 1
+
+        selected = np.zeros((P, n), dtype=bool)
+        for pi, pol in enumerate(policies):
+            for i, pod in enumerate(pods):
+                selected[pi, i] = (
+                    pod.namespace == pol.namespace
+                    and pol.pod_selector.matches(pod.labels)
+                )
+
+        ing_iso = np.zeros(n, dtype=bool)
+        eg_iso = np.zeros(n, dtype=bool)
+        for pi, pol in enumerate(policies):
+            affects_in = pol.affects_ingress if config.direction_aware_isolation else True
+            affects_eg = pol.affects_egress if config.direction_aware_isolation else True
+            if affects_in:
+                ing_iso |= selected[pi]
+            if affects_eg:
+                eg_iso |= selected[pi]
+
+        def peer_match(peer: Peer, pol: NetworkPolicy) -> np.ndarray:
+            """bool[N]: pods this peer matches (see Peer docstring)."""
+            out = np.zeros(n, dtype=bool)
+            for i, pod in enumerate(pods):
+                if peer.ip_block is not None:
+                    out[i] = peer.ip_block.matches_ip(pod.ip)
+                    continue
+                if peer.namespace_selector is None:
+                    ns_ok = pod.namespace == pol.namespace
+                else:
+                    ns_ok = peer.namespace_selector.matches(
+                        ns_labels.get(pod.namespace, {})
+                    )
+                pod_ok = peer.pod_selector is None or peer.pod_selector.matches(
+                    pod.labels
+                )
+                out[i] = ns_ok and pod_ok
+            return out
+
+        def rule_peer_set(rule: Rule, pol: NetworkPolicy) -> np.ndarray:
+            if rule.matches_all_peers:
+                return np.ones(n, dtype=bool)
+            acc = np.zeros(n, dtype=bool)
+            for peer in rule.peers:
+                acc |= peer_match(peer, pol)
+            return acc
+
+        ingress_allow = np.zeros((n, n, Q), dtype=bool)
+        egress_allow = np.zeros((n, n, Q), dtype=bool)
+        for pi, pol in enumerate(policies):
+            tgt = selected[pi]
+            if pol.affects_ingress and pol.ingress:
+                for rule in pol.ingress:
+                    srcs = rule_peer_set(rule, pol)
+                    pmask = (
+                        rule_port_mask(rule, atoms) if atoms else np.ones(1, dtype=bool)
+                    )
+                    ingress_allow |= (
+                        srcs[:, None, None] & tgt[None, :, None] & pmask[None, None, :]
+                    )
+            if pol.affects_egress and pol.egress:
+                for rule in pol.egress:
+                    dsts = rule_peer_set(rule, pol)
+                    pmask = (
+                        rule_port_mask(rule, atoms) if atoms else np.ones(1, dtype=bool)
+                    )
+                    egress_allow |= (
+                        tgt[:, None, None] & dsts[None, :, None] & pmask[None, None, :]
+                    )
+
+        # default-allow: pods unselected in a direction allow everything in it
+        # iff the flag is on (real k8s True; reference's default False,
+        # kubesv/kubesv/constraint.py:202-223).
+        if config.default_allow_unselected:
+            ingress_ok = ingress_allow | ~ing_iso[None, :, None]
+            egress_ok = egress_allow | ~eg_iso[:, None, None]
+        else:
+            ingress_ok = ingress_allow
+            egress_ok = egress_allow
+
+        reach_pq = ingress_ok & egress_ok
+        if config.self_traffic:
+            di = np.arange(n)
+            reach_pq[di, di, :] = True
+        reach = reach_pq.any(axis=2)
+
+        # per-policy src/dst edge sets (direction-swapped kano-style bitmaps)
+        # for the policy-level queries and incremental re-verify.
+        src_sets = np.zeros((P, n), dtype=bool)
+        dst_sets = np.zeros((P, n), dtype=bool)
+        for pi, pol in enumerate(policies):
+            if pol.affects_ingress and pol.ingress:
+                for rule in pol.ingress:
+                    src_sets[pi] |= rule_peer_set(rule, pol)
+                dst_sets[pi] |= selected[pi]
+            if pol.affects_egress and pol.egress:
+                for rule in pol.egress:
+                    dst_sets[pi] |= rule_peer_set(rule, pol)
+                src_sets[pi] |= selected[pi]
+
+        return VerifyResult(
+            n_pods=n,
+            mode="k8s",
+            backend=self.name,
+            config=config,
+            reach=reach,
+            reach_ports=reach_pq if config.compute_ports else None,
+            port_atoms=list(atoms) if atoms else [],
+            src_sets=src_sets,
+            dst_sets=dst_sets,
+            selected=selected,
+            ingress_isolated=ing_iso,
+            egress_isolated=eg_iso,
+            closure=_transitive_closure(reach) if config.closure else None,
+        )
+
+
+def _transitive_closure(reach: np.ndarray) -> np.ndarray:
+    """Boolean transitive closure by repeated squaring — the full-path
+    generalisation of the reference's ≤2-hop ``path``
+    (``kubesv/kubesv/constraint.py:233-237``)."""
+    closure = reach.copy()
+    while True:
+        nxt = closure | ((closure.astype(np.int64) @ closure.astype(np.int64)) > 0)
+        if np.array_equal(nxt, closure):
+            return closure
+        closure = nxt
+
+
+register_backend("cpu", CpuBackend)

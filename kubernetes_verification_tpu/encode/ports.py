@@ -1,0 +1,98 @@
+"""Port-space equivalence-class ("atom") computation.
+
+The reference parses NetworkPolicy ports but never enforces them
+(``kano_py/kano/model.py:54-56`` stores protocols unused;
+``kubesv/kubesv/model.py:365-385`` drops them via a missing return). Here ports
+are first-class: instead of a 3×65535 port axis, the (protocol, port) space is
+partitioned into the coarsest partition under which every policy's port specs
+are constant — the *port atoms*. The reach tensor gets one boolean slot per
+atom, and each atom carries its ``width`` so counting queries can weight pairs
+by how many concrete ports an atom stands for.
+
+Named ports get their own single-slot atoms keyed by (protocol, name); they are
+matched by name (per-destination-pod resolution against ``containerPort`` names
+is an upstream-k8s behaviour approximated here, documented in
+``PortSpec``).
+"""
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..backends.base import PortAtom
+from ..models.core import PROTOCOLS, NetworkPolicy, PortSpec, Rule
+
+__all__ = ["compute_port_atoms", "rule_port_mask", "ALL_ATOM"]
+
+#: The degenerate single atom used when no policy mentions any port.
+ALL_ATOM = PortAtom(protocol="ANY", lo=1, hi=65535)
+
+_MAX_PORT = 65535
+
+
+def _iter_rules(policies: Sequence[NetworkPolicy]) -> Iterable[Rule]:
+    for pol in policies:
+        for rules in (pol.ingress, pol.egress):
+            if rules:
+                yield from rules
+
+
+def compute_port_atoms(policies: Sequence[NetworkPolicy]) -> List[PortAtom]:
+    """Partition (protocol × port) space by the boundaries of every port spec
+    appearing in any rule. Returns a single ``ALL_ATOM`` when no rule
+    constrains ports, so portless clusters verify with a length-1 port axis."""
+    numeric: dict = {}  # protocol -> set of boundaries
+    named: set = set()  # (protocol, name)
+    any_spec = False
+    for rule in _iter_rules(policies):
+        if rule.ports is None:
+            continue
+        for spec in rule.ports:
+            any_spec = True
+            if isinstance(spec.port, str):
+                named.add((spec.protocol, spec.port))
+            elif spec.port is None:
+                numeric.setdefault(spec.protocol, set())
+            else:
+                hi = spec.end_port if spec.end_port is not None else spec.port
+                bounds = numeric.setdefault(spec.protocol, set())
+                bounds.add(spec.port)
+                bounds.add(hi + 1)
+    if not any_spec:
+        return [ALL_ATOM]
+
+    atoms: List[PortAtom] = []
+    for proto in PROTOCOLS:
+        bounds = sorted({1, _MAX_PORT + 1} | numeric.get(proto, set()))
+        for lo, nxt in zip(bounds, bounds[1:]):
+            atoms.append(PortAtom(protocol=proto, lo=lo, hi=nxt - 1))
+    for proto, name in sorted(named):
+        atoms.append(PortAtom(protocol=proto, lo=0, hi=0, name=name))
+    return atoms
+
+
+def _spec_covers(spec: PortSpec, atom: PortAtom) -> bool:
+    if atom.name is not None:
+        return isinstance(spec.port, str) and (spec.protocol, spec.port) == (
+            atom.protocol,
+            atom.name,
+        )
+    if atom.protocol == "ANY":
+        return spec.port is None  # only all-ports specs cover the ANY atom
+    if spec.protocol != atom.protocol or isinstance(spec.port, str):
+        return False
+    if spec.port is None:
+        return True  # all ports of this protocol
+    hi = spec.end_port if spec.end_port is not None else spec.port
+    return spec.port <= atom.lo and atom.hi <= hi
+
+
+def rule_port_mask(rule: Rule, atoms: Sequence[PortAtom]) -> np.ndarray:
+    """bool[Q]: which atoms this rule's ports cover. ``ports=None`` → all."""
+    if rule.ports is None:
+        return np.ones(len(atoms), dtype=bool)
+    mask = np.zeros(len(atoms), dtype=bool)
+    for q, atom in enumerate(atoms):
+        mask[q] = any(_spec_covers(spec, atom) for spec in rule.ports)
+    return mask

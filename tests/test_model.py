@@ -1,0 +1,118 @@
+"""Core data-model semantics: selectors, expressions, rules, policy types."""
+import pytest
+
+from kubernetes_verification_tpu import (
+    Cluster,
+    Expr,
+    IpBlock,
+    NetworkPolicy,
+    Peer,
+    Pod,
+    PortSpec,
+    Rule,
+    Selector,
+)
+
+
+class TestExpr:
+    def test_in(self):
+        e = Expr("role", "In", ("db", "web"))
+        assert e.matches({"role": "db"})
+        assert not e.matches({"role": "cache"})
+        assert not e.matches({})  # In requires the key
+
+    def test_notin_without_key_matches(self):
+        # k8s: NotIn matches objects without the key.
+        e = Expr("role", "NotIn", ("db",))
+        assert e.matches({})
+        assert e.matches({"role": "web"})
+        assert not e.matches({"role": "db"})
+
+    def test_exists(self):
+        assert Expr("k", "Exists").matches({"k": "x"})
+        assert not Expr("k", "Exists").matches({})
+        assert Expr("k", "DoesNotExist").matches({})
+        assert not Expr("k", "DoesNotExist").matches({"k": "x"})
+
+    def test_reference_misspelling_normalised(self):
+        # kubesv's own sample uses DoesNotExists (kubesv/sample/example.py:162)
+        assert Expr("k", "DoesNotExists").op == "DoesNotExist"
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            Expr("k", "Frobnicate")
+        with pytest.raises(ValueError):
+            Expr("k", "In", ())
+        with pytest.raises(ValueError):
+            Expr("k", "Exists", ("v",))
+
+
+class TestSelector:
+    def test_empty_matches_everything(self):
+        assert Selector().matches({})
+        assert Selector().matches({"a": "b"})
+
+    def test_match_labels_conjunction(self):
+        s = Selector({"a": "1", "b": "2"})
+        assert s.matches({"a": "1", "b": "2", "c": "3"})
+        assert not s.matches({"a": "1"})
+
+    def test_expressions_and_labels_conjoin(self):
+        s = Selector({"a": "1"}, (Expr("b", "Exists"),))
+        assert s.matches({"a": "1", "b": "x"})
+        assert not s.matches({"a": "1"})
+
+
+class TestPeerAndPorts:
+    def test_peer_requires_a_field(self):
+        with pytest.raises(ValueError):
+            Peer()
+
+    def test_ipblock_exclusive(self):
+        with pytest.raises(ValueError):
+            Peer(pod_selector=Selector(), ip_block=IpBlock("10.0.0.0/8"))
+
+    def test_ipblock_except(self):
+        b = IpBlock("172.17.0.0/16", ("172.17.1.0/24",))
+        assert b.matches_ip("172.17.0.5")
+        assert not b.matches_ip("172.17.1.5")
+        assert not b.matches_ip("10.0.0.1")
+        assert not b.matches_ip(None)
+
+    def test_port_validation(self):
+        with pytest.raises(ValueError):
+            PortSpec("ICMP", 1)
+        with pytest.raises(ValueError):
+            PortSpec("TCP", 100, end_port=50)
+        with pytest.raises(ValueError):
+            PortSpec("TCP", 0)
+
+    def test_rule_all_peers(self):
+        assert Rule().matches_all_peers
+        assert Rule(peers=()).matches_all_peers
+        assert not Rule(peers=(Peer(pod_selector=Selector()),)).matches_all_peers
+
+
+class TestPolicyTypes:
+    def test_default_ingress_only(self):
+        p = NetworkPolicy("p", ingress=(Rule(),))
+        assert p.effective_policy_types == ("Ingress",)
+        assert p.affects_ingress and not p.affects_egress
+
+    def test_default_with_egress_section(self):
+        p = NetworkPolicy("p", egress=(Rule(),))
+        assert p.effective_policy_types == ("Ingress", "Egress")
+
+    def test_explicit_wins(self):
+        p = NetworkPolicy("p", policy_types=("Egress",), ingress=(Rule(),))
+        assert not p.affects_ingress and p.affects_egress
+
+
+class TestCluster:
+    def test_auto_namespaces(self):
+        c = Cluster(pods=[Pod("a", "ns1"), Pod("b", "ns2")])
+        assert {ns.name for ns in c.namespaces} == {"ns1", "ns2"}
+
+    def test_policy_namespace_autocreated(self):
+        c = Cluster(policies=[NetworkPolicy("p", namespace="prod")])
+        assert {ns.name for ns in c.namespaces} == {"prod"}
