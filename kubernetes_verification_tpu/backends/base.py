@@ -3,11 +3,10 @@
 Every backend consumes the same model objects and produces the same
 ``VerifyResult`` so backends can be differentially tested against each other
 (the rebuild's first-class version of the reference's implicit two-verifier
-cross-check, SURVEY.md §4). Registered backends:
-
-* ``cpu``     — object-level NumPy reference; semantics oracle (``backends/cpu.py``)
-* ``tpu``     — single-device JAX/XLA kernels (``backends/tpu.py``)
-* ``sharded`` — multi-device ``shard_map`` over a pod-axis mesh (``backends/sharded.py``)
+cross-check, SURVEY.md §4). Backends register themselves on import via
+``register_backend``; ``available_backends()`` lists what this build provides
+(at minimum ``cpu`` — the object-level NumPy semantics oracle — and ``tpu``,
+the single-device JAX/XLA kernel backend).
 """
 from __future__ import annotations
 

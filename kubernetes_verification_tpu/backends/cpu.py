@@ -14,8 +14,6 @@ the role of both reference verifiers:
   Datalog program (``kubesv/kubesv/constraint.py:136-298``), with the
   reference's two semantic flags plus correct policyTypes handling.
 
-At scale the hot loops here hand off to the native C++ bitset engine when it
-is built (``native/``); pure NumPy otherwise.
 """
 from __future__ import annotations
 
@@ -120,14 +118,29 @@ class CpuBackend(VerifierBackend):
                     and pol.pod_selector.matches(pod.labels)
                 )
 
+        # Direction gating: with direction_aware_isolation=False (reference
+        # compat, kubesv never consults policyTypes) every selecting policy
+        # isolates AND its rules apply in both directions.
+        affects_in = np.array(
+            [
+                pol.affects_ingress if config.direction_aware_isolation else True
+                for pol in policies
+            ],
+            dtype=bool,
+        )
+        affects_eg = np.array(
+            [
+                pol.affects_egress if config.direction_aware_isolation else True
+                for pol in policies
+            ],
+            dtype=bool,
+        )
         ing_iso = np.zeros(n, dtype=bool)
         eg_iso = np.zeros(n, dtype=bool)
-        for pi, pol in enumerate(policies):
-            affects_in = pol.affects_ingress if config.direction_aware_isolation else True
-            affects_eg = pol.affects_egress if config.direction_aware_isolation else True
-            if affects_in:
+        for pi in range(P):
+            if affects_in[pi]:
                 ing_iso |= selected[pi]
-            if affects_eg:
+            if affects_eg[pi]:
                 eg_iso |= selected[pi]
 
         def peer_match(peer: Peer, pol: NetworkPolicy) -> np.ndarray:
@@ -157,11 +170,15 @@ class CpuBackend(VerifierBackend):
                 acc |= peer_match(peer, pol)
             return acc
 
+        # Single pass over rules: compute each rule's peer set once and use it
+        # both for the allow tensors and the per-policy src/dst edge sets.
         ingress_allow = np.zeros((n, n, Q), dtype=bool)
         egress_allow = np.zeros((n, n, Q), dtype=bool)
+        src_sets = np.zeros((P, n), dtype=bool)
+        dst_sets = np.zeros((P, n), dtype=bool)
         for pi, pol in enumerate(policies):
             tgt = selected[pi]
-            if pol.affects_ingress and pol.ingress:
+            if affects_in[pi] and pol.ingress:
                 for rule in pol.ingress:
                     srcs = rule_peer_set(rule, pol)
                     pmask = (
@@ -170,7 +187,9 @@ class CpuBackend(VerifierBackend):
                     ingress_allow |= (
                         srcs[:, None, None] & tgt[None, :, None] & pmask[None, None, :]
                     )
-            if pol.affects_egress and pol.egress:
+                    src_sets[pi] |= srcs
+                dst_sets[pi] |= tgt
+            if affects_eg[pi] and pol.egress:
                 for rule in pol.egress:
                     dsts = rule_peer_set(rule, pol)
                     pmask = (
@@ -179,6 +198,8 @@ class CpuBackend(VerifierBackend):
                     egress_allow |= (
                         tgt[:, None, None] & dsts[None, :, None] & pmask[None, None, :]
                     )
+                    dst_sets[pi] |= dsts
+                src_sets[pi] |= tgt
 
         # default-allow: pods unselected in a direction allow everything in it
         # iff the flag is on (real k8s True; reference's default False,
@@ -195,20 +216,6 @@ class CpuBackend(VerifierBackend):
             di = np.arange(n)
             reach_pq[di, di, :] = True
         reach = reach_pq.any(axis=2)
-
-        # per-policy src/dst edge sets (direction-swapped kano-style bitmaps)
-        # for the policy-level queries and incremental re-verify.
-        src_sets = np.zeros((P, n), dtype=bool)
-        dst_sets = np.zeros((P, n), dtype=bool)
-        for pi, pol in enumerate(policies):
-            if pol.affects_ingress and pol.ingress:
-                for rule in pol.ingress:
-                    src_sets[pi] |= rule_peer_set(rule, pol)
-                dst_sets[pi] |= selected[pi]
-            if pol.affects_egress and pol.egress:
-                for rule in pol.egress:
-                    dst_sets[pi] |= rule_peer_set(rule, pol)
-                src_sets[pi] |= selected[pi]
 
         return VerifyResult(
             n_pods=n,

@@ -1,0 +1,170 @@
+"""Single-device JAX/XLA backend.
+
+Encode on host (one transfer), then run the jitted kernels from ``ops/``:
+selector matching, grant contraction and closure all fuse into a handful of
+MXU matmuls. Jitted callables are cached per (shape signature, semantic
+flags); re-verifying a same-shaped cluster (the incremental path) reuses the
+compiled executable.
+"""
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import Sequence
+
+import jax
+import numpy as np
+
+from ..encode.encoder import encode_cluster, encode_kano
+from ..models.core import Cluster, Container, KanoPolicy
+from ..ops.closure import transitive_closure
+from ..ops.reach import k8s_reach, kano_reach
+from .base import (
+    VerifierBackend,
+    VerifyConfig,
+    VerifyResult,
+    register_backend,
+)
+
+__all__ = ["TpuBackend"]
+
+
+@partial(jax.jit, static_argnames=("with_closure",))
+def _kano_step(pod_kv, src_req, src_imp, dst_req, dst_imp, *, with_closure: bool):
+    out = kano_reach(pod_kv, src_req, src_imp, dst_req, dst_imp)
+    closure = transitive_closure(out.reach) if with_closure else None
+    return out, closure
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "self_traffic",
+        "default_allow_unselected",
+        "direction_aware_isolation",
+        "with_closure",
+    ),
+)
+def _k8s_step(
+    pod_kv,
+    pod_key,
+    pod_ns,
+    ns_kv,
+    ns_key,
+    pol_sel,
+    pol_ns,
+    aff_ing,
+    aff_eg,
+    ingress,
+    egress,
+    *,
+    self_traffic: bool,
+    default_allow_unselected: bool,
+    direction_aware_isolation: bool,
+    with_closure: bool,
+):
+    out = k8s_reach(
+        pod_kv,
+        pod_key,
+        pod_ns,
+        ns_kv,
+        ns_key,
+        pol_sel,
+        pol_ns,
+        aff_ing,
+        aff_eg,
+        ingress,
+        egress,
+        self_traffic=self_traffic,
+        default_allow_unselected=default_allow_unselected,
+        direction_aware_isolation=direction_aware_isolation,
+    )
+    closure = transitive_closure(out.reach) if with_closure else None
+    return out, closure
+
+
+class TpuBackend(VerifierBackend):
+    name = "tpu"
+
+    def verify(self, cluster: Cluster, config: VerifyConfig) -> VerifyResult:
+        t0 = time.perf_counter()
+        enc = encode_cluster(cluster, compute_ports=config.compute_ports)
+        t1 = time.perf_counter()
+        out, closure = _k8s_step(
+            enc.pod_kv,
+            enc.pod_key,
+            enc.pod_ns,
+            enc.ns_kv,
+            enc.ns_key,
+            enc.pol_sel,
+            enc.pol_ns,
+            enc.pol_affects_ingress,
+            enc.pol_affects_egress,
+            enc.ingress,
+            enc.egress,
+            self_traffic=config.self_traffic,
+            default_allow_unselected=config.default_allow_unselected,
+            direction_aware_isolation=config.direction_aware_isolation,
+            with_closure=config.closure,
+        )
+        jax.block_until_ready(out.reach)
+        t2 = time.perf_counter()
+        return VerifyResult(
+            n_pods=cluster.n_pods,
+            mode="k8s",
+            backend=self.name,
+            config=config,
+            reach=np.asarray(out.reach),
+            reach_ports=np.asarray(out.reach_ports) if config.compute_ports else None,
+            port_atoms=enc.atoms,
+            src_sets=np.asarray(out.src_sets),
+            dst_sets=np.asarray(out.dst_sets),
+            selected=np.asarray(out.selected),
+            ingress_isolated=np.asarray(out.ingress_isolated),
+            egress_isolated=np.asarray(out.egress_isolated),
+            closure=np.asarray(closure) if closure is not None else None,
+            timings={"encode": t1 - t0, "solve": t2 - t1},
+        )
+
+    def verify_kano(
+        self,
+        containers: Sequence[Container],
+        policies: Sequence[KanoPolicy],
+        config: VerifyConfig,
+    ) -> VerifyResult:
+        t0 = time.perf_counter()
+        enc = encode_kano(containers, policies)
+        t1 = time.perf_counter()
+        out, closure = _kano_step(
+            enc.pod_kv,
+            enc.src_req,
+            enc.src_impossible,
+            enc.dst_req,
+            enc.dst_impossible,
+            with_closure=config.closure,
+        )
+        jax.block_until_ready(out.reach)
+        t2 = time.perf_counter()
+        src_sets = np.asarray(out.src_sets)
+        dst_sets = np.asarray(out.dst_sets)
+        # maintain the reference's per-container policy index lists
+        # (kano_py/kano/model.py:158-163)
+        for i, c in enumerate(containers):
+            c.select_policies.clear()
+            c.allow_policies.clear()
+            c.select_policies.extend(np.nonzero(src_sets[:, i])[0].tolist())
+            c.allow_policies.extend(np.nonzero(dst_sets[:, i])[0].tolist())
+        return VerifyResult(
+            n_pods=len(containers),
+            mode="kano",
+            backend=self.name,
+            config=config,
+            reach=np.asarray(out.reach),
+            src_sets=src_sets,
+            dst_sets=dst_sets,
+            closure=np.asarray(closure) if closure is not None else None,
+            timings={"encode": t1 - t0, "solve": t2 - t1},
+        )
+
+
+register_backend("tpu", TpuBackend)

@@ -1,0 +1,366 @@
+"""Cluster → dense tensors: the tensorised form of fact/selector compilation.
+
+This is the host-side encode phase (one transfer to device, SURVEY.md §7.2
+layer 2). It turns the object model into fixed-shape boolean/integer arrays
+consumed by the JAX kernels in ``ops/``:
+
+* label facts → ``pod_kv``/``pod_key``/``ns_kv``/``ns_key`` matrices — the
+  role of ``define_pod_facts`` (``kubesv/kubesv/constraint.py:242-275``);
+* each ``LabelSelector`` → one row of a ``SelectorEnc`` stack — the role of
+  ``define_label_selector`` (``kubesv/kubesv/model.py:178-243``), with the
+  whole matchExpressions algebra folded into five masks + an In-expression
+  block (see ``SelectorEnc``);
+* each (policy, rule, peer) → one *grant* row of a ``GrantBlock`` — the role
+  of ``define_ingress_rules``/``define_egress_rules``/``define_peer_rule``
+  (``kubesv/kubesv/model.py:432-483,350-363``).
+
+Everything is NumPy here; the backend moves arrays to device once.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from ..backends.base import PortAtom
+from ..models.core import (
+    Cluster,
+    Container,
+    KanoPolicy,
+    NetworkPolicy,
+    Selector,
+)
+from .ports import compute_port_atoms, rule_port_mask
+from .vocab import Vocab
+
+__all__ = [
+    "SelectorEnc",
+    "GrantBlock",
+    "EncodedCluster",
+    "EncodedKano",
+    "encode_cluster",
+    "encode_kano",
+]
+
+
+@dataclass
+class SelectorEnc:
+    """A stack of S compiled selectors over a V-pair / K-key vocabulary.
+
+    An entity with pair bitmap ``kv`` and key bitmap ``key`` matches row s iff
+
+    * ``req_eq[s] ⊆ kv``          (matchLabels pairs, all present)
+    * ``req_key[s] ⊆ key``        (Exists keys, all present)
+    * ``forbid_eq[s] ∩ kv = ∅``   (union of NotIn value masks — NotIn folds
+                                   across expressions because each entity has
+                                   at most one value per key)
+    * ``forbid_key[s] ∩ key = ∅`` (DoesNotExist keys)
+    * for each valid In expression e: ``in_mask[s,e] ∩ kv ≠ ∅``
+    * ``not impossible[s]``       (selector requires a pair/key no entity in
+                                   the cluster has — it can match nothing)
+
+    All five subset/disjointness tests are count comparisons after an integer
+    matmul, so the whole stack evaluates as a handful of MXU contractions
+    (``ops/match.py``).
+    """
+
+    req_eq: np.ndarray  # bool [S, V]
+    req_key: np.ndarray  # bool [S, K]
+    forbid_eq: np.ndarray  # bool [S, V]
+    forbid_key: np.ndarray  # bool [S, K]
+    in_mask: np.ndarray  # bool [S, E, V]
+    in_valid: np.ndarray  # bool [S, E]
+    impossible: np.ndarray  # bool [S]
+
+    @property
+    def n(self) -> int:
+        return self.req_eq.shape[0]
+
+
+def _encode_selector_stack(
+    selectors: Sequence[Optional[Selector]], vocab: Vocab
+) -> SelectorEnc:
+    """Compile selectors (None → empty row that matches everything)."""
+    S, V, K = len(selectors), vocab.n_pairs, vocab.n_keys
+    E = max(
+        (
+            sum(1 for e in s.match_expressions if e.op == "In")
+            for s in selectors
+            if s is not None
+        ),
+        default=0,
+    )
+    enc = SelectorEnc(
+        req_eq=np.zeros((S, V), dtype=bool),
+        req_key=np.zeros((S, K), dtype=bool),
+        forbid_eq=np.zeros((S, V), dtype=bool),
+        forbid_key=np.zeros((S, K), dtype=bool),
+        in_mask=np.zeros((S, E, V), dtype=bool),
+        in_valid=np.zeros((S, E), dtype=bool),
+        impossible=np.zeros(S, dtype=bool),
+    )
+    for s, sel in enumerate(selectors):
+        if sel is None:
+            continue
+        for k, v in sel.match_labels.items():
+            pid = vocab.pair(k, v)
+            if pid is None:
+                # no entity carries this pair → the selector matches nothing
+                enc.impossible[s] = True
+            else:
+                enc.req_eq[s, pid] = True
+        e_idx = 0
+        for expr in sel.match_expressions:
+            if expr.op == "Exists":
+                kid = vocab.key(expr.key)
+                if kid is None:
+                    enc.impossible[s] = True
+                else:
+                    enc.req_key[s, kid] = True
+            elif expr.op == "DoesNotExist":
+                kid = vocab.key(expr.key)
+                if kid is not None:  # unknown key: everyone satisfies
+                    enc.forbid_key[s, kid] = True
+            elif expr.op == "NotIn":
+                for v in expr.values:
+                    pid = vocab.pair(expr.key, v)
+                    if pid is not None:
+                        enc.forbid_eq[s, pid] = True
+            else:  # In
+                enc.in_valid[s, e_idx] = True
+                for v in expr.values:
+                    pid = vocab.pair(expr.key, v)
+                    if pid is not None:
+                        enc.in_mask[s, e_idx, pid] = True
+                # all-unknown values leave an empty mask: matches nothing,
+                # which is exactly In's semantics here.
+                e_idx += 1
+    return enc
+
+
+@dataclass
+class GrantBlock:
+    """Flattened (policy, rule, peer) triples for one direction.
+
+    Row g grants traffic between the pods selected by policy ``pol[g]`` and
+    the peer-matched pods, on the port atoms in ``ports[g]``. ``match_all``
+    marks rules with empty/missing ``from``/``to``; ``ns_sel_null`` switches
+    the namespace scope between "policy's own namespace" (null) and the
+    compiled namespace selector; ``ip_match`` carries host-precomputed
+    ipBlock↔pod-IP matches when any ipBlock peer exists."""
+
+    pol: np.ndarray  # int32 [G]
+    match_all: np.ndarray  # bool [G]
+    pod_sel: SelectorEnc  # [G] over pod labels
+    ns_sel: SelectorEnc  # [G] over namespace labels
+    ns_sel_null: np.ndarray  # bool [G]
+    is_ipblock: np.ndarray  # bool [G]
+    ports: np.ndarray  # bool [G, Q]
+    ip_match: Optional[np.ndarray] = None  # bool [G, N] | None
+
+    @property
+    def n(self) -> int:
+        return self.pol.shape[0]
+
+
+@dataclass
+class EncodedCluster:
+    n_pods: int
+    n_namespaces: int
+    n_policies: int
+    vocab: Vocab
+    atoms: List[PortAtom]
+    pod_kv: np.ndarray  # bool [N, V]
+    pod_key: np.ndarray  # bool [N, K]
+    pod_ns: np.ndarray  # int32 [N]
+    ns_kv: np.ndarray  # bool [M, V]
+    ns_key: np.ndarray  # bool [M, K]
+    pol_sel: SelectorEnc  # [P] podSelector stack
+    pol_ns: np.ndarray  # int32 [P]
+    pol_affects_ingress: np.ndarray  # bool [P] (effective policyTypes)
+    pol_affects_egress: np.ndarray  # bool [P]
+    ingress: GrantBlock
+    egress: GrantBlock
+
+
+def _encode_grants(
+    cluster: Cluster,
+    direction: str,
+    atoms: Sequence[PortAtom],
+    vocab: Vocab,
+    ignore_ports: bool = False,
+) -> GrantBlock:
+    pols: List[int] = []
+    match_all: List[bool] = []
+    pod_sels: List[Optional[Selector]] = []
+    ns_sels: List[Optional[Selector]] = []
+    ns_null: List[bool] = []
+    is_ip: List[bool] = []
+    port_rows: List[np.ndarray] = []
+    ip_rows: Dict[int, np.ndarray] = {}
+
+    n = cluster.n_pods
+    for pi, pol in enumerate(cluster.policies):
+        rules = pol.ingress if direction == "ingress" else pol.egress
+        if not rules:
+            continue
+        for rule in rules:
+            pmask = (
+                np.ones(len(atoms), dtype=bool)
+                if ignore_ports
+                else rule_port_mask(rule, atoms)
+            )
+            if rule.matches_all_peers:
+                pols.append(pi)
+                match_all.append(True)
+                pod_sels.append(None)
+                ns_sels.append(None)
+                ns_null.append(True)
+                is_ip.append(False)
+                port_rows.append(pmask)
+                continue
+            for peer in rule.peers:
+                g = len(pols)
+                pols.append(pi)
+                match_all.append(False)
+                if peer.ip_block is not None:
+                    pod_sels.append(None)
+                    ns_sels.append(None)
+                    ns_null.append(True)
+                    is_ip.append(True)
+                    ip_rows[g] = np.array(
+                        [peer.ip_block.matches_ip(p.ip) for p in cluster.pods],
+                        dtype=bool,
+                    )
+                else:
+                    pod_sels.append(peer.pod_selector)
+                    ns_sels.append(peer.namespace_selector)
+                    ns_null.append(peer.namespace_selector is None)
+                    is_ip.append(False)
+                port_rows.append(pmask)
+
+    G, Q = len(pols), len(atoms)
+    ip_match = None
+    if ip_rows:
+        ip_match = np.zeros((G, n), dtype=bool)
+        for g, row in ip_rows.items():
+            ip_match[g] = row
+    return GrantBlock(
+        pol=np.asarray(pols, dtype=np.int32),
+        match_all=np.asarray(match_all, dtype=bool),
+        pod_sel=_encode_selector_stack(pod_sels, vocab),
+        ns_sel=_encode_selector_stack(ns_sels, vocab),
+        ns_sel_null=np.asarray(ns_null, dtype=bool),
+        is_ipblock=np.asarray(is_ip, dtype=bool),
+        ports=(
+            np.stack(port_rows) if port_rows else np.zeros((0, Q), dtype=bool)
+        ),
+        ip_match=ip_match,
+    )
+
+
+def encode_cluster(
+    cluster: Cluster, compute_ports: bool = True
+) -> EncodedCluster:
+    vocab = Vocab.build(
+        [p.labels for p in cluster.pods] + [ns.labels for ns in cluster.namespaces]
+    )
+    atoms = (
+        compute_port_atoms(cluster.policies)
+        if compute_ports
+        else [PortAtom("ANY", 1, 65535)]
+    )
+    ns_index = cluster.namespace_index()
+
+    pod_kv, pod_key = vocab.encode_label_matrix(p.labels for p in cluster.pods)
+    ns_kv, ns_key = vocab.encode_label_matrix(ns.labels for ns in cluster.namespaces)
+    pod_ns = np.asarray([ns_index[p.namespace] for p in cluster.pods], dtype=np.int32)
+    pol_ns = np.asarray(
+        [ns_index[pol.namespace] for pol in cluster.policies], dtype=np.int32
+    )
+    return EncodedCluster(
+        n_pods=cluster.n_pods,
+        n_namespaces=len(cluster.namespaces),
+        n_policies=len(cluster.policies),
+        vocab=vocab,
+        atoms=list(atoms),
+        pod_kv=pod_kv,
+        pod_key=pod_key,
+        pod_ns=pod_ns,
+        ns_kv=ns_kv,
+        ns_key=ns_key,
+        pol_sel=_encode_selector_stack(
+            [pol.pod_selector for pol in cluster.policies], vocab
+        ),
+        pol_ns=pol_ns,
+        pol_affects_ingress=np.asarray(
+            [pol.affects_ingress for pol in cluster.policies], dtype=bool
+        ),
+        pol_affects_egress=np.asarray(
+            [pol.affects_egress for pol in cluster.policies], dtype=bool
+        ),
+        ingress=_encode_grants(
+            cluster, "ingress", atoms, vocab, ignore_ports=not compute_ports
+        ),
+        egress=_encode_grants(
+            cluster, "egress", atoms, vocab, ignore_ports=not compute_ports
+        ),
+    )
+
+
+# ---------------------------------------------------------------------------
+# kano level
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class EncodedKano:
+    """kano-level encoding: per-policy src/dst requirement masks with the
+    reference's matcher quirk baked in (selector keys on no container are
+    dropped; known keys with unseen values poison the row —
+    ``kano_py/kano/model.py:142-154``)."""
+
+    n_pods: int
+    n_policies: int
+    vocab: Vocab
+    pod_kv: np.ndarray  # bool [N, V]
+    src_req: np.ndarray  # bool [P, V]
+    src_impossible: np.ndarray  # bool [P]
+    dst_req: np.ndarray  # bool [P, V]
+    dst_impossible: np.ndarray  # bool [P]
+
+
+def encode_kano(
+    containers: Sequence[Container], policies: Sequence[KanoPolicy]
+) -> EncodedKano:
+    vocab = Vocab.build(c.labels for c in containers)
+    pod_kv, _ = vocab.encode_label_matrix(c.labels for c in containers)
+    P, V = len(policies), vocab.n_pairs
+    src_req = np.zeros((P, V), dtype=bool)
+    dst_req = np.zeros((P, V), dtype=bool)
+    src_imp = np.zeros(P, dtype=bool)
+    dst_imp = np.zeros(P, dtype=bool)
+    for pi, pol in enumerate(policies):
+        for req, imp, labels in (
+            (src_req, src_imp, pol.src_labels),
+            (dst_req, dst_imp, pol.dst_labels),
+        ):
+            for k, v in labels.items():
+                if vocab.key(k) is None:
+                    continue  # key unknown to the cluster: ignored (quirk)
+                pid = vocab.pair(k, v)
+                if pid is None:
+                    imp[pi] = True  # known key, unseen value: matches nothing
+                else:
+                    req[pi, pid] = True
+    return EncodedKano(
+        n_pods=len(containers),
+        n_policies=P,
+        vocab=vocab,
+        pod_kv=pod_kv,
+        src_req=src_req,
+        src_impossible=src_imp,
+        dst_req=dst_req,
+        dst_impossible=dst_imp,
+    )

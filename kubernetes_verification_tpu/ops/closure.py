@@ -1,0 +1,49 @@
+"""On-device boolean transitive closure.
+
+Generalises the reference's ``path`` relation — which is hardcoded to paths of
+length ≤ 2 (``kubesv/kubesv/constraint.py:233-237``) — to the true transitive
+closure by repeated squaring: after k squarings the matrix covers paths of
+length ≤ 2^k, so ⌈log₂N⌉ squarings suffice. Each squaring is one MXU boolean
+matmul, so the whole closure stays on device inside one ``jit``.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["transitive_closure", "path_upto"]
+
+_F = jnp.float32
+
+
+def _square(reach: jnp.ndarray) -> jnp.ndarray:
+    counts = jax.lax.dot_general(
+        reach.astype(_F), reach.astype(_F), (((1,), (0,)), ((), ())),
+        preferred_element_type=_F,
+    )
+    return reach | (counts > 0)
+
+
+def transitive_closure(reach: jnp.ndarray) -> jnp.ndarray:
+    """bool[N, N] → its transitive closure (edges composed any number of
+    times; the diagonal is NOT added unless already present)."""
+    n = reach.shape[0]
+    steps = max(1, math.ceil(math.log2(max(n, 2))))
+    return jax.lax.fori_loop(0, steps, lambda _, r: _square(r), reach)
+
+
+def path_upto(reach: jnp.ndarray, hops: int) -> jnp.ndarray:
+    """Paths of length ≤ ``hops`` — ``hops=2`` reproduces the reference's
+    ``path`` exactly."""
+    out = reach
+    acc = reach
+    for _ in range(hops - 1):
+        counts = jax.lax.dot_general(
+            acc.astype(_F), reach.astype(_F), (((1,), (0,)), ((), ())),
+            preferred_element_type=_F,
+        )
+        acc = counts > 0
+        out = out | acc
+    return out

@@ -1,0 +1,84 @@
+"""Batched label-selector matching as MXU contractions.
+
+The reference evaluates selectors one (policy, container) pair at a time in
+pure Python (``kano_py/kano/model.py:95-111,150-154``) or one Datalog atom at
+a time inside Z3 (``kubesv/kubesv/model.py:178-243``). Here the whole selector
+stack evaluates at once: every subset / disjointness / non-empty-intersection
+test in ``SelectorEnc`` is a count comparison after an integer matmul
+
+    have[s, n] = Σ_v req[s, v] · kv[n, v]
+
+which XLA tiles onto the MXU. float32 accumulation is exact for counts below
+2²⁴, far above any realistic label vocabulary.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["match_selectors", "subset_match", "SelectorEnc", "GrantBlock"]
+
+from ..encode.encoder import GrantBlock, SelectorEnc
+
+jax.tree_util.register_dataclass(
+    SelectorEnc,
+    data_fields=[
+        "req_eq",
+        "req_key",
+        "forbid_eq",
+        "forbid_key",
+        "in_mask",
+        "in_valid",
+        "impossible",
+    ],
+    meta_fields=[],
+)
+jax.tree_util.register_dataclass(
+    GrantBlock,
+    data_fields=[
+        "pol",
+        "match_all",
+        "pod_sel",
+        "ns_sel",
+        "ns_sel_null",
+        "is_ipblock",
+        "ports",
+        "ip_match",
+    ],
+    meta_fields=[],
+)
+
+_F = jnp.float32
+
+
+def _count(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """int-exact boolean matmul: [S, V] × [N, V] → counts [S, N] on the MXU."""
+    return jax.lax.dot_general(
+        a.astype(_F),
+        b.astype(_F),
+        (((1,), (1,)), ((), ())),
+        preferred_element_type=_F,
+    )
+
+
+def subset_match(req: jnp.ndarray, kv: jnp.ndarray) -> jnp.ndarray:
+    """bool[S, N]: req[s] ⊆ kv[n] (all required bits present)."""
+    need = req.astype(_F).sum(axis=-1, keepdims=True)
+    return _count(req, kv) >= need
+
+
+def match_selectors(sel: SelectorEnc, kv: jnp.ndarray, key: jnp.ndarray) -> jnp.ndarray:
+    """Evaluate a compiled selector stack against entity label matrices.
+
+    kv: bool[N, V], key: bool[N, K] → bool[S, N].
+    """
+    ok = subset_match(sel.req_eq, kv)
+    ok &= subset_match(sel.req_key, key)
+    forbidden = _count(sel.forbid_eq, kv) + _count(sel.forbid_key, key)
+    ok &= forbidden == 0
+    S, E, V = sel.in_mask.shape
+    if E:
+        hits = _count(sel.in_mask.reshape(S * E, V), kv)  # [S·E, N]
+        in_ok = (hits > 0).reshape(S, E, -1) | ~sel.in_valid[:, :, None]
+        ok &= in_ok.all(axis=1)
+    return ok & ~sel.impossible[:, None]

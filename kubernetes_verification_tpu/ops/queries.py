@@ -3,8 +3,7 @@
 NumPy implementations of the reference's six analyses
 (``kano_py/kano/algorithm.py:4-100``), vectorised: the reference's
 O(N²) Python-level column gathers (``kano_py/kano/model.py:180-184``) become
-axis reductions; the pairwise policy scans become boolean matmuls. JAX/jittable
-variants for the large-scale path live in ``ops/queries_jax.py``.
+axis reductions; the pairwise policy scans become boolean matmuls.
 
 All functions take the matrix in the reference's orientation:
 ``reach[src, dst]``.

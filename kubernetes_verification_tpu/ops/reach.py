@@ -1,0 +1,212 @@
+"""Reachability assembly kernels.
+
+The hot loops of the reference become three fused XLA contractions
+(SURVEY.md §3.5):
+
+1. selector refinement loops (``kano_py/kano/model.py:150-154``) →
+   ``match_selectors`` matmuls;
+2. the per-policy matrix scatter (``kano_py/kano/model.py:158-163``) →
+   one OR-accumulated outer product, expressed as a boolean matmul over the
+   policy/grant axis;
+3. the Datalog allow/deny derivation (``kubesv/kubesv/constraint.py:190-231``)
+   → the k8s-mode grant contraction over a (pods × pods × port-atoms) tensor.
+
+All functions are shape-polymorphic pure JAX; backends ``jit`` them with the
+semantic flags bound statically.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..encode.encoder import GrantBlock, SelectorEnc
+from .match import match_selectors, subset_match
+
+__all__ = ["kano_reach", "KanoOut", "k8s_reach", "K8sOut"]
+
+_F = jnp.float32
+
+
+def _bool_or_matmul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """OR-accumulated contraction: out[i, j] = ∨_g a[g, i] ∧ b[g, j]."""
+    counts = jax.lax.dot_general(
+        a.astype(_F), b.astype(_F), (((0,), (0,)), ((), ())),
+        preferred_element_type=_F,
+    )
+    return counts > 0
+
+
+class KanoOut(NamedTuple):
+    reach: jnp.ndarray  # bool [N, N]
+    src_sets: jnp.ndarray  # bool [P, N]
+    dst_sets: jnp.ndarray  # bool [P, N]
+
+
+def kano_reach(
+    pod_kv: jnp.ndarray,
+    src_req: jnp.ndarray,
+    src_impossible: jnp.ndarray,
+    dst_req: jnp.ndarray,
+    dst_impossible: jnp.ndarray,
+) -> KanoOut:
+    """The kano matrix build (``kano_py/kano/model.py:124-165``) as two
+    subset-match matmuls and one OR-outer-product contraction."""
+    src_sets = subset_match(src_req, pod_kv) & ~src_impossible[:, None]
+    dst_sets = subset_match(dst_req, pod_kv) & ~dst_impossible[:, None]
+    reach = _bool_or_matmul(src_sets, dst_sets)
+    return KanoOut(reach=reach, src_sets=src_sets, dst_sets=dst_sets)
+
+
+class K8sOut(NamedTuple):
+    reach: jnp.ndarray  # bool [N, N]
+    reach_ports: jnp.ndarray  # bool [N, N, Q]
+    selected: jnp.ndarray  # bool [P, N]
+    ingress_isolated: jnp.ndarray  # bool [N]
+    egress_isolated: jnp.ndarray  # bool [N]
+    src_sets: jnp.ndarray  # bool [P, N]
+    dst_sets: jnp.ndarray  # bool [P, N]
+
+
+def _grant_peers(
+    block: GrantBlock,
+    pod_kv: jnp.ndarray,
+    pod_key: jnp.ndarray,
+    ns_kv: jnp.ndarray,
+    ns_key: jnp.ndarray,
+    pod_ns: jnp.ndarray,
+    pol_ns: jnp.ndarray,
+) -> jnp.ndarray:
+    """bool[G, N]: pods matched by each grant's peer clause."""
+    pod_ok = match_selectors(block.pod_sel, pod_kv, pod_key)
+    ns_sel_ok = match_selectors(block.ns_sel, ns_kv, ns_key)  # [G, M]
+    same_ns = pol_ns[block.pol][:, None] == pod_ns[None, :]  # [G, N]
+    ns_ok = jnp.where(block.ns_sel_null[:, None], same_ns, ns_sel_ok[:, pod_ns])
+    ok = pod_ok & ns_ok
+    if block.ip_match is not None:
+        ok = jnp.where(block.is_ipblock[:, None], block.ip_match, ok)
+    else:
+        ok &= ~block.is_ipblock[:, None]
+    return ok | block.match_all[:, None]
+
+
+def _grant_contract(
+    side_a: jnp.ndarray,  # bool [G, N] (source side)
+    side_b: jnp.ndarray,  # bool [G, N] (destination side)
+    ports: jnp.ndarray,  # bool [G, Q]
+) -> jnp.ndarray:
+    """allow[s, d, q] = ∨_g side_a[g, s] ∧ side_b[g, d] ∧ ports[g, q].
+
+    Evaluated as one MXU matmul [N, G] × [G, N·Q]."""
+    G, N = side_a.shape
+    Q = ports.shape[1]
+    b = (side_b[:, :, None] & ports[:, None, :]).reshape(G, N * Q)
+    counts = jax.lax.dot_general(
+        side_a.astype(_F), b.astype(_F), (((0,), (0,)), ((), ())),
+        preferred_element_type=_F,
+    )
+    return (counts > 0).reshape(N, N, Q)
+
+
+def _policy_or(values: jnp.ndarray, pol: jnp.ndarray, n_pol: int) -> jnp.ndarray:
+    """OR grant rows [G, N] into per-policy rows [P, N]."""
+    summed = jax.ops.segment_sum(
+        values.astype(jnp.int32), pol, num_segments=n_pol
+    )
+    return summed > 0
+
+
+def k8s_reach(
+    pod_kv: jnp.ndarray,
+    pod_key: jnp.ndarray,
+    pod_ns: jnp.ndarray,
+    ns_kv: jnp.ndarray,
+    ns_key: jnp.ndarray,
+    pol_sel: SelectorEnc,
+    pol_ns: jnp.ndarray,
+    pol_affects_ingress: jnp.ndarray,
+    pol_affects_egress: jnp.ndarray,
+    ingress: GrantBlock,
+    egress: GrantBlock,
+    *,
+    self_traffic: bool,
+    default_allow_unselected: bool,
+    direction_aware_isolation: bool,
+) -> K8sOut:
+    """Full NetworkPolicy reachability over (pods × pods × port-atoms).
+
+    Tensorised form of the Datalog program ``define_model`` +
+    ``define_pol_facts`` (``kubesv/kubesv/constraint.py:136-298``): the
+    ``selected_by_pol`` / ``ingress_allow_by_pol`` / ``egress_allow_by_pol``
+    relations are the intermediates below; the ``*_traffic`` rules and the
+    flag-gated variants correspond to the masks combined at the end.
+    """
+    n_pol = pol_ns.shape[0]
+    N = pod_kv.shape[0]
+
+    # selected_by_pol(pod, pol): podSelector ∧ policy namespace
+    selected = match_selectors(pol_sel, pod_kv, pod_key)
+    selected &= pol_ns[:, None] == pod_ns[None, :]
+
+    if direction_aware_isolation:
+        sel_ing = selected & pol_affects_ingress[:, None]
+        sel_eg = selected & pol_affects_egress[:, None]
+    else:
+        # reference compat: kubesv never consults policyTypes
+        sel_ing = selected
+        sel_eg = selected
+    ing_iso = sel_ing.any(axis=0)
+    eg_iso = sel_eg.any(axis=0)
+
+    def allow(block: GrantBlock, dir_selected: jnp.ndarray, is_ingress: bool):
+        peers = _grant_peers(block, pod_kv, pod_key, ns_kv, ns_key, pod_ns, pol_ns)
+        targets = dir_selected[block.pol]  # [G, N]
+        if is_ingress:
+            # allow[src, dst, q]: src = peer, dst = selected
+            return _grant_contract(peers, targets, block.ports), peers, targets
+        # egress: src = selected, dst = peer
+        return _grant_contract(targets, peers, block.ports), peers, targets
+
+    ing_allow, ing_peers, _ = allow(ingress, sel_ing, True)
+    eg_allow, eg_peers, _ = allow(egress, sel_eg, False)
+
+    if default_allow_unselected:
+        ing_ok = ing_allow | ~ing_iso[None, :, None]
+        eg_ok = eg_allow | ~eg_iso[:, None, None]
+    else:
+        ing_ok = ing_allow
+        eg_ok = eg_allow
+
+    reach_pq = ing_ok & eg_ok
+    if self_traffic:
+        eye = jnp.eye(N, dtype=bool)[:, :, None]
+        reach_pq |= eye
+    reach = reach_pq.any(axis=-1)
+
+    # per-policy direction-swapped src/dst edge sets for the policy queries
+    # (the kano store_bcp analogue, kano_py/kano/model.py:119-121)
+    ing_src = _policy_or(ing_peers, ingress.pol, n_pol)  # sources via ingress rules
+    eg_dst = _policy_or(eg_peers, egress.pol, n_pol)  # dests via egress rules
+    has_ing_grant = _policy_or(
+        jnp.ones_like(ingress.pol, dtype=bool)[:, None], ingress.pol, n_pol
+    )
+    has_eg_grant = _policy_or(
+        jnp.ones_like(egress.pol, dtype=bool)[:, None], egress.pol, n_pol
+    )
+    if direction_aware_isolation:
+        # rules of a direction a policy's policyTypes exclude are inert
+        ing_src &= pol_affects_ingress[:, None]
+        eg_dst &= pol_affects_egress[:, None]
+    src_sets = ing_src | (sel_eg & has_eg_grant)
+    dst_sets = eg_dst | (sel_ing & has_ing_grant)
+
+    return K8sOut(
+        reach=reach,
+        reach_ports=reach_pq,
+        selected=selected,
+        ingress_isolated=ing_iso,
+        egress_isolated=eg_iso,
+        src_sets=src_sets,
+        dst_sets=dst_sets,
+    )

@@ -4,7 +4,9 @@ paths compile and execute without TPU hardware (the driver's real-TPU runs use
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force, don't setdefault: the session environment pins JAX_PLATFORMS=axon
+# (the real TPU); tests must run on the virtual-device CPU backend.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

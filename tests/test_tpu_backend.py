@@ -1,0 +1,241 @@
+"""Differential tests: the tensorised JAX backend must agree exactly with the
+object-level CPU reference backend — the rebuild's first-class version of the
+reference's implicit two-verifier cross-check (SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+from kubernetes_verification_tpu import (
+    VerifyConfig,
+    verify,
+    verify_kano,
+)
+from kubernetes_verification_tpu.harness.generate import (
+    GeneratorConfig,
+    random_cluster,
+    random_kano,
+)
+from kubernetes_verification_tpu.models.fixtures import (
+    kano_paper_example,
+    kano_paper_example_as_cluster,
+    kubesv_paper_example,
+)
+
+CPU = VerifyConfig(backend="cpu")
+TPU = VerifyConfig(backend="tpu")
+
+
+def _assert_same(res_cpu, res_tpu, ports=True):
+    np.testing.assert_array_equal(res_cpu.reach, res_tpu.reach)
+    np.testing.assert_array_equal(res_cpu.src_sets, res_tpu.src_sets)
+    np.testing.assert_array_equal(res_cpu.dst_sets, res_tpu.dst_sets)
+    if ports and res_cpu.reach_ports is not None:
+        np.testing.assert_array_equal(res_cpu.reach_ports, res_tpu.reach_ports)
+    if res_cpu.selected is not None:
+        np.testing.assert_array_equal(res_cpu.selected, res_tpu.selected)
+        np.testing.assert_array_equal(
+            res_cpu.ingress_isolated, res_tpu.ingress_isolated
+        )
+        np.testing.assert_array_equal(res_cpu.egress_isolated, res_tpu.egress_isolated)
+    if res_cpu.closure is not None or res_tpu.closure is not None:
+        np.testing.assert_array_equal(res_cpu.closure, res_tpu.closure)
+
+
+class TestKanoParity:
+    def test_paper_example(self):
+        c1, p1 = kano_paper_example()
+        c2, p2 = kano_paper_example()
+        _assert_same(verify_kano(c1, p1, CPU), verify_kano(c2, p2, TPU))
+
+    def test_paper_example_ground_truth_on_tpu(self):
+        containers, policies = kano_paper_example()
+        res = verify_kano(containers, policies, TPU)
+        assert res.reachable(0, 1) and res.reachable(2, 0) and res.reachable(4, 2)
+        assert res.all_reachable() == []
+        assert res.all_isolated() == [4]
+        assert res.user_crosscheck(containers, "app") == [1, 2, 3]
+        assert res.policy_shadow() == [(2, 3), (3, 2)]
+        assert containers[2].select_policies == [2, 3]
+
+    @pytest.mark.parametrize("seed", range(5))
+    def test_random_kano(self, seed):
+        c1, p1 = random_kano(n_containers=60, n_policies=30, seed=seed)
+        c2, p2 = random_kano(n_containers=60, n_policies=30, seed=seed)
+        _assert_same(verify_kano(c1, p1, CPU), verify_kano(c2, p2, TPU))
+
+    def test_closure_parity(self):
+        c1, p1 = random_kano(n_containers=40, n_policies=20, seed=9)
+        cfg_c = VerifyConfig(backend="cpu", closure=True)
+        cfg_t = VerifyConfig(backend="tpu", closure=True)
+        _assert_same(verify_kano(c1, p1, cfg_c), verify_kano(c1, p1, cfg_t))
+
+
+class TestK8sParity:
+    def test_kano_cluster_fixture(self):
+        _assert_same(
+            verify(kano_paper_example_as_cluster(), CPU),
+            verify(kano_paper_example_as_cluster(), TPU),
+        )
+
+    def test_kubesv_paper_example_all_flag_combos(self):
+        cluster = kubesv_paper_example()
+        for self_traffic in (True, False):
+            for default_allow in (True, False):
+                for dir_aware in (True, False):
+                    kw = dict(
+                        self_traffic=self_traffic,
+                        default_allow_unselected=default_allow,
+                        direction_aware_isolation=dir_aware,
+                    )
+                    _assert_same(
+                        verify(cluster, VerifyConfig(backend="cpu", **kw)),
+                        verify(cluster, VerifyConfig(backend="tpu", **kw)),
+                    )
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_random_clusters(self, seed):
+        cluster = random_cluster(
+            GeneratorConfig(n_pods=50, n_policies=25, n_namespaces=4, seed=seed)
+        )
+        _assert_same(verify(cluster, CPU), verify(cluster, TPU))
+
+    def test_random_cluster_reference_compat_flags(self):
+        cluster = random_cluster(
+            GeneratorConfig(n_pods=40, n_policies=20, n_namespaces=3, seed=42)
+        )
+        kw = dict(
+            self_traffic=True,
+            default_allow_unselected=False,
+            direction_aware_isolation=False,
+        )
+        _assert_same(
+            verify(cluster, VerifyConfig(backend="cpu", **kw)),
+            verify(cluster, VerifyConfig(backend="tpu", **kw)),
+        )
+
+    def test_compute_ports_false_parity(self):
+        # regression: compute_ports=False must mean "ignore ports", not
+        # "enforce an empty port set" (the TPU encoder used to emit all-False
+        # port masks for port-carrying rules in this mode).
+        from kubernetes_verification_tpu import (
+            Cluster,
+            NetworkPolicy,
+            Peer,
+            Pod,
+            PortSpec,
+            Rule,
+            Selector,
+        )
+
+        pods = [Pod("a", labels={"app": "a"}), Pod("b", labels={"app": "b"})]
+        pol = NetworkPolicy(
+            "p",
+            pod_selector=Selector({"app": "b"}),
+            ingress=(
+                Rule(
+                    peers=(Peer(pod_selector=Selector({"app": "a"})),),
+                    ports=(PortSpec("TCP", 80),),
+                ),
+            ),
+        )
+        cluster = Cluster(pods=pods, policies=pol and [pol])
+        for backend in ("cpu", "tpu"):
+            res = verify(
+                cluster, VerifyConfig(backend=backend, compute_ports=False)
+            )
+            assert res.reach[0, 1], backend
+        _assert_same(
+            verify(cluster, VerifyConfig(backend="cpu", compute_ports=False)),
+            verify(cluster, VerifyConfig(backend="tpu", compute_ports=False)),
+            ports=False,
+        )
+
+    def test_compat_mode_ignores_policy_types(self):
+        # regression: with direction_aware_isolation=False BOTH backends must
+        # apply rules of directions the policyTypes exclude (kubesv behaviour).
+        from kubernetes_verification_tpu import (
+            Cluster,
+            NetworkPolicy,
+            Peer,
+            Pod,
+            Rule,
+            Selector,
+        )
+
+        pods = [Pod("a", labels={"app": "a"}), Pod("b", labels={"app": "b"})]
+        pol = NetworkPolicy(
+            "p",
+            pod_selector=Selector({"app": "b"}),
+            policy_types=("Egress",),  # ingress rule below is inert in k8s
+            ingress=(Rule(peers=(Peer(pod_selector=Selector({"app": "a"})),)),),
+        )
+        cluster = Cluster(pods=pods, policies=[pol])
+        for dir_aware in (True, False):
+            cfg_c = VerifyConfig(
+                backend="cpu",
+                direction_aware_isolation=dir_aware,
+                default_allow_unselected=False,
+                self_traffic=False,
+            )
+            cfg_t = VerifyConfig(
+                backend="tpu",
+                direction_aware_isolation=dir_aware,
+                default_allow_unselected=False,
+                self_traffic=False,
+            )
+            r_cpu, r_tpu = verify(cluster, cfg_c), verify(cluster, cfg_t)
+            _assert_same(r_cpu, r_tpu)
+            # k8s semantics: inert ingress rule → no edge; compat: edge exists
+            # but still needs the egress side, which grants nothing → no reach
+            # either way; the observable difference is in src_sets.
+            assert bool(r_cpu.src_sets.any()) == (not dir_aware)
+
+    @pytest.mark.parametrize("seed", range(3))
+    def test_random_clusters_ipblock_named_ports(self, seed):
+        cluster = random_cluster(
+            GeneratorConfig(
+                n_pods=40,
+                n_policies=20,
+                n_namespaces=3,
+                p_ipblock_peer=0.4,
+                p_named_port=0.4,
+                p_ports=0.7,
+                seed=100 + seed,
+            )
+        )
+        _assert_same(verify(cluster, CPU), verify(cluster, TPU))
+
+    def test_queries_match(self):
+        cluster = random_cluster(
+            GeneratorConfig(n_pods=40, n_policies=20, n_namespaces=3, seed=7)
+        )
+        r_cpu = verify(cluster, CPU)
+        r_tpu = verify(cluster, TPU)
+        assert r_cpu.all_reachable() == r_tpu.all_reachable()
+        assert r_cpu.all_isolated() == r_tpu.all_isolated()
+        assert r_cpu.user_crosscheck(cluster.pods, "app") == r_tpu.user_crosscheck(
+            cluster.pods, "app"
+        )
+        assert r_cpu.policy_shadow() == r_tpu.policy_shadow()
+        assert r_cpu.policy_conflict() == r_tpu.policy_conflict()
+
+
+class TestProperties:
+    """Property tests from SURVEY.md §4's implication list."""
+
+    def test_deny_all_zeroes_columns(self):
+        from kubernetes_verification_tpu import Cluster, NetworkPolicy, Pod, Selector
+
+        pods = [Pod(f"p{i}", "default", {"app": str(i)}) for i in range(6)]
+        deny = NetworkPolicy("deny", pod_selector=Selector(), ingress=())
+        res = verify(
+            Cluster(pods=pods, policies=[deny]),
+            VerifyConfig(backend="tpu", self_traffic=False),
+        )
+        assert not res.reach.any()
+
+    def test_no_policies_full_matrix(self):
+        from kubernetes_verification_tpu import Cluster, Pod
+
+        pods = [Pod(f"p{i}", "default", {"app": str(i)}) for i in range(6)]
+        res = verify(Cluster(pods=pods), TPU)
+        assert res.reach.all()
