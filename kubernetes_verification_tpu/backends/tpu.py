@@ -116,7 +116,7 @@ class TpuBackend(VerifierBackend):
             config=config,
             reach=np.asarray(out.reach),
             reach_ports=np.asarray(out.reach_ports) if config.compute_ports else None,
-            port_atoms=enc.atoms,
+            port_atoms=list(enc.atoms) if config.compute_ports else [],
             src_sets=np.asarray(out.src_sets),
             dst_sets=np.asarray(out.dst_sets),
             selected=np.asarray(out.selected),

@@ -1,0 +1,71 @@
+"""Device-mesh construction and padding helpers for the sharded backend.
+
+The reference has no distribution of any kind (SURVEY.md §2.4); scale-out here
+is designed TPU-first: a 2-D ``jax.sharding.Mesh`` whose axes are the two big
+problem dimensions —
+
+* ``"pods"`` — the N axis. Rows of every pod-indexed array (and of the N×N
+  reachability matrix) are sharded across it; collectives over it are
+  ``all_gather`` of the destination-side blocks (these ride ICI within a
+  slice, DCN across slices).
+* ``"grants"`` — the flattened (policy, rule, peer) axis. Each device
+  evaluates a slice of the grant stack; the OR-accumulation across grants is a
+  ``psum`` over this axis.
+
+``mesh_for`` picks a default factorisation of the available devices; tests and
+``__graft_entry__.dryrun_multichip`` run the same code on virtual CPU devices
+(``--xla_force_host_platform_device_count``).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+__all__ = ["POD_AXIS", "GRANT_AXIS", "mesh_for", "pad_rows", "pad_amount"]
+
+POD_AXIS = "pods"
+GRANT_AXIS = "grants"
+
+
+def mesh_for(
+    shape: Optional[Tuple[int, int]] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> jax.sharding.Mesh:
+    """Build a ``(pods, grants)`` mesh.
+
+    ``shape=None`` puts every device on the pod axis — the right default
+    because the N×N matrix dominates memory and the pod axis dominates FLOPs.
+    An explicit ``(dp, mp)`` factorisation spreads the grant stack too (useful
+    when P·G is the large dimension, e.g. many policies over few pods).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if shape is None:
+        shape = (len(devices), 1)
+    dp, mp = shape
+    if dp * mp != len(devices):
+        raise ValueError(f"mesh shape {shape} != {len(devices)} devices")
+    arr = np.asarray(devices).reshape(dp, mp)
+    return jax.sharding.Mesh(arr, (POD_AXIS, GRANT_AXIS))
+
+
+def pad_amount(n: int, multiple: int) -> int:
+    """Rows to add so ``n`` becomes a (positive) multiple of ``multiple``."""
+    if multiple <= 1:
+        return 0
+    r = n % multiple
+    pad = (multiple - r) % multiple
+    if n == 0:
+        # zero rows are divisible by anything, but shard_map still needs a
+        # non-empty leading axis on some platforms; keep 0 — XLA handles it.
+        return 0
+    return pad
+
+
+def pad_rows(a: np.ndarray, pad: int, fill=0) -> np.ndarray:
+    """Pad ``pad`` rows (leading axis) with ``fill``."""
+    if pad == 0:
+        return a
+    widths = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
+    return np.pad(a, widths, constant_values=fill)

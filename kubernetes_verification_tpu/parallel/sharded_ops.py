@@ -1,0 +1,464 @@
+"""Multi-device sharded verification kernels (``shard_map`` over a 2-D mesh).
+
+The single-device kernels in ``ops/reach.py`` are re-expressed SPMD over the
+``(pods, grants)`` mesh from ``parallel/mesh.py``:
+
+* every pod-indexed array is sharded on its pod axis; each device owns a block
+  of source rows of the N×N reachability matrix end-to-end (matching the
+  reference's row-major matrix orientation, ``kano_py/kano/model.py:158-163``);
+* the grant stack (flattened policy×rule×peer triples) is sharded on the
+  ``grants`` axis; each device evaluates its grant slice against its pod block
+  *locally*, destination-side blocks are combined with one ``all_gather`` over
+  ``pods``, and the OR over grants becomes a ``psum`` over ``grants``;
+* the transitive closure (the generalisation of the reference's ≤2-hop
+  ``path``, ``kubesv/kubesv/constraint.py:233-237``) runs as row-block ×
+  ``all_gather``-ed matrix squarings.
+
+Padding: N is padded to a multiple of the pod-axis size with label-less pods
+in a nonexistent namespace (index −1 — never equal to any policy namespace, so
+pads are never selected and never isolate anything); G is padded with
+impossible selectors assigned to a sink policy slot P (dropped after
+``segment_sum``). Padded rows/columns are masked out of every output before
+returning, so results are exactly those of the unsharded kernels (asserted by
+the differential tests in ``tests/test_sharded.py``).
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..encode.encoder import EncodedCluster, EncodedKano, GrantBlock, SelectorEnc
+from ..ops.match import match_selectors, subset_match
+from ..ops.reach import K8sOut, KanoOut, _grant_peers
+from .mesh import GRANT_AXIS, POD_AXIS, pad_amount, pad_rows
+
+__all__ = [
+    "pad_pods",
+    "pad_grants",
+    "pad_selector_rows",
+    "sharded_k8s_reach",
+    "sharded_kano_reach",
+    "sharded_closure",
+]
+
+_F = jnp.float32
+
+
+# ---------------------------------------------------------------------------
+# host-side padding
+# ---------------------------------------------------------------------------
+
+
+def pad_selector_rows(sel: SelectorEnc, pad: int) -> SelectorEnc:
+    """Append ``pad`` rows that can match nothing (``impossible=True``)."""
+    if pad == 0:
+        return sel
+    return SelectorEnc(
+        req_eq=pad_rows(sel.req_eq, pad),
+        req_key=pad_rows(sel.req_key, pad),
+        forbid_eq=pad_rows(sel.forbid_eq, pad),
+        forbid_key=pad_rows(sel.forbid_key, pad),
+        in_mask=pad_rows(sel.in_mask, pad),
+        in_valid=pad_rows(sel.in_valid, pad),
+        impossible=pad_rows(sel.impossible, pad, fill=True),
+    )
+
+
+def pad_grants(block: GrantBlock, pad: int, sink_pol: int, n_pad_pods: int) -> GrantBlock:
+    """Append ``pad`` inert grant rows owned by the sink policy slot."""
+    ip = block.ip_match
+    if ip is not None:
+        ip = np.pad(ip, ((0, pad), (0, n_pad_pods)), constant_values=False)
+    if pad == 0 and ip is block.ip_match:
+        return block
+    return GrantBlock(
+        pol=pad_rows(block.pol, pad, fill=sink_pol),
+        match_all=pad_rows(block.match_all, pad),
+        pod_sel=pad_selector_rows(block.pod_sel, pad),
+        ns_sel=pad_selector_rows(block.ns_sel, pad),
+        ns_sel_null=pad_rows(block.ns_sel_null, pad, fill=True),
+        is_ipblock=pad_rows(block.is_ipblock, pad),
+        ports=pad_rows(block.ports, pad),
+        ip_match=ip,
+    )
+
+
+def pad_pods(
+    pod_kv: np.ndarray, pod_key: np.ndarray, pod_ns: np.ndarray, pad: int
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Label-less pods in namespace −1: selected by nothing, peer to nothing
+    label-based; whatever pad rows/cols do pick up (match-all rules,
+    default-allow) is masked out of the outputs."""
+    return (
+        pad_rows(pod_kv, pad),
+        pad_rows(pod_key, pad),
+        pad_rows(pod_ns, pad, fill=-1),
+    )
+
+
+def _specs_like(tree, spec: P):
+    """One PartitionSpec per array leaf (selector/grant stacks shard their
+    leading row axis; trailing axes replicate)."""
+
+    def leaf_spec(x):
+        extra = (None,) * (np.ndim(x) - len(spec))
+        return P(*spec, *extra)
+
+    return jax.tree.map(leaf_spec, tree)
+
+
+# ---------------------------------------------------------------------------
+# k8s mode
+# ---------------------------------------------------------------------------
+
+
+def _count_contract(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """[G, X] × [G, Y] → float counts [X, Y] on the MXU."""
+    return jax.lax.dot_general(
+        a.astype(_F), b.astype(_F), (((0,), (0,)), ((), ())),
+        preferred_element_type=_F,
+    )
+
+
+def _segment_or(values: jnp.ndarray, seg: jnp.ndarray, n: int) -> jnp.ndarray:
+    summed = jax.ops.segment_sum(values.astype(jnp.int32), seg, num_segments=n)
+    return jax.lax.psum(summed, GRANT_AXIS) > 0
+
+
+def _k8s_local(
+    pod_kv,
+    pod_key,
+    pod_ns,
+    valid,
+    ns_kv,
+    ns_key,
+    pol_sel,
+    pol_ns,
+    aff_ing,
+    aff_eg,
+    ingress: GrantBlock,
+    egress: GrantBlock,
+    *,
+    self_traffic: bool,
+    default_allow_unselected: bool,
+    direction_aware_isolation: bool,
+    n_pol: int,
+):
+    """SPMD body: pod arrays are local row blocks, grant blocks local grant
+    slices, everything else replicated. Returns this device's source-row block
+    of every output."""
+    n_loc = pod_kv.shape[0]
+
+    # selected_by_pol over the local pod block, then the full row via gather.
+    selected_loc = match_selectors(pol_sel, pod_kv, pod_key)
+    selected_loc &= pol_ns[:, None] == pod_ns[None, :]
+    if direction_aware_isolation:
+        sel_ing_loc = selected_loc & aff_ing[:, None]
+        sel_eg_loc = selected_loc & aff_eg[:, None]
+    else:
+        sel_ing_loc = selected_loc
+        sel_eg_loc = selected_loc
+    sel_ing_full = jax.lax.all_gather(sel_ing_loc, POD_AXIS, axis=1, tiled=True)
+    sel_eg_full = jax.lax.all_gather(sel_eg_loc, POD_AXIS, axis=1, tiled=True)
+    ing_iso_full = sel_ing_full.any(axis=0)  # [N]
+    eg_iso_loc = sel_eg_loc.any(axis=0)  # [n_loc]
+
+    valid_full = jax.lax.all_gather(valid, POD_AXIS, axis=0, tiled=True)
+
+    def dir_allow(block: GrantBlock, is_ingress: bool):
+        # peers evaluated against the LOCAL pod block only — [G_loc, n_loc]
+        peers_loc = _grant_peers(block, pod_kv, pod_key, ns_kv, ns_key, pod_ns, pol_ns)
+        if is_ingress:
+            # allow[src, dst]: src side is the peer (local rows), dst side the
+            # selected pods (needs the full row → use the gathered selection).
+            a = peers_loc  # [G_loc, n_loc] source block
+            b = sel_ing_full[block.pol]  # [G_loc, N]
+        else:
+            a = sel_eg_loc[block.pol]  # [G_loc, n_loc]
+            b = jax.lax.all_gather(peers_loc, POD_AXIS, axis=1, tiled=True)
+        gq = block.ports  # [G_loc, Q]
+        G, N = b.shape
+        Q = gq.shape[1]
+        b_pq = (b[:, :, None] & gq[:, None, :]).reshape(G, N * Q)
+        counts = _count_contract(a, b_pq)  # [n_loc, N·Q]
+        counts = jax.lax.psum(counts, GRANT_AXIS)
+        return (counts > 0).reshape(n_loc, N, Q), peers_loc
+
+    ing_allow, ing_peers_loc = dir_allow(ingress, True)
+    eg_allow, eg_peers_loc = dir_allow(egress, False)
+
+    if default_allow_unselected:
+        ing_ok = ing_allow | ~ing_iso_full[None, :, None]
+        eg_ok = eg_allow | ~eg_iso_loc[:, None, None]
+    else:
+        ing_ok = ing_allow
+        eg_ok = eg_allow
+
+    reach_pq = ing_ok & eg_ok
+    if self_traffic:
+        N = reach_pq.shape[1]
+        row0 = jax.lax.axis_index(POD_AXIS) * n_loc
+        gidx = row0 + jnp.arange(n_loc)
+        eye_block = (gidx[:, None] == jnp.arange(N)[None, :])[:, :, None]
+        reach_pq |= eye_block
+    # mask padded rows/columns
+    reach_pq &= valid[:, None, None] & valid_full[None, :, None]
+    reach = reach_pq.any(axis=-1)
+
+    # per-policy src/dst edge sets (sink slot n_pol holds the padding grants)
+    ing_src = _segment_or(ing_peers_loc, ingress.pol, n_pol + 1)[:-1]
+    eg_dst = _segment_or(eg_peers_loc, egress.pol, n_pol + 1)[:-1]
+    ones_i = jnp.ones((ingress.pol.shape[0], 1), dtype=bool)
+    ones_e = jnp.ones((egress.pol.shape[0], 1), dtype=bool)
+    has_ing = _segment_or(ones_i, ingress.pol, n_pol + 1)[:-1, 0]
+    has_eg = _segment_or(ones_e, egress.pol, n_pol + 1)[:-1, 0]
+    if direction_aware_isolation:
+        ing_src &= aff_ing[:, None]
+        eg_dst &= aff_eg[:, None]
+    src_sets = (ing_src | (sel_eg_loc & has_eg[:, None])) & valid[None, :]
+    dst_sets = (eg_dst | (sel_ing_loc & has_ing[:, None])) & valid[None, :]
+
+    return K8sOut(
+        reach=reach,
+        reach_ports=reach_pq,
+        selected=selected_loc & valid[None, :],
+        ingress_isolated=sel_ing_loc.any(axis=0) & valid,
+        egress_isolated=eg_iso_loc & valid,
+        src_sets=src_sets,
+        dst_sets=dst_sets,
+    )
+
+
+def _closure_local(rows: jnp.ndarray, steps: int) -> jnp.ndarray:
+    """Row-block transitive closure: each squaring gathers the full matrix
+    over the pod axis and contracts the local rows against it."""
+
+    def step(_, r):
+        full = jax.lax.all_gather(r, POD_AXIS, axis=0, tiled=True)
+        counts = jax.lax.dot_general(
+            r.astype(_F), full.astype(_F), (((1,), (0,)), ((), ())),
+            preferred_element_type=_F,
+        )
+        return r | (counts > 0)
+
+    return jax.lax.fori_loop(0, steps, step, rows)
+
+
+def _pod_pspecs():
+    return dict(
+        pod_kv=P(POD_AXIS, None),
+        pod_key=P(POD_AXIS, None),
+        pod_ns=P(POD_AXIS),
+        valid=P(POD_AXIS),
+    )
+
+
+def _grant_pspecs(block: GrantBlock):
+    specs = _specs_like(block, P(GRANT_AXIS))
+    if block.ip_match is not None:
+        specs = dataclasses.replace(specs, ip_match=P(GRANT_AXIS, POD_AXIS))
+    return specs
+
+
+def sharded_k8s_reach(
+    mesh: jax.sharding.Mesh,
+    enc: EncodedCluster,
+    *,
+    self_traffic: bool,
+    default_allow_unselected: bool,
+    direction_aware_isolation: bool,
+    with_closure: bool,
+) -> Tuple[K8sOut, Optional[np.ndarray]]:
+    """Pad, shard, solve, unpad. Output arrays are NumPy, exactly equal to the
+    single-device ``k8s_reach`` on the same encoding."""
+    dp = mesh.shape[POD_AXIS]
+    mp = mesh.shape[GRANT_AXIS]
+    n = enc.n_pods
+    n_pad = pad_amount(n, dp)
+    pod_kv, pod_key, pod_ns = pad_pods(enc.pod_kv, enc.pod_key, enc.pod_ns, n_pad)
+    valid = np.arange(n + n_pad) < n
+    ingress = pad_grants(
+        enc.ingress, pad_amount(enc.ingress.n, mp), enc.n_policies, n_pad
+    )
+    egress = pad_grants(enc.egress, pad_amount(enc.egress.n, mp), enc.n_policies, n_pad)
+
+    body = partial(
+        _k8s_local,
+        self_traffic=self_traffic,
+        default_allow_unselected=default_allow_unselected,
+        direction_aware_isolation=direction_aware_isolation,
+        n_pol=enc.n_policies,
+    )
+    pod_specs = _pod_pspecs()
+    in_specs = (
+        pod_specs["pod_kv"],
+        pod_specs["pod_key"],
+        pod_specs["pod_ns"],
+        pod_specs["valid"],
+        P(),  # ns_kv
+        P(),  # ns_key
+        _specs_like(enc.pol_sel, P()),
+        P(),  # pol_ns
+        P(),  # aff_ing
+        P(),  # aff_eg
+        _grant_pspecs(ingress),
+        _grant_pspecs(egress),
+    )
+    out_specs = K8sOut(
+        reach=P(POD_AXIS, None),
+        reach_ports=P(POD_AXIS, None, None),
+        selected=P(None, POD_AXIS),
+        ingress_isolated=P(POD_AXIS),
+        egress_isolated=P(POD_AXIS),
+        src_sets=P(None, POD_AXIS),
+        dst_sets=P(None, POD_AXIS),
+    )
+    fn = jax.jit(
+        jax.shard_map(
+            body, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
+        )
+    )
+    out = fn(
+        pod_kv,
+        pod_key,
+        pod_ns,
+        valid,
+        enc.ns_kv,
+        enc.ns_key,
+        enc.pol_sel,
+        enc.pol_ns,
+        enc.pol_affects_ingress,
+        enc.pol_affects_egress,
+        ingress,
+        egress,
+    )
+    closure = None
+    if with_closure:
+        steps = max(1, math.ceil(math.log2(max(n + n_pad, 2))))
+        cfn = jax.jit(
+            jax.shard_map(
+                partial(_closure_local, steps=steps),
+                mesh=mesh,
+                in_specs=P(POD_AXIS, None),
+                out_specs=P(POD_AXIS, None),
+                check_vma=False,
+            )
+        )
+        closure = np.asarray(cfn(out.reach))[:n, :n]
+
+    trim = lambda a, *ax: np.asarray(a)[
+        tuple(slice(0, n) if i in ax else slice(None) for i in range(np.ndim(a)))
+    ]
+    out_np = K8sOut(
+        reach=trim(out.reach, 0, 1),
+        reach_ports=trim(out.reach_ports, 0, 1),
+        selected=trim(out.selected, 1),
+        ingress_isolated=trim(out.ingress_isolated, 0),
+        egress_isolated=trim(out.egress_isolated, 0),
+        src_sets=trim(out.src_sets, 1),
+        dst_sets=trim(out.dst_sets, 1),
+    )
+    return out_np, closure
+
+
+# ---------------------------------------------------------------------------
+# kano mode
+# ---------------------------------------------------------------------------
+
+
+def _kano_local(pod_kv, valid, src_req, src_imp, dst_req, dst_imp):
+    src_loc = subset_match(src_req, pod_kv) & ~src_imp[:, None]  # [P_loc, n_loc]
+    dst_loc = subset_match(dst_req, pod_kv) & ~dst_imp[:, None]
+    dst_full = jax.lax.all_gather(dst_loc, POD_AXIS, axis=1, tiled=True)
+    counts = _count_contract(src_loc, dst_full)  # [n_loc, N]
+    counts = jax.lax.psum(counts, GRANT_AXIS)
+    valid_full = jax.lax.all_gather(valid, POD_AXIS, axis=0, tiled=True)
+    reach = (counts > 0) & valid[:, None] & valid_full[None, :]
+    return KanoOut(
+        reach=reach,
+        src_sets=src_loc & valid[None, :],
+        dst_sets=dst_loc & valid[None, :],
+    )
+
+
+def sharded_kano_reach(
+    mesh: jax.sharding.Mesh, enc: EncodedKano, *, with_closure: bool
+) -> Tuple[KanoOut, Optional[np.ndarray]]:
+    dp = mesh.shape[POD_AXIS]
+    mp = mesh.shape[GRANT_AXIS]
+    n, p = enc.n_pods, enc.n_policies
+    n_pad = pad_amount(n, dp)
+    p_pad = pad_amount(p, mp)
+    pod_kv = pad_rows(enc.pod_kv, n_pad)
+    valid = np.arange(n + n_pad) < n
+    src_req = pad_rows(enc.src_req, p_pad)
+    dst_req = pad_rows(enc.dst_req, p_pad)
+    src_imp = pad_rows(enc.src_impossible, p_pad, fill=True)
+    dst_imp = pad_rows(enc.dst_impossible, p_pad, fill=True)
+
+    fn = jax.jit(
+        jax.shard_map(
+            _kano_local,
+            mesh=mesh,
+            in_specs=(
+                P(POD_AXIS, None),
+                P(POD_AXIS),
+                P(GRANT_AXIS, None),
+                P(GRANT_AXIS),
+                P(GRANT_AXIS, None),
+                P(GRANT_AXIS),
+            ),
+            out_specs=KanoOut(
+                reach=P(POD_AXIS, None),
+                src_sets=P(GRANT_AXIS, POD_AXIS),
+                dst_sets=P(GRANT_AXIS, POD_AXIS),
+            ),
+            check_vma=False,
+        )
+    )
+    out = fn(pod_kv, valid, src_req, src_imp, dst_req, dst_imp)
+    closure = None
+    if with_closure:
+        steps = max(1, math.ceil(math.log2(max(n + n_pad, 2))))
+        cfn = jax.jit(
+            jax.shard_map(
+                partial(_closure_local, steps=steps),
+                mesh=mesh,
+                in_specs=P(POD_AXIS, None),
+                out_specs=P(POD_AXIS, None),
+                check_vma=False,
+            )
+        )
+        closure = np.asarray(cfn(out.reach))[:n, :n]
+    out_np = KanoOut(
+        reach=np.asarray(out.reach)[:n, :n],
+        src_sets=np.asarray(out.src_sets)[:p, :n],
+        dst_sets=np.asarray(out.dst_sets)[:p, :n],
+    )
+    return out_np, closure
+
+
+def sharded_closure(mesh: jax.sharding.Mesh, reach: np.ndarray) -> np.ndarray:
+    """Standalone sharded transitive closure of an arbitrary bool matrix."""
+    dp = mesh.shape[POD_AXIS]
+    n = reach.shape[0]
+    n_pad = pad_amount(n, dp)
+    rows = np.pad(reach, ((0, n_pad), (0, n_pad)), constant_values=False)
+    steps = max(1, math.ceil(math.log2(max(n + n_pad, 2))))
+    cfn = jax.jit(
+        jax.shard_map(
+            partial(_closure_local, steps=steps),
+            mesh=mesh,
+            in_specs=P(POD_AXIS, None),
+            out_specs=P(POD_AXIS, None),
+            check_vma=False,
+        )
+    )
+    return np.asarray(cfn(rows))[:n, :n]

@@ -1,16 +1,23 @@
 """Test env: force JAX onto CPU with 8 virtual devices so multi-chip sharding
 paths compile and execute without TPU hardware (the driver's real-TPU runs use
-``bench.py`` instead). Must run before the first ``import jax`` anywhere."""
+``bench.py`` instead).
+
+The session image registers the TPU platform from a baked ``sitecustomize``
+and pins ``JAX_PLATFORMS``, so setting the env var alone is NOT enough — the
+platform must also be overridden via ``jax.config`` before any device is
+touched."""
 import os
 import sys
 
-# Force, don't setdefault: the session environment pins JAX_PLATFORMS=axon
-# (the real TPU); tests must run on the virtual-device CPU backend.
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
