@@ -35,6 +35,8 @@ from .backends.base import (
     verify_kano,
 )
 
+from .ingest import dump_cluster, load_cluster, load_kano
+
 # Importing backend modules registers them.
 from .backends import cpu as _cpu_backend  # noqa: F401
 
@@ -73,5 +75,8 @@ __all__ = [
     "register_backend",
     "verify",
     "verify_kano",
+    "load_cluster",
+    "load_kano",
+    "dump_cluster",
     "__version__",
 ]

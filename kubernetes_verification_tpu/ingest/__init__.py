@@ -1,0 +1,22 @@
+"""Cluster ingestion: YAML → model objects, no cluster/kube-config required.
+
+The reference needed a loadable ``~/.kube/config`` just to *parse* YAML
+(``kubesv/kubesv/parser.py:10``); here ingestion is self-contained.
+"""
+from .yaml_io import (
+    dump_cluster,
+    load_cluster,
+    load_kano,
+    parse_network_policy,
+    parse_namespace,
+    parse_pod,
+)
+
+__all__ = [
+    "dump_cluster",
+    "load_cluster",
+    "load_kano",
+    "parse_network_policy",
+    "parse_namespace",
+    "parse_pod",
+]

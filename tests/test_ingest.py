@@ -1,0 +1,215 @@
+"""YAML ingestion tests: hand-written manifests covering the null-vs-empty
+semantic edge cases, round-trip through ``dump_cluster``, and the kano-level
+walk — including the reference parser bugs that are fixed here
+(``kano_py/kano/parser.py:61-76``, ``kubesv/kubesv/parser.py:9-22``)."""
+import os
+import textwrap
+
+import numpy as np
+import pytest
+
+import kubernetes_verification_tpu as kv
+from kubernetes_verification_tpu.harness.generate import GeneratorConfig, random_cluster
+from kubernetes_verification_tpu.ingest import (
+    dump_cluster,
+    load_cluster,
+    load_kano,
+)
+from kubernetes_verification_tpu.ingest.yaml_io import IngestError
+
+POLICY_YAML = textwrap.dedent(
+    """\
+    apiVersion: networking.k8s.io/v1
+    kind: NetworkPolicy
+    metadata:
+      name: api-allow
+      namespace: prod
+    spec:
+      podSelector:
+        matchLabels:
+          app: api
+      policyTypes: [Ingress, Egress]
+      ingress:
+        - from:
+            - podSelector:
+                matchLabels:
+                  role: frontend
+            - namespaceSelector: {}
+              podSelector:
+                matchExpressions:
+                  - {key: env, operator: In, values: [staging, prod]}
+            - ipBlock:
+                cidr: 10.0.0.0/8
+                except: [10.1.0.0/16]
+          ports:
+            - {protocol: TCP, port: 443}
+            - {protocol: TCP, port: 8000, endPort: 9000}
+      egress:
+        - {}
+    ---
+    apiVersion: networking.k8s.io/v1
+    kind: NetworkPolicy
+    metadata:
+      name: deny-all
+      namespace: prod
+    spec:
+      podSelector: {}
+      ingress: []
+    ---
+    apiVersion: v1
+    kind: Pod
+    metadata:
+      name: web
+      namespace: prod
+      labels: {app: api, role: frontend}
+    spec:
+      containers:
+        - name: c
+          ports:
+            - {name: http, containerPort: 80, protocol: TCP}
+    status:
+      podIP: 10.1.2.3
+    ---
+    apiVersion: v1
+    kind: Namespace
+    metadata:
+      name: prod
+      labels: {env: prod}
+    ---
+    kind: ConfigMap
+    metadata: {name: junk}
+    """
+)
+
+
+@pytest.fixture()
+def manifest(tmp_path):
+    p = tmp_path / "all.yaml"
+    p.write_text(POLICY_YAML)
+    return str(p)
+
+
+def test_k8s_parse_fields(manifest):
+    cluster, skipped = load_cluster(manifest)
+    assert len(skipped) == 1 and "ConfigMap" in skipped[0]
+    assert [p.name for p in cluster.pods] == ["web"]
+    assert cluster.pods[0].ip == "10.1.2.3"
+    assert cluster.pods[0].container_ports == {"http": ("TCP", 80)}
+    assert [ns.name for ns in cluster.namespaces] == ["prod"]
+
+    allow, deny = cluster.policies
+    assert allow.policy_types == ("Ingress", "Egress")
+    (rule,) = allow.ingress
+    p1, p2, p3 = rule.peers
+    assert p1.pod_selector.match_labels == {"role": "frontend"}
+    assert p1.namespace_selector is None  # absent → null → policy's own ns
+    assert p2.namespace_selector is not None and p2.namespace_selector.is_empty
+    assert p2.pod_selector.match_expressions[0].op == "In"
+    assert p3.ip_block.cidr == "10.0.0.0/8" and p3.ip_block.excepts == ("10.1.0.0/16",)
+    assert rule.ports[1].end_port == 9000
+    # egress: single empty rule = allow-all
+    assert allow.egress[0].matches_all_peers and allow.egress[0].ports is None
+
+    # deny-all: empty podSelector (selects whole ns), empty ingress list
+    assert deny.pod_selector.is_empty
+    assert deny.ingress == () and deny.egress is None
+    assert deny.effective_policy_types == ("Ingress",)
+
+
+def test_parse_then_verify(manifest):
+    cluster, _ = load_cluster(manifest)
+    res = kv.verify(cluster, kv.VerifyConfig(backend="cpu"))
+    # web is ingress-isolated by both policies; no frontend peer pod exists
+    # other than itself.
+    assert res.ingress_isolated[0]
+
+
+def test_strict_mode(manifest):
+    with pytest.raises(IngestError):
+        load_cluster(manifest, strict=True)
+
+
+def test_directory_walk_and_roundtrip(tmp_path):
+    cluster = random_cluster(
+        GeneratorConfig(n_pods=23, n_policies=9, n_namespaces=3, seed=13)
+    )
+    out = tmp_path / "dump"
+    written = dump_cluster(cluster, out)
+    assert len(written) == 3
+    loaded, skipped = load_cluster(out)
+    assert skipped == []
+    ref = kv.verify(cluster, kv.VerifyConfig(backend="cpu"))
+    got = kv.verify(loaded, kv.VerifyConfig(backend="cpu"))
+    np.testing.assert_array_equal(got.reach, ref.reach)
+    np.testing.assert_array_equal(got.reach_ports, ref.reach_ports)
+
+
+def test_null_vs_empty_survives_roundtrip(tmp_path):
+    cluster = kv.Cluster(
+        pods=[kv.Pod("a", "ns1", {"x": "1"})],
+        policies=[
+            kv.NetworkPolicy(
+                "p1", namespace="ns1", ingress=None, egress=()
+            ),  # absent vs empty section
+            kv.NetworkPolicy(
+                "p2",
+                namespace="ns1",
+                ingress=(kv.Rule(peers=None), kv.Rule(peers=())),
+            ),
+        ],
+    )
+    dump_cluster(cluster, tmp_path / "d")
+    loaded, _ = load_cluster(tmp_path / "d")
+    p1, p2 = loaded.policies
+    assert p1.ingress is None and p1.egress == ()
+    assert p2.ingress[0].peers is None and p2.ingress[1].peers == ()
+
+
+def test_kano_walk(tmp_path):
+    (tmp_path / "pol.yml").write_text(
+        textwrap.dedent(
+            """\
+            kind: NetworkPolicy
+            metadata: {name: np}
+            spec:
+              podSelector:
+                matchLabels: {app: db}
+              ingress:
+                - from:
+                    - podSelector:
+                        matchLabels: {app: web}
+                  ports:
+                    - {protocol: UDP, port: 53}
+              egress:
+                - to:
+                    - podSelector:
+                        matchLabels: {app: dns}
+            """
+        )
+    )
+    (tmp_path / "pod.yml").write_text(
+        textwrap.dedent(
+            """\
+            kind: Pod
+            metadata: {name: db-0, labels: {app: db}}
+            spec:
+              containers: [{name: main}, {name: sidecar}]
+            """
+        )
+    )
+    containers, policies = load_kano(tmp_path)
+    assert [c.name for c in containers] == ["main", "sidecar"]
+    assert all(c.labels == {"app": "db"} for c in containers)
+    ing = next(p for p in policies if p.ingress)
+    eg = next(p for p in policies if not p.ingress)
+    assert ing.select == {"app": "db"} and ing.allow == {"app": "web"}
+    # ports parsed from the RULE level (the reference read them from inside
+    # `from` entries and always got none, kano_py/kano/parser.py:61-62)
+    assert ing.protocols == ("UDP",)
+    assert eg.allow == {"app": "dns"} and eg.protocols == ()
+
+
+def test_malformed_yaml_raises(tmp_path):
+    (tmp_path / "bad.yaml").write_text("kind: Pod\n  bad indent: [")
+    with pytest.raises(IngestError):
+        load_cluster(tmp_path)
