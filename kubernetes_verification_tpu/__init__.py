@@ -39,6 +39,7 @@ from .ingest import dump_cluster, load_cluster, load_kano
 
 # Importing backend modules registers them.
 from .backends import cpu as _cpu_backend  # noqa: F401
+from .datalog import k8s_program as _datalog_backend  # noqa: F401
 
 try:  # JAX backends are optional at import time (e.g. docs builds)
     from .backends import tpu as _tpu_backend  # noqa: F401

@@ -89,8 +89,12 @@ def _spec_covers(spec: PortSpec, atom: PortAtom) -> bool:
 
 
 def rule_port_mask(rule: Rule, atoms: Sequence[PortAtom]) -> np.ndarray:
-    """bool[Q]: which atoms this rule's ports cover. ``ports=None`` → all."""
-    if rule.ports is None:
+    """bool[Q]: which atoms this rule's ports cover.
+
+    ``ports=None`` *and* ``ports=()`` both mean all ports — the k8s API says
+    "if this field is empty or missing, this rule matches all traffic"
+    (mirrored for peers by ``Rule.matches_all_peers``)."""
+    if not rule.ports:
         return np.ones(len(atoms), dtype=bool)
     mask = np.zeros(len(atoms), dtype=bool)
     for q, atom in enumerate(atoms):

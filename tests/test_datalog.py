@@ -1,0 +1,205 @@
+"""Datalog engine unit tests + differential tests of the ``datalog`` backend
+against the CPU oracle — three independent implementations of the same
+semantics now cross-check each other (the reference had two, SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+import kubernetes_verification_tpu as kv
+from kubernetes_verification_tpu.datalog import Atom, Program, solve
+from kubernetes_verification_tpu.harness.generate import (
+    GeneratorConfig,
+    random_cluster,
+    random_kano,
+)
+from kubernetes_verification_tpu.models.fixtures import (
+    kano_paper_example,
+    kubesv_paper_example,
+)
+
+
+# ---------------------------------------------------------------------------
+# engine
+# ---------------------------------------------------------------------------
+
+
+def test_transitive_closure_chain():
+    prog = Program()
+    n = prog.domain("n", 6)
+    prog.relation("edge", n, n)
+    prog.relation("path", n, n)
+    for i in range(5):
+        prog.fact("edge", i, i + 1)
+    prog.rule(Atom("path", ("s", "d")), Atom("edge", ("s", "d")))
+    prog.rule(
+        Atom("path", ("s", "d")), Atom("path", ("s", "x")), Atom("path", ("x", "d"))
+    )
+    sol = solve(prog)
+    path = sol["path"]
+    assert path[0, 5] and path[2, 4] and not path[3, 1]
+    assert sol.query("path", (0, None)) == [(0, i) for i in range(1, 6)]
+
+
+def test_negation_stratified():
+    # not_labeled(a) :- is_vec(a), ¬label(a) — the reference's z3 scratch demo
+    # (kubesv/kubesv/main.py:3-37).
+    prog = Program()
+    v = prog.domain("v", 4)
+    prog.relation("is_vec", v)
+    prog.relation("label", v)
+    prog.relation("not_labeled", v)
+    prog.fact_array("is_vec", np.ones(4, dtype=bool))
+    prog.fact("label", 1)
+    prog.fact("label", 3)
+    prog.rule(
+        Atom("not_labeled", ("a",)),
+        Atom("is_vec", ("a",)),
+        Atom("label", ("a",), negated=True),
+    )
+    sol = solve(prog)
+    np.testing.assert_array_equal(sol["not_labeled"], [True, False, True, False])
+
+
+def test_negation_cycle_rejected():
+    prog = Program()
+    v = prog.domain("v", 2)
+    prog.relation("a", v)
+    prog.relation("b", v)
+    prog.fact("a", 0)
+    prog.rule(Atom("b", ("x",)), Atom("a", ("x",)), Atom("b", ("x",), negated=True))
+    with pytest.raises(ValueError, match="not stratifiable"):
+        prog.strata()
+
+
+def test_unsafe_rules_rejected():
+    prog = Program()
+    v = prog.domain("v", 2)
+    prog.relation("a", v)
+    prog.relation("b", v)
+    with pytest.raises(ValueError, match="unsafe"):
+        prog.rule(Atom("b", ("y",)), Atom("a", ("x",)))
+    with pytest.raises(ValueError, match="unsafe"):
+        prog.rule(Atom("b", ("x",)), Atom("a", ("x",)), Atom("a", ("z",), negated=True))
+
+
+def test_constants_and_repeated_head_vars():
+    prog = Program()
+    n = prog.domain("n", 3)
+    m = prog.domain("m", 2)
+    prog.relation("r", n, m)
+    prog.relation("diag", n, n)
+    prog.relation("hit", n)
+    prog.fact("r", 1, 0)
+    prog.fact("r", 2, 1)
+    # constant in body: hit(x) :- r(x, 0)
+    prog.rule(Atom("hit", ("x",)), Atom("r", ("x", 0)))
+    # repeated head var: diag(x, x) :- hit(x)
+    prog.rule(Atom("diag", ("x", "x")), Atom("hit", ("x",)))
+    sol = solve(prog)
+    np.testing.assert_array_equal(sol["hit"], [False, True, False])
+    assert sol.query("diag") == [(1, 1)]
+
+
+def test_dump_renders_program():
+    prog = Program()
+    n = prog.domain("n", 3)
+    prog.relation("e", n, n)
+    prog.relation("p", n, n)
+    prog.fact("e", 0, 1)
+    prog.rule(Atom("p", ("s", "d")), Atom("e", ("s", "d")))
+    text = prog.dump()
+    assert "p(s, d) :- e(s, d)." in text
+    assert "% relation e(n, n)  [1 facts]" in text
+
+
+def test_jax_evaluation_matches_numpy():
+    prog = Program()
+    n = prog.domain("n", 5)
+    prog.relation("e", n, n)
+    prog.relation("p", n, n)
+    rng = np.random.default_rng(0)
+    prog.fact_array("e", rng.random((5, 5)) < 0.3)
+    prog.rule(Atom("p", ("s", "d")), Atom("e", ("s", "d")))
+    prog.rule(Atom("p", ("s", "d")), Atom("p", ("s", "x")), Atom("p", ("x", "d")))
+    np.testing.assert_array_equal(
+        solve(prog, use_jax=True)["p"], solve(prog)["p"]
+    )
+
+
+# ---------------------------------------------------------------------------
+# datalog backend vs cpu oracle
+# ---------------------------------------------------------------------------
+
+
+def _diff(cluster, **flags):
+    ref = kv.verify(cluster, kv.VerifyConfig(backend="cpu", **flags))
+    got = kv.verify(cluster, kv.VerifyConfig(backend="datalog", **flags))
+    np.testing.assert_array_equal(got.reach, ref.reach)
+    np.testing.assert_array_equal(got.selected, ref.selected)
+    np.testing.assert_array_equal(got.src_sets, ref.src_sets)
+    np.testing.assert_array_equal(got.dst_sets, ref.dst_sets)
+    np.testing.assert_array_equal(got.ingress_isolated, ref.ingress_isolated)
+    np.testing.assert_array_equal(got.egress_isolated, ref.egress_isolated)
+    return got
+
+
+def test_k8s_backend_matches_cpu():
+    cluster = random_cluster(
+        GeneratorConfig(n_pods=23, n_policies=9, n_namespaces=3, seed=17)
+    )
+    _diff(cluster)
+
+
+@pytest.mark.parametrize(
+    "flags",
+    [
+        dict(self_traffic=False),
+        dict(default_allow_unselected=False),
+        dict(direction_aware_isolation=False),
+    ],
+)
+def test_k8s_backend_flags(flags):
+    cluster = random_cluster(
+        GeneratorConfig(n_pods=19, n_policies=7, n_namespaces=2, seed=23)
+    )
+    _diff(cluster, **flags)
+
+
+def test_k8s_paper_example():
+    cluster = kubesv_paper_example()
+    got = _diff(cluster)
+    assert got.backend == "datalog"
+
+
+def test_closure_is_true_transitive_closure():
+    cluster = random_cluster(
+        GeneratorConfig(n_pods=13, n_policies=5, n_namespaces=2, seed=29)
+    )
+    ref = kv.verify(cluster, kv.VerifyConfig(backend="cpu", closure=True))
+    got = kv.verify(cluster, kv.VerifyConfig(backend="datalog", closure=True))
+    np.testing.assert_array_equal(got.closure, ref.closure)
+
+
+def test_kano_backend_matches_cpu():
+    containers, policies = random_kano(29, 11, seed=31)
+    ref = kv.verify_kano(containers, policies, kv.VerifyConfig(backend="cpu"))
+    got = kv.verify_kano(containers, policies, kv.VerifyConfig(backend="datalog"))
+    np.testing.assert_array_equal(got.reach, ref.reach)
+    np.testing.assert_array_equal(got.src_sets, ref.src_sets)
+    np.testing.assert_array_equal(got.dst_sets, ref.dst_sets)
+
+
+def test_kano_paper_example_queries():
+    containers, policies = kano_paper_example()
+    res = kv.verify_kano(containers, policies, kv.VerifyConfig(backend="datalog"))
+    assert res.all_isolated() == [4]
+    assert res.user_crosscheck(containers, "app") == [1, 2, 3]
+
+
+def test_program_dump_names_reference_relations():
+    cluster = kubesv_paper_example()
+    from kubernetes_verification_tpu.datalog import build_k8s_program
+
+    prog, _ = build_k8s_program(cluster, kv.VerifyConfig())
+    text = prog.dump()
+    for rel in ("selected", "ing_allow", "ingress_traffic", "edge", "path"):
+        assert rel in text
