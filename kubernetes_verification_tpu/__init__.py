@@ -49,6 +49,10 @@ try:
     from .backends import sharded as _sharded_backend  # noqa: F401
 except ImportError:  # pragma: no cover
     pass
+try:  # needs a C++ compiler (or a previously built .so)
+    from .backends import native as _native_backend  # noqa: F401
+except Exception:  # pragma: no cover - NativeUnavailable or loader errors
+    pass
 
 __version__ = "0.1.0"
 

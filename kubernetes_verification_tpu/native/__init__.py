@@ -1,0 +1,8 @@
+"""Framework-owned native (C++) components.
+
+The reference authored no native code; its entire native surface was
+third-party (``bitarray``, z3 — SURVEY.md §2.3). Here the packed-bitset
+engine is part of the framework: ``bitset.cpp`` compiled on demand,
+``binding.py`` exposing it via ctypes. Import of this package is safe without
+a compiler; importing :mod:`.binding` raises ``NativeUnavailable`` instead.
+"""

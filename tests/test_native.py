@@ -1,0 +1,146 @@
+"""Native packed-bitset backend: kernel unit tests + differential tests
+against the CPU oracle (fourth independent engine over the same semantics)."""
+import numpy as np
+import pytest
+
+import kubernetes_verification_tpu as kv
+
+pytest.importorskip("kubernetes_verification_tpu.native.binding")
+
+from kubernetes_verification_tpu.harness.generate import (  # noqa: E402
+    GeneratorConfig,
+    random_cluster,
+    random_kano,
+)
+from kubernetes_verification_tpu.models.fixtures import (  # noqa: E402
+    kano_paper_example,
+    kubesv_paper_example,
+)
+from kubernetes_verification_tpu.native.binding import (  # noqa: E402
+    BitMatrix,
+    pack,
+    unpack,
+)
+
+
+# ---------------------------------------------------------------------------
+# kernels
+# ---------------------------------------------------------------------------
+
+
+def test_pack_roundtrip_odd_widths():
+    rng = np.random.default_rng(1)
+    for cols in (1, 63, 64, 65, 200):
+        a = rng.random((7, cols)) < 0.4
+        np.testing.assert_array_equal(unpack(pack(a), cols), a)
+
+
+def test_subset_disjoint_intersect():
+    rng = np.random.default_rng(2)
+    a = rng.random((13, 150)) < 0.3
+    b = rng.random((17, 150)) < 0.5
+    A, B = BitMatrix.from_bool(a), BitMatrix.from_bool(b)
+    ref_sub = (a[:, None, :] & ~b[None, :, :]).sum(-1) == 0
+    ref_dis = (a[:, None, :] & b[None, :, :]).sum(-1) == 0
+    np.testing.assert_array_equal(A.subset_of(B), ref_sub)
+    np.testing.assert_array_equal(A.disjoint_from(B), ref_dis)
+    np.testing.assert_array_equal(A.intersects(B), ~ref_dis)
+
+
+def test_or_scatter_matches_outer_or():
+    rng = np.random.default_rng(3)
+    P, N = 9, 70
+    sel = rng.random((P, N)) < 0.3
+    val = rng.random((P, N)) < 0.3
+    out = BitMatrix.zeros(N, N)
+    out.or_scatter_into(BitMatrix.from_bool(sel), BitMatrix.from_bool(val))
+    ref = np.zeros((N, N), dtype=bool)
+    for p in range(P):
+        ref |= np.outer(sel[p], val[p])
+    np.testing.assert_array_equal(out.to_bool(), ref)
+
+
+def test_closure_popcount_transpose():
+    rng = np.random.default_rng(4)
+    m = rng.random((41, 41)) < 0.06
+    M = BitMatrix.from_bool(m)
+    M.closure_inplace()
+    ref = m.copy()
+    while True:
+        nxt = ref | ((ref.astype(np.int64) @ ref.astype(np.int64)) > 0)
+        if np.array_equal(nxt, ref):
+            break
+        ref = nxt
+    np.testing.assert_array_equal(M.to_bool(), ref)
+    np.testing.assert_array_equal(M.popcount_rows(), ref.sum(1))
+    np.testing.assert_array_equal(M.transpose().to_bool(), ref.T)
+
+
+# ---------------------------------------------------------------------------
+# backend differential
+# ---------------------------------------------------------------------------
+
+
+def _diff(cluster, **flags):
+    ref = kv.verify(cluster, kv.VerifyConfig(backend="cpu", **flags))
+    got = kv.verify(cluster, kv.VerifyConfig(backend="native", **flags))
+    np.testing.assert_array_equal(got.reach, ref.reach)
+    if ref.reach_ports is not None:
+        np.testing.assert_array_equal(got.reach_ports, ref.reach_ports)
+    np.testing.assert_array_equal(got.selected, ref.selected)
+    np.testing.assert_array_equal(got.src_sets, ref.src_sets)
+    np.testing.assert_array_equal(got.dst_sets, ref.dst_sets)
+    np.testing.assert_array_equal(got.ingress_isolated, ref.ingress_isolated)
+    np.testing.assert_array_equal(got.egress_isolated, ref.egress_isolated)
+
+
+def test_k8s_matches_cpu():
+    cluster = random_cluster(
+        GeneratorConfig(n_pods=43, n_policies=17, n_namespaces=3, seed=37)
+    )
+    _diff(cluster)
+
+
+@pytest.mark.parametrize(
+    "flags",
+    [
+        dict(self_traffic=False),
+        dict(default_allow_unselected=False),
+        dict(direction_aware_isolation=False),
+        dict(compute_ports=False),
+    ],
+)
+def test_k8s_flags(flags):
+    cluster = random_cluster(
+        GeneratorConfig(n_pods=31, n_policies=11, n_namespaces=2, seed=41)
+    )
+    _diff(cluster, **flags)
+
+
+def test_k8s_closure():
+    cluster = random_cluster(
+        GeneratorConfig(n_pods=21, n_policies=9, n_namespaces=2, seed=43)
+    )
+    ref = kv.verify(cluster, kv.VerifyConfig(backend="cpu", closure=True))
+    got = kv.verify(cluster, kv.VerifyConfig(backend="native", closure=True))
+    np.testing.assert_array_equal(got.closure, ref.closure)
+
+
+def test_k8s_paper_example():
+    _diff(kubesv_paper_example())
+
+
+def test_kano_matches_cpu():
+    containers, policies = random_kano(51, 19, seed=47)
+    ref = kv.verify_kano(containers, policies, kv.VerifyConfig(backend="cpu"))
+    got = kv.verify_kano(containers, policies, kv.VerifyConfig(backend="native"))
+    np.testing.assert_array_equal(got.reach, ref.reach)
+    np.testing.assert_array_equal(got.src_sets, ref.src_sets)
+    np.testing.assert_array_equal(got.dst_sets, ref.dst_sets)
+
+
+def test_kano_paper_queries():
+    containers, policies = kano_paper_example()
+    res = kv.verify_kano(containers, policies, kv.VerifyConfig(backend="native"))
+    assert res.all_isolated() == [4]
+    assert res.user_crosscheck(containers, "app") == [1, 2, 3]
