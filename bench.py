@@ -30,16 +30,90 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+def bench_tiled(args) -> None:
+    """The BASELINE config-4 run: 100k pods / 10k policies, ingress+egress,
+    one chip, packed-bitmap output kept on device (``ops/tiled.py``)."""
+    import jax
+
+    from kubernetes_verification_tpu.encode.encoder import encode_cluster
+    from kubernetes_verification_tpu.harness.generate import (
+        GeneratorConfig,
+        random_cluster,
+    )
+    from kubernetes_verification_tpu.ops.tiled import tiled_k8s_reach
+
+    dev = jax.devices()[0]
+    log(f"device: {dev} ({jax.default_backend()})")
+    n = args.pods
+    t0 = time.perf_counter()
+    cluster = random_cluster(
+        GeneratorConfig(
+            n_pods=n,
+            n_policies=args.policies,
+            n_namespaces=args.namespaces,
+            p_ipblock_peer=0.0,
+            min_selector_labels=1,  # discriminating selectors (non-saturated matrix)
+            seed=0,
+        )
+    )
+    t1 = time.perf_counter()
+    enc = encode_cluster(cluster, compute_ports=False)
+    t2 = time.perf_counter()
+    log(
+        f"generate {t1 - t0:.1f}s  encode {t2 - t1:.1f}s  "
+        f"grants in/eg {enc.ingress.n}/{enc.egress.n}"
+    )
+    res = tiled_k8s_reach(enc, device=dev, fetch=False)  # compile + run
+    t3 = time.perf_counter()
+    log(f"compile+first solve {t3 - t2:.1f}s")
+    times = []
+    for _ in range(max(2, min(args.repeats, 5))):
+        r = tiled_k8s_reach(enc, device=dev, fetch=False)
+        times.append(r.timings["solve"])
+    solve = sorted(times)[len(times) // 2]
+    value = float(n) * float(n) / solve
+    log(
+        f"solve median {solve:.2f}s; {value / 1e9:.2f}e9 pairs/s; "
+        f"{r.timings['reachable_pairs']} reachable pairs"
+    )
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    f"all-pairs reachability, {n} pods / {args.policies} "
+                    f"policies (north-star config), 1 chip"
+                ),
+                "value": round(value, 1),
+                "unit": "pairs/s",
+                "vs_baseline": round(value / BASELINE_PAIRS_PER_SEC, 4),
+            }
+        )
+    )
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--pods", type=int, default=10_000)
-    ap.add_argument("--policies", type=int, default=1_000)
+    ap.add_argument("--pods", type=int, default=None)
+    ap.add_argument("--policies", type=int, default=None)
     ap.add_argument("--namespaces", type=int, default=20)
     ap.add_argument("--repeats", type=int, default=5)
-    ap.add_argument("--mode", choices=("k8s", "kano"), default="k8s")
+    ap.add_argument(
+        "--mode",
+        choices=("tiled", "k8s", "kano"),
+        default="tiled",
+        help="tiled = the BASELINE north-star config (100k pods / 10k "
+        "policies, packed-bitmap output); k8s/kano = dense kernels at 10k",
+    )
     args = ap.parse_args()
+    if args.pods is None:
+        args.pods = 100_000 if args.mode == "tiled" else 10_000
+    if args.policies is None:
+        args.policies = 10_000 if args.mode == "tiled" else 1_000
 
     import jax
+
+    if args.mode == "tiled":
+        return bench_tiled(args)
 
     from kubernetes_verification_tpu.encode.encoder import (
         encode_cluster,

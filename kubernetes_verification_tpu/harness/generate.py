@@ -62,6 +62,11 @@ class GeneratorConfig:
     p_explicit_policy_types: float = 0.2
     p_ipblock_peer: float = 0.05
     p_named_port: float = 0.05
+    #: minimum matchLabels entries per random selector. The default 0 lets
+    #: ~1/3 of selectors be empty (match-all) — fine for semantics fuzzing,
+    #: degenerate for benchmarks (the reach matrix saturates); benchmarks use
+    #: 1 so selectors actually discriminate.
+    min_selector_labels: int = 0
     seed: int = 0
 
 
@@ -100,7 +105,8 @@ def random_kano(
 def _rand_selector(rng: random.Random, pool: List[dict], cfg: GeneratorConfig) -> Selector:
     src = rng.choice(pool)
     items = sorted(src.items())
-    match_labels = dict(rng.sample(items, rng.randint(0, min(2, len(items)))))
+    lo = min(cfg.min_selector_labels, len(items))
+    match_labels = dict(rng.sample(items, rng.randint(lo, min(2, len(items)))))
     exprs: List[Expr] = []
     if rng.random() < cfg.p_match_expressions:
         op = rng.choice(["In", "NotIn", "Exists", "DoesNotExist"])
