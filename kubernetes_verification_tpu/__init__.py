@@ -37,6 +37,11 @@ from .backends.base import (
 
 from .ingest import dump_cluster, load_cluster, load_kano
 
+try:  # JAX-dependent; optional at import time
+    from .incremental import IncrementalVerifier
+except ImportError:  # pragma: no cover
+    pass
+
 # Importing backend modules registers them.
 from .backends import cpu as _cpu_backend  # noqa: F401
 from .datalog import k8s_program as _datalog_backend  # noqa: F401
@@ -83,5 +88,6 @@ __all__ = [
     "load_cluster",
     "load_kano",
     "dump_cluster",
+    "IncrementalVerifier",
     "__version__",
 ]

@@ -1,0 +1,387 @@
+"""Incremental re-verify on policy diffs (BASELINE config 5).
+
+The reference hints at per-policy contribution tracking with
+``Container.select_policies``/``allow_policies``
+(``kano_py/kano/model.py:16-17,161-163``) but always rebuilds from scratch.
+Here the decomposition is explicit: with any-port semantics the reachability
+matrix is
+
+    reach = ((Σ_p ing_peersₚ ⊗ sel_ingₚ > 0) ∨ ¬ing_iso)
+          ∧ ((Σ_p sel_egₚ ⊗ eg_peersₚ > 0) ∨ ¬eg_iso)   ∨ diag
+
+an OR over per-policy outer products. ``IncrementalVerifier`` keeps the *sum*
+(int32 count matrices, device-resident) instead of the OR, so a policy
+add/remove/update is one subtract + one add of a rank-1 outer product —
+O(N²) work independent of the policy count (vs O(P·N²) for a rebuild) — and
+pod label changes patch one row + one column of each count matrix. All
+updates run as jitted device ops with donated buffers (no reallocation);
+``reach`` re-derives from the counts on demand.
+
+Scope: any-port semantics (the ``compute_ports=False`` mode, like the tiled
+path); pod add/remove changes N and falls back to a rebuild.
+"""
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .backends.base import VerifyConfig
+from .models.core import Cluster, NetworkPolicy, Pod
+
+__all__ = ["IncrementalVerifier"]
+
+_I32 = jnp.int32
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _rank1_add(count, src, dst, sign):
+    """count += sign · src ⊗ dst (int32, donated in place)."""
+    return count + sign * (src.astype(_I32)[:, None] * dst.astype(_I32)[None, :])
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _row_col_patch(count, idx, d_row, d_col):
+    """Add deltas to row ``idx`` and column ``idx`` of a count matrix. The
+    (idx, idx) cell must be carried by ``d_row`` only (``d_col[idx] == 0``)."""
+    count = count.at[idx, :].add(d_row.astype(_I32))
+    count = count.at[:, idx].add(d_col.astype(_I32))
+    return count
+
+
+@partial(
+    jax.jit,
+    static_argnames=("self_traffic", "default_allow_unselected"),
+)
+def _derive_reach(
+    ing_count,
+    eg_count,
+    ing_iso_count,
+    eg_iso_count,
+    *,
+    self_traffic: bool,
+    default_allow_unselected: bool,
+):
+    ing_ok = ing_count > 0
+    eg_ok = eg_count > 0
+    if default_allow_unselected:
+        ing_ok |= ing_iso_count[None, :] == 0
+        eg_ok |= eg_iso_count[:, None] == 0
+    reach = ing_ok & eg_ok
+    if self_traffic:
+        n = reach.shape[0]
+        reach |= jnp.eye(n, dtype=bool)
+    return reach
+
+
+class IncrementalVerifier:
+    """Maintains a cluster's reachability under policy/pod-label diffs."""
+
+    def __init__(
+        self,
+        cluster: Cluster,
+        config: Optional[VerifyConfig] = None,
+        device=None,
+    ) -> None:
+        self.config = config or VerifyConfig()
+        self.device = device or jax.devices()[0]
+        self.pods: List[Pod] = list(cluster.pods)
+        self.namespaces = list(cluster.namespaces)
+        self._ns_labels = {ns.name: ns.labels for ns in self.namespaces}
+        self.policies: Dict[str, NetworkPolicy] = {}
+        n = len(self.pods)
+        self._ing_count = jnp.zeros((n, n), dtype=_I32, device=self.device)
+        self._eg_count = jnp.zeros((n, n), dtype=_I32, device=self.device)
+        self._ing_iso = np.zeros(n, dtype=np.int64)
+        self._eg_iso = np.zeros(n, dtype=np.int64)
+        #: per-policy contribution vectors (host copies, bool [N])
+        self._vectors: Dict[str, Tuple[np.ndarray, ...]] = {}
+        self._reach_dirty = True
+        self._reach = None
+        self.update_count = 0
+        if cluster.policies:
+            self._batch_init(cluster)
+
+    def _batch_init(self, cluster: Cluster) -> None:
+        """Initial build: one encoder pass + one batched device contraction
+        (P rank-1 updates collapsed into two [P,N]×[P,N] matmuls)."""
+        from .encode.encoder import encode_cluster
+        from .ops.tiled import _grant_peers_full
+
+        enc = encode_cluster(cluster, compute_ports=False)
+        P, n = enc.n_policies, enc.n_pods
+        cfg = self.config
+
+        @jax.jit
+        def build(pod_kv, pod_key, pod_ns, ns_kv, ns_key, pol_sel, pol_ns,
+                  aff_i, aff_e, ingress, egress):
+            from .ops.match import match_selectors
+
+            selected = match_selectors(pol_sel, pod_kv, pod_key)
+            selected &= pol_ns[:, None] == pod_ns[None, :]
+            if cfg.direction_aware_isolation:
+                sel_ing = selected & aff_i[:, None]
+                sel_eg = selected & aff_e[:, None]
+            else:
+                sel_ing = selected
+                sel_eg = selected
+            ip = _grant_peers_full(
+                ingress, pod_kv, pod_key, ns_kv, ns_key, pod_ns, pol_ns
+            )
+            ep = _grant_peers_full(
+                egress, pod_kv, pod_key, ns_kv, ns_key, pod_ns, pol_ns
+            )
+            seg = lambda v, s: jnp.clip(
+                jax.ops.segment_max(v.astype(jnp.int8), s, num_segments=P + 1)[:P],
+                0, 1,
+            ).astype(bool)
+            ing_peers = seg(ip, ingress.pol)
+            eg_peers = seg(ep, egress.pol)
+            if cfg.direction_aware_isolation:
+                ing_peers &= aff_i[:, None]
+                eg_peers &= aff_e[:, None]
+            dot = lambda a, b: jax.lax.dot_general(
+                a.astype(jnp.int8), b.astype(jnp.int8),
+                (((0,), (0,)), ((), ())), preferred_element_type=_I32,
+            )
+            return (
+                dot(ing_peers, sel_ing),
+                dot(sel_eg, eg_peers),
+                sel_ing, sel_eg, ing_peers, eg_peers,
+            )
+
+        args = jax.device_put(
+            (
+                enc.pod_kv, enc.pod_key, enc.pod_ns, enc.ns_kv, enc.ns_key,
+                enc.pol_sel, enc.pol_ns, enc.pol_affects_ingress,
+                enc.pol_affects_egress, enc.ingress, enc.egress,
+            ),
+            self.device,
+        )
+        ing_c, eg_c, sel_ing, sel_eg, ing_peers, eg_peers = build(*args)
+        self._ing_count = ing_c
+        self._eg_count = eg_c
+        sel_ing = np.asarray(sel_ing)
+        sel_eg = np.asarray(sel_eg)
+        ing_peers = np.asarray(ing_peers)
+        eg_peers = np.asarray(eg_peers)
+        self._ing_iso = sel_ing.sum(axis=0, dtype=np.int64)
+        self._eg_iso = sel_eg.sum(axis=0, dtype=np.int64)
+        for i, pol in enumerate(cluster.policies):
+            key = self._key(pol)
+            if key in self.policies:
+                raise KeyError(f"duplicate policy {key}")
+            self.policies[key] = pol
+            self._vectors[key] = (
+                sel_ing[i].copy(), sel_eg[i].copy(),
+                ing_peers[i].copy(), eg_peers[i].copy(),
+            )
+
+    # ---------------------------------------------------------------- diffs
+    def _key(self, pol: NetworkPolicy) -> str:
+        return f"{pol.namespace}/{pol.name}"
+
+    def _policy_vectors(
+        self, pol: NetworkPolicy
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """(sel_ing, sel_eg, ing_peers, eg_peers) bool [N] for one policy —
+        the object-level semantics of the CPU oracle (``backends/cpu.py``),
+        evaluated for a single policy."""
+        n = len(self.pods)
+        cfg = self.config
+        selected = np.fromiter(
+            (
+                p.namespace == pol.namespace and pol.pod_selector.matches(p.labels)
+                for p in self.pods
+            ),
+            dtype=bool,
+            count=n,
+        )
+        aff_in = pol.affects_ingress if cfg.direction_aware_isolation else True
+        aff_eg = pol.affects_egress if cfg.direction_aware_isolation else True
+        sel_ing = selected & aff_in
+        sel_eg = selected & aff_eg
+
+        def peer_union(rules) -> np.ndarray:
+            acc = np.zeros(n, dtype=bool)
+            for rule in rules or ():
+                if rule.matches_all_peers:
+                    acc[:] = True
+                    continue
+                for peer in rule.peers:
+                    for i, pod in enumerate(self.pods):
+                        if acc[i]:
+                            continue
+                        if peer.ip_block is not None:
+                            acc[i] = peer.ip_block.matches_ip(pod.ip)
+                            continue
+                        if peer.namespace_selector is None:
+                            ns_ok = pod.namespace == pol.namespace
+                        else:
+                            ns_ok = peer.namespace_selector.matches(
+                                self._ns_labels.get(pod.namespace, {})
+                            )
+                        acc[i] = ns_ok and (
+                            peer.pod_selector is None
+                            or peer.pod_selector.matches(pod.labels)
+                        )
+            return acc
+
+        ing_peers = peer_union(pol.ingress) if aff_in else np.zeros(n, dtype=bool)
+        eg_peers = peer_union(pol.egress) if aff_eg else np.zeros(n, dtype=bool)
+        return sel_ing, sel_eg, ing_peers, eg_peers
+
+    def _apply(self, vecs, sign: int) -> None:
+        sel_ing, sel_eg, ing_peers, eg_peers = (jnp.asarray(v) for v in vecs)
+        self._ing_count = _rank1_add(self._ing_count, ing_peers, sel_ing, sign)
+        self._eg_count = _rank1_add(self._eg_count, sel_eg, eg_peers, sign)
+        self._ing_iso += sign * np.asarray(vecs[0], dtype=np.int64)
+        self._eg_iso += sign * np.asarray(vecs[1], dtype=np.int64)
+        self._reach_dirty = True
+        self.update_count += 1
+
+    def add_policy(self, pol: NetworkPolicy) -> None:
+        key = self._key(pol)
+        if key in self.policies:
+            raise KeyError(f"policy {key} exists; use update_policy")
+        if pol.namespace not in self._ns_labels:
+            self._ns_labels[pol.namespace] = {}
+        vecs = self._policy_vectors(pol)
+        self.policies[key] = pol
+        self._vectors[key] = vecs
+        self._apply(vecs, +1)
+
+    def remove_policy(self, namespace: str, name: str) -> None:
+        key = f"{namespace}/{name}"
+        pol = self.policies.pop(key)  # KeyError if absent
+        vecs = self._vectors.pop(key)
+        self._apply(vecs, -1)
+
+    def update_policy(self, pol: NetworkPolicy) -> None:
+        self.remove_policy(pol.namespace, pol.name)
+        self.add_policy(pol)
+
+    def update_pod_labels(self, idx: int, labels: Dict[str, str]) -> None:
+        """Relabel pod ``idx``: every policy's contribution through this pod
+        is patched — one row + one column of each count matrix, O(P + N)
+        host work and O(N) device writes."""
+        pod = self.pods[idx]
+        n = len(self.pods)
+
+        def row_col_sums():
+            """(ing_row, ing_col, eg_row, eg_col, iso_i, iso_e): Σ_p
+            contributions through pod ``idx``, from the current vectors.
+            ing_count[src, dst] = Σ ing_peers[src]·sel_ing[dst] so its row
+            idx is Σ ing_peers[idx]·sel_ing[:], its col idx (corner zeroed)
+            Σ sel_ing[idx]·ing_peers[:]; egress is the mirror."""
+            ing_row = np.zeros(n, dtype=np.int64)
+            ing_col = np.zeros(n, dtype=np.int64)
+            eg_row = np.zeros(n, dtype=np.int64)
+            eg_col = np.zeros(n, dtype=np.int64)
+            iso_i = 0
+            iso_e = 0
+            for vec in self._vectors.values():
+                sel_ing, sel_eg, ing_peers, eg_peers = vec
+                if ing_peers[idx]:
+                    ing_row += sel_ing
+                if sel_ing[idx]:
+                    ing_col += ing_peers
+                    iso_i += 1
+                if sel_eg[idx]:
+                    eg_row += eg_peers
+                    iso_e += 1
+                if eg_peers[idx]:
+                    eg_col += sel_eg
+            ing_col[idx] = 0  # corner lives in the row sums
+            eg_col[idx] = 0
+            return ing_row, ing_col, eg_row, eg_col, iso_i, iso_e
+
+        old = row_col_sums()
+        pod.labels = dict(labels)
+        for key, pol in self.policies.items():
+            sel_ing, sel_eg, ing_peers, eg_peers = self._vectors[key]
+            cfg = self.config
+            aff_in = pol.affects_ingress if cfg.direction_aware_isolation else True
+            aff_eg = pol.affects_egress if cfg.direction_aware_isolation else True
+            selected = (
+                pod.namespace == pol.namespace
+                and pol.pod_selector.matches(pod.labels)
+            )
+            sel_ing[idx] = selected and aff_in
+            sel_eg[idx] = selected and aff_eg
+            ing_peers[idx] = (
+                self._peer_match_one(pol, pol.ingress, pod) if aff_in else False
+            )
+            eg_peers[idx] = (
+                self._peer_match_one(pol, pol.egress, pod) if aff_eg else False
+            )
+        new = row_col_sums()
+        self._ing_count = _row_col_patch(
+            self._ing_count, idx,
+            jnp.asarray(new[0] - old[0], dtype=_I32),
+            jnp.asarray(new[1] - old[1], dtype=_I32),
+        )
+        self._eg_count = _row_col_patch(
+            self._eg_count, idx,
+            jnp.asarray(new[2] - old[2], dtype=_I32),
+            jnp.asarray(new[3] - old[3], dtype=_I32),
+        )
+        self._ing_iso[idx] += new[4] - old[4]
+        self._eg_iso[idx] += new[5] - old[5]
+        self._reach_dirty = True
+        self.update_count += 1
+
+    def _peer_match_one(self, pol, rules, pod) -> bool:
+        for rule in rules or ():
+            if rule.matches_all_peers:
+                return True
+            for peer in rule.peers:
+                if peer.ip_block is not None:
+                    if peer.ip_block.matches_ip(pod.ip):
+                        return True
+                    continue
+                if peer.namespace_selector is None:
+                    ns_ok = pod.namespace == pol.namespace
+                else:
+                    ns_ok = peer.namespace_selector.matches(
+                        self._ns_labels.get(pod.namespace, {})
+                    )
+                if ns_ok and (
+                    peer.pod_selector is None
+                    or peer.pod_selector.matches(pod.labels)
+                ):
+                    return True
+        return False
+
+    # --------------------------------------------------------------- result
+    @property
+    def reach(self) -> np.ndarray:
+        """Current reachability matrix (derived from counts on demand)."""
+        if self._reach_dirty:
+            t0 = time.perf_counter()
+            self._reach = np.asarray(
+                _derive_reach(
+                    self._ing_count,
+                    self._eg_count,
+                    jnp.asarray(self._ing_iso, dtype=_I32),
+                    jnp.asarray(self._eg_iso, dtype=_I32),
+                    self_traffic=self.config.self_traffic,
+                    default_allow_unselected=self.config.default_allow_unselected,
+                )
+            )
+            self._derive_time = time.perf_counter() - t0
+            self._reach_dirty = False
+        return self._reach
+
+    def as_cluster(self) -> Cluster:
+        """Snapshot of the current state as a plain Cluster (for full-solve
+        cross-checks and checkpointing)."""
+        return Cluster(
+            pods=[Pod(p.name, p.namespace, dict(p.labels), p.ip, dict(p.container_ports)) for p in self.pods],
+            namespaces=list(self.namespaces),
+            policies=list(self.policies.values()),
+        )

@@ -1,0 +1,128 @@
+"""Incremental re-verify: every mutation's result must equal a from-scratch
+solve of the mutated cluster (any-port mode), across adds/removes/updates and
+pod relabels — the BASELINE config-5 capability."""
+import numpy as np
+import pytest
+
+import kubernetes_verification_tpu as kv
+from kubernetes_verification_tpu.harness.generate import (
+    GeneratorConfig,
+    random_cluster,
+)
+from kubernetes_verification_tpu.incremental import IncrementalVerifier
+
+
+def _full(cluster, config):
+    return kv.verify(
+        cluster,
+        kv.VerifyConfig(
+            backend="cpu",
+            compute_ports=False,
+            self_traffic=config.self_traffic,
+            default_allow_unselected=config.default_allow_unselected,
+            direction_aware_isolation=config.direction_aware_isolation,
+        ),
+    ).reach
+
+
+@pytest.fixture()
+def setup():
+    cluster = random_cluster(
+        GeneratorConfig(n_pods=31, n_policies=9, n_namespaces=3, seed=51)
+    )
+    cfg = kv.VerifyConfig(compute_ports=False)
+    inc = IncrementalVerifier(cluster, cfg)
+    return cluster, cfg, inc
+
+
+def test_initial_build_matches_full(setup):
+    cluster, cfg, inc = setup
+    np.testing.assert_array_equal(inc.reach, _full(cluster, cfg))
+
+
+def test_remove_and_readd(setup):
+    cluster, cfg, inc = setup
+    victim = cluster.policies[3]
+    inc.remove_policy(victim.namespace, victim.name)
+    np.testing.assert_array_equal(inc.reach, _full(inc.as_cluster(), cfg))
+    inc.add_policy(victim)
+    np.testing.assert_array_equal(inc.reach, _full(cluster, cfg))
+
+
+def test_update_policy(setup):
+    cluster, cfg, inc = setup
+    old = cluster.policies[2]
+    new = kv.NetworkPolicy(
+        name=old.name,
+        namespace=old.namespace,
+        pod_selector=kv.Selector(),  # select whole namespace now
+        ingress=(kv.Rule(peers=(kv.Peer(pod_selector=kv.Selector({"app": "alpha"})),)),),
+    )
+    inc.update_policy(new)
+    np.testing.assert_array_equal(inc.reach, _full(inc.as_cluster(), cfg))
+
+
+def test_add_policy_new_namespace(setup):
+    _cluster, cfg, inc = setup
+    pol = kv.NetworkPolicy(
+        name="lockdown",
+        namespace="ns0",
+        pod_selector=kv.Selector(),
+        policy_types=("Ingress", "Egress"),
+        ingress=(),
+        egress=(),
+    )
+    inc.add_policy(pol)
+    np.testing.assert_array_equal(inc.reach, _full(inc.as_cluster(), cfg))
+
+
+def test_pod_relabel(setup):
+    cluster, cfg, inc = setup
+    for idx, labels in ((0, {"app": "alpha", "tier": "beta"}), (17, {}), (30, {"zone": "gamma"})):
+        inc.update_pod_labels(idx, labels)
+        np.testing.assert_array_equal(
+            inc.reach, _full(inc.as_cluster(), cfg), err_msg=f"idx={idx}"
+        )
+
+
+def test_mutation_storm_stays_consistent(setup):
+    cluster, cfg, inc = setup
+    rng = np.random.default_rng(5)
+    extra = random_cluster(
+        GeneratorConfig(n_pods=31, n_policies=6, n_namespaces=3, seed=99)
+    ).policies
+    for i, pol in enumerate(extra):
+        renamed = kv.NetworkPolicy(
+            name=f"extra{i}",
+            namespace=pol.namespace,
+            pod_selector=pol.pod_selector,
+            policy_types=pol.policy_types,
+            ingress=pol.ingress,
+            egress=pol.egress,
+        )
+        inc.add_policy(renamed)
+    for name in list(inc.policies)[:4]:
+        ns, n = name.split("/")
+        inc.remove_policy(ns, n)
+    inc.update_pod_labels(int(rng.integers(31)), {"app": "delta"})
+    np.testing.assert_array_equal(inc.reach, _full(inc.as_cluster(), cfg))
+    assert inc.update_count >= 11
+
+
+@pytest.mark.parametrize(
+    "flags",
+    [
+        dict(self_traffic=False),
+        dict(default_allow_unselected=False),
+        dict(direction_aware_isolation=False),
+    ],
+)
+def test_flags(flags):
+    cluster = random_cluster(
+        GeneratorConfig(n_pods=19, n_policies=5, n_namespaces=2, seed=61)
+    )
+    cfg = kv.VerifyConfig(compute_ports=False, **flags)
+    inc = IncrementalVerifier(cluster, cfg)
+    victim = cluster.policies[0]
+    inc.remove_policy(victim.namespace, victim.name)
+    np.testing.assert_array_equal(inc.reach, _full(inc.as_cluster(), cfg))
