@@ -63,12 +63,15 @@ def bench_tiled(args) -> None:
         f"generate {t1 - t0:.1f}s  encode {t2 - t1:.1f}s  "
         f"grants in/eg {enc.ingress.n}/{enc.egress.n}"
     )
-    res = tiled_k8s_reach(enc, device=dev, fetch=False)  # compile + run
+    run = lambda: tiled_k8s_reach(
+        enc, device=dev, fetch=False, use_pallas=args.pallas
+    )
+    res = run()  # compile + first solve
     t3 = time.perf_counter()
     log(f"compile+first solve {t3 - t2:.1f}s")
     times = []
     for _ in range(max(2, min(args.repeats, 5))):
-        r = tiled_k8s_reach(enc, device=dev, fetch=False)
+        r = run()
         times.append(r.timings["solve"])
     solve = sorted(times)[len(times) // 2]
     value = float(n) * float(n) / solve
@@ -103,6 +106,11 @@ def main() -> None:
         default="tiled",
         help="tiled = the BASELINE north-star config (100k pods / 10k "
         "policies, packed-bitmap output); k8s/kano = dense kernels at 10k",
+    )
+    ap.add_argument(
+        "--pallas",
+        action="store_true",
+        help="tiled mode: use the fused Pallas kernels instead of the XLA path",
     )
     args = ap.parse_args()
     if args.pods is None:

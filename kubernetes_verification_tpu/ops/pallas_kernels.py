@@ -1,0 +1,207 @@
+"""Pallas TPU kernels for the packed reachability path.
+
+``packed_dir_allow`` fuses one direction's grant contraction with the
+default-allow OR and the 32-bit bit-pack: an int8 MXU dot with a blocked
+policy axis accumulated in VMEM scratch, the ``counts > 0 ∨ ¬isolated``
+combine on the VPU, and packing via two more MXU dots against a constant
+block-diagonal weight matrix. The int32 count tile and the boolean tile never
+round-trip through HBM — each kernel call writes only its ``uint32[N, N/32]``
+bitmap, 32× less traffic than the unfused path's count tiles. The two
+directions then combine with one word-wise AND (+ packed diagonal OR) in XLA:
+on bit-packed matrices the ``∧`` of the semantics is a single ``uint32 &``.
+
+Why this shape (see ``/opt/skills/guides/pallas_guide.md``):
+
+* grid ``(N/TM, N/TN, P/TK)`` with ``dimension_semantics``
+  ``(parallel, parallel, arbitrary)`` — the policy axis is the sequential
+  reduction accumulating into int32 VMEM scratch;
+* the output block's last dim must be a multiple of 128 words, forcing
+  ``TN = 4096`` — which is why only ONE direction fits per kernel: two
+  direction accumulators at (256, 4096) would blow the ~16 MB VMEM budget
+  (empirically verified — the two-dot variant fails Mosaic compilation);
+* Mosaic cannot relayout a lane-splitting reshape, so the bit-pack is
+  expressed as MXU dots against constant 16-bit-half weight matrices (every
+  product and partial sum is a sum of distinct powers of two < 2¹⁶, exact in
+  f32), combined with an integer shift-OR and a bitcast.
+
+``interpret=True`` runs the same kernels on CPU for the differential tests.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["packed_dir_allow", "packed_reach"]
+
+_I32 = jnp.int32
+_U32 = jnp.uint32
+
+
+def _dir_kernel(
+    a_ref,  # int8 [TK, TM]  source-side columns
+    b_ref,  # int8 [TK, TN]  destination-side columns
+    niso_ref,  # int32 [8, TN or TM]  1 where NOT isolated (row 0 used; 8
+    #           sublane-replicated rows keep the block within the int32
+    #           (8, 128) min-tile — a (1, n) int8 block fails Mosaic)
+    wlo_ref,  # f32 [TN, TN//32] pack matrix, bits 0-15 of each word
+    whi_ref,  # f32 [TN, TN//32] pack matrix, bits 16-31
+    out_ref,  # uint32 [TM, TN//32]
+    acc,  # scratch int32 [TM, TN]
+    *,
+    tm: int,
+    tn: int,
+    default_allow_axis: int,  # 0: OR ¬iso over rows (src); 1: over cols (dst); -1: none
+):
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _():
+        acc[:] = jnp.zeros((tm, tn), dtype=_I32)
+
+    acc[:] += jax.lax.dot_general(
+        a_ref[:], b_ref[:], (((0,), (0,)), ((), ())), preferred_element_type=_I32
+    )
+
+    @pl.when(k == pl.num_programs(2) - 1)
+    def _():
+        ok = acc[:] > 0
+        if default_allow_axis == 1:  # ingress: unselected dst accepts all
+            ok |= niso_ref[0, :][None, :] > 0
+        elif default_allow_axis == 0:  # egress: unselected src sends anywhere
+            ok |= niso_ref[0, :][:, None] > 0
+        rf = ok.astype(jnp.float32)
+        dn2 = (((1,), (0,)), ((), ()))
+        lo = jax.lax.dot_general(
+            rf, wlo_ref[:], dn2, preferred_element_type=jnp.float32
+        )
+        hi = jax.lax.dot_general(
+            rf, whi_ref[:], dn2, preferred_element_type=jnp.float32
+        )
+        packed = lo.astype(_I32) | (hi.astype(_I32) << 16)
+        out_ref[:] = pltpu.bitcast(packed, _U32)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("tm", "tn", "tk", "default_allow_axis", "interpret"),
+)
+def packed_dir_allow(
+    a,  # int8 [P, N] source-side per-policy map
+    b,  # int8 [P, N] destination-side per-policy map
+    not_iso,  # int32 [8, N] (row 0 consulted)
+    *,
+    tm: int = 256,
+    tn: int = 4096,
+    tk: int = 256,
+    default_allow_axis: int = -1,
+    interpret: bool = False,
+):
+    """uint32 [N, N/32]: pack((aᵀb > 0) ∨ ¬iso). N must divide by tm and tn,
+    P by tk (pad with zero rows — inert)."""
+    P, N = a.shape
+    if N % tm or N % tn or tn % 32 or (not interpret and (tn // 32) % 128):
+        raise ValueError(f"N={N} incompatible with tiles ({tm}, {tn})")
+    if P % tk:
+        raise ValueError(f"P={P} not divisible by tk={tk}")
+    grid = (N // tm, N // tn, P // tk)
+    niso_spec = (
+        pl.BlockSpec((8, tn), lambda i, j, k: (0, j), memory_space=pltpu.VMEM)
+        if default_allow_axis == 1
+        else pl.BlockSpec((8, tm), lambda i, j, k: (0, i), memory_space=pltpu.VMEM)
+    )
+    return pl.pallas_call(
+        partial(_dir_kernel, tm=tm, tn=tn, default_allow_axis=default_allow_axis),
+        out_shape=jax.ShapeDtypeStruct((N, N // 32), _U32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tk, tm), lambda i, j, k: (k, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tk, tn), lambda i, j, k: (k, j), memory_space=pltpu.VMEM),
+            niso_spec,
+            pl.BlockSpec(
+                (tn, tn // 32), lambda i, j, k: (0, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (tn, tn // 32), lambda i, j, k: (0, 0), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (tm, tn // 32), lambda i, j, k: (i, j), memory_space=pltpu.VMEM
+        ),
+        scratch_shapes=[pltpu.VMEM((tm, tn), _I32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * P * N * N + 2 * 2 * N * N * 32,
+            bytes_accessed=2 * P * N + N * N // 8,
+            transcendentals=0,
+        ),
+        interpret=pltpu.InterpretParams() if interpret else False,
+    )(a, b, not_iso, *_pack_matrices(tn))
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "tm",
+        "tn",
+        "tk",
+        "self_traffic",
+        "default_allow_unselected",
+        "interpret",
+    ),
+)
+def packed_reach(
+    ing_by_pol,  # int8 [P, N] (src side of ingress)
+    sel_ing,  # int8 [P, N] (dst side of ingress)
+    sel_eg,  # int8 [P, N] (src side of egress)
+    eg_by_pol,  # int8 [P, N] (dst side of egress)
+    not_ing_iso,  # int32 [8, N]
+    not_eg_iso,  # int32 [8, N]
+    *,
+    tm: int = 256,
+    tn: int = 4096,
+    tk: int = 256,
+    self_traffic: bool = True,
+    default_allow_unselected: bool = True,
+    interpret: bool = False,
+):
+    """uint32 [N, N/32] packed reachability: two fused direction kernels, one
+    word-wise AND, and a packed-diagonal OR."""
+    da = default_allow_unselected
+    ing = packed_dir_allow(
+        ing_by_pol, sel_ing, not_ing_iso,
+        tm=tm, tn=tn, tk=tk, default_allow_axis=1 if da else -1,
+        interpret=interpret,
+    )
+    eg = packed_dir_allow(
+        sel_eg, eg_by_pol, not_eg_iso,
+        tm=tm, tn=tn, tk=tk, default_allow_axis=0 if da else -1,
+        interpret=interpret,
+    )
+    out = ing & eg
+    if self_traffic:
+        N = out.shape[0]
+        rows = jnp.arange(N)
+        cols = rows // 32
+        bits = jnp.uint32(1) << (rows % 32).astype(_U32)
+        out = out.at[rows, cols].set(out[rows, cols] | bits)
+    return out
+
+
+def _pack_matrices(tn: int):
+    """Block-diagonal pack matrices: column c contributes 2^(c%32) to word
+    c//32, split into 16-bit halves so the f32 MXU sums stay exact."""
+    c = np.arange(tn)
+    wi, bi = np.divmod(c, 32)
+    w_lo = np.zeros((tn, tn // 32), np.float32)
+    w_hi = np.zeros((tn, tn // 32), np.float32)
+    lo = bi < 16
+    w_lo[c[lo], wi[lo]] = (1 << bi[lo]).astype(np.float32)
+    w_hi[c[~lo], wi[~lo]] = (1 << (bi[~lo] - 16)).astype(np.float32)
+    return jnp.asarray(w_lo), jnp.asarray(w_hi)

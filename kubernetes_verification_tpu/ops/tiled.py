@@ -96,6 +96,7 @@ def _grant_peers_full(
         "self_traffic",
         "default_allow_unselected",
         "direction_aware_isolation",
+        "use_pallas",
     ),
 )
 def _tiled_step(
@@ -117,6 +118,7 @@ def _tiled_step(
     self_traffic: bool,
     default_allow_unselected: bool,
     direction_aware_isolation: bool,
+    use_pallas: bool = False,
 ):
     N = pod_kv.shape[0]
     P = pol_ns.shape[0]
@@ -165,6 +167,28 @@ def _tiled_step(
         return jax.lax.dot_general(
             a, b, (((0,), (0,)), ((), ())), preferred_element_type=_I32
         )
+
+    if use_pallas:
+        # fused Pallas kernel: dots + combine + pack in VMEM, one HBM write
+        from .pallas_kernels import packed_reach
+
+        tk = 256
+        p_pad = (tk - P % tk) % tk if P else tk
+        padp = lambda a: jnp.pad(a, ((0, p_pad), (0, 0)))
+        out = packed_reach(
+            padp(ing_by_pol),
+            padp(sel_ing8),
+            padp(sel_eg8),
+            padp(eg_by_pol),
+            jnp.broadcast_to((~ing_iso).astype(jnp.int32), (8, N)),
+            jnp.broadcast_to((~eg_iso).astype(jnp.int32), (8, N)),
+            tk=tk,
+            self_traffic=self_traffic,
+            default_allow_unselected=default_allow_unselected,
+            interpret=jax.default_backend() != "tpu",
+        )
+        out &= col_mask[None, :]
+        return out, ing_iso, eg_iso, selected8 > 0
 
     def body(t, out):
         d0 = t * tile
@@ -239,6 +263,7 @@ def tiled_k8s_reach(
     direction_aware_isolation: bool = True,
     device=None,
     fetch: bool = True,
+    use_pallas: bool = False,
 ) -> PackedReach:
     """Host wrapper: pad N to a tile multiple, run the jitted tiled step,
     trim. Semantics = ``compute_ports=False`` mode of the other backends.
@@ -256,6 +281,8 @@ def tiled_k8s_reach(
     tile = max(32, min(tile, 1 << 20))
     if tile % 32:
         raise ValueError("tile must be a multiple of 32")
+    if use_pallas and tile % 4096:
+        raise ValueError("use_pallas requires tile % 4096 == 0 (pallas block)")
     n_pad = (tile - n % tile) % tile
     Np = n + n_pad
 
@@ -296,6 +323,7 @@ def tiled_k8s_reach(
         *args,
         tile=tile,
         chunk=chunk,
+        use_pallas=use_pallas,
         self_traffic=self_traffic,
         default_allow_unselected=default_allow_unselected,
         direction_aware_isolation=direction_aware_isolation,

@@ -1,0 +1,62 @@
+"""Pallas fused packed-reach kernels, run in interpreter mode on CPU and
+pinned to the CPU oracle / the XLA tiled path."""
+import numpy as np
+import pytest
+
+import kubernetes_verification_tpu as kv
+from kubernetes_verification_tpu.encode.encoder import encode_cluster
+from kubernetes_verification_tpu.harness.generate import (
+    GeneratorConfig,
+    random_cluster,
+)
+from kubernetes_verification_tpu.ops.pallas_kernels import packed_dir_allow
+from kubernetes_verification_tpu.ops.tiled import tiled_k8s_reach, unpack_cols
+
+
+def test_packed_dir_allow_kernel():
+    rng = np.random.default_rng(0)
+    P, N = 64, 256
+    a = (rng.random((P, N)) < 0.1).astype(np.int8)
+    b = (rng.random((P, N)) < 0.1).astype(np.int8)
+    niso1 = rng.random(N) < 0.5
+    niso = np.broadcast_to(niso1.astype(np.int32), (8, N)).copy()
+    counts = a.astype(np.int64).T @ b.astype(np.int64)
+    for axis, ref in (
+        (1, (counts > 0) | niso1[None, :]),
+        (0, (counts > 0) | niso1[:, None]),
+        (-1, counts > 0),
+    ):
+        out = packed_dir_allow(
+            a, b, niso, tm=64, tn=64, tk=32,
+            default_allow_axis=axis, interpret=True,
+        )
+        np.testing.assert_array_equal(
+            unpack_cols(np.asarray(out), N), ref, err_msg=f"axis={axis}"
+        )
+
+
+@pytest.mark.parametrize("seed", [1, 2])
+def test_tiled_pallas_matches_cpu(seed):
+    cluster = random_cluster(
+        GeneratorConfig(n_pods=300, n_policies=17, n_namespaces=3, seed=seed)
+    )
+    ref = kv.verify(cluster, kv.VerifyConfig(backend="cpu", compute_ports=False))
+    enc = encode_cluster(cluster, compute_ports=False)
+    got = tiled_k8s_reach(enc, tile=4096, chunk=16, use_pallas=True)
+    np.testing.assert_array_equal(got.to_bool(), ref.reach)
+
+
+@pytest.mark.parametrize(
+    "flags",
+    [dict(self_traffic=False), dict(default_allow_unselected=False)],
+)
+def test_tiled_pallas_flags(flags):
+    cluster = random_cluster(
+        GeneratorConfig(n_pods=150, n_policies=9, n_namespaces=2, seed=5)
+    )
+    ref = kv.verify(
+        cluster, kv.VerifyConfig(backend="cpu", compute_ports=False, **flags)
+    )
+    enc = encode_cluster(cluster, compute_ports=False)
+    got = tiled_k8s_reach(enc, tile=4096, chunk=16, use_pallas=True, **flags)
+    np.testing.assert_array_equal(got.to_bool(), ref.reach)
