@@ -1,0 +1,202 @@
+"""Checkpoint / resume and artifact export.
+
+The reference's only persistence is debug dumps of SMT2 programs and answers
+into a gitignored directory (``kubesv/tests/test_basic.py:24-36``). Here
+persistence is first-class:
+
+* ``save_result`` / ``load_result`` — a :class:`VerifyResult` round-trips
+  through one ``.npz`` (arrays) + embedded JSON (config/meta);
+* ``save_packed`` / ``load_packed`` — the large-N :class:`PackedReach`
+  bitmap, 1.25 GB at 100k pods, stored as raw packed words;
+* ``save_incremental`` / ``load_incremental`` — an
+  :class:`IncrementalVerifier`'s full state (count matrices, per-policy
+  contribution vectors, cluster manifests via ``dump_cluster``) so a
+  long-lived re-verify service resumes without re-solving (BASELINE
+  config 5);
+* ``export_encoding`` — the encoded tensors + a human-readable summary: the
+  tensor-era analogue of the reference's ``get_datalog`` "explain the model"
+  dump (``kubesv/kubesv/constraint.py:127-128``).
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+import numpy as np
+
+from ..backends.base import PortAtom, VerifyConfig, VerifyResult
+
+__all__ = [
+    "save_result",
+    "load_result",
+    "save_packed",
+    "load_packed",
+    "save_incremental",
+    "load_incremental",
+    "export_encoding",
+]
+
+_OPT = ("reach_ports", "src_sets", "dst_sets", "selected",
+        "ingress_isolated", "egress_isolated", "closure")
+
+
+def save_result(result: VerifyResult, path: str) -> None:
+    meta = {
+        "n_pods": result.n_pods,
+        "mode": result.mode,
+        "backend": result.backend,
+        "config": {
+            "backend": result.config.backend,
+            "self_traffic": result.config.self_traffic,
+            "default_allow_unselected": result.config.default_allow_unselected,
+            "direction_aware_isolation": result.config.direction_aware_isolation,
+            "compute_ports": result.config.compute_ports,
+            "closure": result.config.closure,
+        },
+        "port_atoms": [
+            [a.protocol, a.lo, a.hi, a.name] for a in result.port_atoms
+        ],
+        "timings": result.timings,
+    }
+    arrays = {"reach": result.reach}
+    for name in _OPT:
+        v = getattr(result, name)
+        if v is not None:
+            arrays[name] = v
+    np.savez_compressed(
+        path, __meta__=np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8),
+        **arrays,
+    )
+
+
+def load_result(path: str) -> VerifyResult:
+    with np.load(path) as z:
+        meta = json.loads(bytes(z["__meta__"]).decode())
+        arrays = {k: z[k] for k in z.files if k != "__meta__"}
+    return VerifyResult(
+        n_pods=meta["n_pods"],
+        mode=meta["mode"],
+        backend=meta["backend"],
+        config=VerifyConfig(**meta["config"]),
+        reach=arrays["reach"],
+        port_atoms=[
+            PortAtom(protocol=p, lo=lo, hi=hi, name=n)
+            for p, lo, hi, n in meta["port_atoms"]
+        ],
+        timings=meta.get("timings") or {},
+        **{k: arrays.get(k) for k in _OPT},
+    )
+
+
+def save_packed(packed_reach, path: str) -> None:
+    """Persist a :class:`~..ops.tiled.PackedReach`."""
+    np.savez_compressed(
+        path,
+        packed=np.asarray(packed_reach.packed),
+        n_pods=np.int64(packed_reach.n_pods),
+        ingress_isolated=packed_reach.ingress_isolated,
+        egress_isolated=packed_reach.egress_isolated,
+    )
+
+
+def load_packed(path: str):
+    from ..ops.tiled import PackedReach
+
+    with np.load(path) as z:
+        return PackedReach(
+            packed=z["packed"],
+            n_pods=int(z["n_pods"]),
+            ingress_isolated=z["ingress_isolated"],
+            egress_isolated=z["egress_isolated"],
+        )
+
+
+def save_incremental(inc, directory: str) -> None:
+    """Checkpoint an :class:`~..incremental.IncrementalVerifier`."""
+    from ..ingest import dump_cluster
+
+    os.makedirs(directory, exist_ok=True)
+    dump_cluster(inc.as_cluster(), os.path.join(directory, "cluster"))
+    keys = list(inc.policies)
+    vec = {
+        f"vec_{i}": np.stack(inc._vectors[k]) for i, k in enumerate(keys)
+    }
+    np.savez_compressed(
+        os.path.join(directory, "state.npz"),
+        ing_count=np.asarray(inc._ing_count),
+        eg_count=np.asarray(inc._eg_count),
+        ing_iso=inc._ing_iso,
+        eg_iso=inc._eg_iso,
+        keys=np.array(keys),
+        update_count=np.int64(inc.update_count),
+        **vec,
+    )
+
+
+def load_incremental(directory: str, config: Optional[VerifyConfig] = None,
+                     device=None):
+    """Resume an :class:`~..incremental.IncrementalVerifier` from a
+    checkpoint without re-solving."""
+    import jax.numpy as jnp
+
+    from ..incremental import IncrementalVerifier
+    from ..ingest import load_cluster
+    from ..models.core import Cluster
+
+    cluster, _ = load_cluster(os.path.join(directory, "cluster"))
+    inc = IncrementalVerifier(
+        Cluster(pods=cluster.pods, namespaces=cluster.namespaces, policies=[]),
+        config,
+        device=device,
+    )
+    with np.load(os.path.join(directory, "state.npz")) as z:
+        inc._ing_count = jnp.asarray(z["ing_count"])
+        inc._eg_count = jnp.asarray(z["eg_count"])
+        inc._ing_iso = z["ing_iso"].copy()
+        inc._eg_iso = z["eg_iso"].copy()
+        inc.update_count = int(z["update_count"])
+        keys = [str(k) for k in z["keys"]]
+        by_key = {f"{p.namespace}/{p.name}": p for p in cluster.policies}
+        for i, key in enumerate(keys):
+            v = z[f"vec_{i}"]
+            inc.policies[key] = by_key[key]
+            inc._vectors[key] = tuple(row.copy() for row in v.astype(bool))
+    inc._reach_dirty = True
+    return inc
+
+
+def export_encoding(enc, path_prefix: str) -> str:
+    """Dump an :class:`~..encode.encoder.EncodedCluster` as ``.npz`` + a text
+    summary — the debug/"explain" facility (SURVEY.md §5.5)."""
+    arrays = {
+        "pod_kv": enc.pod_kv, "pod_key": enc.pod_key, "pod_ns": enc.pod_ns,
+        "ns_kv": enc.ns_kv, "ns_key": enc.ns_key, "pol_ns": enc.pol_ns,
+        "pol_affects_ingress": enc.pol_affects_ingress,
+        "pol_affects_egress": enc.pol_affects_egress,
+    }
+    for prefix, block in (("ingress", enc.ingress), ("egress", enc.egress)):
+        arrays[f"{prefix}_pol"] = block.pol
+        arrays[f"{prefix}_match_all"] = block.match_all
+        arrays[f"{prefix}_ports"] = block.ports
+        arrays[f"{prefix}_is_ipblock"] = block.is_ipblock
+    np.savez_compressed(path_prefix + ".npz", **arrays)
+
+    lines = [
+        f"EncodedCluster: {enc.n_pods} pods, {enc.n_namespaces} namespaces, "
+        f"{enc.n_policies} policies",
+        f"vocab: {enc.vocab.n_pairs} label pairs, {enc.vocab.n_keys} keys",
+        f"port atoms ({len(enc.atoms)}):",
+    ]
+    for a in enc.atoms:
+        lines.append(f"  {a.protocol} {a.name or f'{a.lo}-{a.hi}'}")
+    for prefix, block in (("ingress", enc.ingress), ("egress", enc.egress)):
+        lines.append(
+            f"{prefix}: {block.n} grant rows "
+            f"({int(block.match_all.sum())} match-all, "
+            f"{int(block.is_ipblock.sum())} ipBlock)"
+        )
+    txt = path_prefix + ".txt"
+    with open(txt, "w") as fh:
+        fh.write("\n".join(lines) + "\n")
+    return txt

@@ -1,0 +1,96 @@
+"""Checkpoint/resume + export + observability tests."""
+import logging
+
+import numpy as np
+
+import kubernetes_verification_tpu as kv
+from kubernetes_verification_tpu.encode.encoder import encode_cluster
+from kubernetes_verification_tpu.harness.generate import (
+    GeneratorConfig,
+    random_cluster,
+)
+from kubernetes_verification_tpu.incremental import IncrementalVerifier
+from kubernetes_verification_tpu.ops.tiled import tiled_k8s_reach
+from kubernetes_verification_tpu.utils.observe import Phases, log_event, logger
+from kubernetes_verification_tpu.utils.persist import (
+    export_encoding,
+    load_incremental,
+    load_packed,
+    load_result,
+    save_incremental,
+    save_packed,
+    save_result,
+)
+
+
+def _cluster(seed=71):
+    return random_cluster(
+        GeneratorConfig(n_pods=29, n_policies=9, n_namespaces=3, seed=seed)
+    )
+
+
+def test_result_roundtrip(tmp_path):
+    res = kv.verify(_cluster(), kv.VerifyConfig(backend="cpu", closure=True))
+    p = str(tmp_path / "res.npz")
+    save_result(res, p)
+    back = load_result(p)
+    np.testing.assert_array_equal(back.reach, res.reach)
+    np.testing.assert_array_equal(back.reach_ports, res.reach_ports)
+    np.testing.assert_array_equal(back.closure, res.closure)
+    assert back.config == res.config
+    assert back.port_atoms == res.port_atoms
+    assert back.all_isolated() == res.all_isolated()
+
+
+def test_packed_roundtrip(tmp_path):
+    cluster = _cluster()
+    enc = encode_cluster(cluster, compute_ports=False)
+    pr = tiled_k8s_reach(enc, tile=32, chunk=8)
+    p = str(tmp_path / "packed.npz")
+    save_packed(pr, p)
+    back = load_packed(p)
+    np.testing.assert_array_equal(back.to_bool(), pr.to_bool())
+    assert back.all_isolated() == pr.all_isolated()
+
+
+def test_incremental_checkpoint_resume(tmp_path):
+    cluster = _cluster()
+    cfg = kv.VerifyConfig(compute_ports=False)
+    inc = IncrementalVerifier(cluster, cfg)
+    victim = cluster.policies[0]
+    inc.remove_policy(victim.namespace, victim.name)
+    reach_before = inc.reach.copy()
+
+    save_incremental(inc, str(tmp_path / "ckpt"))
+    inc2 = load_incremental(str(tmp_path / "ckpt"), cfg)
+    np.testing.assert_array_equal(inc2.reach, reach_before)
+    assert inc2.update_count == inc.update_count
+
+    # the resumed verifier keeps mutating correctly
+    inc.add_policy(victim)
+    inc2.add_policy(victim)
+    np.testing.assert_array_equal(inc2.reach, inc.reach)
+
+
+def test_export_encoding(tmp_path):
+    enc = encode_cluster(_cluster(), compute_ports=True)
+    txt = export_encoding(enc, str(tmp_path / "model"))
+    content = open(txt).read()
+    assert "EncodedCluster: 29 pods" in content
+    assert "grant rows" in content
+    with np.load(str(tmp_path / "model.npz")) as z:
+        np.testing.assert_array_equal(z["pod_kv"], enc.pod_kv)
+
+
+def test_phases_and_events(caplog):
+    ph = Phases()
+    with ph("encode"):
+        pass
+    with ph("solve"):
+        pass
+    with ph("solve"):
+        pass
+    assert set(ph.timings) == {"encode", "solve"}
+    with caplog.at_level(logging.INFO, logger="kvtpu"):
+        log_event("bench", value=1.5)
+    assert any("bench" in r.message for r in caplog.records)
