@@ -1,0 +1,172 @@
+"""``kv-tpu`` — command-line front end.
+
+The reference has no CLI at all (both verifiers are driven by unit tests
+only, SURVEY.md §1); this exposes the full pipeline:
+
+* ``kv-tpu verify PATH``   — load manifests, verify, print queries/summary;
+* ``kv-tpu explain PATH``  — export the encoded tensors + the Datalog
+  program text (the ``get_datalog`` facility, ``kubesv/kubesv/
+  constraint.py:127-128``, for both representations);
+* ``kv-tpu generate DIR``  — write a synthetic cluster as YAML manifests;
+* ``kv-tpu backends``      — list available execution backends.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Optional
+
+
+def _add_verify_flags(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--backend", default="cpu")
+    p.add_argument("--closure", action="store_true")
+    p.add_argument("--no-ports", dest="ports", action="store_false")
+    p.add_argument("--no-self-traffic", dest="self_traffic", action="store_false")
+    p.add_argument(
+        "--no-default-allow", dest="default_allow", action="store_false",
+        help="reproduce the reference's unselected-pods-unreachable behaviour",
+    )
+    p.add_argument("--kano", action="store_true", help="kano-level semantics")
+    p.add_argument("--output", help="save the VerifyResult as .npz")
+    p.add_argument("--json", action="store_true", help="machine-readable output")
+
+
+def cmd_verify(args) -> int:
+    import kubernetes_verification_tpu as kv
+
+    cfg = kv.VerifyConfig(
+        backend=args.backend,
+        closure=args.closure,
+        compute_ports=args.ports,
+        self_traffic=args.self_traffic,
+        default_allow_unselected=args.default_allow,
+    )
+    if args.kano:
+        containers, policies = kv.load_kano(args.path)
+        res = kv.verify_kano(containers, policies, cfg)
+        pods = containers
+        skipped = []
+    else:
+        cluster, skipped = kv.load_cluster(args.path)
+        res = kv.verify(cluster, cfg)
+        pods = cluster.pods
+    iso = res.all_isolated()
+    hubs = res.all_reachable()
+    out = {
+        "pods": res.n_pods,
+        "backend": res.backend,
+        "mode": res.mode,
+        "reachable_pairs": int(res.reach.sum()),
+        "all_isolated": iso,
+        "all_reachable": hubs,
+        "policy_shadow": (
+            res.policy_shadow() if res.src_sets is not None else None
+        ),
+        "policy_conflict": (
+            res.policy_conflict() if res.src_sets is not None else None
+        ),
+        "timings": res.timings,
+        "skipped_documents": skipped,
+    }
+    if args.output:
+        from .utils.persist import save_result
+
+        save_result(res, args.output)
+        out["saved"] = args.output
+    if args.json:
+        print(json.dumps(out))
+    else:
+        name = lambda i: getattr(pods[i], "name", str(i))
+        print(f"{res.n_pods} pods verified on backend={res.backend} "
+              f"({res.mode} mode): {out['reachable_pairs']} reachable pairs")
+        print(f"  fully isolated pods: {[name(i) for i in iso] or 'none'}")
+        print(f"  reachable-from-everywhere pods: {[name(i) for i in hubs] or 'none'}")
+        if out["policy_shadow"]:
+            print(f"  shadowed policy pairs: {out['policy_shadow']}")
+        if out["policy_conflict"]:
+            print(f"  conflicting policy pairs: {out['policy_conflict']}")
+        for k, v in res.timings.items():
+            print(f"  {k}: {v * 1e3:.1f} ms")
+        if skipped:
+            print(f"  skipped {len(skipped)} non-verifiable documents")
+    return 0
+
+
+def cmd_explain(args) -> int:
+    import kubernetes_verification_tpu as kv
+    from .datalog import build_k8s_program
+    from .encode.encoder import encode_cluster
+    from .utils.persist import export_encoding
+
+    cluster, _ = kv.load_cluster(args.path)
+    txt = export_encoding(
+        encode_cluster(cluster, compute_ports=args.ports), args.out
+    )
+    prog, _ = build_k8s_program(cluster, kv.VerifyConfig())
+    dl = args.out + ".datalog"
+    with open(dl, "w") as fh:
+        fh.write(prog.dump() + "\n")
+    print(open(txt).read().rstrip())
+    print(f"wrote {args.out}.npz, {txt}, {dl}")
+    return 0
+
+
+def cmd_generate(args) -> int:
+    from .harness.generate import GeneratorConfig, random_cluster
+    from .ingest import dump_cluster
+
+    cluster = random_cluster(
+        GeneratorConfig(
+            n_pods=args.pods,
+            n_policies=args.policies,
+            n_namespaces=args.namespaces,
+            seed=args.seed,
+        )
+    )
+    paths = dump_cluster(cluster, args.dir)
+    print(f"wrote {len(cluster.pods)} pods / {len(cluster.policies)} policies "
+          f"to {', '.join(paths)}")
+    return 0
+
+
+def cmd_backends(_args) -> int:
+    import kubernetes_verification_tpu as kv
+
+    for name in kv.available_backends():
+        print(name)
+    return 0
+
+
+def main(argv: Optional[list] = None) -> int:
+    ap = argparse.ArgumentParser(prog="kv-tpu", description=__doc__)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("verify", help="verify manifests under PATH")
+    p.add_argument("path")
+    _add_verify_flags(p)
+    p.set_defaults(fn=cmd_verify)
+
+    p = sub.add_parser("explain", help="export encoded model + Datalog program")
+    p.add_argument("path")
+    p.add_argument("--out", default="model")
+    p.add_argument("--no-ports", dest="ports", action="store_false")
+    p.set_defaults(fn=cmd_explain)
+
+    p = sub.add_parser("generate", help="write a synthetic cluster as YAML")
+    p.add_argument("dir")
+    p.add_argument("--pods", type=int, default=100)
+    p.add_argument("--policies", type=int, default=50)
+    p.add_argument("--namespaces", type=int, default=5)
+    p.add_argument("--seed", type=int, default=0)
+    p.set_defaults(fn=cmd_generate)
+
+    p = sub.add_parser("backends", help="list available backends")
+    p.set_defaults(fn=cmd_backends)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

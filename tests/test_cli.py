@@ -1,0 +1,32 @@
+"""CLI smoke tests: generate → verify → explain round trip."""
+import json
+import os
+
+from kubernetes_verification_tpu.cli import main
+
+
+def test_generate_verify_explain(tmp_path, capsys):
+    d = str(tmp_path / "cluster")
+    assert main(["generate", d, "--pods", "30", "--policies", "8"]) == 0
+    capsys.readouterr()
+
+    out_npz = str(tmp_path / "res.npz")
+    assert main(["verify", d, "--backend", "cpu", "--json",
+                 "--output", out_npz]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["pods"] == 30
+    assert out["reachable_pairs"] > 0
+    assert os.path.exists(out_npz)
+
+    assert main(["verify", d, "--kano"]) == 0
+    assert "kano mode" in capsys.readouterr().out
+
+    prefix = str(tmp_path / "model")
+    assert main(["explain", d, "--out", prefix]) == 0
+    assert os.path.exists(prefix + ".npz")
+    assert os.path.exists(prefix + ".datalog")
+    text = open(prefix + ".datalog").read()
+    assert "edge(s, d)" in text
+
+    assert main(["backends"]) == 0
+    assert "cpu" in capsys.readouterr().out
