@@ -37,10 +37,11 @@ from .backends.base import (
 
 from .ingest import dump_cluster, load_cluster, load_kano
 
+_HAVE_INCREMENTAL = True
 try:  # JAX-dependent; optional at import time
     from .incremental import IncrementalVerifier
 except ImportError:  # pragma: no cover
-    pass
+    _HAVE_INCREMENTAL = False
 
 # Importing backend modules registers them.
 from .backends import cpu as _cpu_backend  # noqa: F401
@@ -88,6 +89,8 @@ __all__ = [
     "load_cluster",
     "load_kano",
     "dump_cluster",
-    "IncrementalVerifier",
     "__version__",
 ]
+
+if _HAVE_INCREMENTAL:
+    __all__.append("IncrementalVerifier")

@@ -103,7 +103,7 @@ def cmd_explain(args) -> int:
     txt = export_encoding(
         encode_cluster(cluster, compute_ports=args.ports), args.out
     )
-    prog, _ = build_k8s_program(cluster, kv.VerifyConfig())
+    prog, _, _atoms = build_k8s_program(cluster, kv.VerifyConfig())
     dl = args.out + ".datalog"
     with open(dl, "w") as fh:
         fh.write(prog.dump() + "\n")

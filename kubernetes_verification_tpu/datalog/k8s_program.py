@@ -24,10 +24,11 @@ on the dense-tensor engine in :mod:`.engine` instead of z3:
 Differences from the reference, by design: policyTypes are honored
 (``direction_aware_isolation``; the reference's ``policy_types`` is dead
 code), ipBlock peers match pods by IP (host-side fact emission; the reference
-parses and ignores them), and the missing-``return`` ports bug is absent —
-though like the reference the Datalog program does not model the port axis
-(port-atom reachability lives in the tensor backends; any-port reachability is
-identical either way since every port spec covers at least one atom).
+parses and ignores them), and ports are enforced (the reference drops them via
+a missing ``return``): the allow/traffic/edge relations carry a port-atom
+argument over the same equivalence classes the tensor backends use
+(``encode/ports.py``), so ``reach``/``reach_ports`` match them bit-for-bit
+under every ``compute_ports`` setting.
 
 This backend is the *semantics oracle at Datalog granularity* — use the
 tensor backends for scale.
@@ -111,7 +112,7 @@ class _SelectorCompiler:
 
 def build_k8s_program(
     cluster: Cluster, config: VerifyConfig
-) -> Tuple[Program, Vocab]:
+) -> Tuple[Program, Vocab, list]:
     """Emit the full program for a cluster under the semantic flags."""
     prog = Program()
     pods, namespaces, policies = cluster.pods, cluster.namespaces, cluster.policies
@@ -121,11 +122,17 @@ def build_k8s_program(
     )
     ns_index = cluster.namespace_index()
 
+    from ..encode.ports import ALL_ATOM, compute_port_atoms, rule_port_mask
+
+    atoms = compute_port_atoms(policies) if config.compute_ports else [ALL_ATOM]
+    Q = len(atoms)
+
     pod_d = prog.domain("pod", N)
     ns_d = prog.domain("ns", M)
     pol_d = prog.domain("pol", max(P, 1))
     pair_d = prog.domain("pair", max(vocab.n_pairs, 1))
     key_d = prog.domain("key", max(vocab.n_keys, 1))
+    q_d = prog.domain("q", Q)  # port atoms (encode/ports.py)
 
     # --- base facts (define_pod_facts, constraint.py:242-275) -------------
     prog.relation("is_pod", pod_d)
@@ -147,12 +154,19 @@ def build_k8s_program(
     prog.fact_array("has_key_ns", _pad_cols(ns_key, key_d.size))
 
     # --- derived relations ------------------------------------------------
-    for rel in ("selected", "sel_ing", "sel_eg", "ing_allow", "eg_allow"):
+    for rel in ("selected", "sel_ing", "sel_eg"):
         prog.relation(rel, pod_d, pol_d)
+    # allow relations carry the port atom — the dimension the reference
+    # parsed but dropped (kubesv/kubesv/model.py:365-385, missing return)
+    prog.relation("ing_allow", pod_d, pol_d, q_d)
+    prog.relation("eg_allow", pod_d, pol_d, q_d)
     for rel in ("sel_any_ing", "sel_any_eg", "sel_none_ing", "sel_none_eg"):
         prog.relation(rel, pod_d)
-    prog.relation("ingress_traffic", pod_d, pod_d)
-    prog.relation("egress_traffic", pod_d, pod_d)
+    prog.relation("is_q", q_d)
+    prog.fact_array("is_q", np.ones(Q, dtype=bool))
+    prog.relation("ingress_traffic", pod_d, pod_d, q_d)
+    prog.relation("egress_traffic", pod_d, pod_d, q_d)
+    prog.relation("edge_q", pod_d, pod_d, q_d)
     prog.relation("edge", pod_d, pod_d)
     prog.relation("path", pod_d, pod_d)
 
@@ -176,19 +190,28 @@ def build_k8s_program(
         if affects_eg:
             prog.rule(Atom("sel_eg", ("x", i)), Atom("selected", ("x", i)))
 
-        def emit_peers(rules, head_rel):
-            ip_rows = np.zeros(N, dtype=bool)
+        def emit_peers(rules, head_rel, direction):
+            ip_rows = np.zeros((N, Q), dtype=bool)
             any_ip = False
-            for rule in rules or ():
+            for ridx, rule in enumerate(rules or ()):
+                pmask = rule_port_mask(rule, atoms)
+                # per-rule port relation: one fact per covered atom
+                ports_rel = f"ports_{direction}_{i}_{ridx}"
+                prog.relation(ports_rel, q_d)
+                prog.fact_array(ports_rel, pmask)
                 if rule.matches_all_peers:
-                    prog.rule(Atom(head_rel, ("s", i)), Atom("is_pod", ("s",)))
+                    prog.rule(
+                        Atom(head_rel, ("s", i, "q")),
+                        Atom("is_pod", ("s",)),
+                        Atom(ports_rel, ("q",)),
+                    )
                     continue
                 for peer in rule.peers:
                     if peer.ip_block is not None:
                         any_ip = True
                         for j, pod in enumerate(pods):
                             if peer.ip_block.matches_ip(pod.ip):
-                                ip_rows[j] = True
+                                ip_rows[j] |= pmask
                         continue
                     p_atoms = pod_c.compile(peer.pod_selector, "s")
                     if p_atoms is None:
@@ -200,16 +223,21 @@ def build_k8s_program(
                         if n_atoms is None:
                             continue
                         scope = [Atom("pod_ns", ("s", "n")), *n_atoms]
-                    prog.rule(Atom(head_rel, ("s", i)), *scope, *p_atoms)
+                    prog.rule(
+                        Atom(head_rel, ("s", i, "q")),
+                        *scope,
+                        *p_atoms,
+                        Atom(ports_rel, ("q",)),
+                    )
             if any_ip:
-                arr = np.zeros((N, pol_d.size), dtype=bool)
-                arr[:, i] = ip_rows
+                arr = np.zeros((N, pol_d.size, Q), dtype=bool)
+                arr[:, i, :] = ip_rows
                 prog.fact_array(head_rel, arr)
 
         if affects_in:
-            emit_peers(pol.ingress, "ing_allow")
+            emit_peers(pol.ingress, "ing_allow", "in")
         if affects_eg:
-            emit_peers(pol.egress, "eg_allow")
+            emit_peers(pol.egress, "eg_allow", "eg")
 
     # --- core program (define_model, constraint.py:136-239) ---------------
     prog.rule(Atom("sel_any_ing", ("x",)), Atom("sel_ing", ("x", "p")))
@@ -224,43 +252,52 @@ def build_k8s_program(
         Atom("is_pod", ("x",)),
         Atom("sel_any_eg", ("x",), negated=True),
     )
-    # ingress_traffic(src, sel): sel may receive from src (constraint.py:195-207)
+    # ingress_traffic(src, sel, q): sel may receive from src on atom q
+    # (constraint.py:195-207, with the port dimension the reference lost)
     prog.rule(
-        Atom("ingress_traffic", ("s", "x")),
+        Atom("ingress_traffic", ("s", "x", "q")),
         Atom("sel_ing", ("x", "p")),
-        Atom("ing_allow", ("s", "p")),
+        Atom("ing_allow", ("s", "p", "q")),
     )
-    # egress_traffic(dst, sel): sel may send to dst (constraint.py:209-223)
+    # egress_traffic(dst, sel, q): sel may send to dst (constraint.py:209-223)
     prog.rule(
-        Atom("egress_traffic", ("d", "x")),
+        Atom("egress_traffic", ("d", "x", "q")),
         Atom("sel_eg", ("x", "p")),
-        Atom("eg_allow", ("d", "p")),
+        Atom("eg_allow", ("d", "p", "q")),
     )
     if config.default_allow_unselected:
         prog.rule(
-            Atom("ingress_traffic", ("s", "x")),
+            Atom("ingress_traffic", ("s", "x", "q")),
             Atom("sel_none_ing", ("x",)),
             Atom("is_pod", ("s",)),
+            Atom("is_q", ("q",)),
         )
         prog.rule(
-            Atom("egress_traffic", ("d", "x")),
+            Atom("egress_traffic", ("d", "x", "q")),
             Atom("sel_none_eg", ("x",)),
             Atom("is_pod", ("d",)),
+            Atom("is_q", ("q",)),
         )
+    # traffic flows on a port atom only if BOTH directions allow that atom
     prog.rule(
-        Atom("edge", ("s", "d")),
-        Atom("ingress_traffic", ("s", "d")),
-        Atom("egress_traffic", ("d", "s")),
+        Atom("edge_q", ("s", "d", "q")),
+        Atom("ingress_traffic", ("s", "d", "q")),
+        Atom("egress_traffic", ("d", "s", "q")),
     )
     if config.self_traffic:
-        prog.rule(Atom("edge", ("x", "x")), Atom("is_pod", ("x",)))
+        prog.rule(
+            Atom("edge_q", ("x", "x", "q")),
+            Atom("is_pod", ("x",)),
+            Atom("is_q", ("q",)),
+        )
+    prog.rule(Atom("edge", ("s", "d")), Atom("edge_q", ("s", "d", "q")))
     prog.rule(Atom("path", ("s", "d")), Atom("edge", ("s", "d")))
     prog.rule(
         Atom("path", ("s", "d")),
         Atom("path", ("s", "x")),
         Atom("path", ("x", "d")),
     )
-    return prog, vocab
+    return prog, vocab, atoms
 
 
 def build_kano_program(
@@ -322,7 +359,7 @@ class DatalogBackend(VerifierBackend):
 
     def verify(self, cluster: Cluster, config: VerifyConfig) -> VerifyResult:
         t0 = time.perf_counter()
-        prog, _ = build_k8s_program(cluster, config)
+        prog, _, atoms = build_k8s_program(cluster, config)
         t1 = time.perf_counter()
         sol = solve(prog, use_jax=bool(config.opt("use_jax", False)))
         t2 = time.perf_counter()
@@ -331,8 +368,11 @@ class DatalogBackend(VerifierBackend):
         selected = sol["selected"][:, :P].T  # [P, N]
         sel_ing = sol["sel_ing"][:, :P].T
         sel_eg = sol["sel_eg"][:, :P].T
-        ing_allow = sol["ing_allow"][:, :P].T
-        eg_allow = sol["eg_allow"][:, :P].T
+        # allow relations are (pod, pol, q); the per-policy edge sets use the
+        # any-port projection (every port spec covers >= 1 atom, so this
+        # equals the kernels' peer-based sets)
+        ing_allow = sol["ing_allow"][:, :P].any(axis=2).T
+        eg_allow = sol["eg_allow"][:, :P].any(axis=2).T
         has_ing = np.array(
             [bool(p.ingress) for p in cluster.policies], dtype=bool
         )
@@ -347,6 +387,8 @@ class DatalogBackend(VerifierBackend):
             backend=self.name,
             config=config,
             reach=sol["edge"],
+            reach_ports=sol["edge_q"] if config.compute_ports else None,
+            port_atoms=list(atoms) if config.compute_ports else [],
             src_sets=src_sets,
             dst_sets=dst_sets,
             selected=selected,

@@ -106,7 +106,8 @@ def _rand_selector(rng: random.Random, pool: List[dict], cfg: GeneratorConfig) -
     src = rng.choice(pool)
     items = sorted(src.items())
     lo = min(cfg.min_selector_labels, len(items))
-    match_labels = dict(rng.sample(items, rng.randint(lo, min(2, len(items)))))
+    hi = max(lo, min(2, len(items)))
+    match_labels = dict(rng.sample(items, rng.randint(lo, hi)))
     exprs: List[Expr] = []
     if rng.random() < cfg.p_match_expressions:
         op = rng.choice(["In", "NotIn", "Exists", "DoesNotExist"])

@@ -31,9 +31,12 @@ _SO = os.path.join(_DIR, "_kvbitset.so")
 def _build() -> str:
     if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
         return _SO
+    # per-process temp name: concurrent first imports must not clobber each
+    # other's half-written artifact before the atomic os.replace
+    tmp = f"{_SO}.{os.getpid()}.tmp"
     cmd = [
         "g++", "-O3", "-march=native", "-shared", "-fPIC", "-fopenmp",
-        "-o", _SO + ".tmp", _SRC,
+        "-o", tmp, _SRC,
     ]
     try:
         subprocess.run(cmd, check=True, capture_output=True, text=True)
@@ -50,7 +53,7 @@ def _build() -> str:
                 continue
         else:
             raise NativeUnavailable(f"compile failed:\n{e.stderr}") from e
-    os.replace(_SO + ".tmp", _SO)
+    os.replace(tmp, _SO)
     return _SO
 
 

@@ -135,8 +135,9 @@ def _tiled_step(
     else:
         sel_ing8 = selected8
         sel_eg8 = selected8
-    ing_iso = sel_ing8.max(axis=0) > 0
-    eg_iso = sel_eg8.max(axis=0) > 0
+    # .any over the policy axis (works for P == 0, unlike .max)
+    ing_iso = (sel_ing8 > 0).any(axis=0)
+    eg_iso = (sel_eg8 > 0).any(axis=0)
 
     def peers_by_policy(block: GrantBlock) -> jnp.ndarray:
         """int8 [P, N]: OR of each policy's grant peer rows, computed in

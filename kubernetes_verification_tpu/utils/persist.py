@@ -113,7 +113,8 @@ def load_packed(path: str):
 
 
 def save_incremental(inc, directory: str) -> None:
-    """Checkpoint an :class:`~..incremental.IncrementalVerifier`."""
+    """Checkpoint an :class:`~..incremental.IncrementalVerifier` — including
+    its semantic config, so a resume can't silently flip flags."""
     from ..ingest import dump_cluster
 
     os.makedirs(directory, exist_ok=True)
@@ -122,6 +123,17 @@ def save_incremental(inc, directory: str) -> None:
     vec = {
         f"vec_{i}": np.stack(inc._vectors[k]) for i, k in enumerate(keys)
     }
+    cfg = inc.config
+    config_json = json.dumps(
+        {
+            "backend": cfg.backend,
+            "self_traffic": cfg.self_traffic,
+            "default_allow_unselected": cfg.default_allow_unselected,
+            "direction_aware_isolation": cfg.direction_aware_isolation,
+            "compute_ports": cfg.compute_ports,
+            "closure": cfg.closure,
+        }
+    )
     np.savez_compressed(
         os.path.join(directory, "state.npz"),
         ing_count=np.asarray(inc._ing_count),
@@ -130,6 +142,7 @@ def save_incremental(inc, directory: str) -> None:
         eg_iso=inc._eg_iso,
         keys=np.array(keys),
         update_count=np.int64(inc.update_count),
+        __config__=np.frombuffer(config_json.encode(), dtype=np.uint8),
         **vec,
     )
 
@@ -145,12 +158,16 @@ def load_incremental(directory: str, config: Optional[VerifyConfig] = None,
     from ..models.core import Cluster
 
     cluster, _ = load_cluster(os.path.join(directory, "cluster"))
+    state_path = os.path.join(directory, "state.npz")
+    if config is None:
+        with np.load(state_path) as z:
+            config = VerifyConfig(**json.loads(bytes(z["__config__"]).decode()))
     inc = IncrementalVerifier(
         Cluster(pods=cluster.pods, namespaces=cluster.namespaces, policies=[]),
         config,
         device=device,
     )
-    with np.load(os.path.join(directory, "state.npz")) as z:
+    with np.load(state_path) as z:
         inc._ing_count = jnp.asarray(z["ing_count"])
         inc._eg_count = jnp.asarray(z["eg_count"])
         inc._ing_iso = z["ing_iso"].copy()

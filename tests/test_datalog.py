@@ -134,6 +134,9 @@ def _diff(cluster, **flags):
     ref = kv.verify(cluster, kv.VerifyConfig(backend="cpu", **flags))
     got = kv.verify(cluster, kv.VerifyConfig(backend="datalog", **flags))
     np.testing.assert_array_equal(got.reach, ref.reach)
+    if ref.reach_ports is not None:
+        np.testing.assert_array_equal(got.reach_ports, ref.reach_ports)
+        assert got.port_atoms == ref.port_atoms
     np.testing.assert_array_equal(got.selected, ref.selected)
     np.testing.assert_array_equal(got.src_sets, ref.src_sets)
     np.testing.assert_array_equal(got.dst_sets, ref.dst_sets)
@@ -199,7 +202,7 @@ def test_program_dump_names_reference_relations():
     cluster = kubesv_paper_example()
     from kubernetes_verification_tpu.datalog import build_k8s_program
 
-    prog, _ = build_k8s_program(cluster, kv.VerifyConfig())
+    prog, _, _ = build_k8s_program(cluster, kv.VerifyConfig())
     text = prog.dump()
     for rel in ("selected", "ing_allow", "ingress_traffic", "edge", "path"):
         assert rel in text
