@@ -21,7 +21,7 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
 
-from ..encode.ports import compute_port_atoms, rule_port_mask
+from ..encode.ports import ALL_ATOM, compute_port_atoms, rule_port_mask
 from ..models.core import (
     Cluster,
     Container,
@@ -107,8 +107,8 @@ class CpuBackend(VerifierBackend):
         n, P = len(pods), len(policies)
         ns_labels = {ns.name: ns.labels for ns in namespaces}
 
-        atoms = compute_port_atoms(policies) if config.compute_ports else None
-        Q = len(atoms) if atoms else 1
+        atoms = compute_port_atoms(policies) if config.compute_ports else [ALL_ATOM]
+        Q = len(atoms)
 
         selected = np.zeros((P, n), dtype=bool)
         for pi, pol in enumerate(policies):
@@ -181,9 +181,7 @@ class CpuBackend(VerifierBackend):
             if affects_in[pi] and pol.ingress:
                 for rule in pol.ingress:
                     srcs = rule_peer_set(rule, pol)
-                    pmask = (
-                        rule_port_mask(rule, atoms) if atoms else np.ones(1, dtype=bool)
-                    )
+                    pmask = rule_port_mask(rule, atoms)
                     ingress_allow |= (
                         srcs[:, None, None] & tgt[None, :, None] & pmask[None, None, :]
                     )
@@ -192,9 +190,7 @@ class CpuBackend(VerifierBackend):
             if affects_eg[pi] and pol.egress:
                 for rule in pol.egress:
                     dsts = rule_peer_set(rule, pol)
-                    pmask = (
-                        rule_port_mask(rule, atoms) if atoms else np.ones(1, dtype=bool)
-                    )
+                    pmask = rule_port_mask(rule, atoms)
                     egress_allow |= (
                         tgt[:, None, None] & dsts[None, :, None] & pmask[None, None, :]
                     )
@@ -224,7 +220,7 @@ class CpuBackend(VerifierBackend):
             config=config,
             reach=reach,
             reach_ports=reach_pq if config.compute_ports else None,
-            port_atoms=list(atoms) if atoms else [],
+            port_atoms=list(atoms) if config.compute_ports else [],
             src_sets=src_sets,
             dst_sets=dst_sets,
             selected=selected,

@@ -267,6 +267,14 @@ def _apply_rule(
         # broadcast ~arr across val: build einsum-style alignment via
         # transpose + expand. Using boolean algebra: val &= ~arr aligned.
         perm_letters = "".join(letters)
+        if len(set(perm_letters)) != len(perm_letters):
+            # repeated variable in a negated atom (e.g. not r(x, x)): the
+            # transpose/expand alignment below handles each letter once, so
+            # first collapse the repeated axes to their diagonal
+            uniq = "".join(dict.fromkeys(perm_letters))
+            # pure diagonal gather (no contraction axes) — works on bool
+            arr = xp.einsum(f"{perm_letters}->{uniq}", arr)
+            perm_letters = uniq
         # expand arr to the full var_order axes
         expand = [slice(None) if c in perm_letters else None for c in out_letters]
         order = [perm_letters.index(c) for c in out_letters if c in perm_letters]

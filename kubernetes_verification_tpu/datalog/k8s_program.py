@@ -194,6 +194,7 @@ def build_k8s_program(
             ip_rows = np.zeros((N, Q), dtype=bool)
             any_ip = False
             for ridx, rule in enumerate(rules or ()):
+                # ignores port specs when atoms == [ALL_ATOM] (ports off)
                 pmask = rule_port_mask(rule, atoms)
                 # per-rule port relation: one fact per covered atom
                 ports_rel = f"ports_{direction}_{i}_{ridx}"

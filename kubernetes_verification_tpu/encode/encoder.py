@@ -31,7 +31,7 @@ from ..models.core import (
     NetworkPolicy,
     Selector,
 )
-from .ports import compute_port_atoms, rule_port_mask
+from .ports import ALL_ATOM, compute_port_atoms, rule_port_mask
 from .vocab import Vocab
 
 __all__ = [
@@ -189,7 +189,6 @@ def _encode_grants(
     direction: str,
     atoms: Sequence[PortAtom],
     vocab: Vocab,
-    ignore_ports: bool = False,
 ) -> GrantBlock:
     pols: List[int] = []
     match_all: List[bool] = []
@@ -206,11 +205,8 @@ def _encode_grants(
         if not rules:
             continue
         for rule in rules:
-            pmask = (
-                np.ones(len(atoms), dtype=bool)
-                if ignore_ports
-                else rule_port_mask(rule, atoms)
-            )
+            # rule_port_mask ignores port specs when atoms == [ALL_ATOM]
+            pmask = rule_port_mask(rule, atoms)
             if rule.matches_all_peers:
                 pols.append(pi)
                 match_all.append(True)
@@ -269,7 +265,7 @@ def encode_cluster(
     atoms = (
         compute_port_atoms(cluster.policies)
         if compute_ports
-        else [PortAtom("ANY", 1, 65535)]
+        else [ALL_ATOM]
     )
     ns_index = cluster.namespace_index()
 
@@ -301,10 +297,10 @@ def encode_cluster(
             [pol.affects_egress for pol in cluster.policies], dtype=bool
         ),
         ingress=_encode_grants(
-            cluster, "ingress", atoms, vocab, ignore_ports=not compute_ports
+            cluster, "ingress", atoms, vocab
         ),
         egress=_encode_grants(
-            cluster, "egress", atoms, vocab, ignore_ports=not compute_ports
+            cluster, "egress", atoms, vocab
         ),
     )
 

@@ -93,8 +93,13 @@ def rule_port_mask(rule: Rule, atoms: Sequence[PortAtom]) -> np.ndarray:
 
     ``ports=None`` *and* ``ports=()`` both mean all ports — the k8s API says
     "if this field is empty or missing, this rule matches all traffic"
-    (mirrored for peers by ``Rule.matches_all_peers``)."""
-    if not rule.ports:
+    (mirrored for peers by ``Rule.matches_all_peers``).
+
+    When the port axis is the degenerate any-port axis (``[ALL_ATOM]``, i.e.
+    ``compute_ports=False``) port specs are IGNORED, not enforced: a concrete
+    spec tested against the ANY atom would yield an all-False row and silently
+    drop the grant. Centralised here so every emitter gets it right."""
+    if not rule.ports or (len(atoms) == 1 and atoms[0] == ALL_ATOM):
         return np.ones(len(atoms), dtype=bool)
     mask = np.zeros(len(atoms), dtype=bool)
     for q, atom in enumerate(atoms):

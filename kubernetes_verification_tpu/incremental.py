@@ -22,6 +22,7 @@ path); pod add/remove changes N and falls back to a rebuild.
 """
 from __future__ import annotations
 
+import dataclasses
 import time
 from functools import partial
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -89,7 +90,14 @@ class IncrementalVerifier:
     ) -> None:
         self.config = config or VerifyConfig()
         self.device = device or jax.devices()[0]
-        self.pods: List[Pod] = list(cluster.pods)
+        # deep-copy pods: update_pod_labels mutates labels in place, and the
+        # verifier must not silently rewrite the caller's Cluster
+        self.pods: List[Pod] = [
+            dataclasses.replace(
+                p, labels=dict(p.labels), container_ports=dict(p.container_ports)
+            )
+            for p in cluster.pods
+        ]
         self.namespaces = list(cluster.namespaces)
         self._ns_labels = {ns.name: ns.labels for ns in self.namespaces}
         self.policies: Dict[str, NetworkPolicy] = {}

@@ -159,17 +159,38 @@ def load_incremental(directory: str, config: Optional[VerifyConfig] = None,
 
     cluster, _ = load_cluster(os.path.join(directory, "cluster"))
     state_path = os.path.join(directory, "state.npz")
-    if config is None:
-        with np.load(state_path) as z:
-            config = VerifyConfig(**json.loads(bytes(z["__config__"]).decode()))
-    inc = IncrementalVerifier(
-        Cluster(pods=cluster.pods, namespaces=cluster.namespaces, policies=[]),
-        config,
-        device=device,
-    )
     with np.load(state_path) as z:
-        inc._ing_count = jnp.asarray(z["ing_count"])
-        inc._eg_count = jnp.asarray(z["eg_count"])
+        saved = json.loads(bytes(z["__config__"]).decode())
+        if config is None:
+            config = VerifyConfig(**saved)
+        else:
+            # The checkpointed counts were derived under the saved semantic
+            # flags; reinterpreting them under different flags is silent
+            # corruption. Only the backend/device choice may differ on resume.
+            mismatched = {
+                k: (saved[k], getattr(config, k))
+                for k in (
+                    "self_traffic",
+                    "default_allow_unselected",
+                    "direction_aware_isolation",
+                    "compute_ports",
+                    "closure",
+                )
+                if getattr(config, k) != saved[k]
+            }
+            if mismatched:
+                raise ValueError(
+                    "load_incremental: config overrides the checkpointed "
+                    f"semantic flags {mismatched}; resume with matching flags "
+                    "or re-verify from scratch"
+                )
+        inc = IncrementalVerifier(
+            Cluster(pods=cluster.pods, namespaces=cluster.namespaces, policies=[]),
+            config,
+            device=device,
+        )
+        inc._ing_count = jnp.asarray(z["ing_count"], device=inc.device)
+        inc._eg_count = jnp.asarray(z["eg_count"], device=inc.device)
         inc._ing_iso = z["ing_iso"].copy()
         inc._eg_iso = z["eg_iso"].copy()
         inc.update_count = int(z["update_count"])

@@ -206,3 +206,24 @@ def test_program_dump_names_reference_relations():
     text = prog.dump()
     for rel in ("selected", "ing_allow", "ingress_traffic", "edge", "path"):
         assert rel in text
+
+
+def test_negated_atom_with_repeated_variable():
+    # ADVICE r1: `not r(x, x)` must mask only the diagonal of r, not the
+    # whole relation — previously the expand/transpose alignment handled
+    # each letter once and masked everything.
+    prog = Program()
+    n = prog.domain("n", 4)
+    prog.relation("r", n, n)
+    prog.relation("is_n", n)
+    prog.relation("no_self", n)
+    prog.fact_array("is_n", np.ones(4, dtype=bool))
+    prog.fact("r", 1, 1)  # self-loop at 1
+    prog.fact("r", 2, 3)  # off-diagonal edge must NOT mask node 2
+    prog.rule(
+        Atom("no_self", ("x",)),
+        Atom("is_n", ("x",)),
+        Atom("r", ("x", "x"), negated=True),
+    )
+    sol = solve(prog)
+    np.testing.assert_array_equal(sol["no_self"], [True, False, True, True])

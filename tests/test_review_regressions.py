@@ -1,6 +1,7 @@
 """Regression tests for review findings: port-aware Datalog reach, config
 persistence in incremental checkpoints, and the zero-policy tiled path."""
 import numpy as np
+import pytest
 
 import kubernetes_verification_tpu as kv
 from kubernetes_verification_tpu.encode.encoder import encode_cluster
@@ -66,3 +67,35 @@ def test_tiled_zero_policies():
     assert got.to_bool().all()
     ref = kv.verify(cluster, kv.VerifyConfig(backend="cpu", compute_ports=False))
     np.testing.assert_array_equal(got.to_bool(), ref.reach)
+
+
+def test_incremental_does_not_mutate_caller_cluster():
+    # ADVICE r1: IncrementalVerifier must deep-copy pods; update_pod_labels
+    # previously mutated the caller's Pod objects in place.
+    pod = kv.Pod("a", "x", {"team": "blue"})
+    cluster = kv.Cluster(pods=[pod, kv.Pod("b", "x")])
+    inc = IncrementalVerifier(cluster, kv.VerifyConfig(compute_ports=False))
+    inc.update_pod_labels(0, {"team": "red"})
+    assert pod.labels == {"team": "blue"}
+
+
+def test_load_incremental_rejects_flag_flip(tmp_path):
+    # ADVICE r1: a resume with different semantic flags must raise instead of
+    # silently reinterpreting the checkpointed counts.
+    cluster = kv.Cluster(pods=[kv.Pod("a", "x"), kv.Pod("b", "x")])
+    cfg = kv.VerifyConfig(compute_ports=False, self_traffic=False)
+    inc = IncrementalVerifier(cluster, cfg)
+    save_incremental(inc, str(tmp_path / "c"))
+    with pytest.raises(ValueError, match="semantic flags"):
+        load_incremental(
+            str(tmp_path / "c"),
+            config=kv.VerifyConfig(compute_ports=False, self_traffic=True),
+        )
+    # identical flags (different backend) still resumes fine
+    resumed = load_incremental(
+        str(tmp_path / "c"),
+        config=kv.VerifyConfig(
+            backend="tpu", compute_ports=False, self_traffic=False
+        ),
+    )
+    assert resumed.config.backend == "tpu"
