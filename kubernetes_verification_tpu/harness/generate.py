@@ -62,6 +62,12 @@ class GeneratorConfig:
     p_explicit_policy_types: float = 0.2
     p_ipblock_peer: float = 0.05
     p_named_port: float = 0.05
+    #: size of the cluster-wide port-spec library rules draw from. Real
+    #: clusters reuse a small set of service ports (80/443/5432/...) rather
+    #: than minting a fresh range per rule; a bounded library keeps the number
+    #: of distinct port masks — and therefore the port-atom partition — at a
+    #: realistic scale. 0 restores the unbounded per-rule random ranges.
+    port_library_size: int = 12
     #: minimum matchLabels entries per random selector. The default 0 lets
     #: ~1/3 of selectors be empty (match-all) — fine for semantics fuzzing,
     #: degenerate for benchmarks (the reach matrix saturates); benchmarks use
@@ -122,13 +128,50 @@ def _rand_selector(rng: random.Random, pool: List[dict], cfg: GeneratorConfig) -
 _PORT_NAMES = ["http", "metrics", "grpc"]
 
 
-def _rand_ports(rng: random.Random, p_named: float = 0.0) -> Optional[Tuple[PortSpec, ...]]:
+def _port_library(rng: random.Random, size: int) -> List[PortSpec]:
+    """Deterministic cluster-wide pool of (protocol, port[, endPort]) specs.
+
+    Seeded with the common service ports; beyond those, adds random single
+    ports and a few ranges. Every rule's port list samples from this pool, so
+    the number of distinct port masks across the cluster stays bounded by the
+    library size — matching how real clusters reuse standard ports."""
+    base = [
+        PortSpec("TCP", 80),
+        PortSpec("TCP", 443),
+        PortSpec("TCP", 5432),
+        PortSpec("TCP", 6379),
+        PortSpec("TCP", 8080),
+        PortSpec("UDP", 53),
+        PortSpec("TCP", 8000, end_port=8999),  # app range
+        PortSpec("TCP", 30000, end_port=32767),  # nodeport range
+    ]
+    lib = base[: max(1, size)]
+    while len(lib) < size:
+        port = rng.randint(1024, 40000)
+        if rng.random() < 0.25:
+            lib.append(
+                PortSpec("TCP", port, end_port=port + rng.randint(10, 500))
+            )
+        else:
+            lib.append(PortSpec(rng.choice(["TCP", "UDP"]), port))
+    return lib
+
+
+def _rand_ports(
+    rng: random.Random,
+    p_named: float = 0.0,
+    library: Optional[List[PortSpec]] = None,
+) -> Optional[Tuple[PortSpec, ...]]:
     specs = []
     for _ in range(rng.randint(1, 2)):
-        proto = rng.choice(["TCP", "TCP", "UDP"])
         if rng.random() < p_named:
+            proto = rng.choice(["TCP", "TCP", "UDP"])
             specs.append(PortSpec(proto, rng.choice(_PORT_NAMES)))
             continue
+        if library is not None:
+            specs.append(rng.choice(library))
+            continue
+        proto = rng.choice(["TCP", "TCP", "UDP"])
         port = rng.choice([80, 443, 5432, 6379, 8080, 9000])
         if rng.random() < 0.3:
             specs.append(PortSpec(proto, port, end_port=port + rng.randint(1, 200)))
@@ -155,6 +198,11 @@ def random_cluster(cfg: Optional[GeneratorConfig] = None, **kw) -> Cluster:
     ]
     label_pool = [p.labels for p in pods]
     ns_pool = [ns.labels for ns in namespaces]
+    port_lib = (
+        _port_library(rng, cfg.port_library_size)
+        if cfg.port_library_size > 0
+        else None
+    )
 
     def rand_rule() -> Rule:
         if rng.random() < cfg.p_empty_rule:
@@ -180,7 +228,9 @@ def random_cluster(cfg: Optional[GeneratorConfig] = None, **kw) -> Cluster:
                 )
             )
         ports = (
-            _rand_ports(rng, cfg.p_named_port) if rng.random() < cfg.p_ports else None
+            _rand_ports(rng, cfg.p_named_port, port_lib)
+            if rng.random() < cfg.p_ports
+            else None
         )
         return Rule(peers=tuple(peers), ports=ports)
 

@@ -19,19 +19,35 @@ for the BASELINE north-star (100k pods / 10k policies < 5 s on one v5e-1,
   (``native/bitset.cpp``) and of the reference's bitarray matrix
   (``kano_py/kano/model.py:167-184``).
 
-Semantics are the ``compute_ports=False`` (any-port) mode of the other
-backends — port-atom reachability at this scale would need a per-atom pass
-(Q× the work); wire it through ``PackedReach`` consumers when needed.
+Port semantics (BASELINE config 4: "port-range bitmaps" at 100k scale) run
+through a **mask-group decomposition** instead of a per-atom pass (which
+would cost Q× the any-port work — Q can be hundreds of atoms):
+
+* grants group into *virtual policies* — distinct (policy, port-mask) pairs —
+  with the portless full-coverage mask split out as its own block;
+* the port conjunction ``∃q: ingress_q ∧ egress_q`` over nonnegative counts
+  equals ``Σ_{m1,m2} OV[m1,m2]·GI_m1·GE_m2 > 0`` where ``OV`` is the R×R
+  mask-overlap matrix — so R segmented int8 MXU dots (R = distinct *ported*
+  masks, total contraction rows ≈ the virtual-policy count) replace Q dense
+  passes, and the full-mask block collapses to ``GI_full ∧ GE_any`` /
+  ``GI_any ∧ GE_full`` terms;
+* segment bounds are host-computed Python ints baked in as static args —
+  exact-shape ``lax.slice`` dots, no padding waste, no dynamic-shape fallout.
+
+The reference parsed ports and silently dropped them
+(``kubesv/kubesv/model.py:365-385``, missing return); here they survive to
+the 100k-pod flagship path.
 
 Queries run directly on the packed form with ``lax.population_count`` /
 word-wise AND-OR, never unpacking the full matrix.
 """
 from __future__ import annotations
 
+import dataclasses
 import math
 from dataclasses import dataclass
 from functools import partial
-from typing import List, Optional, Tuple
+from typing import List, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -45,6 +61,10 @@ __all__ = ["PackedReach", "tiled_k8s_reach", "pack_bool_cols", "unpack_cols"]
 _I8 = jnp.int8
 _I32 = jnp.int32
 _U32 = jnp.uint32
+
+#: byte budget for the port path's per-tile mask slabs (R bool [N, tile]
+#: planes); bounds the dst-tile size via R·N·tile ≤ budget
+_PORT_SLAB_BUDGET = int(1.2e9)
 
 
 def pack_bool_cols(tile: jnp.ndarray) -> jnp.ndarray:
@@ -88,6 +108,60 @@ def _grant_peers_full(
     return ok | block.match_all[:, None]
 
 
+def _select_maps(
+    pod_kv, pod_key, pod_ns, pol_sel, pol_ns, aff_ing, aff_eg,
+    direction_aware_isolation: bool,
+):
+    """Shared prologue of both tiled kernels: ``selected_by_pol`` as int8
+    [P, N], its per-direction variants, and the isolation vectors."""
+    selected8 = (
+        match_selectors(pol_sel, pod_kv, pod_key)
+        & (pol_ns[:, None] == pod_ns[None, :])
+    ).astype(_I8)
+    if direction_aware_isolation:
+        sel_ing8 = selected8 * aff_ing.astype(_I8)[:, None]
+        sel_eg8 = selected8 * aff_eg.astype(_I8)[:, None]
+    else:
+        sel_ing8 = selected8
+        sel_eg8 = selected8
+    # .any over the policy axis (works for P == 0, unlike .max)
+    ing_iso = (sel_ing8 > 0).any(axis=0)
+    eg_iso = (sel_eg8 > 0).any(axis=0)
+    return selected8, sel_ing8, sel_eg8, ing_iso, eg_iso
+
+
+def _peers_by_slot(
+    block: GrantBlock,
+    slots,
+    total: int,
+    chunk: int,
+    pod_kv, pod_key, ns_kv, ns_key, pod_ns, pol_ns,
+) -> jnp.ndarray:
+    """int8 [total, N]: OR of each slot's grant peer rows, computed in
+    G-chunks so no [G, N] array is ever resident (at 100k pods a full peer
+    matrix alone would be several GB). The slot axis is the policy axis for
+    the any-port kernel and the virtual-policy axis for the port kernel."""
+    N = pod_kv.shape[0]
+    G = block.pol.shape[0]
+    acc = jnp.zeros((total, N), dtype=_I8)
+    if G == 0:
+        return acc
+    n_chunks = G // chunk
+
+    def body(i, acc):
+        blk = jax.tree.map(
+            lambda x: jax.lax.dynamic_slice_in_dim(x, i * chunk, chunk, 0),
+            block,
+        )
+        sl = jax.lax.dynamic_slice_in_dim(slots, i * chunk, chunk, 0)
+        peers = _grant_peers_full(
+            blk, pod_kv, pod_key, ns_kv, ns_key, pod_ns, pol_ns
+        )
+        return acc.at[sl].max(peers.astype(_I8))
+
+    return jax.lax.fori_loop(0, n_chunks, body, acc)
+
+
 @partial(
     jax.jit,
     static_argnames=(
@@ -125,41 +199,18 @@ def _tiled_step(
     n_tiles = N // tile
     W = N // 32
 
-    selected8 = (
-        match_selectors(pol_sel, pod_kv, pod_key)
-        & (pol_ns[:, None] == pod_ns[None, :])
-    ).astype(_I8)
-    if direction_aware_isolation:
-        sel_ing8 = selected8 * aff_ing.astype(_I8)[:, None]
-        sel_eg8 = selected8 * aff_eg.astype(_I8)[:, None]
-    else:
-        sel_ing8 = selected8
-        sel_eg8 = selected8
-    # .any over the policy axis (works for P == 0, unlike .max)
-    ing_iso = (sel_ing8 > 0).any(axis=0)
-    eg_iso = (sel_eg8 > 0).any(axis=0)
+    selected8, sel_ing8, sel_eg8, ing_iso, eg_iso = _select_maps(
+        pod_kv, pod_key, pod_ns, pol_sel, pol_ns, aff_ing, aff_eg,
+        direction_aware_isolation,
+    )
 
     def peers_by_policy(block: GrantBlock) -> jnp.ndarray:
-        """int8 [P, N]: OR of each policy's grant peer rows, computed in
-        G-chunks so no [G, N] array is ever resident (at 100k pods a full
-        peer matrix alone would be several GB)."""
-        G = block.pol.shape[0]
-        acc = jnp.zeros((P + 1, N), dtype=_I8)
-        if G == 0:
-            return acc[:P]
-        n_chunks = G // chunk
-
-        def body(i, acc):
-            blk = jax.tree.map(
-                lambda x: jax.lax.dynamic_slice_in_dim(x, i * chunk, chunk, 0),
-                block,
-            )
-            peers = _grant_peers_full(
-                blk, pod_kv, pod_key, ns_kv, ns_key, pod_ns, pol_ns
-            )
-            return acc.at[blk.pol].max(peers.astype(_I8))
-
-        return jax.lax.fori_loop(0, n_chunks, body, acc)[:P]
+        """int8 [P, N]: OR of each policy's grant peer rows (the slot axis is
+        the policy axis, with the sink row P trimmed)."""
+        return _peers_by_slot(
+            block, block.pol, P + 1, chunk,
+            pod_kv, pod_key, ns_kv, ns_key, pod_ns, pol_ns,
+        )[:P]
 
     ing_by_pol = peers_by_policy(ingress)  # int8 [P, N] (src side)
     eg_by_pol = peers_by_policy(egress)  # int8 [P, N] (dst side)
@@ -211,6 +262,305 @@ def _tiled_step(
 
     out = jnp.zeros((N, W), dtype=_U32)
     out = jax.lax.fori_loop(0, n_tiles, body, out)
+    out &= col_mask[None, :]
+    return out, ing_iso, eg_iso, selected8 > 0
+
+
+def _split_grant_ports(block: GrantBlock) -> GrantBlock:
+    """Split each grant's port mask into maximal consecutive atom *runs*,
+    duplicating the grant row once per run.
+
+    Exact by union semantics: ``allow = ∨_g peers_g ∧ ports_g`` is unchanged
+    under any partition of a grant's atom set. Runs matter because the number
+    of *distinct* run masks across a cluster tracks the distinct port
+    *specs* (each spec covers one contiguous atom interval), while raw rule
+    masks combine specs multiplicatively — e.g. 12 library specs drawn 1-2
+    per rule give ~150 distinct pair masks but only ~15 runs. The mask-group
+    kernel's cost scales with the distinct-mask count R, so this is the
+    difference between R² combine work that fits the VPU budget and one that
+    dominates the solve."""
+    ports = np.asarray(block.ports)
+    G, Q = ports.shape
+    full = ports.all(axis=1)
+    # run starts: True cell whose predecessor is False
+    starts = ports & ~np.concatenate(
+        [np.zeros((G, 1), dtype=bool), ports[:, :-1]], axis=1
+    )
+    n_runs = np.where(full, 1, starts.sum(axis=1))  # full masks stay whole
+    if (n_runs <= 1).all():
+        return block
+    rows: List[int] = []
+    masks: List[np.ndarray] = []
+    for g in range(G):
+        if full[g] or n_runs[g] <= 1:
+            rows.append(g)
+            masks.append(ports[g])
+            continue
+        for lo in np.nonzero(starts[g])[0]:
+            hi = lo
+            while hi + 1 < Q and ports[g, hi + 1]:
+                hi += 1
+            m = np.zeros(Q, dtype=bool)
+            m[lo : hi + 1] = True
+            rows.append(g)
+            masks.append(m)
+    rows_a = np.asarray(rows)
+    out = jax.tree.map(lambda x: np.asarray(x)[rows_a], block)
+    return dataclasses.replace(out, ports=np.asarray(masks))
+
+
+class PortLayout(NamedTuple):
+    """Static virtual-policy layout for the port-aware tiled path.
+
+    Hashable (all Python ints / nested tuples) so it can be a ``jit`` static
+    argument: segment bounds become exact-shape ``lax.slice`` calls.
+
+    Compact VP axis layout per direction: ``[ported segments | full block |
+    sink row]``. ``seg`` holds one ``(start, length)`` per ported mask (same
+    mask order as ``ov_rows``); ``full`` is the ``(start, length)`` of the
+    full-coverage (portless) block. ``ov_rows[m1]`` lists the ported masks
+    overlapping ported mask ``m1`` (from the mask-overlap matrix)."""
+
+    seg_i: Tuple[Tuple[int, int], ...]
+    seg_e: Tuple[Tuple[int, int], ...]
+    full_i: Tuple[int, int]
+    full_e: Tuple[int, int]
+    ov_rows: Tuple[Tuple[int, ...], ...]
+
+    @property
+    def n_masks(self) -> int:
+        return len(self.ov_rows)
+
+
+def _build_port_layout(
+    ing_ports: np.ndarray,  # bool [Gi, Q]
+    eg_ports: np.ndarray,  # bool [Ge, Q]
+    ing_pol: np.ndarray,  # int32 [Gi]
+    eg_pol: np.ndarray,  # int32 [Ge]
+    sink_pol: int,
+) -> Tuple[PortLayout, np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Group grants into (policy, port-mask) virtual policies.
+
+    Returns ``(layout, vp_pol_i, vp_slot_i, vp_pol_e, vp_slot_e)`` where
+    ``vp_pol_*[row]`` is the policy of each compact VP row (sink rows map to
+    ``sink_pol``) and ``vp_slot_*[g]`` sends grant ``g`` to its VP row.
+    Empty-mask grants (inert padding) go to the sink row. Segments are padded
+    to a multiple of 8 with inert rows so dot shapes stay MXU-friendly."""
+    all_ports = np.concatenate([ing_ports, eg_ports], axis=0)
+    masks, inverse = np.unique(all_ports, axis=0, return_inverse=True)
+    full_ids = np.nonzero(masks.all(axis=1))[0]
+    empty_ids = np.nonzero(~masks.any(axis=1))[0]
+    full_id = int(full_ids[0]) if full_ids.size else -1
+    empty_id = int(empty_ids[0]) if empty_ids.size else -2
+    ported = [
+        m for m in range(masks.shape[0]) if m not in (full_id, empty_id)
+    ]
+    rank = {m: r for r, m in enumerate(ported)}
+    pm = masks[ported].astype(np.int64)  # [R, Q]
+    ov = (pm @ pm.T) > 0 if ported else np.zeros((0, 0), dtype=bool)
+    ov_rows = tuple(
+        tuple(int(j) for j in np.nonzero(ov[r])[0]) for r in range(len(ported))
+    )
+
+    # mask-id → bucket lookup: ported mask rank r, then full (R), sink (R+1)
+    R = len(ported)
+    bucket_of_mask = np.full(masks.shape[0], R + 1, dtype=np.int64)
+    for m, r in rank.items():
+        bucket_of_mask[m] = r
+    if full_id >= 0:
+        bucket_of_mask[full_id] = R
+
+    def one_direction(ports, pol, mask_ids):
+        bucket = bucket_of_mask[mask_ids]
+        keys = bucket * (sink_pol + 1) + pol  # unique (bucket, pol) id
+        uniq, slot_of_grant = np.unique(keys, return_inverse=True)
+        vp_bucket = uniq // (sink_pol + 1)
+        vp_pols = uniq % (sink_pol + 1)
+        # compact layout: ported segments (each padded to %8), full, sink
+        seg: List[Tuple[int, int]] = []
+        vp_pol_rows: List[int] = []
+        row_of_vp = np.empty(len(uniq), dtype=np.int64)
+        for r in range(R):
+            members = np.nonzero(vp_bucket == r)[0]
+            start = len(vp_pol_rows)
+            for u in members:
+                row_of_vp[u] = len(vp_pol_rows)
+                vp_pol_rows.append(int(vp_pols[u]))
+            length = len(members)
+            pad = (-length) % 8 if length else 0
+            vp_pol_rows.extend([sink_pol] * pad)
+            seg.append((start, length + pad))
+        full_members = np.nonzero(vp_bucket == R)[0]
+        full_start = len(vp_pol_rows)
+        for u in full_members:
+            row_of_vp[u] = len(vp_pol_rows)
+            vp_pol_rows.append(int(vp_pols[u]))
+        pad = (-len(full_members)) % 8 if len(full_members) else 0
+        vp_pol_rows.extend([sink_pol] * pad)
+        full = (full_start, len(full_members) + pad)
+        sink_row = len(vp_pol_rows)
+        for u in np.nonzero(vp_bucket == R + 1)[0]:
+            row_of_vp[u] = sink_row
+        vp_pol_rows.append(sink_pol)
+        vp_slot = row_of_vp[slot_of_grant].astype(np.int32)
+        return (
+            tuple(seg),
+            full,
+            np.asarray(vp_pol_rows, dtype=np.int32),
+            vp_slot,
+        )
+
+    gi = len(ing_pol)
+    seg_i, full_i, vp_pol_i, vp_slot_i = one_direction(
+        ing_ports, ing_pol, inverse[:gi]
+    )
+    seg_e, full_e, vp_pol_e, vp_slot_e = one_direction(
+        eg_ports, eg_pol, inverse[gi:]
+    )
+    layout = PortLayout(
+        seg_i=seg_i, seg_e=seg_e, full_i=full_i, full_e=full_e,
+        ov_rows=ov_rows,
+    )
+    return layout, vp_pol_i, vp_slot_i, vp_pol_e, vp_slot_e
+
+
+def _dot_lnt(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """int8 [L, N] × int8 [L, T] → int32 [N, T] (contract the VP axis)."""
+    return jax.lax.dot_general(
+        a, b, (((0,), (0,)), ((), ())), preferred_element_type=_I32
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "layout",
+        "tile",
+        "chunk",
+        "self_traffic",
+        "default_allow_unselected",
+        "direction_aware_isolation",
+    ),
+)
+def _tiled_ports_step(
+    pod_kv,
+    pod_key,
+    pod_ns,
+    ns_kv,
+    ns_key,
+    pol_sel: SelectorEnc,
+    pol_ns,
+    aff_ing,
+    aff_eg,
+    ingress: GrantBlock,
+    egress: GrantBlock,
+    vp_pol_i,  # int32 [total_i]
+    vp_slot_i,  # int32 [Gi_pad]
+    vp_pol_e,
+    vp_slot_e,
+    col_mask,  # uint32 [W]
+    *,
+    layout: PortLayout,
+    tile: int,
+    chunk: int,
+    self_traffic: bool,
+    default_allow_unselected: bool,
+    direction_aware_isolation: bool,
+):
+    """Port-aware tiled reachability (see module docstring for the math).
+
+    ``reach[s,d] = ∨_q (GI_q ∨ DI)[s,d] ∧ (GE_q ∨ DE)[s,d]`` expands to
+    ``(DI∧DE) ∨ (DI∧GE_any) ∨ (DE∧GI_any) ∨ (∃q: GI_q∧GE_q)`` since the
+    default-allow terms cover every atom; the grant-grant conjunction runs
+    per mask group with the overlap matrix folded in statically."""
+    N = pod_kv.shape[0]
+    P = pol_ns.shape[0]
+    n_tiles = N // tile
+    W = N // 32
+    R = layout.n_masks
+
+    selected8, sel_ing8, sel_eg8, ing_iso, eg_iso = _select_maps(
+        pod_kv, pod_key, pod_ns, pol_sel, pol_ns, aff_ing, aff_eg,
+        direction_aware_isolation,
+    )
+    # sink policy row (index P) selects nothing
+    zrow = jnp.zeros((1, N), dtype=_I8)
+    sel_ing_ext = jnp.concatenate([sel_ing8, zrow], axis=0)  # [P+1, N]
+    sel_eg_ext = jnp.concatenate([sel_eg8, zrow], axis=0)
+
+    total_i = vp_pol_i.shape[0]
+    total_e = vp_pol_e.shape[0]
+    vp_peers_i = _peers_by_slot(
+        ingress, vp_slot_i, total_i, chunk,
+        pod_kv, pod_key, ns_kv, ns_key, pod_ns, pol_ns,
+    )
+    vp_peers_e = _peers_by_slot(
+        egress, vp_slot_e, total_e, chunk,
+        pod_kv, pod_key, ns_kv, ns_key, pod_ns, pol_ns,
+    )
+    # egress src-side operand, pre-gathered once: row v = selected-by-pol(v)
+    sel_eg_vp = sel_eg_ext[vp_pol_e]  # int8 [total_e, N]
+
+    fs_i, fl_i = layout.full_i
+    fs_e, fl_e = layout.full_e
+
+    def tile_body(t, out):
+        d0 = t * tile
+        sel_ing_t = jax.lax.dynamic_slice(sel_ing_ext, (0, d0), (P + 1, tile))
+        vpe_t = jax.lax.dynamic_slice(vp_peers_e, (0, d0), (total_e, tile))
+        false_t = jnp.zeros((N, tile), dtype=bool)
+
+        def ing_dot(start: int, length: int) -> jnp.ndarray:
+            """GI of one VP row range: counts[s, d_t] > 0."""
+            a = jax.lax.slice(vp_peers_i, (start, 0), (start + length, N))
+            idx = jax.lax.slice(vp_pol_i, (start,), (start + length,))
+            return _dot_lnt(a, sel_ing_t[idx]) > 0
+
+        def eg_dot(start: int, length: int) -> jnp.ndarray:
+            a = jax.lax.slice(sel_eg_vp, (start, 0), (start + length, N))
+            b = jax.lax.slice(vpe_t, (start, 0), (start + length, tile))
+            return _dot_lnt(a, b) > 0
+
+        gi_full = ing_dot(fs_i, fl_i) if fl_i else false_t
+        ge_full = eg_dot(fs_e, fl_e) if fl_e else false_t
+
+        # ported slabs — exact-shape dots per mask (statically unrolled)
+        ge_m = [
+            eg_dot(s, l) if l else false_t for (s, l) in layout.seg_e
+        ]
+        gi_any = gi_full
+        ge_any = ge_full
+        for m in range(R):
+            ge_any = ge_any | ge_m[m]
+        conj = false_t
+        for m1 in range(R):
+            s, l = layout.seg_i[m1]
+            if not l:
+                continue
+            gi = ing_dot(s, l)
+            gi_any = gi_any | gi
+            # egress grants on any overlapping ported mask, or the full block
+            comp = ge_full
+            for m2 in layout.ov_rows[m1]:
+                comp = comp | ge_m[m2]
+            conj = conj | (gi & comp)
+        # full-mask ingress overlaps every egress mask
+        conj = conj | (gi_full & ge_any) | (gi_any & ge_full)
+
+        r = conj
+        if default_allow_unselected:
+            di = ~jax.lax.dynamic_slice(ing_iso, (d0,), (tile,))  # [T]
+            de = ~eg_iso[:, None]  # [N, 1]
+            r = r | (di[None, :] & de) | (di[None, :] & ge_any) | (de & gi_any)
+        if self_traffic:
+            r = r | (
+                jnp.arange(N)[:, None] == (d0 + jnp.arange(tile))[None, :]
+            )
+        packed = pack_bool_cols(r)
+        return jax.lax.dynamic_update_slice(out, packed, (0, d0 // 32))
+
+    out = jnp.zeros((N, W), dtype=_U32)
+    out = jax.lax.fori_loop(0, n_tiles, tile_body, out)
     out &= col_mask[None, :]
     return out, ing_iso, eg_iso, selected8 > 0
 
@@ -267,7 +617,10 @@ def tiled_k8s_reach(
     use_pallas: bool = False,
 ) -> PackedReach:
     """Host wrapper: pad N to a tile multiple, run the jitted tiled step,
-    trim. Semantics = ``compute_ports=False`` mode of the other backends.
+    trim. With a multi-atom encoding (``encode_cluster(compute_ports=True)``
+    and at least one rule naming ports) the port-aware mask-group kernel
+    runs; otherwise the any-port kernel (identical semantics to
+    ``compute_ports=False`` on the other backends).
 
     ``fetch=False`` leaves the packed matrix on device (``PackedReach.packed``
     is a JAX array; force with ``np.asarray`` when needed) and synchronises on
@@ -279,6 +632,37 @@ def tiled_k8s_reach(
     from ..parallel.sharded_ops import pad_grants
 
     n = enc.n_pods
+    with_ports = len(enc.atoms) > 1
+    if with_ports and use_pallas:
+        raise ValueError(
+            "use_pallas supports the any-port path only; encode with "
+            "compute_ports=False or drop use_pallas"
+        )
+    ing_block, eg_block = enc.ingress, enc.egress
+    if with_ports:
+        # run-split the grant masks first (see _split_grant_ports): the
+        # distinct-mask count R after splitting tracks the distinct port
+        # specs, not their combinations
+        ing_block = _split_grant_ports(ing_block)
+        eg_block = _split_grant_ports(eg_block)
+        all_masks = {
+            m
+            for m in map(
+                tuple, np.concatenate([ing_block.ports, eg_block.ports], 0)
+            )
+            if any(m) and not all(m)
+        }
+        R = max(1, len(all_masks))
+        # per-tile memory: R ported egress slabs of [N, tile] bools plus the
+        # packed output — shrink the dst tile to keep the slabs bounded.
+        # NOTE the cap does not bound the three resident [total_vp, N] int8
+        # operands (vp peer maps + gathered egress selection); those scale
+        # with the virtual-policy count (~2 GB each at 100k pods / 10k
+        # policies) and are the port path's memory floor.
+        cap = max(
+            128, (_PORT_SLAB_BUDGET // max(R * max(n, 1), 1)) // 128 * 128
+        )
+        tile = min(tile, cap)
     tile = max(32, min(tile, 1 << 20))
     if tile % 32:
         raise ValueError("tile must be a multiple of 32")
@@ -293,10 +677,10 @@ def tiled_k8s_reach(
     # pad the grant axis to a chunk multiple with inert sink-policy rows
     P = enc.n_policies
     ingress = pad_grants(
-        enc.ingress, (chunk - enc.ingress.n % chunk) % chunk, P, n_pad
+        ing_block, (chunk - ing_block.n % chunk) % chunk, P, n_pad
     )
     egress = pad_grants(
-        enc.egress, (chunk - enc.egress.n % chunk) % chunk, P, n_pad
+        eg_block, (chunk - eg_block.n % chunk) % chunk, P, n_pad
     )
     # mask for padded dst bits
     col_valid = np.zeros(Np, dtype=bool)
@@ -304,7 +688,7 @@ def tiled_k8s_reach(
     col_mask = np.packbits(col_valid, bitorder="little").view("<u4").copy()
 
     t0 = time.perf_counter()
-    args = (
+    common = (
         pod_kv,
         pod_key,
         pod_ns,
@@ -316,19 +700,40 @@ def tiled_k8s_reach(
         enc.pol_affects_egress,
         ingress,
         egress,
-        col_mask,
     )
-    if device is not None:
-        args = jax.device_put(args, device)
-    packed, ing_iso, eg_iso, selected = _tiled_step(
-        *args,
-        tile=tile,
-        chunk=chunk,
-        use_pallas=use_pallas,
-        self_traffic=self_traffic,
-        default_allow_unselected=default_allow_unselected,
-        direction_aware_isolation=direction_aware_isolation,
-    )
+    if with_ports:
+        layout, vp_pol_i, vp_slot_i, vp_pol_e, vp_slot_e = _build_port_layout(
+            np.asarray(ingress.ports),
+            np.asarray(egress.ports),
+            np.asarray(ingress.pol),
+            np.asarray(egress.pol),
+            sink_pol=P,
+        )
+        args = (*common, vp_pol_i, vp_slot_i, vp_pol_e, vp_slot_e, col_mask)
+        if device is not None:
+            args = jax.device_put(args, device)
+        packed, ing_iso, eg_iso, selected = _tiled_ports_step(
+            *args,
+            layout=layout,
+            tile=tile,
+            chunk=chunk,
+            self_traffic=self_traffic,
+            default_allow_unselected=default_allow_unselected,
+            direction_aware_isolation=direction_aware_isolation,
+        )
+    else:
+        args = (*common, col_mask)
+        if device is not None:
+            args = jax.device_put(args, device)
+        packed, ing_iso, eg_iso, selected = _tiled_step(
+            *args,
+            tile=tile,
+            chunk=chunk,
+            use_pallas=use_pallas,
+            self_traffic=self_traffic,
+            default_allow_unselected=default_allow_unselected,
+            direction_aware_isolation=direction_aware_isolation,
+        )
     if fetch:
         packed_out = np.asarray(packed[:n])
         label = "solve+fetch"

@@ -89,3 +89,111 @@ def test_packed_queries_and_point_lookup():
         np.testing.assert_array_equal(got.row(s), ref.reach[s])
         for d in range(0, 37, 5):
             assert got.reachable(s, d) == bool(ref.reach[s, d])
+
+
+# ---------------------------------------------------------------------------
+# port-aware path (mask-group decomposition)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", [1, 2, 5])
+def test_ports_matches_cpu_oracle(seed):
+    """The flagship port-aware kernel vs the CPU oracle: reach under full
+    port-conjunction semantics must agree bit-for-bit."""
+    cluster = random_cluster(
+        GeneratorConfig(
+            n_pods=71, n_policies=19, n_namespaces=3, p_ports=0.7,
+            p_named_port=0.2, seed=seed,
+        )
+    )
+    ref = kv.verify(cluster, kv.VerifyConfig(backend="cpu", compute_ports=True))
+    enc = encode_cluster(cluster, compute_ports=True)
+    assert len(enc.atoms) > 1  # the port path must actually engage
+    got = tiled_k8s_reach(enc, tile=32, chunk=8)
+    np.testing.assert_array_equal(got.to_bool(), ref.reach)
+    np.testing.assert_array_equal(got.ingress_isolated, ref.ingress_isolated)
+    np.testing.assert_array_equal(got.egress_isolated, ref.egress_isolated)
+
+
+@pytest.mark.parametrize(
+    "flags",
+    [
+        dict(self_traffic=False),
+        dict(default_allow_unselected=False),
+        dict(direction_aware_isolation=False),
+    ],
+)
+def test_ports_semantic_flags(flags):
+    cluster = random_cluster(
+        GeneratorConfig(
+            n_pods=43, n_policies=11, n_namespaces=2, p_ports=0.8, seed=13
+        )
+    )
+    ref = kv.verify(
+        cluster, kv.VerifyConfig(backend="cpu", compute_ports=True, **flags)
+    )
+    enc = encode_cluster(cluster, compute_ports=True)
+    got = tiled_k8s_reach(enc, tile=32, chunk=8, **flags)
+    np.testing.assert_array_equal(got.to_bool(), ref.reach)
+
+
+def test_ports_conjunction_disjoint():
+    """Two pods whose only grants are on disjoint ports must NOT reach — the
+    ∃q conjunction, not (∃q ingress) ∧ (∃q egress)."""
+    a = kv.Pod("a", "ns1", {"r": "a"})
+    b = kv.Pod("b", "ns1", {"r": "b"})
+    p1 = kv.NetworkPolicy(
+        "p1", namespace="ns1", pod_selector=kv.Selector({"r": "b"}),
+        ingress=(kv.Rule(peers=(kv.Peer(pod_selector=kv.Selector({"r": "a"})),),
+                         ports=(kv.PortSpec("TCP", 80),)),),
+    )
+    p2 = kv.NetworkPolicy(
+        "p2", namespace="ns1", pod_selector=kv.Selector({"r": "a"}),
+        policy_types=("Egress",),
+        egress=(kv.Rule(peers=(kv.Peer(pod_selector=kv.Selector({"r": "b"})),),
+                        ports=(kv.PortSpec("TCP", 443),)),),
+    )
+    cluster = kv.Cluster(pods=[a, b], policies=[p1, p2])
+    enc = encode_cluster(cluster, compute_ports=True)
+    got = tiled_k8s_reach(enc, tile=32, chunk=8)
+    assert not got.reachable(0, 1)
+    # overlapping ports (same spec both sides) → reachable
+    p2b = kv.NetworkPolicy(
+        "p2", namespace="ns1", pod_selector=kv.Selector({"r": "a"}),
+        policy_types=("Egress",),
+        egress=(kv.Rule(peers=(kv.Peer(pod_selector=kv.Selector({"r": "b"})),),
+                        ports=(kv.PortSpec("TCP", 80),)),),
+    )
+    enc2 = encode_cluster(
+        kv.Cluster(pods=[a, b], policies=[p1, p2b]), compute_ports=True
+    )
+    got2 = tiled_k8s_reach(enc2, tile=32, chunk=8)
+    assert got2.reachable(0, 1)
+
+
+def test_ports_range_overlap():
+    """Range specs: egress grants 8000-8999, ingress grants the single port
+    8080 → overlap; ingress on 9100 → no overlap."""
+    a = kv.Pod("a", "ns1", {"r": "a"})
+    b = kv.Pod("b", "ns1", {"r": "b"})
+
+    def mk(ing_port, end=None):
+        p1 = kv.NetworkPolicy(
+            "p1", namespace="ns1", pod_selector=kv.Selector({"r": "b"}),
+            ingress=(kv.Rule(
+                peers=(kv.Peer(pod_selector=kv.Selector({"r": "a"})),),
+                ports=(kv.PortSpec("TCP", ing_port, end_port=end),)),),
+        )
+        p2 = kv.NetworkPolicy(
+            "p2", namespace="ns1", pod_selector=kv.Selector({"r": "a"}),
+            policy_types=("Egress",),
+            egress=(kv.Rule(
+                peers=(kv.Peer(pod_selector=kv.Selector({"r": "b"})),),
+                ports=(kv.PortSpec("TCP", 8000, end_port=8999),)),),
+        )
+        return kv.Cluster(pods=[a, b], policies=[p1, p2])
+
+    enc = encode_cluster(mk(8080), compute_ports=True)
+    assert tiled_k8s_reach(enc, tile=32, chunk=8).reachable(0, 1)
+    enc = encode_cluster(mk(9100), compute_ports=True)
+    assert not tiled_k8s_reach(enc, tile=32, chunk=8).reachable(0, 1)
