@@ -1,0 +1,417 @@
+"""Sharded *packed* reachability — BASELINE config 5's solve core.
+
+The dense sharded kernel (``sharded_ops.py``) materialises per-device
+``[n_loc, N·Q]`` float count tiles and an ``[N, N]`` bool output — fine to
+~20k pods, impossible at 1M. This module composes the packed tiled design
+(``ops/tiled.py``) with the ``(pods, grants)`` mesh (``parallel/mesh.py``):
+
+* each device owns a block of ``n_loc = N/dp`` **source rows** end-to-end
+  (matching the reference's row-major matrix orientation,
+  ``kano_py/kano/model.py:158-163``);
+* per-policy peer maps are built from the device's **grant slice** against its
+  pod block and OR-combined with one int8 ``psum`` over the ``grants`` axis;
+* the destination axis is swept in tiles: the tile owner broadcasts its
+  ``[P, T]`` selection/peer slices (a masked contribution + ``psum`` over
+  ``pods`` — rides ICI), every device contracts its resident src-side
+  operands against them on the MXU, packs the resulting ``[n_loc, T]`` bool
+  block to uint32 words, and folds aggregates;
+* devices on the ``grants`` axis take dst tiles round-robin — their packed
+  words and aggregate partials cover disjoint tiles, so a final ``psum``
+  doubles as the bitwise OR.
+
+Memory per device at the BASELINE config (1M pods / 50k policies / v5e-8,
+``dp=8``): the two src-side int8 operands (``ing_by_pol``, ``sel_eg``) are
+``P × n_loc`` = 6.25 GB each; the two dst-side arrays (``sel_ing``,
+``eg_by_pol``) are kept **bit-packed** (``P × n_loc/8`` = 0.78 GB each) and
+only their owned ``[P, T]`` tile is unpacked at broadcast time — ~14 GB
+resident of a v5e's 16 GB HBM. The 1M×1M packed matrix itself (125 GB — 15.6
+GB/device) is *not* materialised: the solve streams dst tiles and keeps
+aggregates (out/in-degree, pair totals, isolation vectors); pass
+``keep_matrix=True`` only at scales where ``N·N/8/dp`` fits.
+
+The dst sweep runs in **stripes** (static tile ranges): a full solve sweeps
+all stripes; ``__graft_entry__.dryrun_multichip`` validates the 1M-pod shape
+by compiling the full-scale kernel and executing one stripe (the 2000-tile
+full sweep is ~1e17 MACs — a real v5e-8 job, not a CPU dryrun); callers can
+checkpoint between stripes (SURVEY.md §5.4).
+
+Semantics are the any-port mode (``compute_ports=False``), differentially
+tested against the CPU oracle at small N on the same virtual mesh.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from functools import partial
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..encode.encoder import EncodedCluster, GrantBlock
+from ..ops.match import match_selectors
+from ..ops.reach import _grant_peers
+from ..ops.tiled import pack_bool_cols, unpack_cols
+from .mesh import GRANT_AXIS, POD_AXIS, pad_amount
+from .sharded_ops import _grant_pspecs, _specs_like, pad_grants, pad_pods
+
+__all__ = ["PackedShardedResult", "sharded_packed_reach"]
+
+_I8 = jnp.int8
+_I32 = jnp.int32
+_U32 = jnp.uint32
+_U8 = jnp.uint8
+
+
+def _pack_rows_u8(a: jnp.ndarray) -> jnp.ndarray:
+    """bool [P, C] (C % 8 == 0) → uint8 [P, C/8], bit j of byte b = col b*8+j."""
+    p, c = a.shape
+    w = a.reshape(p, c // 8, 8).astype(_U8)
+    weights = (jnp.uint8(1) << jnp.arange(8, dtype=_U8))[None, None, :]
+    return (w * weights).sum(axis=-1, dtype=_U8)
+
+
+def _unpack_cols_u8(packed: jnp.ndarray, start: int, width: int) -> jnp.ndarray:
+    """uint8 [P, C/8] → int8 [P, width] slice of the unpacked columns.
+
+    ``start`` may be traced (dynamic slice); ``width`` is static and must be
+    a multiple of 8."""
+    p = packed.shape[0]
+    sl = jax.lax.dynamic_slice(packed, (0, start // 8), (p, width // 8))
+    bits = jnp.arange(8, dtype=_U8)[None, None, :]
+    out = (sl[:, :, None] >> bits) & jnp.uint8(1)
+    return out.reshape(p, width).astype(_I8)
+
+
+@dataclass
+class PackedShardedResult:
+    """Aggregate outputs of a sharded packed solve (+ the packed matrix when
+    ``keep_matrix``).
+
+    ``full_sweep`` records whether the solve covered every dst tile. Partial
+    (striped) results expose their aggregate *partials* — a checkpointed
+    sweep sums them across stripes — but the whole-matrix queries refuse to
+    answer from partial coverage rather than return plausible wrong lists."""
+
+    n_pods: int
+    total_pairs: int
+    out_degree: np.ndarray  # int64 [N] — reachable dsts per src (swept tiles)
+    in_degree: np.ndarray  # int64 [N] — reaching srcs per dst (swept tiles)
+    ingress_isolated: np.ndarray  # bool [N]
+    egress_isolated: np.ndarray  # bool [N]
+    full_sweep: bool = True
+    packed: Optional[np.ndarray] = None  # uint32 [N, W] when keep_matrix
+    timings: Optional[dict] = None
+
+    def _require_full(self, what: str) -> None:
+        if not self.full_sweep:
+            raise ValueError(
+                f"{what} needs the full dst sweep; this result covers only "
+                f"stripe {self.timings.get('stripe') if self.timings else '?'}"
+                " — sum aggregate partials across stripes instead"
+            )
+
+    def all_reachable(self) -> List[int]:
+        """Pods reachable from every pod (``kano/algorithm.py:4-9``)."""
+        self._require_full("all_reachable")
+        return np.nonzero(self.in_degree == self.n_pods)[0].tolist()
+
+    def all_isolated(self) -> List[int]:
+        """Pods reachable from no pod (``kano/algorithm.py:12-17``)."""
+        self._require_full("all_isolated")
+        return np.nonzero(self.in_degree == 0)[0].tolist()
+
+    def to_bool(self) -> np.ndarray:
+        if self.packed is None:
+            raise ValueError("solve ran with keep_matrix=False")
+        self._require_full("to_bool")
+        return unpack_cols(self.packed, self.n_pods)
+
+
+def _packed_local(
+    pod_kv,
+    pod_key,
+    pod_ns,
+    valid,
+    ns_kv,
+    ns_key,
+    pol_sel,
+    pol_ns,
+    aff_ing,
+    aff_eg,
+    ingress: GrantBlock,
+    egress: GrantBlock,
+    *,
+    self_traffic: bool,
+    default_allow_unselected: bool,
+    direction_aware_isolation: bool,
+    chunk: int,
+    tile: int,
+    n_total: int,
+    mp: int,
+    stripe: Tuple[int, int],
+    keep_matrix: bool,
+):
+    """SPMD body. Pod arrays are local row blocks, grant blocks local grant
+    slices. Returns this device's packed row block (or a 1-word stub), local
+    aggregate partials, and replicated dst aggregates."""
+    n_loc = pod_kv.shape[0]
+    n_pol = pol_ns.shape[0]
+    my_pod = jax.lax.axis_index(POD_AXIS)
+    my_grant = jax.lax.axis_index(GRANT_AXIS)
+    row0 = my_pod * n_loc
+
+    # --- local selection maps -------------------------------------------
+    selected = match_selectors(pol_sel, pod_kv, pod_key)
+    selected &= pol_ns[:, None] == pod_ns[None, :]
+    if direction_aware_isolation:
+        sel_ing = selected & aff_ing[:, None]
+        sel_eg = selected & aff_eg[:, None]
+    else:
+        sel_ing = selected
+        sel_eg = selected
+    ing_iso_loc = sel_ing.any(axis=0)  # [n_loc]
+    eg_iso_loc = sel_eg.any(axis=0)
+    # src-side dot operand: resident int8
+    sel_eg8 = sel_eg.astype(_I8)  # [P, n_loc]
+    # dst-side arrays: bit-packed, unpacked per owned tile at broadcast time
+    sel_ing_bits = _pack_rows_u8(sel_ing)  # [P, n_loc/8]
+    del selected, sel_ing, sel_eg
+
+    # --- per-policy peer maps (OR over the local grant slice, then over the
+    # grants axis; int8 psum is exact: values ≤ mp ≤ 8) -------------------
+    def peers_by_policy(block: GrantBlock) -> jnp.ndarray:
+        # the host wrapper pads the grant axis to a (mp · chunk) multiple, so
+        # the local slice is an exact number of chunks
+        G = block.pol.shape[0]
+        acc = jnp.zeros((n_pol + 1, n_loc), dtype=_I8)
+        if G:
+            def body(i, acc):
+                blk = jax.tree.map(
+                    lambda x: jax.lax.dynamic_slice_in_dim(
+                        x, i * chunk, chunk, 0
+                    ),
+                    block,
+                )
+                peers = _grant_peers(
+                    blk, pod_kv, pod_key, ns_kv, ns_key, pod_ns, pol_ns
+                )
+                return acc.at[blk.pol].max(peers.astype(_I8))
+
+            acc = jax.lax.fori_loop(0, G // chunk, body, acc)
+        summed = jax.lax.psum(acc[:n_pol], GRANT_AXIS)
+        return (summed > 0).astype(_I8)
+
+    ing_by_pol = peers_by_policy(ingress)  # int8 [P, n_loc] — src side, resident
+    eg_by_pol_bits = _pack_rows_u8(peers_by_policy(egress) > 0)  # dst side
+
+    # dst-side default-allow needs the *global* isolation vectors; they are
+    # [N] bools — tiny — so one all_gather is fine even at 1M pods
+    ing_iso_full = jax.lax.all_gather(ing_iso_loc, POD_AXIS, axis=0, tiled=True)
+    valid_full = jax.lax.all_gather(valid, POD_AXIS, axis=0, tiled=True)
+
+    # --- dst-tile sweep --------------------------------------------------
+    t0, t1 = stripe
+    tiles_per_dev = (t1 - t0) // mp
+    W = n_total // 32
+
+    out = jnp.zeros((n_loc, W if keep_matrix else 1), dtype=_U32)
+    row_deg = jnp.zeros((n_loc,), dtype=_I32)
+    col_deg = jnp.zeros((n_total,), dtype=_I32)
+
+    def fetch_tile(d0):
+        """Broadcast the dst tile's [P, T] slices + [T] iso/valid from the
+        owning device: masked contribution + psum over the pod axis."""
+        owner = d0 // n_loc
+        local0 = d0 - owner * n_loc
+        mine = (my_pod == owner).astype(_I8)
+        sel_t = _unpack_cols_u8(sel_ing_bits, local0, tile) * mine
+        peer_t = _unpack_cols_u8(eg_by_pol_bits, local0, tile) * mine
+        return (
+            jax.lax.psum(sel_t, POD_AXIS),
+            jax.lax.psum(peer_t, POD_AXIS),
+        )
+
+    def body(k, carry):
+        out, row_deg, col_deg = carry
+        t = t0 + k * mp + my_grant
+        d0 = t * tile
+        sel_ing_t, eg_by_pol_t = fetch_tile(d0)
+        ing_iso_t = jax.lax.dynamic_slice(ing_iso_full, (d0,), (tile,))
+        valid_t = jax.lax.dynamic_slice(valid_full, (d0,), (tile,))
+        # ing_allow[src, dst_t] = ∨_p ing_by_pol[p, src] ∧ sel_ing[p, dst_t]
+        ing_ok = (
+            jax.lax.dot_general(
+                ing_by_pol, sel_ing_t, (((0,), (0,)), ((), ())),
+                preferred_element_type=_I32,
+            )
+            > 0
+        )
+        # eg_allow[src, dst_t] = ∨_p sel_eg[p, src] ∧ eg_by_pol[p, dst_t]
+        eg_ok = (
+            jax.lax.dot_general(
+                sel_eg8, eg_by_pol_t, (((0,), (0,)), ((), ())),
+                preferred_element_type=_I32,
+            )
+            > 0
+        )
+        if default_allow_unselected:
+            ing_ok |= ~ing_iso_t[None, :]
+            eg_ok |= ~eg_iso_loc[:, None]
+        r = ing_ok & eg_ok
+        if self_traffic:
+            gidx = row0 + jnp.arange(n_loc)
+            r |= gidx[:, None] == (d0 + jnp.arange(tile))[None, :]
+        r &= valid[:, None] & valid_t[None, :]
+        row_deg += r.sum(axis=1, dtype=_I32)
+        col_deg = jax.lax.dynamic_update_slice(
+            col_deg,
+            jax.lax.dynamic_slice(col_deg, (d0,), (tile,))
+            + r.sum(axis=0, dtype=_I32),
+            (d0,),
+        )
+        if keep_matrix:
+            out = jax.lax.dynamic_update_slice(
+                out, pack_bool_cols(r), (0, d0 // 32)
+            )
+        return out, row_deg, col_deg
+
+    out, row_deg, col_deg = jax.lax.fori_loop(
+        0, tiles_per_dev, body, (out, row_deg, col_deg)
+    )
+    # grant-axis devices covered disjoint tiles: sum == bitwise OR for the
+    # packed words, plain add for the aggregates
+    if keep_matrix:
+        out = jax.lax.psum(out, GRANT_AXIS)
+    row_deg = jax.lax.psum(row_deg, GRANT_AXIS)
+    col_deg = jax.lax.psum(col_deg, (POD_AXIS, GRANT_AXIS))
+    return out, row_deg, col_deg, ing_iso_loc & valid, eg_iso_loc & valid
+
+
+def sharded_packed_reach(
+    mesh: jax.sharding.Mesh,
+    enc: EncodedCluster,
+    *,
+    self_traffic: bool = True,
+    default_allow_unselected: bool = True,
+    direction_aware_isolation: bool = True,
+    tile: int = 512,
+    chunk: int = 1024,
+    stripe: Optional[Tuple[int, int]] = None,
+    keep_matrix: Optional[bool] = None,
+) -> PackedShardedResult:
+    """Pad, shard, sweep. ``stripe=(t0, t1)`` limits the sweep to a dst tile
+    range (default: all tiles); aggregates then cover only the swept dsts.
+    ``keep_matrix=None`` keeps the packed matrix when it is ≤ ~1 GB/device."""
+    import time
+
+    if len(enc.atoms) > 1:
+        raise ValueError(
+            "sharded_packed_reach is any-port; encode with compute_ports=False"
+        )
+    dp = mesh.shape[POD_AXIS]
+    mp = mesh.shape[GRANT_AXIS]
+    n = enc.n_pods
+    tile = max(32, tile - tile % 32)
+    # n_loc must be a multiple of the dst tile so every tile has one owner,
+    # and the total tile count a multiple of mp for the round-robin sweep
+    block = tile * max(1, math.ceil(max(n, 1) / (dp * tile)))
+    while (block * dp // tile) % mp:
+        block += tile
+    Np = block * dp
+    n_pad = Np - n
+    pod_kv, pod_key, pod_ns = pad_pods(enc.pod_kv, enc.pod_key, enc.pod_ns, n_pad)
+    valid = np.arange(Np) < n
+    # grant axis padded to an (mp · chunk) multiple: each device's slice is an
+    # exact number of peer-sweep chunks
+    ingress = pad_grants(
+        enc.ingress, pad_amount(enc.ingress.n, mp * chunk), enc.n_policies, n_pad
+    )
+    egress = pad_grants(
+        enc.egress, pad_amount(enc.egress.n, mp * chunk), enc.n_policies, n_pad
+    )
+
+    n_tiles_total = Np // tile
+    if stripe is None:
+        stripe = (0, n_tiles_total)
+    t0, t1 = stripe
+    if not (0 <= t0 < t1 <= n_tiles_total):
+        raise ValueError(f"stripe {stripe} outside [0, {n_tiles_total})")
+    if (t1 - t0) % mp:
+        raise ValueError(f"stripe width {t1 - t0} not a multiple of mp={mp}")
+    full_sweep = (t0, t1) == (0, n_tiles_total)
+    if keep_matrix is None:
+        # a partial stripe would leave unswept words zero — only aggregates
+        # are meaningful there, so never auto-keep a partial matrix
+        keep_matrix = full_sweep and Np * (Np // 32) * 4 // dp <= (1 << 30)
+
+    body = partial(
+        _packed_local,
+        self_traffic=self_traffic,
+        default_allow_unselected=default_allow_unselected,
+        direction_aware_isolation=direction_aware_isolation,
+        chunk=chunk,
+        tile=tile,
+        n_total=Np,
+        mp=mp,
+        stripe=(t0, t1),
+        keep_matrix=keep_matrix,
+    )
+    in_specs = (
+        P(POD_AXIS, None),  # pod_kv
+        P(POD_AXIS, None),  # pod_key
+        P(POD_AXIS),  # pod_ns
+        P(POD_AXIS),  # valid
+        P(),  # ns_kv
+        P(),  # ns_key
+        _specs_like(enc.pol_sel, P()),
+        P(),  # pol_ns
+        P(),  # aff_ing
+        P(),  # aff_eg
+        _grant_pspecs(ingress),
+        _grant_pspecs(egress),
+    )
+    out_specs = (
+        P(POD_AXIS, None),  # packed block (or stub)
+        P(POD_AXIS),  # row_deg
+        P(),  # col_deg (replicated after psum)
+        P(POD_AXIS),  # ing_iso
+        P(POD_AXIS),  # eg_iso
+    )
+    fn = jax.jit(
+        jax.shard_map(
+            body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    )
+    t_start = time.perf_counter()
+    packed, row_deg, col_deg, ing_iso, eg_iso = fn(
+        pod_kv,
+        pod_key,
+        pod_ns,
+        valid,
+        enc.ns_kv,
+        enc.ns_key,
+        enc.pol_sel,
+        enc.pol_ns,
+        enc.pol_affects_ingress,
+        enc.pol_affects_egress,
+        ingress,
+        egress,
+    )
+    row_deg = np.asarray(row_deg)[:n].astype(np.int64)
+    col_deg = np.asarray(col_deg)[:n].astype(np.int64)
+    elapsed = time.perf_counter() - t_start
+    return PackedShardedResult(
+        n_pods=n,
+        total_pairs=int(row_deg.sum()),
+        out_degree=row_deg,
+        in_degree=col_deg,
+        ingress_isolated=np.asarray(ing_iso)[:n],
+        egress_isolated=np.asarray(eg_iso)[:n],
+        full_sweep=full_sweep,
+        packed=np.asarray(packed)[:n] if keep_matrix else None,
+        timings={"solve": elapsed, "stripe": (t0, t1), "tiles": n_tiles_total},
+    )

@@ -1,0 +1,130 @@
+"""Sharded *packed* path (BASELINE config 5 core) vs the CPU oracle on the
+8-virtual-device mesh: packed matrix, aggregates, stripes, and every mesh
+factorisation."""
+import numpy as np
+import pytest
+
+import kubernetes_verification_tpu as kv
+from kubernetes_verification_tpu.encode.encoder import encode_cluster
+from kubernetes_verification_tpu.harness.generate import (
+    GeneratorConfig,
+    random_cluster,
+)
+from kubernetes_verification_tpu.parallel.mesh import mesh_for
+from kubernetes_verification_tpu.parallel.packed_sharded import (
+    sharded_packed_reach,
+)
+
+MESHES = [(8, 1), (4, 2), (2, 4), (1, 8)]
+
+
+def _solve(cluster, shape, **kw):
+    enc = encode_cluster(cluster, compute_ports=False)
+    mesh = mesh_for(shape)
+    return sharded_packed_reach(mesh, enc, tile=32, chunk=8, **kw)
+
+
+@pytest.mark.parametrize("shape", MESHES)
+def test_matches_cpu_oracle(shape):
+    cluster = random_cluster(
+        GeneratorConfig(n_pods=53, n_policies=13, n_namespaces=3, seed=3)
+    )
+    ref = kv.verify(cluster, kv.VerifyConfig(backend="cpu", compute_ports=False))
+    got = _solve(cluster, shape, keep_matrix=True)
+    np.testing.assert_array_equal(got.to_bool(), ref.reach)
+    np.testing.assert_array_equal(got.out_degree, ref.reach.sum(axis=1))
+    np.testing.assert_array_equal(got.in_degree, ref.reach.sum(axis=0))
+    assert got.total_pairs == int(ref.reach.sum())
+    np.testing.assert_array_equal(got.ingress_isolated, ref.ingress_isolated)
+    np.testing.assert_array_equal(got.egress_isolated, ref.egress_isolated)
+    assert got.all_isolated() == ref.all_isolated()
+    assert got.all_reachable() == ref.all_reachable()
+
+
+@pytest.mark.parametrize(
+    "flags",
+    [
+        dict(self_traffic=False),
+        dict(default_allow_unselected=False),
+        dict(direction_aware_isolation=False),
+    ],
+)
+def test_semantic_flags(flags):
+    cluster = random_cluster(
+        GeneratorConfig(n_pods=41, n_policies=9, n_namespaces=2, seed=5)
+    )
+    ref = kv.verify(
+        cluster, kv.VerifyConfig(backend="cpu", compute_ports=False, **flags)
+    )
+    got = _solve(cluster, (4, 2), keep_matrix=True, **flags)
+    np.testing.assert_array_equal(got.to_bool(), ref.reach)
+
+
+def test_aggregates_only_mode():
+    """keep_matrix=False: the matrix is never materialised; aggregates still
+    exact (the 1M-pod operating mode)."""
+    cluster = random_cluster(
+        GeneratorConfig(n_pods=45, n_policies=11, n_namespaces=2, seed=9)
+    )
+    ref = kv.verify(cluster, kv.VerifyConfig(backend="cpu", compute_ports=False))
+    got = _solve(cluster, (4, 2), keep_matrix=False)
+    assert got.packed is None
+    with pytest.raises(ValueError):
+        got.to_bool()
+    np.testing.assert_array_equal(got.out_degree, ref.reach.sum(axis=1))
+    np.testing.assert_array_equal(got.in_degree, ref.reach.sum(axis=0))
+
+
+def test_stripes_compose():
+    """Sweeping tile stripes separately covers the full dst axis: the union
+    of per-stripe aggregates equals the full solve (the checkpointable-sweep
+    property, SURVEY.md §5.4)."""
+    cluster = random_cluster(
+        GeneratorConfig(n_pods=70, n_policies=9, n_namespaces=2, seed=11)
+    )
+    ref = kv.verify(cluster, kv.VerifyConfig(backend="cpu", compute_ports=False))
+    enc = encode_cluster(cluster, compute_ports=False)
+    mesh = mesh_for((4, 2))
+    full = sharded_packed_reach(mesh, enc, tile=32, chunk=8, keep_matrix=False)
+    n_tiles = full.timings["tiles"]
+    assert n_tiles >= 4
+    mid = (n_tiles // 2 // 2) * 2  # stripe widths must divide mp=2
+    a = sharded_packed_reach(
+        mesh, enc, tile=32, chunk=8, stripe=(0, mid), keep_matrix=False
+    )
+    b = sharded_packed_reach(
+        mesh, enc, tile=32, chunk=8, stripe=(mid, n_tiles), keep_matrix=False
+    )
+    np.testing.assert_array_equal(
+        a.out_degree + b.out_degree, ref.reach.sum(axis=1)
+    )
+    np.testing.assert_array_equal(
+        a.in_degree + b.in_degree, ref.reach.sum(axis=0)
+    )
+    assert a.total_pairs + b.total_pairs == int(ref.reach.sum())
+
+
+def test_ports_encoding_rejected():
+    cluster = random_cluster(
+        GeneratorConfig(n_pods=10, n_policies=4, p_ports=1.0, seed=2)
+    )
+    enc = encode_cluster(cluster, compute_ports=True)
+    if len(enc.atoms) > 1:
+        with pytest.raises(ValueError, match="any-port"):
+            sharded_packed_reach(mesh_for((8, 1)), enc)
+
+
+def test_partial_stripe_refuses_whole_matrix_queries():
+    """A striped result must not answer whole-matrix questions (unswept dsts
+    would read as unreachable) and must never auto-keep a partial matrix."""
+    cluster = random_cluster(
+        GeneratorConfig(n_pods=70, n_policies=9, n_namespaces=2, seed=11)
+    )
+    enc = encode_cluster(cluster, compute_ports=False)
+    mesh = mesh_for((4, 2))
+    part = sharded_packed_reach(mesh, enc, tile=32, chunk=8, stripe=(0, 2))
+    assert not part.full_sweep
+    assert part.packed is None  # heuristic must not keep a partial matrix
+    for q in (part.all_reachable, part.all_isolated):
+        with pytest.raises(ValueError, match="full dst sweep"):
+            q()
