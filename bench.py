@@ -46,7 +46,14 @@ def bench_tiled(args) -> None:
     dev = jax.devices()[0]
     log(f"device: {dev} ({jax.default_backend()})")
     n = args.pods
-    compute_ports = not args.no_ports and not args.pallas
+    if args.pallas and not args.no_ports:
+        # never silently change the benched semantics: the Pallas path is
+        # any-port only, so require the caller to say --no-ports explicitly
+        sys.exit(
+            "--pallas implements any-port semantics only; pass --no-ports "
+            "explicitly so the metric string reflects what actually ran"
+        )
+    compute_ports = not args.no_ports
     t0 = time.perf_counter()
     cluster = random_cluster(
         GeneratorConfig(

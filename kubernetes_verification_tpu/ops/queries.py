@@ -73,32 +73,64 @@ def system_isolation(reach, idx: int) -> List[int]:
     return np.nonzero(~reach[idx])[0].tolist()
 
 
-def _co_select(src_sets: np.ndarray) -> np.ndarray:
-    """bool[P, P]: policies sharing at least one selected (source) pod."""
-    s = src_sets.astype(np.int64)
-    return (s @ s.T) > 0
+#: element-count threshold above which the P×P count matmuls run as int8 MXU
+#: dots on the default JAX device instead of host int64 BLAS (at the flagship
+#: 10k policies × 100k pods, S·Sᵀ is 2e13 MACs — seconds on TPU, hours on one
+#: host core)
+_DEVICE_MATMUL_MIN = 1 << 22
+
+
+_gram_device = None  # lazily-built jitted int8 Gram dot (one cache entry)
+
+
+def _gram(a: np.ndarray) -> np.ndarray:
+    """int32/int64 [P, P] Gram matrix ``a @ a.T`` of a bool [P, N] set stack
+    (counts of co-members; exact — counts ≤ N < 2³¹)."""
+    a = _np(a)
+    if a.size >= _DEVICE_MATMUL_MIN:
+        try:
+            import jax
+            import jax.numpy as jnp
+        except ImportError:
+            jax = None  # CPU-only install: fall through to host BLAS
+        if jax is not None:
+            global _gram_device
+            if _gram_device is None:
+                _gram_device = jax.jit(
+                    lambda x: jax.lax.dot_general(
+                        x, x, (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.int32,
+                    )
+                )
+            return np.asarray(_gram_device(jnp.asarray(a, dtype=jnp.int8)))
+    a64 = a.astype(np.int64)
+    return a64 @ a64.T
+
+
+def _pairs(mask: np.ndarray) -> List[Tuple[int, int]]:
+    """bool [P, P] → (j, k) index pairs in row-major (j-then-k) scan order —
+    the reference's iteration order."""
+    return [(int(j), int(k)) for j, k in np.argwhere(mask)]
 
 
 def policy_shadow(src_sets, dst_sets) -> List[Tuple[int, int]]:
     """Pairs (j, k) of policies co-selecting a pod where k's allow set is
     contained in j's — k adds no edge j doesn't already grant on those pods
-    (``kano_py/kano/algorithm.py:58-80``). Vectorised:
-    ``share = S·Sᵀ > 0`` and ``k⊆j ⟺ (D_k · ¬D_j) == 0``. Unlike the
-    reference (which appends one pair per co-selected container) the result is
-    deduplicated; ordering matches the reference's (j, k) scan order."""
-    S = _np(src_sets).astype(np.int64)
-    D = _np(dst_sets).astype(np.int64)
-    share = (S @ S.T) > 0
-    # uncovered[k, j] = |dst_k \ dst_j| ; k ⊆ j iff 0
-    uncovered = D @ (1 - D.T)  # [k, j]
-    subset_kj = uncovered == 0
-    P = S.shape[0]
-    out = []
-    for j in range(P):
-        for k in range(P):
-            if j != k and share[j, k] and subset_kj[k, j]:
-                out.append((j, k))
-    return out
+    (``kano_py/kano/algorithm.py:58-80``). Vectorised: ``share = S·Sᵀ > 0``
+    and ``k⊆j ⟺ |D_k| - (D·Dᵀ)[k,j] == 0`` — two Gram matmuls (MXU dots at
+    flagship scale) plus an ``np.argwhere`` harvest; no Python-level P² loop.
+    Unlike the reference (which appends one pair per co-selected container)
+    the result is deduplicated; ordering matches the reference's (j, k) scan
+    order."""
+    S = _np(src_sets)
+    D = _np(dst_sets)
+    share = _gram(S) > 0
+    dd = _gram(D)
+    dsize = _np(dst_sets).sum(axis=1, dtype=np.int64)  # |D_k|
+    # k ⊆ j ⟺ |D_k \ D_j| = |D_k| - |D_k ∩ D_j| = 0
+    mask = share & (dd == dsize[None, :])
+    np.fill_diagonal(mask, False)
+    return _pairs(mask)
 
 
 def policy_conflict(src_sets, dst_sets) -> List[Tuple[int, int]]:
@@ -109,23 +141,13 @@ def policy_conflict(src_sets, dst_sets) -> List[Tuple[int, int]]:
     (it iterates ``enumerate(i_select)`` so ``pj``/``pk`` are ints and
     ``pj.working_allow_set`` raises AttributeError); the subset test
     ``k_allow ⊆ ¬j_allow`` it intends is exactly disjointness, computed here
-    as ``D·Dᵀ == 0``. The non-empty guard avoids reporting policies that
-    grant nothing."""
-    S = _np(src_sets).astype(np.int64)
-    D = _np(dst_sets).astype(np.int64)
-    share = (S @ S.T) > 0
-    overlap = D @ D.T  # [j, k] |dst_j ∩ dst_k|
-    nonempty = D.sum(axis=1) > 0
-    P = S.shape[0]
-    out = []
-    for j in range(P):
-        for k in range(P):
-            if (
-                j != k
-                and share[j, k]
-                and overlap[j, k] == 0
-                and nonempty[j]
-                and nonempty[k]
-            ):
-                out.append((j, k))
-    return out
+    as ``D·Dᵀ == 0`` with an ``np.argwhere`` harvest. The non-empty guard
+    avoids reporting policies that grant nothing."""
+    S = _np(src_sets)
+    D = _np(dst_sets)
+    share = _gram(S) > 0
+    overlap = _gram(D)  # [j, k] |dst_j ∩ dst_k|
+    nonempty = D.sum(axis=1, dtype=np.int64) > 0
+    mask = share & (overlap == 0) & nonempty[:, None] & nonempty[None, :]
+    np.fill_diagonal(mask, False)
+    return _pairs(mask)

@@ -56,7 +56,13 @@ import numpy as np
 from ..encode.encoder import EncodedCluster, GrantBlock, SelectorEnc
 from .match import match_selectors
 
-__all__ = ["PackedReach", "tiled_k8s_reach", "pack_bool_cols", "unpack_cols"]
+__all__ = [
+    "PackedReach",
+    "tiled_k8s_reach",
+    "pack_bool_cols",
+    "unpack_cols",
+    "policy_pair_masks",
+]
 
 _I8 = jnp.int8
 _I32 = jnp.int32
@@ -65,6 +71,19 @@ _U32 = jnp.uint32
 #: byte budget for the port path's per-tile mask slabs (R bool [N, tile]
 #: planes); bounds the dst-tile size via R·N·tile ≤ budget
 _PORT_SLAB_BUDGET = int(1.2e9)
+
+#: byte budget for the port path's *resident* int8 operands (the two
+#: [total_vp, N] peer maps + the gathered egress selection) — checked up
+#: front so an over-wide virtual-policy layout raises a clear error instead
+#: of an opaque device OOM mid-solve
+_PORT_RESIDENT_BUDGET = int(12e9)
+
+#: cap on R, the number of distinct ported masks after run-splitting. The
+#: mask-group kernel statically unrolls R segment dots plus O(R²) overlap ORs
+#: per tile body, so an adversarial cluster (hundreds of unrelated port
+#: ranges) would compile an enormous XLA program; fail fast with guidance
+#: instead.
+_MAX_PORT_MASKS = 128
 
 
 def pack_bool_cols(tile: jnp.ndarray) -> jnp.ndarray:
@@ -78,8 +97,11 @@ def pack_bool_cols(tile: jnp.ndarray) -> jnp.ndarray:
 
 def unpack_cols(packed: np.ndarray, n_cols: int) -> np.ndarray:
     """uint32 [R, W] → bool [R, n_cols] (host-side, for tests/small slices)."""
+    # ascontiguousarray: arrays fetched from device (axon tunnel) can come
+    # back with a non-contiguous last axis, which .view(uint8) rejects
+    words = np.ascontiguousarray(np.asarray(packed), dtype="<u4")
     b = np.unpackbits(
-        packed.astype("<u4").view(np.uint8).reshape(packed.shape[0], -1),
+        words.view(np.uint8).reshape(words.shape[0], -1),
         axis=1,
         bitorder="little",
     )
@@ -196,8 +218,6 @@ def _tiled_step(
 ):
     N = pod_kv.shape[0]
     P = pol_ns.shape[0]
-    n_tiles = N // tile
-    W = N // 32
 
     selected8, sel_ing8, sel_eg8, ing_iso, eg_iso = _select_maps(
         pod_kv, pod_key, pod_ns, pol_sel, pol_ns, aff_ing, aff_eg,
@@ -214,11 +234,6 @@ def _tiled_step(
 
     ing_by_pol = peers_by_policy(ingress)  # int8 [P, N] (src side)
     eg_by_pol = peers_by_policy(egress)  # int8 [P, N] (dst side)
-
-    def dot_pn(a, b):  # [P, N] × [P, T] → int32 [N, T]
-        return jax.lax.dot_general(
-            a, b, (((0,), (0,)), ((), ())), preferred_element_type=_I32
-        )
 
     if use_pallas:
         # fused Pallas kernel: dots + combine + pack in VMEM, one HBM write
@@ -242,6 +257,40 @@ def _tiled_step(
         out &= col_mask[None, :]
         return out, ing_iso, eg_iso, selected8 > 0
 
+    out = _sweep_packed(
+        sel_ing8, sel_eg8, ing_by_pol, eg_by_pol, ing_iso, eg_iso, col_mask,
+        tile=tile,
+        self_traffic=self_traffic,
+        default_allow_unselected=default_allow_unselected,
+    )
+    return out, ing_iso, eg_iso, selected8 > 0
+
+
+def _sweep_packed(
+    sel_ing8,  # int8 [P, N] — dst-side ingress selection
+    sel_eg8,  # int8 [P, N] — src-side egress selection
+    ing_by_pol,  # int8 [P, N] — src-side ingress peer map
+    eg_by_pol,  # int8 [P, N] — dst-side egress peer map
+    ing_iso,  # bool [N]
+    eg_iso,  # bool [N]
+    col_mask,  # uint32 [W]
+    *,
+    tile: int,
+    self_traffic: bool,
+    default_allow_unselected: bool,
+) -> jnp.ndarray:
+    """Dst-tiled any-port reachability sweep over per-policy maps → packed
+    uint32 [N, N/32]. Shared by the tiled solver (maps built from a grant
+    encoding) and the packed incremental verifier (maps ARE the state)."""
+    P, N = sel_ing8.shape
+    n_tiles = N // tile
+    W = N // 32
+
+    def dot_pn(a, b):  # [P, N] × [P, T] → int32 [N, T]
+        return jax.lax.dot_general(
+            a, b, (((0,), (0,)), ((), ())), preferred_element_type=_I32
+        )
+
     def body(t, out):
         d0 = t * tile
         sel_ing_t = jax.lax.dynamic_slice(sel_ing8, (0, d0), (P, tile))
@@ -262,8 +311,7 @@ def _tiled_step(
 
     out = jnp.zeros((N, W), dtype=_U32)
     out = jax.lax.fori_loop(0, n_tiles, body, out)
-    out &= col_mask[None, :]
-    return out, ing_iso, eg_iso, selected8 > 0
+    return out & col_mask[None, :]
 
 
 def _split_grant_ports(block: GrantBlock) -> GrantBlock:
@@ -565,43 +613,281 @@ def _tiled_ports_step(
     return out, ing_iso, eg_iso, selected8 > 0
 
 
+@partial(jax.jit, static_argnames=("op",))
+def _device_word_reduce(packed: jnp.ndarray, op: str) -> jnp.ndarray:
+    """Column-wise AND/OR of the packed words on device (uint32 [W])."""
+    comp = jax.lax.bitwise_and if op == "and" else jax.lax.bitwise_or
+    init = jnp.uint32(0xFFFFFFFF) if op == "and" else jnp.uint32(0)
+    return jax.lax.reduce(packed, init, comp, (0,))
+
+
+@jax.jit
+def _device_out_degree(packed: jnp.ndarray) -> jnp.ndarray:
+    """popcount per row on device (int32 [N]; rows hold < 2³¹ set bits)."""
+    return jnp.sum(
+        jax.lax.population_count(packed).astype(_I32), axis=1, dtype=_I32
+    )
+
+
+@partial(jax.jit, static_argnames=("n_groups",))
+def _device_group_or(
+    packed: jnp.ndarray, gid: jnp.ndarray, n_groups: int
+) -> jnp.ndarray:
+    """uint32 [U, W]: OR of the packed rows of each group (device loop — one
+    masked [N, W] reduction per group, fine for the handful of user groups
+    the crosscheck query sees)."""
+
+    def body(g, acc):
+        sel = jnp.where((gid == g)[:, None], packed, jnp.uint32(0))
+        return acc.at[g].set(
+            jax.lax.reduce(sel, jnp.uint32(0), jax.lax.bitwise_or, (0,))
+        )
+
+    acc = jnp.zeros((n_groups, packed.shape[1]), dtype=_U32)
+    return jax.lax.fori_loop(0, n_groups, body, acc)
+
+
+def _crosscheck_from_group_or(
+    group_or: np.ndarray, gid: np.ndarray, n: int
+) -> List[int]:
+    """Finish a crosscheck query from the [U, W] per-group row-OR table:
+    ``or_notg[g] = OR of every group's rows except g's`` via prefix/suffix
+    ORs, then one gather answers bit ``j`` of ``or_notg[gid[j]]`` for all
+    dsts."""
+    U = group_or.shape[0]
+    fwd = np.bitwise_or.accumulate(group_or, axis=0)  # fwd[g] = OR[0..g]
+    bwd = np.bitwise_or.accumulate(group_or[::-1], axis=0)[::-1]  # OR[g..U-1]
+    or_notg = np.zeros_like(group_or)
+    or_notg[1:] |= fwd[:-1]
+    or_notg[:-1] |= bwd[1:]
+    j = np.arange(n)
+    vals = (or_notg[gid, j // 32] >> (j % 32).astype(np.uint32)) & np.uint32(1)
+    return np.nonzero(vals)[0].tolist()
+
+
+def _host_group_or(packed: np.ndarray, gid: np.ndarray, n_groups: int) -> np.ndarray:
+    """uint32 [U, W]: OR of the packed rows of each group (host; one stable
+    sort + ``np.bitwise_or.reduceat`` — no Python-level row loop)."""
+    out = np.zeros((n_groups, packed.shape[1]), dtype=np.uint32)
+    counts = np.bincount(gid, minlength=n_groups)
+    nonempty = np.nonzero(counts > 0)[0]
+    if nonempty.size == 0:
+        return out
+    order = np.argsort(gid, kind="stable")
+    starts = np.zeros(n_groups, dtype=np.int64)
+    starts[1:] = np.cumsum(counts)[:-1]
+    # reduceat over only the nonempty segment starts: each segment then spans
+    # exactly its group's sorted rows
+    out[nonempty] = np.bitwise_or.reduceat(packed[order], starts[nonempty], axis=0)
+    return out
+
+
 @dataclass
 class PackedReach:
     """Bit-packed reachability matrix + packed-domain queries.
 
     ``packed[src, w]`` bit ``j`` ⇔ src reaches pod ``w*32+j``. Queries mirror
-    ``kano_py/kano/algorithm.py`` without ever unpacking [N, N]."""
+    ``kano_py/kano/algorithm.py`` without ever unpacking [N, N]; ``packed``
+    may be a host array (``fetch=True``) or remain device-resident
+    (``fetch=False``) — the whole-matrix queries reduce on device in that
+    case and only ship the tiny result."""
 
-    packed: np.ndarray  # uint32 [N, W]
+    packed: np.ndarray  # uint32 [N, W] (np.ndarray or device jnp array)
     n_pods: int
     ingress_isolated: np.ndarray
     egress_isolated: np.ndarray
     selected: Optional[np.ndarray] = None
     timings: Optional[dict] = None
 
+    @property
+    def _on_host(self) -> bool:
+        return isinstance(self.packed, np.ndarray)
+
     def reachable(self, src: int, dst: int) -> bool:
-        return bool((self.packed[src, dst // 32] >> np.uint32(dst % 32)) & 1)
+        w = self.packed[src, dst // 32]
+        return bool((np.uint32(w) >> np.uint32(dst % 32)) & np.uint32(1))
 
     def row(self, src: int) -> np.ndarray:
-        return unpack_cols(self.packed[src : src + 1], self.n_pods)[0]
+        return unpack_cols(np.asarray(self.packed[src : src + 1]), self.n_pods)[0]
 
     def to_bool(self) -> np.ndarray:
-        return unpack_cols(self.packed, self.n_pods)
+        return unpack_cols(np.asarray(self.packed), self.n_pods)
+
+    def _word_reduce(self, op: str) -> np.ndarray:
+        words = self.packed[: self.n_pods]
+        if self._on_host:
+            ufunc = np.bitwise_and if op == "and" else np.bitwise_or
+            return ufunc.reduce(words, axis=0)
+        return np.asarray(_device_word_reduce(words, op))
 
     def all_reachable(self) -> List[int]:
-        words = self.packed[: self.n_pods]
-        conj = np.bitwise_and.reduce(words, axis=0)
+        """Pods reachable from every pod (``kano/algorithm.py:4-9``)."""
+        conj = self._word_reduce("and")
         return np.nonzero(unpack_cols(conj[None, :], self.n_pods)[0])[0].tolist()
 
     def all_isolated(self) -> List[int]:
-        words = self.packed[: self.n_pods]
-        disj = np.bitwise_or.reduce(words, axis=0)
+        """Pods reachable from no pod (``kano/algorithm.py:12-17``)."""
+        disj = self._word_reduce("or")
         return np.nonzero(~unpack_cols(disj[None, :], self.n_pods)[0])[0].tolist()
 
     def out_degree(self) -> np.ndarray:
-        """popcount per source row."""
-        v = self.packed.view(np.uint8)
-        return np.unpackbits(v, axis=1).sum(axis=1)
+        """popcount per source row — ``lax.population_count`` on device,
+        ``np.bitwise_count`` on host; never unpacks the matrix."""
+        if self._on_host:
+            words = self.packed[: self.n_pods]
+            if hasattr(np, "bitwise_count"):  # numpy ≥ 2.0
+                return np.bitwise_count(words).sum(axis=1, dtype=np.int64)
+            v = np.ascontiguousarray(words).view(np.uint8)
+            return np.unpackbits(v, axis=1).sum(axis=1, dtype=np.int64)
+        return np.asarray(
+            _device_out_degree(self.packed[: self.n_pods])
+        ).astype(np.int64)
+
+    def system_isolation(self, idx: int) -> List[int]:
+        """Pods NOT reachable from pod ``idx`` — the row complement
+        (``kano/algorithm.py:45-55``); unpacks one row only."""
+        return np.nonzero(~self.row(idx))[0].tolist()
+
+    def user_crosscheck(self, objs, label: str) -> List[int]:
+        """Pods reachable from a pod of a *different* user group
+        (``kano/algorithm.py:27-42``) without unpacking: dst ``j`` is flagged
+        iff bit ``j`` is set in the OR of the rows of every group except
+        ``j``'s own, so U per-group row-ORs + a prefix/suffix OR over the
+        [U, W] table answer all dsts at once."""
+        from .queries import user_groups
+
+        gid = user_groups(objs, label)
+        if gid.shape[0] != self.n_pods:
+            raise ValueError(
+                f"user_crosscheck: {gid.shape[0]} objects != {self.n_pods} pods"
+            )
+        return self._crosscheck_from_groups(gid)
+
+    def _crosscheck_from_groups(self, gid: np.ndarray) -> List[int]:
+        n_groups = int(gid.max()) + 1 if gid.size else 0
+        if n_groups <= 1:
+            return []
+        if self._on_host:
+            group_or = _host_group_or(self.packed[: self.n_pods], gid, n_groups)
+        else:
+            group_or = np.asarray(
+                _device_group_or(
+                    self.packed[: self.n_pods], jnp.asarray(gid), n_groups
+                )
+            )
+        return _crosscheck_from_group_or(group_or, gid, self.n_pods)
+
+
+@partial(jax.jit, static_argnames=("chunk",))
+def _policy_sets_step(
+    pod_kv,
+    pod_key,
+    pod_ns,
+    ns_kv,
+    ns_key,
+    pol_sel: SelectorEnc,
+    pol_ns,
+    gate_i,  # bool [P]: policy has ingress rules AND affects ingress
+    gate_e,  # bool [P]
+    ingress: GrantBlock,
+    egress: GrantBlock,
+    *,
+    chunk: int,
+):
+    """Per-policy src/dst edge sets + their Gram matrices, on device.
+
+    ``src_sets``/``dst_sets`` follow the CPU oracle (``backends/cpu.py``):
+    an ingress-affecting policy with rules contributes its peer union to src
+    and its selection to dst; egress mirrors. The [P, P] Gram counts
+    (``share`` co-selection, ``dd`` dst overlap, ``dsize`` dst popcount) are
+    everything ``policy_shadow``/``policy_conflict`` need — the [P, N] sets
+    never leave the device."""
+    P = pol_ns.shape[0]
+    selected8 = (
+        match_selectors(pol_sel, pod_kv, pod_key)
+        & (pol_ns[:, None] == pod_ns[None, :])
+    ).astype(_I8)
+    ing_peers = _peers_by_slot(
+        ingress, ingress.pol, P + 1, chunk,
+        pod_kv, pod_key, ns_kv, ns_key, pod_ns, pol_ns,
+    )[:P]
+    eg_peers = _peers_by_slot(
+        egress, egress.pol, P + 1, chunk,
+        pod_kv, pod_key, ns_kv, ns_key, pod_ns, pol_ns,
+    )[:P]
+    gi = gate_i.astype(_I8)[:, None]
+    ge = gate_e.astype(_I8)[:, None]
+    src8 = jnp.maximum(ing_peers * gi, selected8 * ge)
+    dst8 = jnp.maximum(selected8 * gi, eg_peers * ge)
+
+    def gram(a):  # [P, N] ⊗ [P, N] → int32 [P, P], contract pods
+        return jax.lax.dot_general(
+            a, a, (((1,), (1,)), ((), ())), preferred_element_type=_I32
+        )
+
+    share = gram(src8)
+    dd = gram(dst8)
+    dsize = jnp.sum(dst8.astype(_I32), axis=1)
+    eye = jnp.eye(P, dtype=bool)
+    shadow = (share > 0) & (dd == dsize[None, :]) & ~eye
+    conflict = (
+        (share > 0)
+        & (dd == 0)
+        & (dsize[:, None] > 0)
+        & (dsize[None, :] > 0)
+        & ~eye
+    )
+    return shadow, conflict
+
+
+def policy_pair_masks(
+    enc: EncodedCluster,
+    *,
+    direction_aware_isolation: bool = True,
+    chunk: int = 2048,
+    device=None,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """``(shadow_mask, conflict_mask)`` bool [P, P] for the two pairwise
+    policy queries at flagship scale: the [P, N] src/dst edge sets and their
+    O(P²·N) Gram contractions stay on the MXU (at 10k policies × 100k pods
+    each Gram is 1e12 int8 MACs — seconds on one chip, hours as host BLAS);
+    only the [P, P] masks come back. Feed them to
+    ``ops.queries._pairs``-style ``np.argwhere`` harvesting, or compare with
+    ``VerifyResult.policy_shadow()`` at small N."""
+    from ..parallel.sharded_ops import pad_grants
+
+    P = enc.n_policies
+    has_ing = np.bincount(enc.ingress.pol, minlength=P + 1)[:P] > 0
+    has_eg = np.bincount(enc.egress.pol, minlength=P + 1)[:P] > 0
+    if direction_aware_isolation:
+        gate_i = has_ing & enc.pol_affects_ingress
+        gate_e = has_eg & enc.pol_affects_egress
+    else:
+        gate_i = has_ing
+        gate_e = has_eg
+    ingress = pad_grants(
+        enc.ingress, (chunk - enc.ingress.n % chunk) % chunk, P, 0
+    )
+    egress = pad_grants(
+        enc.egress, (chunk - enc.egress.n % chunk) % chunk, P, 0
+    )
+    args = (
+        enc.pod_kv,
+        enc.pod_key,
+        enc.pod_ns,
+        enc.ns_kv,
+        enc.ns_key,
+        enc.pol_sel,
+        enc.pol_ns,
+        gate_i,
+        gate_e,
+        ingress,
+        egress,
+    )
+    if device is not None:
+        args = jax.device_put(args, device)
+    shadow, conflict = _policy_sets_step(*args, chunk=chunk)
+    return np.asarray(shadow), np.asarray(conflict)
 
 
 def tiled_k8s_reach(
@@ -615,6 +901,7 @@ def tiled_k8s_reach(
     device=None,
     fetch: bool = True,
     use_pallas: bool = False,
+    max_port_masks: int = _MAX_PORT_MASKS,
 ) -> PackedReach:
     """Host wrapper: pad N to a tile multiple, run the jitted tiled step,
     trim. With a multi-atom encoding (``encode_cluster(compute_ports=True)``
@@ -653,6 +940,15 @@ def tiled_k8s_reach(
             if any(m) and not all(m)
         }
         R = max(1, len(all_masks))
+        if R > max_port_masks:
+            raise ValueError(
+                f"{R} distinct ported atom masks after run-splitting exceeds "
+                f"max_port_masks={max_port_masks}: the mask-group kernel "
+                f"unrolls R dots + O(R²) combines per tile and would compile "
+                "an enormous program. Coarsen the cluster's port specs, "
+                "verify with compute_ports=False, or raise max_port_masks "
+                "explicitly if the compile cost is acceptable."
+            )
         # per-tile memory: R ported egress slabs of [N, tile] bools plus the
         # packed output — shrink the dst tile to keep the slabs bounded.
         # NOTE the cap does not bound the three resident [total_vp, N] int8
@@ -709,6 +1005,19 @@ def tiled_k8s_reach(
             np.asarray(egress.pol),
             sink_pol=P,
         )
+        # the three resident int8 operands — two [total_vp, N] peer maps plus
+        # the gathered egress selection — are the port path's memory floor;
+        # catch an over-wide VP layout here rather than as a device OOM
+        resident = (len(vp_pol_i) + 2 * len(vp_pol_e)) * Np
+        if resident > _PORT_RESIDENT_BUDGET:
+            raise ValueError(
+                f"port path needs ~{resident / 1e9:.1f} GB of resident "
+                f"[virtual-policies, N] int8 operands "
+                f"({len(vp_pol_i)}+{len(vp_pol_e)} VP rows × {Np} pods), over "
+                f"the {_PORT_RESIDENT_BUDGET / 1e9:.0f} GB budget. Reduce the "
+                "distinct (policy, port-mask) combinations, or verify with "
+                "compute_ports=False."
+            )
         args = (*common, vp_pol_i, vp_slot_i, vp_pol_e, vp_slot_e, col_mask)
         if device is not None:
             args = jax.device_put(args, device)

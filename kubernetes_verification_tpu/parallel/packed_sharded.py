@@ -103,6 +103,11 @@ class PackedShardedResult:
     egress_isolated: np.ndarray  # bool [N]
     full_sweep: bool = True
     packed: Optional[np.ndarray] = None  # uint32 [N, W] when keep_matrix
+    #: solve-time user groups (``groups=`` arg) and the per-group in-degree
+    #: table [U, N] — lets ``user_crosscheck`` answer from aggregates alone
+    #: at scales where the matrix is never materialised
+    groups: Optional[np.ndarray] = None
+    group_in_degree: Optional[np.ndarray] = None
     timings: Optional[dict] = None
 
     def _require_full(self, what: str) -> None:
@@ -123,6 +128,59 @@ class PackedShardedResult:
         self._require_full("all_isolated")
         return np.nonzero(self.in_degree == 0)[0].tolist()
 
+    def system_isolation(self, idx: int) -> List[int]:
+        """Pods NOT reachable from pod ``idx`` (row complement,
+        ``kano/algorithm.py:45-55``); needs the packed matrix — at
+        matrix-free scale re-solve a one-src stripe instead."""
+        if self.packed is None:
+            raise ValueError(
+                "system_isolation needs keep_matrix=True (a single row of a "
+                "matrix-free solve does not exist); re-run with keep_matrix "
+                "or restrict the cluster"
+            )
+        self._require_full("system_isolation")
+        row = unpack_cols(self.packed[idx : idx + 1], self.n_pods)[0]
+        return np.nonzero(~row)[0].tolist()
+
+    def user_crosscheck(self, objs, label: str) -> List[int]:
+        """Pods reachable from a pod of a different user group
+        (``kano/algorithm.py:27-42``). Prefers the packed matrix (same
+        word-OR algorithm as :class:`~..ops.tiled.PackedReach`); falls back
+        to the per-group in-degree aggregates when the solve ran with
+        ``groups=`` — dst j is flagged iff srcs outside its group reach it,
+        i.e. ``in_degree[j] > group_in_degree[gid[j], j]``."""
+        from ..ops.queries import user_groups
+
+        self._require_full("user_crosscheck")
+        gid = user_groups(objs, label)
+        if gid.shape[0] != self.n_pods:
+            raise ValueError(
+                f"user_crosscheck: {gid.shape[0]} objects != {self.n_pods} pods"
+            )
+        if self.packed is not None:
+            from ..ops.tiled import _crosscheck_from_group_or, _host_group_or
+
+            n_groups = int(gid.max()) + 1
+            if n_groups <= 1:
+                return []
+            group_or = _host_group_or(
+                np.asarray(self.packed[: self.n_pods]), gid, n_groups
+            )
+            return _crosscheck_from_group_or(group_or, gid, self.n_pods)
+        if self.group_in_degree is None or self.groups is None:
+            raise ValueError(
+                "user_crosscheck on a matrix-free solve needs the solve to "
+                "have run with groups=<per-pod group ids>"
+            )
+        if not np.array_equal(gid, self.groups):
+            raise ValueError(
+                "user_crosscheck: requested grouping differs from the "
+                "groups= the solve aggregated over; re-solve with this "
+                "grouping"
+            )
+        own = self.group_in_degree[gid, np.arange(self.n_pods)]
+        return np.nonzero(self.in_degree > own)[0].tolist()
+
     def to_bool(self) -> np.ndarray:
         if self.packed is None:
             raise ValueError("solve ran with keep_matrix=False")
@@ -135,6 +193,7 @@ def _packed_local(
     pod_key,
     pod_ns,
     valid,
+    grp8,  # int8 [U, n_loc] — one-hot user groups over the local src rows
     ns_kv,
     ns_key,
     pol_sel,
@@ -217,9 +276,11 @@ def _packed_local(
     tiles_per_dev = (t1 - t0) // mp
     W = n_total // 32
 
+    U = grp8.shape[0]
     out = jnp.zeros((n_loc, W if keep_matrix else 1), dtype=_U32)
     row_deg = jnp.zeros((n_loc,), dtype=_I32)
     col_deg = jnp.zeros((n_total,), dtype=_I32)
+    grp_deg = jnp.zeros((U, n_total), dtype=_I32)
 
     def fetch_tile(d0):
         """Broadcast the dst tile's [P, T] slices + [T] iso/valid from the
@@ -235,7 +296,7 @@ def _packed_local(
         )
 
     def body(k, carry):
-        out, row_deg, col_deg = carry
+        out, row_deg, col_deg, grp_deg = carry
         t = t0 + k * mp + my_grant
         d0 = t * tile
         sel_ing_t, eg_by_pol_t = fetch_tile(d0)
@@ -272,14 +333,26 @@ def _packed_local(
             + r.sum(axis=0, dtype=_I32),
             (d0,),
         )
+        # per-group column counts: U×n_loc×T int8 dot — noise next to the
+        # P-contraction, and it makes user_crosscheck answerable without the
+        # matrix
+        gc = jax.lax.dot_general(
+            grp8, r.astype(_I8), (((1,), (0,)), ((), ())),
+            preferred_element_type=_I32,
+        )
+        grp_deg = jax.lax.dynamic_update_slice(
+            grp_deg,
+            jax.lax.dynamic_slice(grp_deg, (0, d0), (U, tile)) + gc,
+            (0, d0),
+        )
         if keep_matrix:
             out = jax.lax.dynamic_update_slice(
                 out, pack_bool_cols(r), (0, d0 // 32)
             )
-        return out, row_deg, col_deg
+        return out, row_deg, col_deg, grp_deg
 
-    out, row_deg, col_deg = jax.lax.fori_loop(
-        0, tiles_per_dev, body, (out, row_deg, col_deg)
+    out, row_deg, col_deg, grp_deg = jax.lax.fori_loop(
+        0, tiles_per_dev, body, (out, row_deg, col_deg, grp_deg)
     )
     # grant-axis devices covered disjoint tiles: sum == bitwise OR for the
     # packed words, plain add for the aggregates
@@ -287,7 +360,8 @@ def _packed_local(
         out = jax.lax.psum(out, GRANT_AXIS)
     row_deg = jax.lax.psum(row_deg, GRANT_AXIS)
     col_deg = jax.lax.psum(col_deg, (POD_AXIS, GRANT_AXIS))
-    return out, row_deg, col_deg, ing_iso_loc & valid, eg_iso_loc & valid
+    grp_deg = jax.lax.psum(grp_deg, (POD_AXIS, GRANT_AXIS))
+    return out, row_deg, col_deg, grp_deg, ing_iso_loc & valid, eg_iso_loc & valid
 
 
 def sharded_packed_reach(
@@ -301,10 +375,13 @@ def sharded_packed_reach(
     chunk: int = 1024,
     stripe: Optional[Tuple[int, int]] = None,
     keep_matrix: Optional[bool] = None,
+    groups: Optional[np.ndarray] = None,
 ) -> PackedShardedResult:
     """Pad, shard, sweep. ``stripe=(t0, t1)`` limits the sweep to a dst tile
     range (default: all tiles); aggregates then cover only the swept dsts.
-    ``keep_matrix=None`` keeps the packed matrix when it is ≤ ~1 GB/device."""
+    ``keep_matrix=None`` keeps the packed matrix when it is ≤ ~1 GB/device.
+    ``groups`` (int [N] user-group ids) additionally aggregates per-group
+    in-degrees so ``user_crosscheck`` works without the matrix."""
     import time
 
     if len(enc.atoms) > 1:
@@ -314,7 +391,10 @@ def sharded_packed_reach(
     dp = mesh.shape[POD_AXIS]
     mp = mesh.shape[GRANT_AXIS]
     n = enc.n_pods
-    tile = max(32, tile - tile % 32)
+    if tile < 32 or tile % 32:
+        # same contract as tiled_k8s_reach: never silently change the
+        # caller's tile/stripe geometry
+        raise ValueError(f"tile must be a positive multiple of 32, got {tile}")
     # n_loc must be a multiple of the dst tile so every tile has one owner,
     # and the total tile count a multiple of mp for the round-robin sweep
     block = tile * max(1, math.ceil(max(n, 1) / (dp * tile)))
@@ -324,6 +404,19 @@ def sharded_packed_reach(
     n_pad = Np - n
     pod_kv, pod_key, pod_ns = pad_pods(enc.pod_kv, enc.pod_key, enc.pod_ns, n_pad)
     valid = np.arange(Np) < n
+    if groups is not None:
+        groups = np.asarray(groups)
+        if groups.shape != (n,):
+            raise ValueError(f"groups must be int [{n}], got {groups.shape}")
+        n_groups = int(groups.max()) + 1 if n else 1
+    else:
+        n_groups = 1
+    # one-hot over src rows; padded pods stay all-zero (no group)
+    grp8 = np.zeros((n_groups, Np), dtype=np.int8)
+    if groups is not None:
+        grp8[groups, np.arange(n)] = 1
+    else:
+        grp8[0, :n] = 1
     # grant axis padded to an (mp · chunk) multiple: each device's slice is an
     # exact number of peer-sweep chunks
     ingress = pad_grants(
@@ -364,6 +457,7 @@ def sharded_packed_reach(
         P(POD_AXIS, None),  # pod_key
         P(POD_AXIS),  # pod_ns
         P(POD_AXIS),  # valid
+        P(None, POD_AXIS),  # grp8
         P(),  # ns_kv
         P(),  # ns_key
         _specs_like(enc.pol_sel, P()),
@@ -377,6 +471,7 @@ def sharded_packed_reach(
         P(POD_AXIS, None),  # packed block (or stub)
         P(POD_AXIS),  # row_deg
         P(),  # col_deg (replicated after psum)
+        P(),  # grp_deg (replicated after psum)
         P(POD_AXIS),  # ing_iso
         P(POD_AXIS),  # eg_iso
     )
@@ -387,11 +482,12 @@ def sharded_packed_reach(
         )
     )
     t_start = time.perf_counter()
-    packed, row_deg, col_deg, ing_iso, eg_iso = fn(
+    packed, row_deg, col_deg, grp_deg, ing_iso, eg_iso = fn(
         pod_kv,
         pod_key,
         pod_ns,
         valid,
+        grp8,
         enc.ns_kv,
         enc.ns_key,
         enc.pol_sel,
@@ -413,5 +509,11 @@ def sharded_packed_reach(
         egress_isolated=np.asarray(eg_iso)[:n],
         full_sweep=full_sweep,
         packed=np.asarray(packed)[:n] if keep_matrix else None,
+        groups=groups if groups is not None else None,
+        group_in_degree=(
+            np.asarray(grp_deg)[:, :n].astype(np.int64)
+            if groups is not None
+            else None
+        ),
         timings={"solve": elapsed, "stripe": (t0, t1), "tiles": n_tiles_total},
     )

@@ -159,23 +159,34 @@ def load_incremental(directory: str, config: Optional[VerifyConfig] = None,
 
     cluster, _ = load_cluster(os.path.join(directory, "cluster"))
     state_path = os.path.join(directory, "state.npz")
+    semantic_keys = (
+        "self_traffic",
+        "default_allow_unselected",
+        "direction_aware_isolation",
+        "compute_ports",
+        "closure",
+    )
     with np.load(state_path) as z:
         saved = json.loads(bytes(z["__config__"]).decode())
+        missing = [k for k in semantic_keys if k not in saved]
+        if missing:
+            raise ValueError(
+                f"load_incremental: checkpoint {state_path} lacks semantic "
+                f"config keys {missing} — written by an incompatible "
+                "framework version; re-verify from scratch instead of resuming"
+            )
         if config is None:
-            config = VerifyConfig(**saved)
+            config = VerifyConfig(
+                **{k: saved[k] for k in semantic_keys},
+                backend=saved.get("backend", "cpu"),
+            )
         else:
             # The checkpointed counts were derived under the saved semantic
             # flags; reinterpreting them under different flags is silent
             # corruption. Only the backend/device choice may differ on resume.
             mismatched = {
                 k: (saved[k], getattr(config, k))
-                for k in (
-                    "self_traffic",
-                    "default_allow_unselected",
-                    "direction_aware_isolation",
-                    "compute_ports",
-                    "closure",
-                )
+                for k in semantic_keys
                 if getattr(config, k) != saved[k]
             }
             if mismatched:

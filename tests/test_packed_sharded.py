@@ -104,6 +104,40 @@ def test_stripes_compose():
     assert a.total_pairs + b.total_pairs == int(ref.reach.sum())
 
 
+def test_user_crosscheck_and_system_isolation():
+    """Crosscheck from the packed matrix AND from the matrix-free per-group
+    in-degree aggregates; system_isolation from the matrix (and a clear
+    refusal without it)."""
+    from kubernetes_verification_tpu.ops import queries
+
+    cluster = random_cluster(
+        GeneratorConfig(n_pods=57, n_policies=11, n_namespaces=3, seed=15)
+    )
+    ref = kv.verify(cluster, kv.VerifyConfig(backend="cpu", compute_ports=False))
+    expect = queries.user_crosscheck(ref.reach, cluster.pods, "team")
+
+    with_matrix = _solve(cluster, (4, 2), keep_matrix=True)
+    assert with_matrix.user_crosscheck(cluster.pods, "team") == expect
+    for idx in (0, 29):
+        assert with_matrix.system_isolation(idx) == queries.system_isolation(
+            ref.reach, idx
+        )
+
+    gid = queries.user_groups(cluster.pods, "team")
+    no_matrix = _solve(cluster, (4, 2), keep_matrix=False, groups=gid)
+    assert no_matrix.packed is None
+    assert no_matrix.user_crosscheck(cluster.pods, "team") == expect
+    with pytest.raises(ValueError, match="keep_matrix"):
+        no_matrix.system_isolation(0)
+
+    bare = _solve(cluster, (4, 2), keep_matrix=False)
+    with pytest.raises(ValueError, match="groups"):
+        bare.user_crosscheck(cluster.pods, "team")
+    # a different grouping than the solve aggregated over must be refused
+    with pytest.raises(ValueError, match="grouping"):
+        no_matrix.user_crosscheck(cluster.pods, "app")
+
+
 def test_ports_encoding_rejected():
     cluster = random_cluster(
         GeneratorConfig(n_pods=10, n_policies=4, p_ports=1.0, seed=2)

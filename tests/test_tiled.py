@@ -10,9 +10,11 @@ from kubernetes_verification_tpu.harness.generate import (
     GeneratorConfig,
     random_cluster,
 )
+from kubernetes_verification_tpu.ops import queries
 from kubernetes_verification_tpu.ops.tiled import (
     PackedReach,
     pack_bool_cols,
+    policy_pair_masks,
     tiled_k8s_reach,
     unpack_cols,
 )
@@ -89,6 +91,72 @@ def test_packed_queries_and_point_lookup():
         np.testing.assert_array_equal(got.row(s), ref.reach[s])
         for d in range(0, 37, 5):
             assert got.reachable(s, d) == bool(ref.reach[s, d])
+
+
+# ---------------------------------------------------------------------------
+# flagship-scale queries on the packed form (no to_bool)
+# ---------------------------------------------------------------------------
+
+
+def test_crosscheck_and_isolation_on_packed():
+    """user_crosscheck / system_isolation answered from the packed words must
+    match the dense-matrix query implementations."""
+    cluster = random_cluster(
+        GeneratorConfig(n_pods=67, n_policies=13, n_namespaces=3, seed=17)
+    )
+    ref = kv.verify(cluster, kv.VerifyConfig(backend="cpu", compute_ports=False))
+    enc = encode_cluster(cluster, compute_ports=False)
+    got = tiled_k8s_reach(enc, tile=32, chunk=8)
+    for label in ("team", "app", "nope-such-label"):
+        assert got.user_crosscheck(cluster.pods, label) == queries.user_crosscheck(
+            ref.reach, cluster.pods, label
+        )
+    for idx in (0, 13, 66):
+        assert got.system_isolation(idx) == queries.system_isolation(
+            ref.reach, idx
+        )
+    np.testing.assert_array_equal(got.out_degree(), ref.reach.sum(axis=1))
+
+
+def test_queries_on_device_resident_packed():
+    """fetch=False: every packed query reduces on device (or unpacks one
+    row) instead of shipping the matrix."""
+    cluster = random_cluster(
+        GeneratorConfig(n_pods=50, n_policies=9, n_namespaces=2, seed=19)
+    )
+    ref = kv.verify(cluster, kv.VerifyConfig(backend="cpu", compute_ports=False))
+    enc = encode_cluster(cluster, compute_ports=False)
+    got = tiled_k8s_reach(enc, tile=32, chunk=8, fetch=False)
+    assert not isinstance(got.packed, np.ndarray)
+    assert got.all_isolated() == ref.all_isolated()
+    assert got.all_reachable() == ref.all_reachable()
+    np.testing.assert_array_equal(got.out_degree(), ref.reach.sum(axis=1))
+    assert got.user_crosscheck(cluster.pods, "team") == queries.user_crosscheck(
+        ref.reach, cluster.pods, "team"
+    )
+    assert got.system_isolation(3) == queries.system_isolation(ref.reach, 3)
+
+
+@pytest.mark.parametrize("seed", [21, 22])
+@pytest.mark.parametrize("dai", [True, False])
+def test_policy_pair_masks_match_oracle(seed, dai):
+    """The device-side policy-pair Gram masks reproduce the oracle's
+    policy_shadow / policy_conflict pair lists exactly."""
+    cluster = random_cluster(
+        GeneratorConfig(n_pods=59, n_policies=17, n_namespaces=3, seed=seed)
+    )
+    ref = kv.verify(
+        cluster,
+        kv.VerifyConfig(
+            backend="cpu", compute_ports=False, direction_aware_isolation=dai
+        ),
+    )
+    enc = encode_cluster(cluster, compute_ports=False)
+    shadow, conflict = policy_pair_masks(
+        enc, direction_aware_isolation=dai, chunk=8
+    )
+    assert queries._pairs(shadow) == ref.policy_shadow()
+    assert queries._pairs(conflict) == ref.policy_conflict()
 
 
 # ---------------------------------------------------------------------------
