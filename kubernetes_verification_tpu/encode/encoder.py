@@ -39,8 +39,10 @@ __all__ = [
     "GrantBlock",
     "EncodedCluster",
     "EncodedKano",
+    "PolicyDelta",
     "encode_cluster",
     "encode_kano",
+    "encode_policy_delta",
 ]
 
 
@@ -185,7 +187,8 @@ class EncodedCluster:
 
 
 def _encode_grants(
-    cluster: Cluster,
+    policies: Sequence[NetworkPolicy],
+    pods: Sequence,
     direction: str,
     atoms: Sequence[PortAtom],
     vocab: Vocab,
@@ -199,8 +202,8 @@ def _encode_grants(
     port_rows: List[np.ndarray] = []
     ip_rows: Dict[int, np.ndarray] = {}
 
-    n = cluster.n_pods
-    for pi, pol in enumerate(cluster.policies):
+    n = len(pods)
+    for pi, pol in enumerate(policies):
         rules = pol.ingress if direction == "ingress" else pol.egress
         if not rules:
             continue
@@ -226,7 +229,7 @@ def _encode_grants(
                     ns_null.append(True)
                     is_ip.append(True)
                     ip_rows[g] = np.array(
-                        [peer.ip_block.matches_ip(p.ip) for p in cluster.pods],
+                        [peer.ip_block.matches_ip(p.ip) for p in pods],
                         dtype=bool,
                     )
                 else:
@@ -297,11 +300,54 @@ def encode_cluster(
             [pol.affects_egress for pol in cluster.policies], dtype=bool
         ),
         ingress=_encode_grants(
-            cluster, "ingress", atoms, vocab
+            cluster.policies, cluster.pods, "ingress", atoms, vocab
         ),
         egress=_encode_grants(
-            cluster, "egress", atoms, vocab
+            cluster.policies, cluster.pods, "egress", atoms, vocab
         ),
+    )
+
+
+@dataclass
+class PolicyDelta:
+    """One policy re-encoded against a *frozen* cluster encoding.
+
+    This is the unit of incremental re-verify (BASELINE config 5): a policy
+    diff re-enters the same compilation path as ``encode_cluster`` —
+    ``_encode_selector_stack`` + ``_encode_grants`` — but for a single policy,
+    against the vocab/atom/namespace universe captured at init. Selector pairs
+    the frozen vocab has never seen encode as ``impossible`` rows, which is
+    exact while the pod set is frozen (no pod can carry an unseen pair; pods
+    whose labels diverged after init are patched separately by the verifiers'
+    dirty-pod fixup). A policy in a namespace unknown to the frozen index gets
+    the sentinel ``pol_ns == -2``: it never equals a real pod namespace (>= 0)
+    or the pad sentinel (-1), so it selects nothing and peers nothing
+    same-namespace — correct, because the frozen pod set has no pods there.
+    """
+
+    pol_ns: int
+    affects_ingress: bool
+    affects_egress: bool
+    pod_sel: SelectorEnc  # [1] podSelector
+    ingress: GrantBlock
+    egress: GrantBlock
+
+
+def encode_policy_delta(
+    pol: NetworkPolicy,
+    vocab: Vocab,
+    atoms: Sequence[PortAtom],
+    ns_index: Dict[str, int],
+    pods: Sequence,
+) -> PolicyDelta:
+    """Compile ONE policy against a frozen ``EncodedCluster`` universe."""
+    return PolicyDelta(
+        pol_ns=ns_index.get(pol.namespace, -2),
+        affects_ingress=pol.affects_ingress,
+        affects_egress=pol.affects_egress,
+        pod_sel=_encode_selector_stack([pol.pod_selector], vocab),
+        ingress=_encode_grants([pol], pods, "ingress", atoms, vocab),
+        egress=_encode_grants([pol], pods, "egress", atoms, vocab),
     )
 
 

@@ -99,7 +99,6 @@ class IncrementalVerifier:
             for p in cluster.pods
         ]
         self.namespaces = list(cluster.namespaces)
-        self._ns_labels = {ns.name: ns.labels for ns in self.namespaces}
         self.policies: Dict[str, NetworkPolicy] = {}
         n = len(self.pods)
         self._ing_count = jnp.zeros((n, n), dtype=_I32, device=self.device)
@@ -111,16 +110,46 @@ class IncrementalVerifier:
         self._reach_dirty = True
         self._reach = None
         self.update_count = 0
-        if cluster.policies:
-            self._batch_init(cluster)
+        self._batch_init(cluster)
 
     def _batch_init(self, cluster: Cluster) -> None:
         """Initial build: one encoder pass + one batched device contraction
-        (P rank-1 updates collapsed into two [P,N]×[P,N] matmuls)."""
+        (P rank-1 updates collapsed into two [P,N]×[P,N] matmuls). The frozen
+        encoding also seeds the :class:`~.packed_incremental.PolicyVectorizer`
+        that later policy diffs re-encode through."""
         from .encode.encoder import encode_cluster
+        from .encode.vocab import Vocab
         from .ops.tiled import _grant_peers_full
+        from .packed_incremental import PolicyVectorizer
 
-        enc = encode_cluster(cluster, compute_ports=False)
+        snapshot = Cluster(
+            pods=self.pods, namespaces=self.namespaces,
+            policies=list(cluster.policies),
+        )
+        self._ns_labels = {ns.name: ns.labels for ns in self.namespaces}
+
+        def seed_vectorizer(vocab) -> None:
+            self._vectorizer = PolicyVectorizer(
+                self.pods,
+                self._ns_labels,
+                vocab,
+                {ns.name: i for i, ns in enumerate(self.namespaces)},
+                self.config.direction_aware_isolation,
+            )
+
+        if not cluster.policies:
+            # nothing to solve: skip the full encode (its [N, V] label
+            # matrices and grant stacks feed only the batch contraction) —
+            # the vectorizer needs just the vocab
+            seed_vectorizer(
+                Vocab.build(
+                    [p.labels for p in self.pods]
+                    + [ns.labels for ns in self.namespaces]
+                )
+            )
+            return
+        enc = encode_cluster(snapshot, compute_ports=False)
+        seed_vectorizer(enc.vocab)
         P, n = enc.n_policies, enc.n_pods
         cfg = self.config
 
@@ -197,51 +226,13 @@ class IncrementalVerifier:
         self, pol: NetworkPolicy
     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
         """(sel_ing, sel_eg, ing_peers, eg_peers) bool [N] for one policy —
-        the object-level semantics of the CPU oracle (``backends/cpu.py``),
-        evaluated for a single policy."""
-        n = len(self.pods)
-        cfg = self.config
-        selected = np.fromiter(
-            (
-                p.namespace == pol.namespace and pol.pod_selector.matches(p.labels)
-                for p in self.pods
-            ),
-            dtype=bool,
-            count=n,
+        re-encoded against the frozen init-time encoding and evaluated with
+        the batch match/peer kernels on device (label-drifted pods fixed up
+        on host), replacing the old per-rule × per-peer × per-pod Python
+        loops. Semantics are the CPU oracle's (``backends/cpu.py``)."""
+        return tuple(
+            np.asarray(v, dtype=bool) for v in self._vectorizer.vectors(pol)
         )
-        aff_in = pol.affects_ingress if cfg.direction_aware_isolation else True
-        aff_eg = pol.affects_egress if cfg.direction_aware_isolation else True
-        sel_ing = selected & aff_in
-        sel_eg = selected & aff_eg
-
-        def peer_union(rules) -> np.ndarray:
-            acc = np.zeros(n, dtype=bool)
-            for rule in rules or ():
-                if rule.matches_all_peers:
-                    acc[:] = True
-                    continue
-                for peer in rule.peers:
-                    for i, pod in enumerate(self.pods):
-                        if acc[i]:
-                            continue
-                        if peer.ip_block is not None:
-                            acc[i] = peer.ip_block.matches_ip(pod.ip)
-                            continue
-                        if peer.namespace_selector is None:
-                            ns_ok = pod.namespace == pol.namespace
-                        else:
-                            ns_ok = peer.namespace_selector.matches(
-                                self._ns_labels.get(pod.namespace, {})
-                            )
-                        acc[i] = ns_ok and (
-                            peer.pod_selector is None
-                            or peer.pod_selector.matches(pod.labels)
-                        )
-            return acc
-
-        ing_peers = peer_union(pol.ingress) if aff_in else np.zeros(n, dtype=bool)
-        eg_peers = peer_union(pol.egress) if aff_eg else np.zeros(n, dtype=bool)
-        return sel_ing, sel_eg, ing_peers, eg_peers
 
     def _apply(self, vecs, sign: int) -> None:
         sel_ing, sel_eg, ing_peers, eg_peers = (jnp.asarray(v) for v in vecs)
@@ -310,23 +301,17 @@ class IncrementalVerifier:
 
         old = row_col_sums()
         pod.labels = dict(labels)
+        # the frozen device encoding no longer reflects this pod; later
+        # policy re-encodes must fix its entries up on host
+        self._vectorizer.dirty.add(idx)
+        from .packed_incremental import pod_policy_flags
+
         for key, pol in self.policies.items():
-            sel_ing, sel_eg, ing_peers, eg_peers = self._vectors[key]
-            cfg = self.config
-            aff_in = pol.affects_ingress if cfg.direction_aware_isolation else True
-            aff_eg = pol.affects_egress if cfg.direction_aware_isolation else True
-            selected = (
-                pod.namespace == pol.namespace
-                and pol.pod_selector.matches(pod.labels)
+            flags = pod_policy_flags(
+                pol, pod, self._ns_labels, self.config.direction_aware_isolation
             )
-            sel_ing[idx] = selected and aff_in
-            sel_eg[idx] = selected and aff_eg
-            ing_peers[idx] = (
-                self._peer_match_one(pol, pol.ingress, pod) if aff_in else False
-            )
-            eg_peers[idx] = (
-                self._peer_match_one(pol, pol.egress, pod) if aff_eg else False
-            )
+            for vec, f in zip(self._vectors[key], flags):
+                vec[idx] = f
         new = row_col_sums()
         self._ing_count = _row_col_patch(
             self._ing_count, idx,
@@ -342,28 +327,6 @@ class IncrementalVerifier:
         self._eg_iso[idx] += new[5] - old[5]
         self._reach_dirty = True
         self.update_count += 1
-
-    def _peer_match_one(self, pol, rules, pod) -> bool:
-        for rule in rules or ():
-            if rule.matches_all_peers:
-                return True
-            for peer in rule.peers:
-                if peer.ip_block is not None:
-                    if peer.ip_block.matches_ip(pod.ip):
-                        return True
-                    continue
-                if peer.namespace_selector is None:
-                    ns_ok = pod.namespace == pol.namespace
-                else:
-                    ns_ok = peer.namespace_selector.matches(
-                        self._ns_labels.get(pod.namespace, {})
-                    )
-                if ns_ok and (
-                    peer.pod_selector is None
-                    or peer.pod_selector.matches(pod.labels)
-                ):
-                    return True
-        return False
 
     # --------------------------------------------------------------- result
     @property

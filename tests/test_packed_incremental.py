@@ -1,0 +1,179 @@
+"""Packed incremental re-verify: the device-resident, bit-packed diff path
+(BASELINE config 5's 100k-scale half). Every mutation's result must equal a
+from-scratch CPU-oracle solve of the mutated cluster, and the packed verifier
+must agree bit-for-bit with the dense count-matrix verifier."""
+import dataclasses
+import random
+
+import numpy as np
+import pytest
+
+import kubernetes_verification_tpu as kv
+from kubernetes_verification_tpu.harness.generate import (
+    GeneratorConfig,
+    random_cluster,
+)
+from kubernetes_verification_tpu.incremental import IncrementalVerifier
+from kubernetes_verification_tpu.packed_incremental import (
+    PackedIncrementalVerifier,
+)
+
+
+def _full(cluster, config):
+    return kv.verify(
+        cluster,
+        kv.VerifyConfig(
+            backend="cpu",
+            compute_ports=False,
+            self_traffic=config.self_traffic,
+            default_allow_unselected=config.default_allow_unselected,
+            direction_aware_isolation=config.direction_aware_isolation,
+        ),
+    ).reach
+
+
+@pytest.fixture()
+def setup():
+    cluster = random_cluster(
+        GeneratorConfig(n_pods=57, n_policies=9, n_namespaces=3, seed=7)
+    )
+    cfg = kv.VerifyConfig(compute_ports=False)
+    return cluster, cfg, PackedIncrementalVerifier(cluster, cfg)
+
+
+def test_initial_build_matches_oracle(setup):
+    cluster, cfg, inc = setup
+    np.testing.assert_array_equal(inc.reach, _full(cluster, cfg))
+
+
+def test_remove_add_update_sequence(setup):
+    cluster, cfg, inc = setup
+    pols = list(cluster.policies)
+    inc.remove_policy(pols[0].namespace, pols[0].name)
+    np.testing.assert_array_equal(inc.reach, _full(inc.as_cluster(), cfg))
+    inc.add_policy(dataclasses.replace(pols[0], name="brand-new"))
+    np.testing.assert_array_equal(inc.reach, _full(inc.as_cluster(), cfg))
+    upd = dataclasses.replace(
+        pols[1],
+        ingress=list(pols[2].ingress or []),
+        egress=list(pols[1].egress or []),
+    )
+    inc.update_policy(upd)
+    np.testing.assert_array_equal(inc.reach, _full(inc.as_cluster(), cfg))
+
+
+def test_relabel_then_policy_diff_uses_dirty_fixup(setup):
+    """A pod relabelled to pairs the frozen vocab has never seen must still
+    be matched correctly by policies (re-)encoded afterwards."""
+    cluster, cfg, inc = setup
+    inc.update_pod_labels(3, {"totally": "unseen", "fresh": "pair"})
+    np.testing.assert_array_equal(inc.reach, _full(inc.as_cluster(), cfg))
+    pol = kv.NetworkPolicy(
+        name="sel-unseen",
+        namespace=inc.pods[3].namespace,
+        pod_selector=kv.Selector({"totally": "unseen"}),
+        ingress=(
+            kv.Rule(peers=(kv.Peer(pod_selector=kv.Selector({"fresh": "pair"})),)),
+        ),
+    )
+    inc.add_policy(pol)
+    ref = _full(inc.as_cluster(), cfg)
+    np.testing.assert_array_equal(inc.reach, ref)
+    # the new policy must actually bite: pod 3 became ingress-isolated
+    assert inc.packed_reach().ingress_isolated[3]
+
+
+def test_fuzzed_diffs_match_oracle_and_dense():
+    cluster = random_cluster(
+        GeneratorConfig(n_pods=41, n_policies=7, n_namespaces=3, seed=21)
+    )
+    cfg = kv.VerifyConfig(compute_ports=False)
+    packed = PackedIncrementalVerifier(cluster, cfg)
+    dense = IncrementalVerifier(cluster, cfg)
+    donor = random_cluster(
+        GeneratorConfig(n_pods=41, n_policies=24, n_namespaces=3, seed=22)
+    )
+    rng = random.Random(0)
+    for i, p in enumerate(donor.policies[:10]):
+        p2 = dataclasses.replace(p, name=f"fuzz-{i}")
+        packed.add_policy(p2)
+        dense.add_policy(p2)
+        if i % 3 == 0:
+            key = rng.choice(sorted(packed.policies))
+            ns, name = key.split("/", 1)
+            packed.remove_policy(ns, name)
+            dense.remove_policy(ns, name)
+        if i % 4 == 1:
+            j = rng.randrange(41)
+            lbl = {"app": f"x{i}", "env": "prod"}
+            packed.update_pod_labels(j, lbl)
+            dense.update_pod_labels(j, lbl)
+        ref = _full(packed.as_cluster(), cfg)
+        np.testing.assert_array_equal(packed.reach, ref, err_msg=f"step {i}")
+        np.testing.assert_array_equal(dense.reach, ref, err_msg=f"dense {i}")
+
+
+@pytest.mark.parametrize(
+    "self_traffic,default_allow,direction_aware",
+    [(False, True, True), (True, False, True), (True, True, False),
+     (False, False, False)],
+)
+def test_flag_variants(self_traffic, default_allow, direction_aware):
+    cluster = random_cluster(
+        GeneratorConfig(n_pods=33, n_policies=7, n_namespaces=2, seed=11)
+    )
+    cfg = kv.VerifyConfig(
+        compute_ports=False,
+        self_traffic=self_traffic,
+        default_allow_unselected=default_allow,
+        direction_aware_isolation=direction_aware,
+    )
+    inc = PackedIncrementalVerifier(cluster, cfg)
+    np.testing.assert_array_equal(inc.reach, _full(cluster, cfg))
+    inc.update_policy(dataclasses.replace(cluster.policies[0], ingress=[]))
+    inc.remove_policy(
+        cluster.policies[1].namespace, cluster.policies[1].name
+    )
+    np.testing.assert_array_equal(inc.reach, _full(inc.as_cluster(), cfg))
+
+
+def test_capacity_growth():
+    cluster = random_cluster(
+        GeneratorConfig(n_pods=23, n_policies=3, n_namespaces=2, seed=31)
+    )
+    cfg = kv.VerifyConfig(compute_ports=False)
+    inc = PackedIncrementalVerifier(cluster, cfg, slot_round=4)
+    donor = random_cluster(
+        GeneratorConfig(n_pods=23, n_policies=16, n_namespaces=2, seed=32)
+    )
+    for i, p in enumerate(donor.policies):
+        inc.add_policy(dataclasses.replace(p, name=f"grow-{i}"))
+    assert len(inc.policies) == 19
+    np.testing.assert_array_equal(inc.reach, _full(inc.as_cluster(), cfg))
+
+
+def test_empty_policy_cluster():
+    cluster = random_cluster(
+        GeneratorConfig(n_pods=19, n_policies=0, n_namespaces=2, seed=41)
+    )
+    cfg = kv.VerifyConfig(compute_ports=False)
+    inc = PackedIncrementalVerifier(cluster, cfg)
+    np.testing.assert_array_equal(inc.reach, _full(cluster, cfg))
+    donor = random_cluster(
+        GeneratorConfig(n_pods=19, n_policies=2, n_namespaces=2, seed=42)
+    )
+    for p in donor.policies:
+        inc.add_policy(p)
+    np.testing.assert_array_equal(inc.reach, _full(inc.as_cluster(), cfg))
+
+
+def test_packed_queries_available(setup):
+    """The packed view serves the flagship-scale queries without unpacking."""
+    cluster, cfg, inc = setup
+    pr = inc.packed_reach()
+    ref = _full(cluster, cfg)
+    assert pr.all_isolated() == np.nonzero(~ref.any(axis=0))[0].tolist()
+    assert pr.all_reachable() == np.nonzero(ref.all(axis=0))[0].tolist()
+    np.testing.assert_array_equal(
+        pr.out_degree(), ref.sum(axis=1, dtype=np.int64)
+    )
