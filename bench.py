@@ -155,9 +155,10 @@ def bench_incremental(args) -> None:
                 ("update", dataclasses.replace(victim, ingress=donor.ingress))
             )
         elif kind == "add":
-            diffs.append(("add", dataclasses.replace(donor, name=f"bench-add-{i}")))
-        else:
-            diffs.append(("remove", f"bench-add-{i - 1}"))
+            added = dataclasses.replace(donor, name=f"bench-add-{i}")
+            diffs.append(("add", added))
+        else:  # remove the policy added on the previous iteration, by key
+            diffs.append(("remove", (added.namespace, added.name)))
     # warmup: run the first 3 (one of each kind) to take compiles out
     warm, timed = diffs[:3], diffs[3:]
     samples = {"add": [], "update": [], "remove": []}
@@ -168,9 +169,8 @@ def bench_incremental(args) -> None:
             inc.update_policy(payload)
         elif kind == "add":
             inc.add_policy(payload)
-        else:  # payloads for remove are names of earlier bench adds
-            pol = next(p for p in inc.policies.values() if p.name == payload)
-            inc.remove_policy(pol.namespace, pol.name)
+        else:  # payloads for remove are (namespace, name) keys
+            inc.remove_policy(*payload)
         jax.block_until_ready(inc._packed)
         if record:
             samples[kind].append(time.perf_counter() - s)
@@ -193,11 +193,13 @@ def bench_incremental(args) -> None:
     # locally-attached TPU does not pay)
     k = 10
     piped = {}
+    pipe_adds = [
+        dataclasses.replace(pols[(17 * i + 5) % len(pols)], name=f"pipe-{i}")
+        for i in range(k)
+    ]
     s = time.perf_counter()
-    for i in range(k):
-        inc.add_policy(
-            dataclasses.replace(pols[(17 * i + 5) % len(pols)], name=f"pipe-{i}")
-        )
+    for p in pipe_adds:
+        inc.add_policy(p)
     jax.block_until_ready(inc._packed)
     piped["add"] = (time.perf_counter() - s) / k
     s = time.perf_counter()
@@ -211,9 +213,8 @@ def bench_incremental(args) -> None:
     jax.block_until_ready(inc._packed)
     piped["update"] = (time.perf_counter() - s) / k
     s = time.perf_counter()
-    for i in range(k):
-        pol = next(p for p in inc.policies.values() if p.name == f"pipe-{i}")
-        inc.remove_policy(pol.namespace, pol.name)
+    for p in pipe_adds:
+        inc.remove_policy(p.namespace, p.name)
     jax.block_until_ready(inc._packed)
     piped["remove"] = (time.perf_counter() - s) / k
     overall_piped = statistics.median(sorted(piped.values()))

@@ -40,6 +40,7 @@ __all__ = [
     "EncodedCluster",
     "EncodedKano",
     "PolicyDelta",
+    "cluster_vocab",
     "encode_cluster",
     "encode_kano",
     "encode_policy_delta",
@@ -259,12 +260,20 @@ def _encode_grants(
     )
 
 
+def cluster_vocab(pods: Sequence, namespaces: Sequence) -> Vocab:
+    """The label-pair/key universe an encoding is frozen over: every pod and
+    namespace label. (Policy selector pairs are deliberately excluded — a
+    pair no entity carries can match nothing, and encodes as
+    ``impossible``.)"""
+    return Vocab.build(
+        [p.labels for p in pods] + [ns.labels for ns in namespaces]
+    )
+
+
 def encode_cluster(
     cluster: Cluster, compute_ports: bool = True
 ) -> EncodedCluster:
-    vocab = Vocab.build(
-        [p.labels for p in cluster.pods] + [ns.labels for ns in cluster.namespaces]
-    )
+    vocab = cluster_vocab(cluster.pods, cluster.namespaces)
     atoms = (
         compute_port_atoms(cluster.policies)
         if compute_ports

@@ -117,8 +117,7 @@ class IncrementalVerifier:
         (P rank-1 updates collapsed into two [P,N]×[P,N] matmuls). The frozen
         encoding also seeds the :class:`~.packed_incremental.PolicyVectorizer`
         that later policy diffs re-encode through."""
-        from .encode.encoder import encode_cluster
-        from .encode.vocab import Vocab
+        from .encode.encoder import cluster_vocab, encode_cluster
         from .ops.tiled import _grant_peers_full
         from .packed_incremental import PolicyVectorizer
 
@@ -141,12 +140,7 @@ class IncrementalVerifier:
             # nothing to solve: skip the full encode (its [N, V] label
             # matrices and grant stacks feed only the batch contraction) —
             # the vectorizer needs just the vocab
-            seed_vectorizer(
-                Vocab.build(
-                    [p.labels for p in self.pods]
-                    + [ns.labels for ns in self.namespaces]
-                )
-            )
+            seed_vectorizer(cluster_vocab(self.pods, self.namespaces))
             return
         enc = encode_cluster(snapshot, compute_ports=False)
         seed_vectorizer(enc.vocab)

@@ -309,6 +309,69 @@ class PolicyVectorizer:
 
 
 @partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5))
+def _slot_write(
+    sel_ing8,
+    sel_eg8,
+    ing_by_pol,
+    eg_by_pol,
+    ing_cnt,
+    eg_cnt,
+    slot,
+    new4,  # int8 [4, Np]
+):
+    """Matrix-free diff: write one policy slot's vectors + isolation counts
+    (the state update half of ``_diff_step``; used when the packed matrix is
+    not materialised — dirty rows/columns are tracked host-side and
+    re-verified by ``solve_stripe`` on demand)."""
+    old_si = sel_ing8[slot]
+    old_se = sel_eg8[slot]
+    return (
+        sel_ing8.at[slot].set(new4[0]),
+        sel_eg8.at[slot].set(new4[1]),
+        ing_by_pol.at[slot].set(new4[2]),
+        eg_by_pol.at[slot].set(new4[3]),
+        ing_cnt + (new4[0] - old_si).astype(_I32),
+        eg_cnt + (new4[1] - old_se).astype(_I32),
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=("width", "self_traffic", "default_allow"),
+)
+def _stripe_step(
+    sel_ing8,
+    sel_eg8,
+    ing_by_pol,
+    eg_by_pol,
+    ing_cnt,
+    eg_cnt,
+    col_mask,
+    d0,  # stripe start (multiple of 32)
+    *,
+    width: int,  # stripe width (multiple of 32)
+    self_traffic: bool,
+    default_allow: bool,
+):
+    """Re-solve one dst stripe of the packed matrix straight from the
+    resident per-policy maps — the re-verify primitive of the matrix-free
+    (config-5 scale) mode. Returns uint32 [Np, width/32]."""
+    C, Np = sel_ing8.shape
+    sel_t = jax.lax.dynamic_slice(sel_ing8, (0, d0), (C, width))
+    egp_t = jax.lax.dynamic_slice(eg_by_pol, (0, d0), (C, width))
+    ing_ok = _dot_c(ing_by_pol, sel_t) > 0  # [Np, width]
+    eg_ok = _dot_c(sel_eg8, egp_t) > 0
+    if default_allow:
+        ing_ok |= ~(jax.lax.dynamic_slice(ing_cnt, (d0,), (width,)) > 0)[None, :]
+        eg_ok |= ~(eg_cnt > 0)[:, None]
+    r = ing_ok & eg_ok
+    if self_traffic:
+        r |= jnp.arange(Np)[:, None] == (d0 + jnp.arange(width))[None, :]
+    mask_t = jax.lax.dynamic_slice(col_mask, (d0 // 32,), (width // 32,))
+    return pack_bool_cols(r) & mask_t[None, :]
+
+
+@partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5))
 def _apply_pod_col(
     sel_ing8,
     sel_eg8,
@@ -539,9 +602,22 @@ class PackedIncrementalVerifier:
         device=None,
         slot_round: int = 256,
         chunk: int = 2048,
+        mesh: Optional[jax.sharding.Mesh] = None,
+        keep_matrix: Optional[bool] = None,
     ) -> None:
+        """``mesh``: shard the state over a ``(pods, grants)`` mesh — the
+        slot axis over ``grants``, the pod axis over ``pods`` — instead of a
+        single device; every diff kernel then runs SPMD via jit sharding
+        propagation. ``keep_matrix=False`` (the default on a mesh when the
+        packed matrix exceeds ~1 GB/device) skips materialising the matrix:
+        diffs update the per-policy maps + isolation counts only, touched
+        rows/columns accumulate in ``dirty_rows``/``dirty_cols``, and
+        ``solve_stripe`` re-verifies any dst range straight from the maps —
+        the config-5 (1M-pod) composition, where the full packed matrix
+        (125 GB) never fits."""
         self.config = config or VerifyConfig()
-        self.device = device or jax.devices()[0]
+        self.mesh = mesh
+        self.device = device or (None if mesh else jax.devices()[0])
         self.pods: List[Pod] = [
             dataclasses.replace(
                 p, labels=dict(p.labels), container_ports=dict(p.container_ports)
@@ -564,7 +640,31 @@ class PackedIncrementalVerifier:
         enc = encode_cluster(snapshot, compute_ports=False)
         n = enc.n_pods
         self.n_pods = n
-        Np = max(128, -(-n // 128) * 128)
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as PS
+
+            from .parallel.mesh import GRANT_AXIS, POD_AXIS
+
+            dp = mesh.shape[POD_AXIS]
+            mp = mesh.shape[GRANT_AXIS]
+            if slot_round % mp:
+                raise ValueError(
+                    f"slot_round={slot_round} not divisible by the grant "
+                    f"axis size {mp}"
+                )
+            self._sh = {
+                "maps": NamedSharding(mesh, PS(GRANT_AXIS, POD_AXIS)),
+                "vec": NamedSharding(mesh, PS(POD_AXIS)),
+                "pods": NamedSharding(mesh, PS(POD_AXIS, None)),
+                "new4": NamedSharding(mesh, PS(None, POD_AXIS)),
+                "rep": NamedSharding(mesh, PS()),
+            }
+        else:
+            dp = 1
+            self._sh = None
+        align = 128 * dp
+        Np = max(align, -(-n // align) * align)
         self._n_padded = Np
         tile = next(
             t for t in (4096, 2048, 1024, 512, 256, 128) if Np % t == 0
@@ -575,9 +675,8 @@ class PackedIncrementalVerifier:
         )
         col_valid = np.zeros(Np, dtype=bool)
         col_valid[:n] = True
-        self._col_mask = jax.device_put(
-            np.packbits(col_valid, bitorder="little").view("<u4").copy(),
-            self.device,
+        self._col_mask = self._put(
+            np.packbits(col_valid, bitorder="little").view("<u4").copy(), "rep"
         )
 
         P = enc.n_policies
@@ -587,13 +686,18 @@ class PackedIncrementalVerifier:
             enc.ingress, (-enc.ingress.n) % g_chunk, P, n_pad
         )
         egress = pad_grants(enc.egress, (-enc.egress.n) % g_chunk, P, n_pad)
-        args = jax.device_put(
-            (
-                pod_kv, pod_key, pod_ns, enc.ns_kv, enc.ns_key,
-                enc.pol_sel, enc.pol_ns, enc.pol_affects_ingress,
-                enc.pol_affects_egress, ingress, egress,
+        args = (
+            self._put(pod_kv, "pods"),
+            self._put(pod_key, "pods"),
+            self._put(pod_ns, "vec"),
+            *(
+                self._put(a, "rep")
+                for a in (
+                    enc.ns_kv, enc.ns_key, enc.pol_sel, enc.pol_ns,
+                    enc.pol_affects_ingress, enc.pol_affects_egress,
+                    ingress, egress,
+                )
             ),
-            self.device,
         )
         maps = _build_maps(
             *args,
@@ -602,12 +706,12 @@ class PackedIncrementalVerifier:
         )
         self._capacity = max(slot_round, -(-(P + 8) // slot_round) * slot_round)
         pad_slots = self._capacity - P
-        self._sel_ing8 = jnp.pad(maps[0], ((0, pad_slots), (0, 0)))
-        self._sel_eg8 = jnp.pad(maps[1], ((0, pad_slots), (0, 0)))
-        self._ing_by_pol = jnp.pad(maps[2], ((0, pad_slots), (0, 0)))
-        self._eg_by_pol = jnp.pad(maps[3], ((0, pad_slots), (0, 0)))
-        self._ing_cnt = maps[4]
-        self._eg_cnt = maps[5]
+        self._sel_ing8 = self._place_map(jnp.pad(maps[0], ((0, pad_slots), (0, 0))))
+        self._sel_eg8 = self._place_map(jnp.pad(maps[1], ((0, pad_slots), (0, 0))))
+        self._ing_by_pol = self._place_map(jnp.pad(maps[2], ((0, pad_slots), (0, 0))))
+        self._eg_by_pol = self._place_map(jnp.pad(maps[3], ((0, pad_slots), (0, 0))))
+        self._ing_cnt = self._put(np.asarray(maps[4]), "vec")
+        self._eg_cnt = self._put(np.asarray(maps[5]), "vec")
         self._free = list(range(P, self._capacity))
         for i, pol in enumerate(cluster.policies):
             key = self._key(pol)
@@ -616,18 +720,28 @@ class PackedIncrementalVerifier:
             self.policies[key] = pol
             self._slot[key] = i
 
-        self._packed = _sweep_jit(
-            self._sel_ing8,
-            self._sel_eg8,
-            self._ing_by_pol,
-            self._eg_by_pol,
-            self._ing_cnt > 0,
-            self._eg_cnt > 0,
-            self._col_mask,
-            tile=tile,
-            self_traffic=cfg.self_traffic,
-            default_allow_unselected=cfg.default_allow_unselected,
-        )
+        W = Np // 32
+        if keep_matrix is None:
+            keep_matrix = mesh is None or Np * W * 4 // dp <= (1 << 30)
+        self.keep_matrix = keep_matrix
+        #: matrix-free mode: touched rows/cols since the last full re-solve
+        self.dirty_rows = np.zeros(n, dtype=bool)
+        self.dirty_cols = np.zeros(n, dtype=bool)
+        if keep_matrix:
+            self._packed = _sweep_jit(
+                self._sel_ing8,
+                self._sel_eg8,
+                self._ing_by_pol,
+                self._eg_by_pol,
+                self._ing_cnt > 0,
+                self._eg_cnt > 0,
+                self._col_mask,
+                tile=tile,
+                self_traffic=cfg.self_traffic,
+                default_allow_unselected=cfg.default_allow_unselected,
+            )
+        else:
+            self._packed = None
         self._vectorizer = PolicyVectorizer(
             self.pods,
             self._ns_labels,
@@ -642,6 +756,22 @@ class PackedIncrementalVerifier:
         self._prewarm()
         self.init_time = time.perf_counter() - t0
 
+    def _put(self, x, kind: str):
+        """Place a host array: on the mesh with the named sharding, or on
+        the single device."""
+        if self._sh is not None:
+            return jax.device_put(x, self._sh[kind])
+        if self.device is not None:
+            return jax.device_put(x, self.device)
+        return jnp.asarray(x)
+
+    def _place_map(self, x):
+        """Reshard a computed [C, Np] map onto the state sharding (no-op on
+        a single device — the array is already there)."""
+        if self._sh is not None:
+            return jax.device_put(x, self._sh["maps"])
+        return x
+
     def _prewarm(self) -> None:
         """Compile the diff-path kernels up front — through the exact same
         call path and argument construction real diffs use, so the first
@@ -650,18 +780,29 @@ class PackedIncrementalVerifier:
         value; column group fully masked) plus no-op spill patches."""
         slot = self._free[-1] if self._free else 0
         zeros4 = np.zeros((4, self._n_padded), dtype=np.int8)
+        if self._packed is None:
+            # matrix-free mode: the only diff kernel is the slot write
+            out = _slot_write(
+                *self._maps, np.int32(slot), self._put(zeros4, "new4")
+            )
+            (
+                self._sel_ing8, self._sel_eg8, self._ing_by_pol,
+                self._eg_by_pol, self._ing_cnt, self._eg_cnt,
+            ) = out
+            jax.block_until_ready(self._sel_ing8)
+            return
         r0 = np.zeros(_ROW_GROUP, dtype=np.int32)
         c0 = np.zeros(_COL_GROUP, dtype=np.int32)
         meta0 = self._col_meta(c0, 0)
         for has_rows, has_cols in (
-            (True, True), (False, True), (True, False),
+            (True, True), (False, True), (True, False), (False, False),
         ):
             out = _diff_step(
                 self._packed, *self._maps, self._col_mask,
-                jnp.int32(slot),
-                jax.device_put(zeros4, self.device),
-                jnp.asarray(r0), jnp.asarray(c0),
-                *(jnp.asarray(m) for m in meta0),
+                np.int32(slot),
+                self._put(zeros4, "new4"),
+                self._put(r0, "rep"), self._put(c0, "rep"),
+                *(self._put(m, "rep") for m in meta0),
                 has_rows=has_rows, has_cols=has_cols, **self._flags,
             )
             (
@@ -736,6 +877,19 @@ class PackedIncrementalVerifier:
         column groups; remaining groups spill to the standalone patches.
         (Row group no-ops recompute row 0 to its current value; column
         group no-ops are fully masked.)"""
+        if self._packed is None:
+            # matrix-free: update the maps + counts; record what a later
+            # solve_stripe must re-verify
+            out = _slot_write(
+                *self._maps, np.int32(slot), self._put(new4_padded, "new4")
+            )
+            (
+                self._sel_ing8, self._sel_eg8, self._ing_by_pol,
+                self._eg_by_pol, self._ing_cnt, self._eg_cnt,
+            ) = out
+            self.dirty_rows[rows] = True
+            self.dirty_cols[cols] = True
+            return
         row_groups = list(_groups(rows, _ROW_GROUP))
         col_groups = list(_groups(cols, _COL_GROUP))
         r0 = (
@@ -751,11 +905,11 @@ class PackedIncrementalVerifier:
             meta0 = self._col_meta(c0, 0)
         out = _diff_step(
             self._packed, *self._maps, self._col_mask,
-            jnp.int32(slot),
-            jax.device_put(new4_padded, self.device),
-            jnp.asarray(r0),
-            jnp.asarray(c0),
-            *(jnp.asarray(m) for m in meta0),
+            np.int32(slot),
+            self._put(new4_padded, "new4"),
+            self._put(r0, "rep"),
+            self._put(c0, "rep"),
+            *(self._put(m, "rep") for m in meta0),
             has_rows=bool(row_groups),
             has_cols=bool(col_groups),
             **self._flags,
@@ -770,13 +924,13 @@ class PackedIncrementalVerifier:
         for idx, _ in row_groups:
             self._packed = _patch_rows(
                 self._packed, *self._maps, self._col_mask,
-                jnp.asarray(idx), **self._flags,
+                self._put(idx, "rep"), **self._flags,
             )
         for idx, creal in col_groups:
             meta = self._col_meta(idx, int(creal.sum()))
             self._packed = _patch_cols(
                 self._packed, *self._maps,
-                jnp.asarray(idx), *(jnp.asarray(m) for m in meta),
+                self._put(idx, "rep"), *(self._put(m, "rep") for m in meta),
                 **self._flags,
             )
 
@@ -863,8 +1017,8 @@ class PackedIncrementalVerifier:
             cols[:, self._slot[key]] = flags
         out = _apply_pod_col(
             *self._maps,
-            jnp.int32(idx),
-            *(jax.device_put(c, self.device) for c in cols),
+            np.int32(idx),
+            *(self._put(c, "rep") for c in cols),
         )
         (
             self._sel_ing8, self._sel_eg8, self._ing_by_pol, self._eg_by_pol,
@@ -872,13 +1026,46 @@ class PackedIncrementalVerifier:
         ) = out
         self._h_ing_cnt[idx] = int(cols[0].sum())
         self._h_eg_cnt[idx] = int(cols[1].sum())
-        self._patch(np.asarray([idx]), np.asarray([idx]))
+        if self._packed is None:
+            self.dirty_rows[idx] = True
+            self.dirty_cols[idx] = True
+        else:
+            self._patch(np.asarray([idx]), np.asarray([idx]))
         self.update_count += 1
 
     # --------------------------------------------------------------- result
+    def solve_stripe(self, d0: int, width: int) -> np.ndarray:
+        """Re-solve dst columns ``[d0, d0+width)`` straight from the current
+        per-policy maps → uint32 [n, width/32]. This is matrix-free mode's
+        re-verify primitive (config-5 scale, where the full packed matrix
+        never fits): after a run of diffs, sweep the stripes covering
+        ``dirty_cols`` (plus any stripe — every stripe reflects
+        ``dirty_rows`` automatically, since rows are recomputed whole)."""
+        if d0 % 32 or width % 32 or width <= 0:
+            raise ValueError("d0 and width must be positive multiples of 32")
+        if d0 + width > self._n_padded:
+            raise ValueError(
+                f"stripe [{d0}, {d0 + width}) outside the padded pod range "
+                f"{self._n_padded}"
+            )
+        out = _stripe_step(
+            *self._maps,
+            self._col_mask,
+            np.int32(d0),
+            width=width,
+            **self._flags,
+        )
+        return np.asarray(out[: self.n_pods])
+
     def packed_reach(self) -> PackedReach:
         """Current state as a :class:`~.ops.tiled.PackedReach` (the packed
         matrix stays device-resident; queries reduce on device)."""
+        if self._packed is None:
+            raise ValueError(
+                "keep_matrix=False: the packed matrix is not materialised at "
+                "this scale — use solve_stripe(d0, width) to re-verify dst "
+                "ranges from the maps"
+            )
         n = self.n_pods
         return PackedReach(
             packed=self._packed[:n],

@@ -167,6 +167,54 @@ def test_empty_policy_cluster():
     np.testing.assert_array_equal(inc.reach, _full(inc.as_cluster(), cfg))
 
 
+@pytest.mark.parametrize("shape", [(8, 1), (4, 2), (2, 4)])
+def test_mesh_sharded_state_diffs(shape):
+    """Config-5 composition: the same verifier with its state sharded over a
+    (pods, grants) mesh — every diff kernel runs SPMD — must track the
+    oracle exactly."""
+    from kubernetes_verification_tpu.parallel.mesh import mesh_for
+
+    cluster = random_cluster(
+        GeneratorConfig(n_pods=61, n_policies=11, n_namespaces=3, seed=43)
+    )
+    cfg = kv.VerifyConfig(compute_ports=False)
+    inc = PackedIncrementalVerifier(cluster, cfg, mesh=mesh_for(shape))
+    assert inc.keep_matrix
+    np.testing.assert_array_equal(inc.reach, _full(cluster, cfg))
+    pols = list(cluster.policies)
+    inc.remove_policy(pols[0].namespace, pols[0].name)
+    inc.add_policy(dataclasses.replace(pols[0], name="readd"))
+    inc.update_policy(dataclasses.replace(pols[1], ingress=pols[2].ingress))
+    inc.update_pod_labels(5, {"zz": "qq"})
+    np.testing.assert_array_equal(inc.reach, _full(inc.as_cluster(), cfg))
+
+
+def test_mesh_matrix_free_stripes():
+    """keep_matrix=False (the 1M-pod regime): diffs update only the sharded
+    maps + dirty sets; solve_stripe re-verifies any dst range from the maps."""
+    from kubernetes_verification_tpu.ops.tiled import unpack_cols
+    from kubernetes_verification_tpu.parallel.mesh import mesh_for
+
+    cluster = random_cluster(
+        GeneratorConfig(n_pods=61, n_policies=11, n_namespaces=3, seed=43)
+    )
+    cfg = kv.VerifyConfig(compute_ports=False)
+    inc = PackedIncrementalVerifier(
+        cluster, cfg, mesh=mesh_for((4, 2)), keep_matrix=False
+    )
+    with pytest.raises(ValueError, match="keep_matrix"):
+        inc.packed_reach()
+    pols = list(cluster.policies)
+    inc.update_policy(dataclasses.replace(pols[1], ingress=pols[2].ingress))
+    inc.remove_policy(pols[3].namespace, pols[3].name)
+    assert inc.dirty_cols.any() or inc.dirty_rows.any()
+    ref = _full(inc.as_cluster(), cfg)
+    full = unpack_cols(inc.solve_stripe(0, inc._n_padded), inc.n_pods)
+    np.testing.assert_array_equal(full, ref)
+    s = unpack_cols(inc.solve_stripe(32, 32), 32)  # dst cols [32, 64)
+    np.testing.assert_array_equal(s[:, : 61 - 32], ref[:, 32:61])
+
+
 def test_packed_queries_available(setup):
     """The packed view serves the flagship-scale queries without unpacking."""
     cluster, cfg, inc = setup
