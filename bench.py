@@ -73,8 +73,10 @@ def bench_tiled(args) -> None:
         f"grants in/eg {enc.ingress.n}/{enc.egress.n}  "
         f"port atoms {len(enc.atoms)}"
     )
+    # --pallas forces the fused kernel; otherwise tiled_k8s_reach
+    # auto-selects (Pallas for any-port on TPU, XLA mask-group for ports)
     run = lambda: tiled_k8s_reach(
-        enc, device=dev, fetch=False, use_pallas=args.pallas
+        enc, device=dev, fetch=False, use_pallas=True if args.pallas else None
     )
     res = run()  # compile + first solve
     t3 = time.perf_counter()

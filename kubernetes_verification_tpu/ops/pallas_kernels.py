@@ -25,6 +25,15 @@ Why this shape (see ``/opt/skills/guides/pallas_guide.md``):
   f32), combined with an integer shift-OR and a bitcast.
 
 ``interpret=True`` runs the same kernels on CPU for the differential tests.
+
+Measured head-to-head on real hardware (one v5e chip, 100k pods / 10k
+policies, any-port, identical outputs — 3,100,847,493 reachable pairs both
+ways): **Pallas 2.45 s (4.08e9 pairs/s) vs XLA tiled 2.53 s (3.95e9
+pairs/s)** — a ~3.4% win, so ``tiled_k8s_reach`` auto-selects this kernel
+for any-port solves on TPU. The port mask-group path stays on the XLA
+kernels: its extra work is R more segment dots feeding the same MXU, where
+fusion has proportionally less to save, and the R-segment + O(R²)-combine
+structure would need a per-layout Pallas specialisation for a sub-5% ceiling.
 """
 from __future__ import annotations
 

@@ -900,7 +900,7 @@ def tiled_k8s_reach(
     direction_aware_isolation: bool = True,
     device=None,
     fetch: bool = True,
-    use_pallas: bool = False,
+    use_pallas: Optional[bool] = None,
     max_port_masks: int = _MAX_PORT_MASKS,
 ) -> PackedReach:
     """Host wrapper: pad N to a tile multiple, run the jitted tiled step,
@@ -908,6 +908,13 @@ def tiled_k8s_reach(
     and at least one rule naming ports) the port-aware mask-group kernel
     runs; otherwise the any-port kernel (identical semantics to
     ``compute_ports=False`` on the other backends).
+
+    ``use_pallas=None`` auto-selects: the fused Pallas kernel for any-port
+    solves on real TPU hardware (measured ~3% faster than the XLA path at
+    the flagship config — 4.08e9 vs 3.95e9 pairs/s on one v5e chip, 100k
+    pods / 10k policies, identical outputs), the XLA kernels everywhere
+    else (the port mask-group path, and CPU, where Pallas would run in
+    interpret mode).
 
     ``fetch=False`` leaves the packed matrix on device (``PackedReach.packed``
     is a JAX array; force with ``np.asarray`` when needed) and synchronises on
@@ -920,6 +927,13 @@ def tiled_k8s_reach(
 
     n = enc.n_pods
     with_ports = len(enc.atoms) > 1
+    if use_pallas is None:
+        platform = (
+            device.platform if device is not None else jax.default_backend()
+        )
+        use_pallas = (
+            not with_ports and platform == "tpu" and tile % 4096 == 0
+        )
     if with_ports and use_pallas:
         raise ValueError(
             "use_pallas supports the any-port path only; encode with "

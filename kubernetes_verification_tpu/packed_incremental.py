@@ -357,16 +357,18 @@ def _stripe_step(
     resident per-policy maps — the re-verify primitive of the matrix-free
     (config-5 scale) mode. Returns uint32 [Np, width/32]."""
     C, Np = sel_ing8.shape
-    sel_t = jax.lax.dynamic_slice(sel_ing8, (0, d0), (C, width))
-    egp_t = jax.lax.dynamic_slice(eg_by_pol, (0, d0), (C, width))
-    ing_ok = _dot_c(ing_by_pol, sel_t) > 0  # [Np, width]
-    eg_ok = _dot_c(sel_eg8, egp_t) > 0
-    if default_allow:
-        ing_ok |= ~(jax.lax.dynamic_slice(ing_cnt, (d0,), (width,)) > 0)[None, :]
-        eg_ok |= ~(eg_cnt > 0)[:, None]
-    r = ing_ok & eg_ok
-    if self_traffic:
-        r |= jnp.arange(Np)[:, None] == (d0 + jnp.arange(width))[None, :]
+    r = _reach_block(
+        ing_by_pol,
+        jax.lax.dynamic_slice(sel_ing8, (0, d0), (C, width)),
+        sel_eg8,
+        jax.lax.dynamic_slice(eg_by_pol, (0, d0), (C, width)),
+        jax.lax.dynamic_slice(ing_cnt, (d0,), (width,)),
+        eg_cnt,
+        jnp.arange(Np, dtype=jnp.int32),
+        d0 + jnp.arange(width, dtype=jnp.int32),
+        self_traffic,
+        default_allow,
+    )
     mask_t = jax.lax.dynamic_slice(col_mask, (d0 // 32,), (width // 32,))
     return pack_bool_cols(r) & mask_t[None, :]
 
@@ -403,6 +405,33 @@ def _dot_c(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     )
 
 
+def _reach_block(
+    ing_by_pol_s,  # int8 [C, S] — src-side ingress peer operand
+    sel_ing_d,  # int8 [C, D] — dst-side ingress selection operand
+    sel_eg_s,  # int8 [C, S] — src-side egress selection operand
+    eg_by_pol_d,  # int8 [C, D] — dst-side egress peer operand
+    ing_cnt_d,  # int32 [D]
+    eg_cnt_s,  # int32 [S]
+    src_ids,  # int32 [S] — global pod ids of the block's rows
+    dst_ids,  # int32 [D] — global pod ids of the block's columns
+    self_traffic: bool,
+    default_allow: bool,
+) -> jnp.ndarray:
+    """THE reach formula for an arbitrary (src rows × dst cols) block —
+    the single copy shared by the row patch, the exact-column patch and the
+    stripe re-solve, so a semantics change lands in all three kernels (and
+    stays differentially pinned to ``_sweep_packed``) by construction."""
+    ing_ok = _dot_c(ing_by_pol_s, sel_ing_d) > 0  # [S, D]
+    eg_ok = _dot_c(sel_eg_s, eg_by_pol_d) > 0
+    if default_allow:
+        ing_ok |= ~(ing_cnt_d > 0)[None, :]
+        eg_ok |= ~(eg_cnt_s > 0)[:, None]
+    r = ing_ok & eg_ok
+    if self_traffic:
+        r |= src_ids[:, None] == dst_ids[None, :]
+    return r
+
+
 def _rows_body(
     packed, sel_ing8, sel_eg8, ing_by_pol, eg_by_pol, ing_cnt, eg_cnt,
     col_mask, rows, self_traffic, default_allow,
@@ -410,14 +439,13 @@ def _rows_body(
     """Recompute the full packed rows of the touched sources. ``rows`` may
     contain duplicates (pad repeats) — the scattered values are equal."""
     Np = sel_ing8.shape[1]
-    ing_ok = _dot_c(jnp.take(ing_by_pol, rows, axis=1), sel_ing8) > 0
-    eg_ok = _dot_c(jnp.take(sel_eg8, rows, axis=1), eg_by_pol) > 0
-    if default_allow:
-        ing_ok |= ~(ing_cnt > 0)[None, :]
-        eg_ok |= ~(jnp.take(eg_cnt, rows) > 0)[:, None]
-    r = ing_ok & eg_ok
-    if self_traffic:
-        r |= rows[:, None] == jnp.arange(Np)[None, :]
+    r = _reach_block(
+        jnp.take(ing_by_pol, rows, axis=1), sel_ing8,
+        jnp.take(sel_eg8, rows, axis=1), eg_by_pol,
+        ing_cnt, jnp.take(eg_cnt, rows),
+        rows, jnp.arange(Np, dtype=jnp.int32),
+        self_traffic, default_allow,
+    )
     return packed.at[rows].set(pack_bool_cols(r) & col_mask[None, :])
 
 
@@ -454,14 +482,13 @@ def _cols_body(
     clear: uint32 [Dw] — per word-slot OR of the real cols' bit masks."""
     Np = sel_ing8.shape[1]
     Dw = words.shape[0]
-    ing_ok = _dot_c(ing_by_pol, jnp.take(sel_ing8, cols, axis=1)) > 0
-    eg_ok = _dot_c(sel_eg8, jnp.take(eg_by_pol, cols, axis=1)) > 0
-    if default_allow:
-        ing_ok |= ~(jnp.take(ing_cnt, cols) > 0)[None, :]
-        eg_ok |= ~(eg_cnt > 0)[:, None]
-    r = ing_ok & eg_ok
-    if self_traffic:
-        r |= jnp.arange(Np)[:, None] == cols[None, :]
+    r = _reach_block(
+        ing_by_pol, jnp.take(sel_ing8, cols, axis=1),
+        sel_eg8, jnp.take(eg_by_pol, cols, axis=1),
+        jnp.take(ing_cnt, cols), eg_cnt,
+        jnp.arange(Np, dtype=jnp.int32), cols,
+        self_traffic, default_allow,
+    )
     bits = r.astype(_U32) << (cols % 32).astype(_U32)[None, :]  # [Np, Dc]
     set_words = jax.ops.segment_sum(
         bits.T, seg, num_segments=Dw + 1
@@ -1034,15 +1061,38 @@ class PackedIncrementalVerifier:
         self.update_count += 1
 
     # --------------------------------------------------------------- result
+    def dirty_stripes(self, width: int) -> List[int]:
+        """Stripe starts whose values may differ from the last sweep: the
+        stripes containing a dirty column — or every stripe, when a dirty
+        row exists (a row change spans all columns)."""
+        if width % 32 or width <= 0:
+            raise ValueError("width must be a positive multiple of 32")
+        if self.dirty_rows.any():
+            return list(range(0, self._n_padded, width))
+        cols = np.nonzero(self.dirty_cols)[0]
+        return sorted({int(c) // width * width for c in cols})
+
+    def sweep_dirty(self, width: int):
+        """Yield ``(d0, packed_words)`` for every stripe needing re-verify
+        (``dirty_stripes``); when the iteration COMPLETES, both dirty sets
+        are cleared — an abandoned sweep leaves them marked."""
+        for d0 in self.dirty_stripes(width):
+            yield d0, self.solve_stripe(d0, width)
+        self.dirty_rows[:] = False
+        self.dirty_cols[:] = False
+
     def solve_stripe(self, d0: int, width: int) -> np.ndarray:
         """Re-solve dst columns ``[d0, d0+width)`` straight from the current
         per-policy maps → uint32 [n, width/32]. This is matrix-free mode's
         re-verify primitive (config-5 scale, where the full packed matrix
-        never fits): after a run of diffs, sweep the stripes covering
-        ``dirty_cols`` (plus any stripe — every stripe reflects
-        ``dirty_rows`` automatically, since rows are recomputed whole)."""
-        if d0 % 32 or width % 32 or width <= 0:
-            raise ValueError("d0 and width must be positive multiples of 32")
+        never fits); the result always reflects the CURRENT maps. Drive a
+        post-diff re-verify through ``sweep_dirty`` (which also retires the
+        dirty bookkeeping) rather than calling this directly."""
+        if d0 < 0 or d0 % 32 or width % 32 or width <= 0:
+            raise ValueError(
+                "d0 must be a non-negative multiple of 32 and width a "
+                "positive multiple of 32"
+            )
         if d0 + width > self._n_padded:
             raise ValueError(
                 f"stripe [{d0}, {d0 + width}) outside the padded pod range "

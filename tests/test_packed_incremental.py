@@ -213,6 +213,19 @@ def test_mesh_matrix_free_stripes():
     np.testing.assert_array_equal(full, ref)
     s = unpack_cols(inc.solve_stripe(32, 32), 32)  # dst cols [32, 64)
     np.testing.assert_array_equal(s[:, : 61 - 32], ref[:, 32:61])
+    with pytest.raises(ValueError, match="non-negative"):
+        inc.solve_stripe(-32, 32)
+    # sweep_dirty covers exactly the needed stripes and retires the marks
+    assert inc.dirty_stripes(32), "diffs above must have dirtied something"
+    for d0, words in inc.sweep_dirty(32):
+        got = unpack_cols(words, 32)
+        if d0 >= inc.n_pods:  # pad-only stripe: col_mask zeroes everything
+            assert not got.any()
+            continue
+        hi = min(d0 + 32, inc.n_pods)
+        np.testing.assert_array_equal(got[:, : hi - d0], ref[:, d0:hi])
+    assert not inc.dirty_rows.any() and not inc.dirty_cols.any()
+    assert inc.dirty_stripes(32) == []
 
 
 def test_packed_queries_available(setup):
