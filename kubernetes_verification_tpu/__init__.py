@@ -53,6 +53,7 @@ except ImportError:  # pragma: no cover
     pass
 try:
     from .backends import sharded as _sharded_backend  # noqa: F401
+    from .backends import sharded_packed as _sharded_packed_backend  # noqa: F401
 except ImportError:  # pragma: no cover
     pass
 try:  # needs a C++ compiler (or a previously built .so)

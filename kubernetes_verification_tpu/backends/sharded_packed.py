@@ -1,0 +1,180 @@
+"""The config-5 engine behind the plugin boundary: ``backend="sharded-packed"``.
+
+Routes ``kv.verify()`` / the CLI through
+:func:`~..parallel.packed_sharded.sharded_packed_reach` — the bit-packed,
+dst-tile-streaming SPMD solver (any-port AND port-bitmap semantics via the
+mask-group decomposition) — so large-N solves no longer require importing the
+function API directly. The dense ``sharded`` backend remains for small/medium
+N where a full ``[N, N]`` bool result (plus per-atom ``reach_ports``, closure,
+and the per-policy src/dst sets) is wanted.
+
+Result shape: a :class:`ShardedPackedVerifyResult`. ``reach`` is materialised
+densely only up to ``dense_reach_limit`` pods (default 20k — beyond that a
+bool [N, N] is the exact thing this engine exists to avoid); the packed
+matrix / aggregates stay available via ``packed_result`` and power the
+whole-matrix queries either way.
+
+Backend options (``VerifyConfig.backend_options``): ``mesh`` = (dp, mp)
+factorisation, ``tile``/``chunk`` sweep geometry, ``keep_matrix``,
+``groups_label`` (aggregate per-group in-degrees at solve time so
+``user_crosscheck`` works matrix-free), ``dense_reach_limit``.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from ..encode.encoder import encode_cluster
+from ..models.core import Cluster, Container, KanoPolicy
+from ..parallel.mesh import mesh_for
+from ..parallel.packed_sharded import PackedShardedResult, sharded_packed_reach
+from .base import (
+    VerifierBackend,
+    VerifyConfig,
+    VerifyResult,
+    register_backend,
+)
+
+__all__ = ["ShardedPackedBackend", "ShardedPackedVerifyResult"]
+
+
+@dataclass
+class ShardedPackedVerifyResult(VerifyResult):
+    """``VerifyResult`` whose queries run on the packed/aggregate forms.
+
+    ``reach`` is a dense bool matrix only below the dense-reach limit;
+    above it, ``reach`` is ``None`` and the packed-domain queries (and
+    ``packed_result``) are the API — exactly the contract of
+    :class:`~..ops.tiled.PackedReach` at flagship scale."""
+
+    packed_result: Optional[PackedShardedResult] = None
+
+    def _pk(self) -> PackedShardedResult:
+        if self.packed_result is None:
+            raise ValueError("no packed result attached")
+        return self.packed_result
+
+    def reachable(self, src: int, dst: int) -> bool:
+        if self.reach is not None:
+            return bool(self.reach[src, dst])
+        pk = self._pk()
+        if pk.packed is None:
+            raise ValueError(
+                "solve ran matrix-free (keep_matrix=False): per-pair lookup "
+                "needs the packed matrix; re-run with keep_matrix=True or "
+                "query the aggregates"
+            )
+        w = pk.packed[src, dst // 32]
+        return bool((np.uint32(w) >> np.uint32(dst % 32)) & np.uint32(1))
+
+    def edges(self) -> List[Tuple[int, int]]:
+        if self.reach is not None:
+            return super().edges()
+        s, d = np.nonzero(self._pk().to_bool())
+        return list(zip(s.tolist(), d.tolist()))
+
+    def all_reachable(self) -> List[int]:
+        return self._pk().all_reachable()
+
+    def all_isolated(self) -> List[int]:
+        return self._pk().all_isolated()
+
+    def user_crosscheck(self, containers_or_pods, label: str) -> List[int]:
+        return self._pk().user_crosscheck(containers_or_pods, label)
+
+    def system_isolation(self, idx: int) -> List[int]:
+        return self._pk().system_isolation(idx)
+
+    def policy_shadow(self):
+        raise ValueError(
+            "the sharded-packed engine does not build per-policy src/dst "
+            "sets; use ops.tiled.policy_pair_masks (device Gram masks) or "
+            "the dense backends for the pairwise policy queries"
+        )
+
+    policy_conflict = policy_shadow
+
+
+class ShardedPackedBackend(VerifierBackend):
+    name = "sharded-packed"
+
+    def __init__(self, mesh: Optional[jax.sharding.Mesh] = None) -> None:
+        self._mesh = mesh
+
+    def _resolve_mesh(self, config: VerifyConfig) -> jax.sharding.Mesh:
+        if self._mesh is not None:
+            return self._mesh
+        shape = config.opt("mesh")
+        return mesh_for(tuple(shape) if shape is not None else None)
+
+    def verify(self, cluster: Cluster, config: VerifyConfig) -> VerifyResult:
+        if config.closure:
+            raise ValueError(
+                "sharded-packed has no closure path yet; use the sharded or "
+                "tpu backends for transitive closure"
+            )
+        mesh = self._resolve_mesh(config)
+        t0 = time.perf_counter()
+        enc = encode_cluster(cluster, compute_ports=config.compute_ports)
+        t1 = time.perf_counter()
+        groups = None
+        glabel = config.opt("groups_label")
+        if glabel is not None:
+            from ..ops.queries import user_groups
+
+            groups = user_groups(cluster.pods, glabel)
+        pk = sharded_packed_reach(
+            mesh,
+            enc,
+            self_traffic=config.self_traffic,
+            default_allow_unselected=config.default_allow_unselected,
+            direction_aware_isolation=config.direction_aware_isolation,
+            tile=config.opt("tile", 512),
+            chunk=config.opt("chunk", 1024),
+            keep_matrix=config.opt("keep_matrix"),
+            groups=groups,
+            max_port_masks=config.opt("max_port_masks"),
+        )
+        t2 = time.perf_counter()
+        dense_limit = config.opt("dense_reach_limit", 20_000)
+        reach = (
+            pk.to_bool()
+            if pk.packed is not None and cluster.n_pods <= dense_limit
+            else None
+        )
+        return ShardedPackedVerifyResult(
+            n_pods=cluster.n_pods,
+            mode="k8s",
+            backend=self.name,
+            config=config,
+            reach=reach,
+            port_atoms=list(enc.atoms) if config.compute_ports else [],
+            ingress_isolated=pk.ingress_isolated,
+            egress_isolated=pk.egress_isolated,
+            timings={
+                # "solve" is the whole engine call (host prep + device
+                # sweep); the inner sweep-only figures keep their own keys
+                "encode": t1 - t0,
+                "solve": t2 - t1,
+                **{f"sweep_{k}": v for k, v in (pk.timings or {}).items()},
+            },
+            packed_result=pk,
+        )
+
+    def verify_kano(
+        self,
+        containers: Sequence[Container],
+        policies: Sequence[KanoPolicy],
+        config: VerifyConfig,
+    ) -> VerifyResult:
+        raise ValueError(
+            "sharded-packed is a k8s-mode engine; use the sharded backend "
+            "for kano-mode scale-out"
+        )
+
+
+register_backend("sharded-packed", ShardedPackedBackend)

@@ -479,6 +479,69 @@ def _dot_lnt(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     )
 
 
+def _split_and_check_port_masks(
+    ing_block: GrantBlock, eg_block: GrantBlock, limit: int
+) -> Tuple[GrantBlock, GrantBlock, int]:
+    """Run-split both directions' grant port masks and enforce the
+    distinct-ported-mask cap R — the shared host prologue of the single-chip
+    and sharded port kernels (the mask-group kernel unrolls R dots + O(R²)
+    combines per tile, so an unbounded R compiles an enormous program)."""
+    ing_block = _split_grant_ports(ing_block)
+    eg_block = _split_grant_ports(eg_block)
+    all_masks = {
+        m
+        for m in map(
+            tuple, np.concatenate([ing_block.ports, eg_block.ports], 0)
+        )
+        if any(m) and not all(m)
+    }
+    R = max(1, len(all_masks))
+    if R > limit:
+        raise ValueError(
+            f"{R} distinct ported atom masks after run-splitting exceeds "
+            f"max_port_masks={limit}: the mask-group kernel unrolls R dots "
+            "+ O(R²) combines per tile and would compile an enormous "
+            "program. Coarsen the cluster's port specs, verify with "
+            "compute_ports=False, or raise max_port_masks explicitly if the "
+            "compile cost is acceptable."
+        )
+    return ing_block, eg_block, R
+
+
+def _mask_group_conj(layout: "PortLayout", ing_dot, eg_dot, false_t):
+    """The mask-group port conjunction ``∃q: GI_q ∧ GE_q`` over a dst tile —
+    the single copy shared by the single-chip tiled kernel and the sharded
+    SPMD body. ``ing_dot(start, length)`` / ``eg_dot(start, length)`` are
+    the caller's segment-dot closures returning bool tiles; returns
+    ``(conj, gi_any, ge_any)`` for the caller's default-allow expansion."""
+    fs_i, fl_i = layout.full_i
+    fs_e, fl_e = layout.full_e
+    R = layout.n_masks
+    gi_full = ing_dot(fs_i, fl_i) if fl_i else false_t
+    ge_full = eg_dot(fs_e, fl_e) if fl_e else false_t
+    # ported slabs — exact-shape dots per mask (statically unrolled)
+    ge_m = [eg_dot(s, l) if l else false_t for (s, l) in layout.seg_e]
+    gi_any = gi_full
+    ge_any = ge_full
+    for m in range(R):
+        ge_any = ge_any | ge_m[m]
+    conj = false_t
+    for m1 in range(R):
+        s, l = layout.seg_i[m1]
+        if not l:
+            continue
+        gi = ing_dot(s, l)
+        gi_any = gi_any | gi
+        # egress grants on any overlapping ported mask, or the full block
+        comp = ge_full
+        for m2 in layout.ov_rows[m1]:
+            comp = comp | ge_m[m2]
+        conj = conj | (gi & comp)
+    # full-mask ingress overlaps every egress mask
+    conj = conj | (gi_full & ge_any) | (gi_any & ge_full)
+    return conj, gi_any, ge_any
+
+
 @partial(
     jax.jit,
     static_argnames=(
@@ -525,7 +588,6 @@ def _tiled_ports_step(
     P = pol_ns.shape[0]
     n_tiles = N // tile
     W = N // 32
-    R = layout.n_masks
 
     selected8, sel_ing8, sel_eg8, ing_iso, eg_iso = _select_maps(
         pod_kv, pod_key, pod_ns, pol_sel, pol_ns, aff_ing, aff_eg,
@@ -549,9 +611,6 @@ def _tiled_ports_step(
     # egress src-side operand, pre-gathered once: row v = selected-by-pol(v)
     sel_eg_vp = sel_eg_ext[vp_pol_e]  # int8 [total_e, N]
 
-    fs_i, fl_i = layout.full_i
-    fs_e, fl_e = layout.full_e
-
     def tile_body(t, out):
         d0 = t * tile
         sel_ing_t = jax.lax.dynamic_slice(sel_ing_ext, (0, d0), (P + 1, tile))
@@ -569,31 +628,7 @@ def _tiled_ports_step(
             b = jax.lax.slice(vpe_t, (start, 0), (start + length, tile))
             return _dot_lnt(a, b) > 0
 
-        gi_full = ing_dot(fs_i, fl_i) if fl_i else false_t
-        ge_full = eg_dot(fs_e, fl_e) if fl_e else false_t
-
-        # ported slabs — exact-shape dots per mask (statically unrolled)
-        ge_m = [
-            eg_dot(s, l) if l else false_t for (s, l) in layout.seg_e
-        ]
-        gi_any = gi_full
-        ge_any = ge_full
-        for m in range(R):
-            ge_any = ge_any | ge_m[m]
-        conj = false_t
-        for m1 in range(R):
-            s, l = layout.seg_i[m1]
-            if not l:
-                continue
-            gi = ing_dot(s, l)
-            gi_any = gi_any | gi
-            # egress grants on any overlapping ported mask, or the full block
-            comp = ge_full
-            for m2 in layout.ov_rows[m1]:
-                comp = comp | ge_m[m2]
-            conj = conj | (gi & comp)
-        # full-mask ingress overlaps every egress mask
-        conj = conj | (gi_full & ge_any) | (gi_any & ge_full)
+        conj, gi_any, ge_any = _mask_group_conj(layout, ing_dot, eg_dot, false_t)
 
         r = conj
         if default_allow_unselected:
@@ -944,25 +979,9 @@ def tiled_k8s_reach(
         # run-split the grant masks first (see _split_grant_ports): the
         # distinct-mask count R after splitting tracks the distinct port
         # specs, not their combinations
-        ing_block = _split_grant_ports(ing_block)
-        eg_block = _split_grant_ports(eg_block)
-        all_masks = {
-            m
-            for m in map(
-                tuple, np.concatenate([ing_block.ports, eg_block.ports], 0)
-            )
-            if any(m) and not all(m)
-        }
-        R = max(1, len(all_masks))
-        if R > max_port_masks:
-            raise ValueError(
-                f"{R} distinct ported atom masks after run-splitting exceeds "
-                f"max_port_masks={max_port_masks}: the mask-group kernel "
-                f"unrolls R dots + O(R²) combines per tile and would compile "
-                "an enormous program. Coarsen the cluster's port specs, "
-                "verify with compute_ports=False, or raise max_port_masks "
-                "explicitly if the compile cost is acceptable."
-            )
+        ing_block, eg_block, R = _split_and_check_port_masks(
+            ing_block, eg_block, max_port_masks
+        )
         # per-tile memory: R ported egress slabs of [N, tile] bools plus the
         # packed output — shrink the dst tile to keep the slabs bounded.
         # NOTE the cap does not bound the three resident [total_vp, N] int8

@@ -53,7 +53,7 @@ from jax.sharding import PartitionSpec as P
 from ..encode.encoder import EncodedCluster, GrantBlock
 from ..ops.match import match_selectors
 from ..ops.reach import _grant_peers
-from ..ops.tiled import pack_bool_cols, unpack_cols
+from ..ops.tiled import PortLayout, pack_bool_cols, unpack_cols
 from .mesh import GRANT_AXIS, POD_AXIS, pad_amount
 from .sharded_ops import _grant_pspecs, _specs_like, pad_grants, pad_pods
 
@@ -202,6 +202,10 @@ def _packed_local(
     aff_eg,
     ingress: GrantBlock,
     egress: GrantBlock,
+    vp_slot_i,  # int32 [G_loc] — grant → VP row (port mode; [0] any-port)
+    vp_slot_e,
+    vp_pol_i,  # int32 [total_i] — VP row → policy (replicated; [0] any-port)
+    vp_pol_e,
     *,
     self_traffic: bool,
     default_allow_unselected: bool,
@@ -212,10 +216,16 @@ def _packed_local(
     mp: int,
     stripe: Tuple[int, int],
     keep_matrix: bool,
+    layout: Optional["PortLayout"],
 ):
     """SPMD body. Pod arrays are local row blocks, grant blocks local grant
     slices. Returns this device's packed row block (or a 1-word stub), local
-    aggregate partials, and replicated dst aggregates."""
+    aggregate partials, and replicated dst aggregates.
+
+    ``layout=None`` is the any-port path; a :class:`~..ops.tiled.PortLayout`
+    switches the per-tile reach computation to the mask-group port kernel
+    (same math as ``_tiled_ports_step``) with the dst-side VP operands kept
+    bit-packed until their owned tile broadcasts."""
     n_loc = pod_kv.shape[0]
     n_pol = pol_ns.shape[0]
     my_pod = jax.lax.axis_index(POD_AXIS)
@@ -233,19 +243,15 @@ def _packed_local(
         sel_eg = selected
     ing_iso_loc = sel_ing.any(axis=0)  # [n_loc]
     eg_iso_loc = sel_eg.any(axis=0)
-    # src-side dot operand: resident int8
-    sel_eg8 = sel_eg.astype(_I8)  # [P, n_loc]
-    # dst-side arrays: bit-packed, unpacked per owned tile at broadcast time
-    sel_ing_bits = _pack_rows_u8(sel_ing)  # [P, n_loc/8]
-    del selected, sel_ing, sel_eg
 
-    # --- per-policy peer maps (OR over the local grant slice, then over the
-    # grants axis; int8 psum is exact: values ≤ mp ≤ 8) -------------------
-    def peers_by_policy(block: GrantBlock) -> jnp.ndarray:
-        # the host wrapper pads the grant axis to a (mp · chunk) multiple, so
-        # the local slice is an exact number of chunks
+    def peers_by_slot(block: GrantBlock, slots, total: int) -> jnp.ndarray:
+        """int8 [total, n_loc]: OR of each slot's grant peer rows over the
+        local grant slice, then over the grants axis (int8 psum is exact:
+        values ≤ mp ≤ 8). The host wrapper pads the grant axis to a
+        (mp · chunk) multiple, so the local slice is an exact number of
+        chunks."""
         G = block.pol.shape[0]
-        acc = jnp.zeros((n_pol + 1, n_loc), dtype=_I8)
+        acc = jnp.zeros((total, n_loc), dtype=_I8)
         if G:
             def body(i, acc):
                 blk = jax.tree.map(
@@ -254,17 +260,103 @@ def _packed_local(
                     ),
                     block,
                 )
+                sl = jax.lax.dynamic_slice_in_dim(slots, i * chunk, chunk, 0)
                 peers = _grant_peers(
                     blk, pod_kv, pod_key, ns_kv, ns_key, pod_ns, pol_ns
                 )
-                return acc.at[blk.pol].max(peers.astype(_I8))
+                return acc.at[sl].max(peers.astype(_I8))
 
             acc = jax.lax.fori_loop(0, G // chunk, body, acc)
-        summed = jax.lax.psum(acc[:n_pol], GRANT_AXIS)
+        summed = jax.lax.psum(acc, GRANT_AXIS)
         return (summed > 0).astype(_I8)
 
-    ing_by_pol = peers_by_policy(ingress)  # int8 [P, n_loc] — src side, resident
-    eg_by_pol_bits = _pack_rows_u8(peers_by_policy(egress) > 0)  # dst side
+    def dot_ln(a, b):  # [L, S] × [L, T] → int32 [S, T] (contract slot axis)
+        return jax.lax.dot_general(
+            a, b, (((0,), (0,)), ((), ())), preferred_element_type=_I32
+        )
+
+    if layout is None:
+        # src-side dot operand: resident int8
+        sel_eg8 = sel_eg.astype(_I8)  # [P, n_loc]
+        # dst-side arrays: bit-packed, unpacked per owned tile at broadcast
+        sel_ing_bits = _pack_rows_u8(sel_ing)  # [P, n_loc/8]
+        del selected, sel_ing, sel_eg
+        ing_by_pol = peers_by_slot(ingress, ingress.pol, n_pol + 1)[:n_pol]
+        eg_by_pol_bits = _pack_rows_u8(
+            peers_by_slot(egress, egress.pol, n_pol + 1)[:n_pol] > 0
+        )
+
+        def fetch_tile(d0):
+            """Broadcast the dst tile's [P, T] slices from the owning
+            device: masked contribution + psum over the pod axis."""
+            owner = d0 // n_loc
+            local0 = d0 - owner * n_loc
+            mine = (my_pod == owner).astype(_I8)
+            sel_t = _unpack_cols_u8(sel_ing_bits, local0, tile) * mine
+            peer_t = _unpack_cols_u8(eg_by_pol_bits, local0, tile) * mine
+            return (
+                jax.lax.psum(sel_t, POD_AXIS),
+                jax.lax.psum(peer_t, POD_AXIS),
+            )
+
+        def tile_reach(d0):
+            sel_ing_t, eg_by_pol_t = fetch_tile(d0)
+            # ing_allow[s, d_t] = ∨_p ing_by_pol[p, s] ∧ sel_ing[p, d_t]
+            # eg_allow[s, d_t] = ∨_p sel_eg[p, s] ∧ eg_by_pol[p, d_t]
+            ing_ok = dot_ln(ing_by_pol, sel_ing_t) > 0
+            eg_ok = dot_ln(sel_eg8, eg_by_pol_t) > 0
+            return ing_ok, eg_ok, None
+    else:
+        # ----- port mode: virtual-policy (mask-group) operands -----------
+        zrow = jnp.zeros((1, n_loc), dtype=_I8)
+        sel_ing_ext_bits = _pack_rows_u8(
+            jnp.concatenate([sel_ing.astype(_I8), zrow], axis=0) > 0
+        )  # [P+1, n_loc/8] — dst side, sink row P selects nothing
+        sel_eg_ext = jnp.concatenate([sel_eg.astype(_I8), zrow], axis=0)
+        del selected, sel_ing, sel_eg
+        total_i = vp_pol_i.shape[0]
+        total_e = vp_pol_e.shape[0]
+        vp_peers_i = peers_by_slot(ingress, vp_slot_i, total_i)  # src side
+        vp_peers_e_bits = _pack_rows_u8(
+            peers_by_slot(egress, vp_slot_e, total_e) > 0
+        )  # dst side, bit-packed until broadcast
+        # egress src-side operand, pre-gathered once: row v = sel(pol(v))
+        sel_eg_vp = sel_eg_ext[vp_pol_e]  # int8 [total_e, n_loc]
+        def fetch_tile_ports(d0):
+            owner = d0 // n_loc
+            local0 = d0 - owner * n_loc
+            mine = (my_pod == owner).astype(_I8)
+            sel_t = _unpack_cols_u8(sel_ing_ext_bits, local0, tile) * mine
+            vpe_t = _unpack_cols_u8(vp_peers_e_bits, local0, tile) * mine
+            return (
+                jax.lax.psum(sel_t, POD_AXIS),  # [P+1, T]
+                jax.lax.psum(vpe_t, POD_AXIS),  # [total_e, T]
+            )
+
+        def tile_reach(d0):
+            """Mask-group port conjunction — the sharded form of
+            ``_tiled_ports_step``'s tile body: the shared ``_mask_group_conj``
+            combine over this device's segment-dot closures."""
+            from ..ops.tiled import _mask_group_conj
+
+            sel_ing_t, vpe_t = fetch_tile_ports(d0)
+            false_t = jnp.zeros((n_loc, tile), dtype=bool)
+
+            def ing_dot(start: int, length: int) -> jnp.ndarray:
+                a = jax.lax.slice(
+                    vp_peers_i, (start, 0), (start + length, n_loc)
+                )
+                idx = jax.lax.slice(vp_pol_i, (start,), (start + length,))
+                return dot_ln(a, sel_ing_t[idx]) > 0
+
+            def eg_dot(start: int, length: int) -> jnp.ndarray:
+                a = jax.lax.slice(
+                    sel_eg_vp, (start, 0), (start + length, n_loc)
+                )
+                b = jax.lax.slice(vpe_t, (start, 0), (start + length, tile))
+                return dot_ln(a, b) > 0
+
+            return _mask_group_conj(layout, ing_dot, eg_dot, false_t)
 
     # dst-side default-allow needs the *global* isolation vectors; they are
     # [N] bools — tiny — so one all_gather is fine even at 1M pods
@@ -282,46 +374,27 @@ def _packed_local(
     col_deg = jnp.zeros((n_total,), dtype=_I32)
     grp_deg = jnp.zeros((U, n_total), dtype=_I32)
 
-    def fetch_tile(d0):
-        """Broadcast the dst tile's [P, T] slices + [T] iso/valid from the
-        owning device: masked contribution + psum over the pod axis."""
-        owner = d0 // n_loc
-        local0 = d0 - owner * n_loc
-        mine = (my_pod == owner).astype(_I8)
-        sel_t = _unpack_cols_u8(sel_ing_bits, local0, tile) * mine
-        peer_t = _unpack_cols_u8(eg_by_pol_bits, local0, tile) * mine
-        return (
-            jax.lax.psum(sel_t, POD_AXIS),
-            jax.lax.psum(peer_t, POD_AXIS),
-        )
-
     def body(k, carry):
         out, row_deg, col_deg, grp_deg = carry
         t = t0 + k * mp + my_grant
         d0 = t * tile
-        sel_ing_t, eg_by_pol_t = fetch_tile(d0)
         ing_iso_t = jax.lax.dynamic_slice(ing_iso_full, (d0,), (tile,))
         valid_t = jax.lax.dynamic_slice(valid_full, (d0,), (tile,))
-        # ing_allow[src, dst_t] = ∨_p ing_by_pol[p, src] ∧ sel_ing[p, dst_t]
-        ing_ok = (
-            jax.lax.dot_general(
-                ing_by_pol, sel_ing_t, (((0,), (0,)), ((), ())),
-                preferred_element_type=_I32,
-            )
-            > 0
-        )
-        # eg_allow[src, dst_t] = ∨_p sel_eg[p, src] ∧ eg_by_pol[p, dst_t]
-        eg_ok = (
-            jax.lax.dot_general(
-                sel_eg8, eg_by_pol_t, (((0,), (0,)), ((), ())),
-                preferred_element_type=_I32,
-            )
-            > 0
-        )
-        if default_allow_unselected:
-            ing_ok |= ~ing_iso_t[None, :]
-            eg_ok |= ~eg_iso_loc[:, None]
-        r = ing_ok & eg_ok
+        if layout is None:
+            ing_ok, eg_ok, _ = tile_reach(d0)
+            if default_allow_unselected:
+                ing_ok |= ~ing_iso_t[None, :]
+                eg_ok |= ~eg_iso_loc[:, None]
+            r = ing_ok & eg_ok
+        else:
+            # reach = (DI∧DE) ∨ (DI∧GE_any) ∨ (DE∧GI_any) ∨ (∃q: GI_q∧GE_q)
+            # — the default-allow terms cover every port atom
+            conj, gi_any, ge_any = tile_reach(d0)
+            r = conj
+            if default_allow_unselected:
+                di = ~ing_iso_t[None, :]
+                de = ~eg_iso_loc[:, None]
+                r = r | (di & de) | (di & ge_any) | (de & gi_any)
         if self_traffic:
             gidx = row0 + jnp.arange(n_loc)
             r |= gidx[:, None] == (d0 + jnp.arange(tile))[None, :]
@@ -376,18 +449,31 @@ def sharded_packed_reach(
     stripe: Optional[Tuple[int, int]] = None,
     keep_matrix: Optional[bool] = None,
     groups: Optional[np.ndarray] = None,
+    max_port_masks: Optional[int] = None,
 ) -> PackedShardedResult:
     """Pad, shard, sweep. ``stripe=(t0, t1)`` limits the sweep to a dst tile
     range (default: all tiles); aggregates then cover only the swept dsts.
     ``keep_matrix=None`` keeps the packed matrix when it is ≤ ~1 GB/device.
     ``groups`` (int [N] user-group ids) additionally aggregates per-group
-    in-degrees so ``user_crosscheck`` works without the matrix."""
+    in-degrees so ``user_crosscheck`` works without the matrix.
+
+    A multi-atom encoding (``compute_ports=True`` with port-bearing rules)
+    runs the port-aware SPMD body: the mask-group decomposition of
+    ``ops/tiled.py`` composed with the dst-tile broadcast — grants group
+    into (policy, port-mask) virtual policies on the host, each device
+    builds VP peer maps from its grant slice (int8 ``psum`` over the grants
+    axis), the dst side stays bit-packed until its owned tile broadcasts,
+    and the per-tile port conjunction runs the same statically-unrolled
+    segment dots + overlap combine as the single-chip port kernel."""
     import time
 
-    if len(enc.atoms) > 1:
-        raise ValueError(
-            "sharded_packed_reach is any-port; encode with compute_ports=False"
-        )
+    from ..ops.tiled import (
+        _MAX_PORT_MASKS,
+        _PORT_SLAB_BUDGET,
+        _build_port_layout,
+        _split_and_check_port_masks,
+    )
+
     dp = mesh.shape[POD_AXIS]
     mp = mesh.shape[GRANT_AXIS]
     n = enc.n_pods
@@ -395,6 +481,29 @@ def sharded_packed_reach(
         # same contract as tiled_k8s_reach: never silently change the
         # caller's tile/stripe geometry
         raise ValueError(f"tile must be a positive multiple of 32, got {tile}")
+    with_ports = len(enc.atoms) > 1
+    ing_block, eg_block = enc.ingress, enc.egress
+    if with_ports:
+        ing_block, eg_block, R = _split_and_check_port_masks(
+            ing_block,
+            eg_block,
+            _MAX_PORT_MASKS if max_port_masks is None else max_port_masks,
+        )
+        # per-tile memory: tile_reach holds ~R ported egress slabs of
+        # [n_loc, tile] bools at once. This path never silently changes the
+        # caller's tile/stripe geometry, so (unlike tiled_k8s_reach, which
+        # shrinks the tile) an over-budget combination is an error.
+        n_loc_est = -(-max(n, 1) // dp)
+        if R * n_loc_est * tile > _PORT_SLAB_BUDGET:
+            cap = max(
+                32, (_PORT_SLAB_BUDGET // max(R * n_loc_est, 1)) // 32 * 32
+            )
+            raise ValueError(
+                f"port path holds ~{R} bool slabs of [{n_loc_est}, {tile}] "
+                f"per tile step (~{R * n_loc_est * tile / 1e9:.1f} GB), over "
+                f"the {_PORT_SLAB_BUDGET / 1e9:.1f} GB budget — pass "
+                f"tile<={cap}, or verify with compute_ports=False."
+            )
     # n_loc must be a multiple of the dst tile so every tile has one owner,
     # and the total tile count a multiple of mp for the round-robin sweep
     block = tile * max(1, math.ceil(max(n, 1) / (dp * tile)))
@@ -419,12 +528,42 @@ def sharded_packed_reach(
         grp8[0, :n] = 1
     # grant axis padded to an (mp · chunk) multiple: each device's slice is an
     # exact number of peer-sweep chunks
+    P_pol = enc.n_policies
     ingress = pad_grants(
-        enc.ingress, pad_amount(enc.ingress.n, mp * chunk), enc.n_policies, n_pad
+        ing_block, pad_amount(ing_block.n, mp * chunk), P_pol, n_pad
     )
     egress = pad_grants(
-        enc.egress, pad_amount(enc.egress.n, mp * chunk), enc.n_policies, n_pad
+        eg_block, pad_amount(eg_block.n, mp * chunk), P_pol, n_pad
     )
+    if with_ports:
+        # group (policy, port-mask) pairs into virtual policies AFTER grant
+        # padding (padded rows carry empty masks → the sink VP row), so the
+        # vp_slot arrays align row-for-row with the sharded grant stacks
+        layout, vp_pol_i, vp_slot_i, vp_pol_e, vp_slot_e = _build_port_layout(
+            np.asarray(ingress.ports),
+            np.asarray(egress.ports),
+            np.asarray(ingress.pol),
+            np.asarray(egress.pol),
+            sink_pol=P_pol,
+        )
+        # per-device resident VP operands: vp_peers_i + sel_eg_vp int8
+        # [total, n_loc] (+ the bit-packed dst forms) — fail fast like the
+        # tiled path instead of an opaque device OOM
+        resident = (len(vp_pol_i) + 2 * len(vp_pol_e)) * (Np // dp)
+        if resident > int(12e9):
+            raise ValueError(
+                f"port path needs ~{resident / 1e9:.1f} GB/device of "
+                f"resident virtual-policy operands ({len(vp_pol_i)}+"
+                f"{len(vp_pol_e)} VP rows × {Np // dp} local pods); shrink "
+                "the distinct (policy, port-mask) combinations or verify "
+                "with compute_ports=False."
+            )
+    else:
+        layout = None
+        vp_slot_i = np.zeros_like(np.asarray(ingress.pol))
+        vp_slot_e = np.zeros_like(np.asarray(egress.pol))
+        vp_pol_i = np.zeros(1, dtype=np.int32)
+        vp_pol_e = np.zeros(1, dtype=np.int32)
 
     n_tiles_total = Np // tile
     if stripe is None:
@@ -451,6 +590,7 @@ def sharded_packed_reach(
         mp=mp,
         stripe=(t0, t1),
         keep_matrix=keep_matrix,
+        layout=layout,
     )
     in_specs = (
         P(POD_AXIS, None),  # pod_kv
@@ -466,6 +606,10 @@ def sharded_packed_reach(
         P(),  # aff_eg
         _grant_pspecs(ingress),
         _grant_pspecs(egress),
+        P(GRANT_AXIS),  # vp_slot_i (aligned with the grant rows)
+        P(GRANT_AXIS),  # vp_slot_e
+        P(),  # vp_pol_i (replicated)
+        P(),  # vp_pol_e
     )
     out_specs = (
         P(POD_AXIS, None),  # packed block (or stub)
@@ -496,6 +640,10 @@ def sharded_packed_reach(
         enc.pol_affects_egress,
         ingress,
         egress,
+        np.asarray(vp_slot_i, dtype=np.int32),
+        np.asarray(vp_slot_e, dtype=np.int32),
+        np.asarray(vp_pol_i, dtype=np.int32),
+        np.asarray(vp_pol_e, dtype=np.int32),
     )
     row_deg = np.asarray(row_deg)[:n].astype(np.int64)
     col_deg = np.asarray(col_deg)[:n].astype(np.int64)

@@ -138,14 +138,149 @@ def test_user_crosscheck_and_system_isolation():
         no_matrix.user_crosscheck(cluster.pods, "app")
 
 
-def test_ports_encoding_rejected():
+@pytest.mark.parametrize("shape", MESHES)
+def test_ports_match_cpu_oracle(shape):
+    """BASELINE config 4 semantics on the config 5 engine: the mask-group
+    port decomposition composed with the dst-tile broadcast must equal the
+    CPU oracle with port bitmaps on."""
     cluster = random_cluster(
-        GeneratorConfig(n_pods=10, n_policies=4, p_ports=1.0, seed=2)
+        GeneratorConfig(
+            n_pods=61, n_policies=11, n_namespaces=3, p_ports=0.8, seed=43
+        )
+    )
+    enc = encode_cluster(cluster, compute_ports=True)
+    assert len(enc.atoms) > 1, "fixture must exercise real port atoms"
+    ref = kv.verify(cluster, kv.VerifyConfig(backend="cpu", compute_ports=True))
+    got = sharded_packed_reach(
+        mesh_for(shape), enc, tile=32, chunk=8, keep_matrix=True
+    )
+    np.testing.assert_array_equal(got.to_bool(), ref.reach)
+    np.testing.assert_array_equal(got.out_degree, ref.reach.sum(axis=1))
+    np.testing.assert_array_equal(got.in_degree, ref.reach.sum(axis=0))
+
+
+def test_ports_match_tiled_packed():
+    """Sharded-with-ports must agree bit-for-bit with the single-chip tiled
+    port kernel on the packed form."""
+    from kubernetes_verification_tpu.ops.tiled import tiled_k8s_reach
+
+    cluster = random_cluster(
+        GeneratorConfig(
+            n_pods=87, n_policies=17, n_namespaces=4, p_ports=0.7, seed=7
+        )
+    )
+    enc = encode_cluster(cluster, compute_ports=True)
+    tiled = tiled_k8s_reach(enc, tile=128)
+    got = sharded_packed_reach(
+        mesh_for((4, 2)), enc, tile=32, chunk=8, keep_matrix=True
+    )
+    np.testing.assert_array_equal(got.to_bool(), tiled.to_bool())
+
+
+def test_ports_stripes_and_groups():
+    """Striped port sweeps compose, and the per-group in-degree aggregates
+    (matrix-free user_crosscheck) stay exact under the port kernel."""
+    from kubernetes_verification_tpu.ops.queries import user_groups
+
+    cluster = random_cluster(
+        GeneratorConfig(
+            n_pods=47, n_policies=9, n_namespaces=3, p_ports=0.9, seed=11
+        )
+    )
+    enc = encode_cluster(cluster, compute_ports=True)
+    assert len(enc.atoms) > 1
+    ref = kv.verify(cluster, kv.VerifyConfig(backend="cpu", compute_ports=True))
+    mesh = mesh_for((4, 2))
+    gid = user_groups(cluster.pods, "team")
+    full = sharded_packed_reach(
+        mesh, enc, tile=32, chunk=8, keep_matrix=False, groups=gid
+    )
+    np.testing.assert_array_equal(full.out_degree, ref.reach.sum(axis=1))
+    assert full.user_crosscheck(cluster.pods, "team") == ref.user_crosscheck(
+        cluster.pods, "team"
+    )
+    # stripes: aggregate partials over disjoint stripes sum to the full sweep
+    n_tiles = full.timings["tiles"]
+    half = n_tiles // 2 - (n_tiles // 2) % 2  # multiple of mp=2
+    if half:
+        a = sharded_packed_reach(
+            mesh, enc, tile=32, chunk=8, stripe=(0, half), keep_matrix=False
+        )
+        b = sharded_packed_reach(
+            mesh, enc, tile=32, chunk=8, stripe=(half, n_tiles),
+            keep_matrix=False,
+        )
+        np.testing.assert_array_equal(
+            a.out_degree + b.out_degree, full.out_degree
+        )
+        np.testing.assert_array_equal(
+            a.in_degree + b.in_degree, full.in_degree
+        )
+
+
+def test_registered_backend_routes_through_verify():
+    """The config-5 engine must be reachable through the plugin boundary:
+    kv.verify(backend='sharded-packed') — with and without ports, dense
+    reach below the limit, packed queries above it."""
+    cluster = random_cluster(
+        GeneratorConfig(
+            n_pods=53, n_policies=13, n_namespaces=3, p_ports=0.7, seed=3
+        )
+    )
+    for ports in (False, True):
+        ref = kv.verify(
+            cluster, kv.VerifyConfig(backend="cpu", compute_ports=ports)
+        )
+        res = kv.verify(
+            cluster,
+            kv.VerifyConfig(
+                backend="sharded-packed",
+                compute_ports=ports,
+                backend_options=(
+                    ("mesh", (4, 2)), ("tile", 32), ("chunk", 8),
+                    ("keep_matrix", True),
+                ),
+            ),
+        )
+        np.testing.assert_array_equal(res.reach, ref.reach)
+        assert res.all_isolated() == ref.all_isolated()
+        assert res.system_isolation(3) == ref.system_isolation(3)
+        assert res.user_crosscheck(cluster.pods, "team") == ref.user_crosscheck(
+            cluster.pods, "team"
+        )
+        assert res.reachable(0, 1) == bool(ref.reach[0, 1])
+    # above the dense limit: reach is None, packed queries still answer
+    res2 = kv.verify(
+        cluster,
+        kv.VerifyConfig(
+            backend="sharded-packed",
+            compute_ports=False,
+            backend_options=(
+                ("mesh", (4, 2)), ("tile", 32), ("chunk", 8),
+                ("keep_matrix", True), ("dense_reach_limit", 10),
+            ),
+        ),
+    )
+    assert res2.reach is None
+    ref2 = kv.verify(cluster, kv.VerifyConfig(backend="cpu", compute_ports=False))
+    assert res2.all_isolated() == ref2.all_isolated()
+    np.testing.assert_array_equal(res2.packed_result.to_bool(), ref2.reach)
+    with pytest.raises(ValueError, match="policy"):
+        res2.policy_shadow()
+
+
+def test_port_mask_cap_enforced():
+    cluster = random_cluster(
+        GeneratorConfig(
+            n_pods=21, n_policies=7, n_namespaces=2, p_ports=0.9, seed=5
+        )
     )
     enc = encode_cluster(cluster, compute_ports=True)
     if len(enc.atoms) > 1:
-        with pytest.raises(ValueError, match="any-port"):
-            sharded_packed_reach(mesh_for((8, 1)), enc)
+        with pytest.raises(ValueError, match="max_port_masks"):
+            sharded_packed_reach(
+                mesh_for((8, 1)), enc, tile=32, chunk=8, max_port_masks=0
+            )
 
 
 def test_partial_stripe_refuses_whole_matrix_queries():
