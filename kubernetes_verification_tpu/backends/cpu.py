@@ -107,8 +107,38 @@ class CpuBackend(VerifierBackend):
         n, P = len(pods), len(policies)
         ns_labels = {ns.name: ns.labels for ns in namespaces}
 
-        atoms = compute_port_atoms(policies) if config.compute_ports else [ALL_ATOM]
+        atoms = (
+            compute_port_atoms(policies, pods)
+            if config.compute_ports
+            else [ALL_ATOM]
+        )
         Q = len(atoms)
+
+        def rule_dst_ports(rule: Rule) -> np.ndarray:
+            """bool [N, Q]: which atoms this rule's ports cover *per
+            destination pod* — numeric specs cover their atoms for every
+            dst; a named spec covers, for dst d, exactly the atom holding
+            the number d's container spec declares under that name (real
+            k8s resolution; independent of the encoder's restriction-bank
+            mechanism so the differential tests stay meaningful)."""
+            pmask = rule_port_mask(rule, atoms)
+            out = np.broadcast_to(pmask, (n, Q)).copy()
+            for spec in rule.ports or ():
+                if not isinstance(spec.port, str):
+                    continue
+                for d, pod in enumerate(pods):
+                    entry = pod.container_ports.get(spec.port)
+                    if entry is None or entry[0] != spec.protocol:
+                        continue
+                    num = int(entry[1])
+                    for q, atom in enumerate(atoms):
+                        if (
+                            atom.name is None
+                            and atom.protocol == spec.protocol
+                            and atom.lo <= num <= atom.hi
+                        ):
+                            out[d, q] = True
+            return out
 
         selected = np.zeros((P, n), dtype=bool)
         for pi, pol in enumerate(policies):
@@ -181,18 +211,18 @@ class CpuBackend(VerifierBackend):
             if affects_in[pi] and pol.ingress:
                 for rule in pol.ingress:
                     srcs = rule_peer_set(rule, pol)
-                    pmask = rule_port_mask(rule, atoms)
+                    dmask = rule_dst_ports(rule)  # [N, Q], dst = selected
                     ingress_allow |= (
-                        srcs[:, None, None] & tgt[None, :, None] & pmask[None, None, :]
+                        srcs[:, None, None] & (tgt[:, None] & dmask)[None, :, :]
                     )
                     src_sets[pi] |= srcs
                 dst_sets[pi] |= tgt
             if affects_eg[pi] and pol.egress:
                 for rule in pol.egress:
                     dsts = rule_peer_set(rule, pol)
-                    pmask = rule_port_mask(rule, atoms)
+                    dmask = rule_dst_ports(rule)  # [N, Q], dst = peers
                     egress_allow |= (
-                        tgt[:, None, None] & dsts[None, :, None] & pmask[None, None, :]
+                        tgt[:, None, None] & (dsts[:, None] & dmask)[None, :, :]
                     )
                     dst_sets[pi] |= dsts
                 src_sets[pi] |= tgt

@@ -172,10 +172,21 @@ class NativeBackend(VerifierBackend):
         )
         ing_targets = sel_ing[enc.ingress.pol]  # [G, N]
         eg_targets = sel_eg[enc.egress.pol]
+        # named-port resolution: AND each grant's dst-restriction bank row
+        # into its dst-side operand (ingress dst = targets, egress dst =
+        # peers); the unrestricted eg_peers still feed the edge sets below
+        eg_peers_dst = eg_peers
+        if enc.ingress.dst_restrict is not None:
+            ing_targets = ing_targets & enc.restrict_bank[enc.ingress.dst_restrict]
+        if enc.egress.dst_restrict is not None:
+            eg_peers_dst = eg_peers & enc.restrict_bank[enc.egress.dst_restrict]
 
         ing_peers_p = pack(ing_peers) if ing_peers.size else np.zeros((0, W), np.uint64)
         ing_targets_p = pack(ing_targets) if ing_targets.size else np.zeros((0, W), np.uint64)
         eg_peers_p = pack(eg_peers) if eg_peers.size else np.zeros((0, W), np.uint64)
+        eg_peers_dst_p = (
+            pack(eg_peers_dst) if eg_peers_dst.size else np.zeros((0, W), np.uint64)
+        )
         eg_targets_p = pack(eg_targets) if eg_targets.size else np.zeros((0, W), np.uint64)
 
         not_ing_iso_row = pack(~ing_iso[None, :])[0]
@@ -197,7 +208,7 @@ class NativeBackend(VerifierBackend):
             eg_q = BitMatrix.zeros(n, n)
             eg_q.or_scatter_into(
                 BitMatrix(np.ascontiguousarray(eg_targets_p[ge]), n),
-                BitMatrix(np.ascontiguousarray(eg_peers_p[ge]), n),
+                BitMatrix(np.ascontiguousarray(eg_peers_dst_p[ge]), n),
             )
             if config.default_allow_unselected:
                 # unselected dst accept from anyone; unselected src send anywhere

@@ -57,6 +57,7 @@ def _k8s_step(
     aff_eg,
     ingress,
     egress,
+    restrict_bank=None,
     *,
     self_traffic: bool,
     default_allow_unselected: bool,
@@ -75,6 +76,7 @@ def _k8s_step(
         aff_eg,
         ingress,
         egress,
+        restrict_bank,
         self_traffic=self_traffic,
         default_allow_unselected=default_allow_unselected,
         direction_aware_isolation=direction_aware_isolation,
@@ -102,6 +104,7 @@ class TpuBackend(VerifierBackend):
             enc.pol_affects_egress,
             enc.ingress,
             enc.egress,
+            enc.restrict_bank,
             self_traffic=config.self_traffic,
             default_allow_unselected=config.default_allow_unselected,
             direction_aware_isolation=config.direction_aware_isolation,

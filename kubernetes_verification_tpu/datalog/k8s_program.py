@@ -122,9 +122,20 @@ def build_k8s_program(
     )
     ns_index = cluster.namespace_index()
 
-    from ..encode.ports import ALL_ATOM, compute_port_atoms, rule_port_mask
+    from ..encode.ports import (
+        ALL_ATOM,
+        compute_port_atoms,
+        named_resolution,
+        rule_named_specs,
+        rule_port_mask,
+    )
 
-    atoms = compute_port_atoms(policies) if config.compute_ports else [ALL_ATOM]
+    if config.compute_ports:
+        atoms = compute_port_atoms(policies, pods)
+        resolution = named_resolution(policies, atoms, pods)
+    else:
+        atoms = [ALL_ATOM]
+        resolution = {}
     Q = len(atoms)
 
     pod_d = prog.domain("pod", N)
@@ -191,11 +202,48 @@ def build_k8s_program(
             prog.rule(Atom("sel_eg", ("x", i)), Atom("selected", ("x", i)))
 
         def emit_peers(rules, head_rel, direction):
+            # named-port resolution couples (dst, atom): each (name, atom)
+            # variant emits a DIRECT *_traffic rule with a constant atom and
+            # a per-dst restriction relation — the Datalog form of the
+            # encoder's GrantBlock.dst_restrict bank. Static (numeric) port
+            # coverage keeps the per-rule ports relation below.
+            traffic_rel = (
+                "ingress_traffic" if direction == "in" else "egress_traffic"
+            )
+            sel_rel = "sel_ing" if direction == "in" else "sel_eg"
+            peer_var = "s" if direction == "in" else "d"
+            # named restrictions gate the edge's DESTINATION: the selected
+            # pod for ingress ("x"), the peer for egress (peer_var "d")
+            restrict_var = "x" if direction == "in" else peer_var
+
+            def named_variants(rule, ridx):
+                out = []
+                for k, (proto, name) in enumerate(rule_named_specs(rule)):
+                    res = resolution.get((proto, name))
+                    if res is None:
+                        continue
+                    for q in np.nonzero(res.any(axis=0))[0]:
+                        rel = f"named_{direction}_{i}_{ridx}_{k}_{int(q)}"
+                        prog.relation(rel, pod_d)
+                        prog.fact_array(rel, res[:, q])
+                        out.append((int(q), rel))
+                return out
+
+            def emit_named(variants, src_body):
+                for q, restrict_rel in variants:
+                    prog.rule(
+                        Atom(traffic_rel, (peer_var, "x", q)),
+                        Atom(sel_rel, ("x", i)),
+                        Atom(restrict_rel, (restrict_var,)),
+                        *src_body,
+                    )
+
             ip_rows = np.zeros((N, Q), dtype=bool)
             any_ip = False
             for ridx, rule in enumerate(rules or ()):
                 # ignores port specs when atoms == [ALL_ATOM] (ports off)
                 pmask = rule_port_mask(rule, atoms)
+                variants = named_variants(rule, ridx)
                 # per-rule port relation: one fact per covered atom
                 ports_rel = f"ports_{direction}_{i}_{ridx}"
                 prog.relation(ports_rel, q_d)
@@ -206,30 +254,39 @@ def build_k8s_program(
                         Atom("is_pod", ("s",)),
                         Atom(ports_rel, ("q",)),
                     )
+                    emit_named(variants, [Atom("is_pod", (peer_var,))])
                     continue
-                for peer in rule.peers:
+                for pidx, peer in enumerate(rule.peers):
                     if peer.ip_block is not None:
                         any_ip = True
-                        for j, pod in enumerate(pods):
-                            if peer.ip_block.matches_ip(pod.ip):
-                                ip_rows[j] |= pmask
+                        ip_hits = np.array(
+                            [peer.ip_block.matches_ip(p.ip) for p in pods],
+                            dtype=bool,
+                        )
+                        ip_rows |= ip_hits[:, None] & pmask[None, :]
+                        if variants:
+                            ip_rel = f"ipsrc_{direction}_{i}_{ridx}_{pidx}"
+                            prog.relation(ip_rel, pod_d)
+                            prog.fact_array(ip_rel, ip_hits)
+                            emit_named(variants, [Atom(ip_rel, (peer_var,))])
                         continue
-                    p_atoms = pod_c.compile(peer.pod_selector, "s")
+                    p_atoms = pod_c.compile(peer.pod_selector, peer_var)
                     if p_atoms is None:
                         continue
                     if peer.namespace_selector is None:
-                        scope = [Atom("pod_ns", ("s", c_ns))]
+                        scope = [Atom("pod_ns", (peer_var, c_ns))]
                     else:
                         n_atoms = ns_c.compile(peer.namespace_selector, "n")
                         if n_atoms is None:
                             continue
-                        scope = [Atom("pod_ns", ("s", "n")), *n_atoms]
+                        scope = [Atom("pod_ns", (peer_var, "n")), *n_atoms]
                     prog.rule(
-                        Atom(head_rel, ("s", i, "q")),
+                        Atom(head_rel, (peer_var, i, "q")),
                         *scope,
                         *p_atoms,
                         Atom(ports_rel, ("q",)),
                     )
+                    emit_named(variants, [*scope, *p_atoms])
             if any_ip:
                 arr = np.zeros((N, pol_d.size, Q), dtype=bool)
                 arr[:, i, :] = ip_rows

@@ -31,7 +31,13 @@ from ..models.core import (
     NetworkPolicy,
     Selector,
 )
-from .ports import ALL_ATOM, compute_port_atoms, rule_port_mask
+from .ports import (
+    ALL_ATOM,
+    compute_port_atoms,
+    named_resolution,
+    rule_named_specs,
+    rule_port_mask,
+)
 from .vocab import Vocab
 
 __all__ = [
@@ -151,7 +157,15 @@ class GrantBlock:
     marks rules with empty/missing ``from``/``to``; ``ns_sel_null`` switches
     the namespace scope between "policy's own namespace" (null) and the
     compiled namespace selector; ``ip_match`` carries host-precomputed
-    ipBlock↔pod-IP matches when any ipBlock peer exists."""
+    ipBlock↔pod-IP matches when any ipBlock peer exists.
+
+    ``dst_restrict[g]`` indexes ``EncodedCluster.restrict_bank``: the grant
+    only reaches destination pods in that bank row (row 0 = no restriction).
+    This is how named ports resolve per destination — a rule naming a port
+    splits into one grant per (name, resolved atom) whose restriction is the
+    set of dst pods resolving the name to that atom. Every kernel ANDs the
+    bank row into the grant's dst-side operand (the selected pods for
+    ingress, the peer set for egress)."""
 
     pol: np.ndarray  # int32 [G]
     match_all: np.ndarray  # bool [G]
@@ -161,6 +175,7 @@ class GrantBlock:
     is_ipblock: np.ndarray  # bool [G]
     ports: np.ndarray  # bool [G, Q]
     ip_match: Optional[np.ndarray] = None  # bool [G, N] | None
+    dst_restrict: Optional[np.ndarray] = None  # int32 [G] | None (= all 0)
 
     @property
     def n(self) -> int:
@@ -185,6 +200,27 @@ class EncodedCluster:
     pol_affects_egress: np.ndarray  # bool [P]
     ingress: GrantBlock
     egress: GrantBlock
+    #: named-port dst-restriction rows (bool [B, N]; row 0 all-True); None
+    #: when no named spec resolves — see GrantBlock.dst_restrict
+    restrict_bank: Optional[np.ndarray] = None
+
+
+class _RestrictBank:
+    """Interns named-port dst-restriction rows. Row 0 is the all-True
+    unrestricted row; one row per (protocol, name, atom) actually used."""
+
+    def __init__(self, n_pods: int) -> None:
+        self.rows: List[np.ndarray] = [np.ones(n_pods, dtype=bool)]
+        self._ids: Dict[Tuple[str, str, int], int] = {}
+
+    def intern(self, key: Tuple[str, str, int], mask: np.ndarray) -> int:
+        if key not in self._ids:
+            self._ids[key] = len(self.rows)
+            self.rows.append(mask)
+        return self._ids[key]
+
+    def array(self) -> Optional[np.ndarray]:
+        return np.stack(self.rows) if len(self.rows) > 1 else None
 
 
 def _encode_grants(
@@ -193,6 +229,8 @@ def _encode_grants(
     direction: str,
     atoms: Sequence[PortAtom],
     vocab: Vocab,
+    resolution: Optional[Dict] = None,
+    bank: Optional[_RestrictBank] = None,
 ) -> GrantBlock:
     pols: List[int] = []
     match_all: List[bool] = []
@@ -201,51 +239,87 @@ def _encode_grants(
     ns_null: List[bool] = []
     is_ip: List[bool] = []
     port_rows: List[np.ndarray] = []
+    restricts: List[int] = []
     ip_rows: Dict[int, np.ndarray] = {}
 
     n = len(pods)
+    Q = len(atoms)
     for pi, pol in enumerate(policies):
         rules = pol.ingress if direction == "ingress" else pol.egress
         if not rules:
             continue
         for rule in rules:
-            # rule_port_mask ignores port specs when atoms == [ALL_ATOM]
+            # rule_port_mask ignores port specs when atoms == [ALL_ATOM];
+            # in resolution mode it covers the numeric specs only — named
+            # specs become extra single-atom variants with a dst restriction
             pmask = rule_port_mask(rule, atoms)
-            if rule.matches_all_peers:
-                pols.append(pi)
-                match_all.append(True)
-                pod_sels.append(None)
-                ns_sels.append(None)
-                ns_null.append(True)
-                is_ip.append(False)
-                port_rows.append(pmask)
-                continue
-            for peer in rule.peers:
+            # the base row is emitted even with an all-false mask (a rule
+            # whose only specs are unresolvable named ports): it grants no
+            # edges but its peer rows still feed the per-policy src/dst edge
+            # sets and has-grant flags, matching the oracle
+            variants: List[Tuple[np.ndarray, int]] = [(pmask, 0)]
+            if resolution is not None:
+                for proto, name in rule_named_specs(rule):
+                    res = resolution.get((proto, name))
+                    if res is None:
+                        continue
+                    for q in np.nonzero(res.any(axis=0))[0]:
+                        rid = bank.intern(
+                            (proto, name, int(q)), res[:, q].copy()
+                        )
+                        onehot = np.zeros(Q, dtype=bool)
+                        onehot[q] = True
+                        variants.append((onehot, rid))
+            def emit_row(mask, rid, peer=None, ip_row=None):
                 g = len(pols)
                 pols.append(pi)
-                match_all.append(False)
-                if peer.ip_block is not None:
+                if peer is None:  # match-all rule
+                    match_all.append(True)
+                    pod_sels.append(None)
+                    ns_sels.append(None)
+                    ns_null.append(True)
+                    is_ip.append(False)
+                elif peer.ip_block is not None:
+                    match_all.append(False)
                     pod_sels.append(None)
                     ns_sels.append(None)
                     ns_null.append(True)
                     is_ip.append(True)
-                    ip_rows[g] = np.array(
-                        [peer.ip_block.matches_ip(p.ip) for p in pods],
-                        dtype=bool,
-                    )
+                    ip_rows[g] = ip_row
                 else:
+                    match_all.append(False)
                     pod_sels.append(peer.pod_selector)
                     ns_sels.append(peer.namespace_selector)
                     ns_null.append(peer.namespace_selector is None)
                     is_ip.append(False)
-                port_rows.append(pmask)
+                port_rows.append(mask)
+                restricts.append(rid)
 
-    G, Q = len(pols), len(atoms)
+            if rule.matches_all_peers:
+                for mask, rid in variants:
+                    emit_row(mask, rid)
+            else:
+                for peer in rule.peers:
+                    # the ipBlock↔pod-IP row is O(N) Python — compute it
+                    # once per peer and share it across the port variants
+                    ip_row = (
+                        np.array(
+                            [peer.ip_block.matches_ip(p.ip) for p in pods],
+                            dtype=bool,
+                        )
+                        if peer.ip_block is not None
+                        else None
+                    )
+                    for mask, rid in variants:
+                        emit_row(mask, rid, peer, ip_row)
+
+    G = len(pols)
     ip_match = None
     if ip_rows:
         ip_match = np.zeros((G, n), dtype=bool)
         for g, row in ip_rows.items():
             ip_match[g] = row
+    any_restrict = any(restricts)
     return GrantBlock(
         pol=np.asarray(pols, dtype=np.int32),
         match_all=np.asarray(match_all, dtype=bool),
@@ -257,6 +331,9 @@ def _encode_grants(
             np.stack(port_rows) if port_rows else np.zeros((0, Q), dtype=bool)
         ),
         ip_match=ip_match,
+        dst_restrict=(
+            np.asarray(restricts, dtype=np.int32) if any_restrict else None
+        ),
     )
 
 
@@ -274,11 +351,15 @@ def encode_cluster(
     cluster: Cluster, compute_ports: bool = True
 ) -> EncodedCluster:
     vocab = cluster_vocab(cluster.pods, cluster.namespaces)
-    atoms = (
-        compute_port_atoms(cluster.policies)
-        if compute_ports
-        else [ALL_ATOM]
-    )
+    resolution = None
+    bank = None
+    if compute_ports:
+        atoms = compute_port_atoms(cluster.policies, cluster.pods)
+        resolution = named_resolution(cluster.policies, atoms, cluster.pods)
+        if resolution:
+            bank = _RestrictBank(cluster.n_pods)
+    else:
+        atoms = [ALL_ATOM]
     ns_index = cluster.namespace_index()
 
     pod_kv, pod_key = vocab.encode_label_matrix(p.labels for p in cluster.pods)
@@ -309,11 +390,14 @@ def encode_cluster(
             [pol.affects_egress for pol in cluster.policies], dtype=bool
         ),
         ingress=_encode_grants(
-            cluster.policies, cluster.pods, "ingress", atoms, vocab
+            cluster.policies, cluster.pods, "ingress", atoms, vocab,
+            resolution, bank,
         ),
         egress=_encode_grants(
-            cluster.policies, cluster.pods, "egress", atoms, vocab
+            cluster.policies, cluster.pods, "egress", atoms, vocab,
+            resolution, bank,
         ),
+        restrict_bank=bank.array() if bank is not None else None,
     )
 
 

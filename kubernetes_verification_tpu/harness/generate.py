@@ -62,6 +62,10 @@ class GeneratorConfig:
     p_explicit_policy_types: float = 0.2
     p_ipblock_peer: float = 0.05
     p_named_port: float = 0.05
+    #: probability a pod declares container ports for the well-known names
+    #: (named-port resolution needs dst pods that actually expose the name;
+    #: numbers vary per pod so the same name resolves to different ports)
+    p_container_ports: float = 0.3
     #: size of the cluster-wide port-spec library rules draw from. Real
     #: clusters reuse a small set of service ports (80/443/5432/...) rather
     #: than minting a fresh range per rule; a bounded library keeps the number
@@ -187,12 +191,28 @@ def random_cluster(cfg: Optional[GeneratorConfig] = None, **kw) -> Cluster:
     namespaces = [
         Namespace(f"ns{i}", _rand_labels(rng, 2)) for i in range(cfg.n_namespaces)
     ]
+    def _rand_container_ports(i: int):
+        if rng.random() >= cfg.p_container_ports:
+            return {}
+        # a few canonical numbers per name so resolution diverges across pods
+        choices = {
+            "http": [8080, 8081, 9090, 80],
+            "metrics": [9100, 9101, 2112],
+            "grpc": [50051, 50052],
+        }
+        return {
+            name: ("TCP", rng.choice(nums))
+            for name, nums in choices.items()
+            if rng.random() < 0.6
+        }
+
     pods = [
         Pod(
             f"pod{i}",
             rng.choice(namespaces).name,
             _rand_labels(rng, cfg.max_labels_per_pod),
             ip=f"10.{(i >> 16) & 255}.{(i >> 8) & 255}.{i & 255}",
+            container_ports=_rand_container_ports(i),
         )
         for i in range(cfg.n_pods)
     ]

@@ -44,6 +44,7 @@ jax.tree_util.register_dataclass(
         "is_ipblock",
         "ports",
         "ip_match",
+        "dst_restrict",
     ],
     meta_fields=[],
 )

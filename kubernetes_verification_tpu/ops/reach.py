@@ -129,6 +129,7 @@ def k8s_reach(
     pol_affects_egress: jnp.ndarray,
     ingress: GrantBlock,
     egress: GrantBlock,
+    restrict_bank: Optional[jnp.ndarray] = None,  # bool [B, N]
     *,
     self_traffic: bool,
     default_allow_unselected: bool,
@@ -162,11 +163,15 @@ def k8s_reach(
     def allow(block: GrantBlock, dir_selected: jnp.ndarray, is_ingress: bool):
         peers = _grant_peers(block, pod_kv, pod_key, ns_kv, ns_key, pod_ns, pol_ns)
         targets = dir_selected[block.pol]  # [G, N]
-        if is_ingress:
-            # allow[src, dst, q]: src = peer, dst = selected
-            return _grant_contract(peers, targets, block.ports), peers, targets
-        # egress: src = selected, dst = peer
-        return _grant_contract(targets, peers, block.ports), peers, targets
+        src, dst = (peers, targets) if is_ingress else (targets, peers)
+        if block.dst_restrict is not None:
+            # named-port resolution: each grant reaches only the dst pods in
+            # its restriction row (encoder.GrantBlock.dst_restrict)
+            dst = dst & restrict_bank[block.dst_restrict]
+        # allow[src, dst, q]: ingress src = peer / dst = selected; egress
+        # src = selected / dst = peer (the unrestricted peers feed the
+        # per-policy edge sets below, matching the oracle)
+        return _grant_contract(src, dst, block.ports), peers, targets
 
     ing_allow, ing_peers, _ = allow(ingress, sel_ing, True)
     eg_allow, eg_peers, _ = allow(egress, sel_eg, False)

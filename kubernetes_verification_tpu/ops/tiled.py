@@ -386,14 +386,25 @@ def _build_port_layout(
     ing_pol: np.ndarray,  # int32 [Gi]
     eg_pol: np.ndarray,  # int32 [Ge]
     sink_pol: int,
-) -> Tuple[PortLayout, np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
-    """Group grants into (policy, port-mask) virtual policies.
+    ing_restrict: Optional[np.ndarray] = None,  # int32 [Gi] | None
+    eg_restrict: Optional[np.ndarray] = None,  # int32 [Ge] | None
+) -> Tuple[
+    PortLayout,
+    np.ndarray, np.ndarray, np.ndarray,
+    np.ndarray, np.ndarray, np.ndarray,
+]:
+    """Group grants into (policy, port-mask, dst-restriction) virtual
+    policies.
 
-    Returns ``(layout, vp_pol_i, vp_slot_i, vp_pol_e, vp_slot_e)`` where
-    ``vp_pol_*[row]`` is the policy of each compact VP row (sink rows map to
-    ``sink_pol``) and ``vp_slot_*[g]`` sends grant ``g`` to its VP row.
-    Empty-mask grants (inert padding) go to the sink row. Segments are padded
-    to a multiple of 8 with inert rows so dot shapes stay MXU-friendly."""
+    Returns ``(layout, vp_pol_i, vp_restrict_i, vp_slot_i, vp_pol_e,
+    vp_restrict_e, vp_slot_e)`` where ``vp_pol_*[row]`` is the policy of
+    each compact VP row (sink rows map to ``sink_pol``),
+    ``vp_restrict_*[row]`` its named-port restriction-bank row (0 = none),
+    and ``vp_slot_*[g]`` sends grant ``g`` to its VP row. Grants differing
+    only in restriction stay in separate VPs — merging them would OR their
+    peer maps and lose the per-dst gating. Empty-mask grants (inert padding)
+    go to the sink row. Segments are padded to a multiple of 8 with inert
+    rows so dot shapes stay MXU-friendly."""
     all_ports = np.concatenate([ing_ports, eg_ports], axis=0)
     masks, inverse = np.unique(all_ports, axis=0, return_inverse=True)
     full_ids = np.nonzero(masks.all(axis=1))[0]
@@ -418,15 +429,26 @@ def _build_port_layout(
     if full_id >= 0:
         bucket_of_mask[full_id] = R
 
-    def one_direction(ports, pol, mask_ids):
+    n_restrict = 1 + max(
+        int(ing_restrict.max()) if ing_restrict is not None and len(ing_restrict) else 0,
+        int(eg_restrict.max()) if eg_restrict is not None and len(eg_restrict) else 0,
+    )
+
+    def one_direction(ports, pol, mask_ids, restrict):
+        if restrict is None:
+            restrict = np.zeros(len(pol), dtype=np.int64)
         bucket = bucket_of_mask[mask_ids]
-        keys = bucket * (sink_pol + 1) + pol  # unique (bucket, pol) id
+        # unique (bucket, pol, restrict) id
+        keys = (bucket * (sink_pol + 1) + pol) * n_restrict + restrict
         uniq, slot_of_grant = np.unique(keys, return_inverse=True)
-        vp_bucket = uniq // (sink_pol + 1)
-        vp_pols = uniq % (sink_pol + 1)
+        vp_restricts = uniq % n_restrict
+        vp_bp = uniq // n_restrict
+        vp_bucket = vp_bp // (sink_pol + 1)
+        vp_pols = vp_bp % (sink_pol + 1)
         # compact layout: ported segments (each padded to %8), full, sink
         seg: List[Tuple[int, int]] = []
         vp_pol_rows: List[int] = []
+        vp_res_rows: List[int] = []
         row_of_vp = np.empty(len(uniq), dtype=np.int64)
         for r in range(R):
             members = np.nonzero(vp_bucket == r)[0]
@@ -434,42 +456,48 @@ def _build_port_layout(
             for u in members:
                 row_of_vp[u] = len(vp_pol_rows)
                 vp_pol_rows.append(int(vp_pols[u]))
+                vp_res_rows.append(int(vp_restricts[u]))
             length = len(members)
             pad = (-length) % 8 if length else 0
             vp_pol_rows.extend([sink_pol] * pad)
+            vp_res_rows.extend([0] * pad)
             seg.append((start, length + pad))
         full_members = np.nonzero(vp_bucket == R)[0]
         full_start = len(vp_pol_rows)
         for u in full_members:
             row_of_vp[u] = len(vp_pol_rows)
             vp_pol_rows.append(int(vp_pols[u]))
+            vp_res_rows.append(int(vp_restricts[u]))
         pad = (-len(full_members)) % 8 if len(full_members) else 0
         vp_pol_rows.extend([sink_pol] * pad)
+        vp_res_rows.extend([0] * pad)
         full = (full_start, len(full_members) + pad)
         sink_row = len(vp_pol_rows)
         for u in np.nonzero(vp_bucket == R + 1)[0]:
             row_of_vp[u] = sink_row
         vp_pol_rows.append(sink_pol)
+        vp_res_rows.append(0)
         vp_slot = row_of_vp[slot_of_grant].astype(np.int32)
         return (
             tuple(seg),
             full,
             np.asarray(vp_pol_rows, dtype=np.int32),
+            np.asarray(vp_res_rows, dtype=np.int32),
             vp_slot,
         )
 
     gi = len(ing_pol)
-    seg_i, full_i, vp_pol_i, vp_slot_i = one_direction(
-        ing_ports, ing_pol, inverse[:gi]
+    seg_i, full_i, vp_pol_i, vp_res_i, vp_slot_i = one_direction(
+        ing_ports, ing_pol, inverse[:gi], ing_restrict
     )
-    seg_e, full_e, vp_pol_e, vp_slot_e = one_direction(
-        eg_ports, eg_pol, inverse[gi:]
+    seg_e, full_e, vp_pol_e, vp_res_e, vp_slot_e = one_direction(
+        eg_ports, eg_pol, inverse[gi:], eg_restrict
     )
     layout = PortLayout(
         seg_i=seg_i, seg_e=seg_e, full_i=full_i, full_e=full_e,
         ov_rows=ov_rows,
     )
-    return layout, vp_pol_i, vp_slot_i, vp_pol_e, vp_slot_e
+    return layout, vp_pol_i, vp_res_i, vp_slot_i, vp_pol_e, vp_res_e, vp_slot_e
 
 
 def _dot_lnt(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
@@ -566,9 +594,12 @@ def _tiled_ports_step(
     ingress: GrantBlock,
     egress: GrantBlock,
     vp_pol_i,  # int32 [total_i]
+    vp_res_i,  # int32 [total_i] — restriction-bank row per VP
     vp_slot_i,  # int32 [Gi_pad]
     vp_pol_e,
+    vp_res_e,
     vp_slot_e,
+    bank8,  # int8 [B, N] — named-port dst restrictions (row 0 all-ones)
     col_mask,  # uint32 [W]
     *,
     layout: PortLayout,
@@ -608,20 +639,29 @@ def _tiled_ports_step(
         egress, vp_slot_e, total_e, chunk,
         pod_kv, pod_key, ns_kv, ns_key, pod_ns, pol_ns,
     )
+    # named-port resolution, egress side: the dst operand is the peer map —
+    # gate each VP's rows by its restriction-bank row
+    vp_peers_e = vp_peers_e * bank8[vp_res_e]
     # egress src-side operand, pre-gathered once: row v = selected-by-pol(v)
     sel_eg_vp = sel_eg_ext[vp_pol_e]  # int8 [total_e, N]
 
     def tile_body(t, out):
         d0 = t * tile
         sel_ing_t = jax.lax.dynamic_slice(sel_ing_ext, (0, d0), (P + 1, tile))
+        bank_t = jax.lax.dynamic_slice(
+            bank8, (0, d0), (bank8.shape[0], tile)
+        )
         vpe_t = jax.lax.dynamic_slice(vp_peers_e, (0, d0), (total_e, tile))
         false_t = jnp.zeros((N, tile), dtype=bool)
 
         def ing_dot(start: int, length: int) -> jnp.ndarray:
-            """GI of one VP row range: counts[s, d_t] > 0."""
+            """GI of one VP row range: counts[s, d_t] > 0. The dst operand
+            (the policy's selection tile) is gated by each VP's named-port
+            restriction row."""
             a = jax.lax.slice(vp_peers_i, (start, 0), (start + length, N))
             idx = jax.lax.slice(vp_pol_i, (start,), (start + length,))
-            return _dot_lnt(a, sel_ing_t[idx]) > 0
+            ridx = jax.lax.slice(vp_res_i, (start,), (start + length,))
+            return _dot_lnt(a, sel_ing_t[idx] * bank_t[ridx]) > 0
 
         def eg_dot(start: int, length: int) -> jnp.ndarray:
             a = jax.lax.slice(sel_eg_vp, (start, 0), (start + length, N))
@@ -1031,13 +1071,31 @@ def tiled_k8s_reach(
         egress,
     )
     if with_ports:
-        layout, vp_pol_i, vp_slot_i, vp_pol_e, vp_slot_e = _build_port_layout(
+        (
+            layout, vp_pol_i, vp_res_i, vp_slot_i,
+            vp_pol_e, vp_res_e, vp_slot_e,
+        ) = _build_port_layout(
             np.asarray(ingress.ports),
             np.asarray(egress.ports),
             np.asarray(ingress.pol),
             np.asarray(egress.pol),
             sink_pol=P,
+            ing_restrict=(
+                np.asarray(ingress.dst_restrict)
+                if ingress.dst_restrict is not None
+                else None
+            ),
+            eg_restrict=(
+                np.asarray(egress.dst_restrict)
+                if egress.dst_restrict is not None
+                else None
+            ),
         )
+        if enc.restrict_bank is not None:
+            bank8 = np.zeros((enc.restrict_bank.shape[0], Np), dtype=np.int8)
+            bank8[:, :n] = enc.restrict_bank
+        else:
+            bank8 = np.ones((1, Np), dtype=np.int8)
         # the three resident int8 operands — two [total_vp, N] peer maps plus
         # the gathered egress selection — are the port path's memory floor;
         # catch an over-wide VP layout here rather than as a device OOM
@@ -1051,7 +1109,10 @@ def tiled_k8s_reach(
                 "distinct (policy, port-mask) combinations, or verify with "
                 "compute_ports=False."
             )
-        args = (*common, vp_pol_i, vp_slot_i, vp_pol_e, vp_slot_e, col_mask)
+        args = (
+            *common, vp_pol_i, vp_res_i, vp_slot_i,
+            vp_pol_e, vp_res_e, vp_slot_e, bank8, col_mask,
+        )
         if device is not None:
             args = jax.device_put(args, device)
         packed, ing_iso, eg_iso, selected = _tiled_ports_step(

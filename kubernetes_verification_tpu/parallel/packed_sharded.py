@@ -206,6 +206,9 @@ def _packed_local(
     vp_slot_e,
     vp_pol_i,  # int32 [total_i] — VP row → policy (replicated; [0] any-port)
     vp_pol_e,
+    vp_res_i,  # int32 [total_i] — VP row → restriction-bank row
+    vp_res_e,
+    bank8,  # int8 [B, N] replicated — named-port dst restrictions
     *,
     self_traffic: bool,
     default_allow_unselected: bool,
@@ -316,10 +319,16 @@ def _packed_local(
         del selected, sel_ing, sel_eg
         total_i = vp_pol_i.shape[0]
         total_e = vp_pol_e.shape[0]
+        # local column block of the replicated restriction bank (named-port
+        # resolution): gates the dst-side operands below
+        bank_loc = jax.lax.dynamic_slice(
+            bank8, (0, row0), (bank8.shape[0], n_loc)
+        )
         vp_peers_i = peers_by_slot(ingress, vp_slot_i, total_i)  # src side
         vp_peers_e_bits = _pack_rows_u8(
-            peers_by_slot(egress, vp_slot_e, total_e) > 0
-        )  # dst side, bit-packed until broadcast
+            (peers_by_slot(egress, vp_slot_e, total_e) * bank_loc[vp_res_e])
+            > 0
+        )  # dst side (restriction-gated), bit-packed until broadcast
         # egress src-side operand, pre-gathered once: row v = sel(pol(v))
         sel_eg_vp = sel_eg_ext[vp_pol_e]  # int8 [total_e, n_loc]
         def fetch_tile_ports(d0):
@@ -340,6 +349,9 @@ def _packed_local(
             from ..ops.tiled import _mask_group_conj
 
             sel_ing_t, vpe_t = fetch_tile_ports(d0)
+            bank_t = jax.lax.dynamic_slice(
+                bank8, (0, d0), (bank8.shape[0], tile)
+            )
             false_t = jnp.zeros((n_loc, tile), dtype=bool)
 
             def ing_dot(start: int, length: int) -> jnp.ndarray:
@@ -347,7 +359,8 @@ def _packed_local(
                     vp_peers_i, (start, 0), (start + length, n_loc)
                 )
                 idx = jax.lax.slice(vp_pol_i, (start,), (start + length,))
-                return dot_ln(a, sel_ing_t[idx]) > 0
+                ridx = jax.lax.slice(vp_res_i, (start,), (start + length,))
+                return dot_ln(a, sel_ing_t[idx] * bank_t[ridx]) > 0
 
             def eg_dot(start: int, length: int) -> jnp.ndarray:
                 a = jax.lax.slice(
@@ -536,16 +549,35 @@ def sharded_packed_reach(
         eg_block, pad_amount(eg_block.n, mp * chunk), P_pol, n_pad
     )
     if with_ports:
-        # group (policy, port-mask) pairs into virtual policies AFTER grant
-        # padding (padded rows carry empty masks → the sink VP row), so the
-        # vp_slot arrays align row-for-row with the sharded grant stacks
-        layout, vp_pol_i, vp_slot_i, vp_pol_e, vp_slot_e = _build_port_layout(
+        # group (policy, port-mask, restriction) triples into virtual
+        # policies AFTER grant padding (padded rows carry empty masks → the
+        # sink VP row), so the vp_slot arrays align row-for-row with the
+        # sharded grant stacks
+        (
+            layout, vp_pol_i, vp_res_i, vp_slot_i,
+            vp_pol_e, vp_res_e, vp_slot_e,
+        ) = _build_port_layout(
             np.asarray(ingress.ports),
             np.asarray(egress.ports),
             np.asarray(ingress.pol),
             np.asarray(egress.pol),
             sink_pol=P_pol,
+            ing_restrict=(
+                np.asarray(ingress.dst_restrict)
+                if ingress.dst_restrict is not None
+                else None
+            ),
+            eg_restrict=(
+                np.asarray(egress.dst_restrict)
+                if egress.dst_restrict is not None
+                else None
+            ),
         )
+        if enc.restrict_bank is not None:
+            bank8 = np.zeros((enc.restrict_bank.shape[0], Np), dtype=np.int8)
+            bank8[:, :n] = enc.restrict_bank
+        else:
+            bank8 = np.ones((1, Np), dtype=np.int8)
         # per-device resident VP operands: vp_peers_i + sel_eg_vp int8
         # [total, n_loc] (+ the bit-packed dst forms) — fail fast like the
         # tiled path instead of an opaque device OOM
@@ -564,6 +596,9 @@ def sharded_packed_reach(
         vp_slot_e = np.zeros_like(np.asarray(egress.pol))
         vp_pol_i = np.zeros(1, dtype=np.int32)
         vp_pol_e = np.zeros(1, dtype=np.int32)
+        vp_res_i = np.zeros(1, dtype=np.int32)
+        vp_res_e = np.zeros(1, dtype=np.int32)
+        bank8 = np.ones((1, Np), dtype=np.int8)
 
     n_tiles_total = Np // tile
     if stripe is None:
@@ -610,6 +645,9 @@ def sharded_packed_reach(
         P(GRANT_AXIS),  # vp_slot_e
         P(),  # vp_pol_i (replicated)
         P(),  # vp_pol_e
+        P(),  # vp_res_i (replicated)
+        P(),  # vp_res_e
+        P(),  # bank8 (replicated — B is small)
     )
     out_specs = (
         P(POD_AXIS, None),  # packed block (or stub)
@@ -644,6 +682,9 @@ def sharded_packed_reach(
         np.asarray(vp_slot_e, dtype=np.int32),
         np.asarray(vp_pol_i, dtype=np.int32),
         np.asarray(vp_pol_e, dtype=np.int32),
+        np.asarray(vp_res_i, dtype=np.int32),
+        np.asarray(vp_res_e, dtype=np.int32),
+        bank8,
     )
     row_deg = np.asarray(row_deg)[:n].astype(np.int64)
     col_deg = np.asarray(col_deg)[:n].astype(np.int64)

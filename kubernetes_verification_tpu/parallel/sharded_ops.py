@@ -87,6 +87,11 @@ def pad_grants(block: GrantBlock, pad: int, sink_pol: int, n_pad_pods: int) -> G
         is_ipblock=pad_rows(block.is_ipblock, pad),
         ports=pad_rows(block.ports, pad),
         ip_match=ip,
+        dst_restrict=(
+            pad_rows(block.dst_restrict, pad)  # pads unrestricted (row 0)
+            if block.dst_restrict is not None
+            else None
+        ),
     )
 
 
@@ -145,6 +150,7 @@ def _k8s_local(
     aff_eg,
     ingress: GrantBlock,
     egress: GrantBlock,
+    bank,  # bool [B, N] replicated — named-port dst restrictions (row 0 ones)
     *,
     self_traffic: bool,
     default_allow_unselected: bool,
@@ -183,6 +189,10 @@ def _k8s_local(
         else:
             a = sel_eg_loc[block.pol]  # [G_loc, n_loc]
             b = jax.lax.all_gather(peers_loc, POD_AXIS, axis=1, tiled=True)
+        if block.dst_restrict is not None:
+            # named-port resolution: gate each grant's dst-side operand by
+            # its restriction-bank row (encoder.GrantBlock.dst_restrict)
+            b = b & bank[block.dst_restrict]
         gq = block.ports  # [G_loc, Q]
         G, N = b.shape
         Q = gq.shape[1]
@@ -288,6 +298,11 @@ def sharded_k8s_reach(
         enc.ingress, pad_amount(enc.ingress.n, mp), enc.n_policies, n_pad
     )
     egress = pad_grants(enc.egress, pad_amount(enc.egress.n, mp), enc.n_policies, n_pad)
+    if enc.restrict_bank is not None:
+        bank_full = np.zeros((enc.restrict_bank.shape[0], n + n_pad), dtype=bool)
+        bank_full[:, :n] = enc.restrict_bank
+    else:
+        bank_full = np.ones((1, n + n_pad), dtype=bool)
 
     body = partial(
         _k8s_local,
@@ -310,6 +325,7 @@ def sharded_k8s_reach(
         P(),  # aff_eg
         _grant_pspecs(ingress),
         _grant_pspecs(egress),
+        P(),  # restriction bank (replicated — B is small)
     )
     out_specs = K8sOut(
         reach=P(POD_AXIS, None),
@@ -338,6 +354,7 @@ def sharded_k8s_reach(
         enc.pol_affects_egress,
         ingress,
         egress,
+        bank_full,
     )
     closure = None
     if with_closure:

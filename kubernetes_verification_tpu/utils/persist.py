@@ -229,6 +229,10 @@ def export_encoding(enc, path_prefix: str) -> str:
         arrays[f"{prefix}_match_all"] = block.match_all
         arrays[f"{prefix}_ports"] = block.ports
         arrays[f"{prefix}_is_ipblock"] = block.is_ipblock
+        if block.dst_restrict is not None:
+            arrays[f"{prefix}_dst_restrict"] = block.dst_restrict
+    if enc.restrict_bank is not None:
+        arrays["restrict_bank"] = enc.restrict_bank
     np.savez_compressed(path_prefix + ".npz", **arrays)
 
     lines = [
@@ -240,10 +244,20 @@ def export_encoding(enc, path_prefix: str) -> str:
     for a in enc.atoms:
         lines.append(f"  {a.protocol} {a.name or f'{a.lo}-{a.hi}'}")
     for prefix, block in (("ingress", enc.ingress), ("egress", enc.egress)):
+        restricted = (
+            int((block.dst_restrict > 0).sum())
+            if block.dst_restrict is not None
+            else 0
+        )
         lines.append(
             f"{prefix}: {block.n} grant rows "
             f"({int(block.match_all.sum())} match-all, "
-            f"{int(block.is_ipblock.sum())} ipBlock)"
+            f"{int(block.is_ipblock.sum())} ipBlock, "
+            f"{restricted} named-port restricted)"
+        )
+    if enc.restrict_bank is not None:
+        lines.append(
+            f"named-port restriction bank: {enc.restrict_bank.shape[0]} rows"
         )
     txt = path_prefix + ".txt"
     with open(txt, "w") as fh:
