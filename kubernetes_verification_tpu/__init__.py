@@ -13,6 +13,8 @@ from .models.core import (
     Expr,
     IpBlock,
     KanoPolicy,
+    LabelRelation,
+    DefaultEqualityLabelRelation,
     Namespace,
     NetworkPolicy,
     Peer,
@@ -69,6 +71,8 @@ __all__ = [
     "Expr",
     "IpBlock",
     "KanoPolicy",
+    "LabelRelation",
+    "DefaultEqualityLabelRelation",
     "Namespace",
     "NetworkPolicy",
     "Peer",

@@ -62,6 +62,12 @@ class VerifyConfig:
     direction_aware_isolation: bool = True
     compute_ports: bool = True
     closure: bool = False
+    #: kano-mode label matcher plugin (the reference's only extension point,
+    #: ``kano_py/kano/model.py:59-68``): an object with
+    #: ``match(rule_value, label_value) -> bool``; None = string equality.
+    #: Honored by ``verify_kano`` backends; k8s-mode selectors follow the
+    #: Kubernetes API spec and reject a custom relation.
+    label_relation: Optional[object] = None
     #: extra, backend-specific options (e.g. mesh shape for ``sharded``)
     backend_options: Tuple[Tuple[str, object], ...] = ()
 
@@ -163,6 +169,10 @@ class VerifierBackend:
     """Backend interface. Implementations provide one or both modes."""
 
     name: str = "abstract"
+    #: whether verify_kano honors VerifyConfig.label_relation (the kano
+    #: matcher plugin); the dispatcher rejects a custom relation otherwise
+    #: rather than silently computing equality-only results
+    supports_label_relation: bool = False
 
     def verify(self, cluster: Cluster, config: VerifyConfig) -> VerifyResult:
         raise NotImplementedError
@@ -196,6 +206,12 @@ def get_backend(name: str) -> VerifierBackend:
 def verify(cluster: Cluster, config: Optional[VerifyConfig] = None) -> VerifyResult:
     """Verify a k8s-level cluster with the configured backend."""
     config = config or VerifyConfig()
+    if config.label_relation is not None:
+        raise ValueError(
+            "label_relation is the kano-mode matcher plugin; k8s-mode "
+            "selectors follow the Kubernetes LabelSelector spec (use "
+            "verify_kano)"
+        )
     return get_backend(config.backend).verify(cluster, config)
 
 
@@ -206,4 +222,13 @@ def verify_kano(
 ) -> VerifyResult:
     """Verify a kano-level scenario with the configured backend."""
     config = config or VerifyConfig()
-    return get_backend(config.backend).verify_kano(containers, policies, config)
+    backend = get_backend(config.backend)
+    if (
+        config.label_relation is not None
+        and not backend.supports_label_relation
+    ):
+        raise ValueError(
+            f"backend {config.backend!r} does not honor label_relation; "
+            "use the cpu or tpu backend for a custom kano matcher"
+        )
+    return backend.verify_kano(containers, policies, config)

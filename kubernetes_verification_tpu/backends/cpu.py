@@ -41,21 +41,36 @@ from .base import (
 __all__ = ["CpuBackend"]
 
 
-def _kano_match(labels: Dict[str, str], rule: Dict[str, str], cluster_keys: Set[str]) -> bool:
+def _kano_match(
+    labels: Dict[str, str],
+    rule: Dict[str, str],
+    cluster_keys: Set[str],
+    relation=None,
+) -> bool:
     """kano select/allow semantics: every rule key that exists *somewhere* in
-    the cluster must be present on the container with an equal value; rule
+    the cluster must be present on the container with a matching value; rule
     keys unknown to the whole cluster are ignored
-    (``kano_py/kano/model.py:142-154``)."""
+    (``kano_py/kano/model.py:142-154``). ``relation`` is the pluggable value
+    matcher (``LabelRelation``, ``kano_py/kano/model.py:59-68``); None =
+    string equality — the reference's key-presence bitmap semantics mean the
+    container must CARRY the key either way, the relation only decides
+    whether the values agree."""
     for k, v in rule.items():
         if k not in cluster_keys:
             continue
-        if labels.get(k) != v:
+        if k not in labels:
+            return False
+        if relation is None:
+            if labels[k] != v:
+                return False
+        elif not relation.match(v, labels[k]):
             return False
     return True
 
 
 class CpuBackend(VerifierBackend):
     name = "cpu"
+    supports_label_relation = True
 
     # ------------------------------------------------------------------ kano
     def verify_kano(
@@ -77,10 +92,15 @@ class CpuBackend(VerifierBackend):
             c.select_policies.clear()
             c.allow_policies.clear()
 
+        relation = config.label_relation
         for pi, pol in enumerate(policies):
             for i, c in enumerate(containers):
-                src_sets[pi, i] = _kano_match(c.labels, pol.src_labels, cluster_keys)
-                dst_sets[pi, i] = _kano_match(c.labels, pol.dst_labels, cluster_keys)
+                src_sets[pi, i] = _kano_match(
+                    c.labels, pol.src_labels, cluster_keys, relation
+                )
+                dst_sets[pi, i] = _kano_match(
+                    c.labels, pol.dst_labels, cluster_keys, relation
+                )
             # matrix[src] |= dst_set for every selected src
             # (kano_py/kano/model.py:158-163)
             reach |= np.outer(src_sets[pi], dst_sets[pi])

@@ -36,6 +36,23 @@ def _kano_step(pod_kv, src_req, src_imp, dst_req, dst_imp, *, with_closure: bool
     return out, closure
 
 
+@partial(jax.jit, static_argnames=("with_closure",))
+def _kano_relation_step(pod_kv, pod_key, src_sel, dst_sel, *, with_closure: bool):
+    """kano matrix build under a custom LabelRelation: each policy's label
+    requirements were re-encoded as acceptable-pair In-masks
+    (``encode_kano_relation``), so the pluggable matcher evaluates as the
+    standard selector-match MXU contraction."""
+    from ..ops.match import match_selectors
+    from ..ops.reach import KanoOut, _bool_or_matmul
+
+    src_sets = match_selectors(src_sel, pod_kv, pod_key)
+    dst_sets = match_selectors(dst_sel, pod_kv, pod_key)
+    reach = _bool_or_matmul(src_sets, dst_sets)
+    out = KanoOut(reach=reach, src_sets=src_sets, dst_sets=dst_sets)
+    closure = transitive_closure(reach) if with_closure else None
+    return out, closure
+
+
 @partial(
     jax.jit,
     static_argnames=(
@@ -87,6 +104,7 @@ def _k8s_step(
 
 class TpuBackend(VerifierBackend):
     name = "tpu"
+    supports_label_relation = True
 
     def verify(self, cluster: Cluster, config: VerifyConfig) -> VerifyResult:
         t0 = time.perf_counter()
@@ -136,16 +154,31 @@ class TpuBackend(VerifierBackend):
         config: VerifyConfig,
     ) -> VerifyResult:
         t0 = time.perf_counter()
-        enc = encode_kano(containers, policies)
-        t1 = time.perf_counter()
-        out, closure = _kano_step(
-            enc.pod_kv,
-            enc.src_req,
-            enc.src_impossible,
-            enc.dst_req,
-            enc.dst_impossible,
-            with_closure=config.closure,
-        )
+        if config.label_relation is not None:
+            from ..encode.encoder import encode_kano_relation
+
+            enc_r = encode_kano_relation(
+                containers, policies, config.label_relation
+            )
+            t1 = time.perf_counter()
+            out, closure = _kano_relation_step(
+                enc_r.pod_kv,
+                enc_r.pod_key,
+                enc_r.src_sel,
+                enc_r.dst_sel,
+                with_closure=config.closure,
+            )
+        else:
+            enc = encode_kano(containers, policies)
+            t1 = time.perf_counter()
+            out, closure = _kano_step(
+                enc.pod_kv,
+                enc.src_req,
+                enc.src_impossible,
+                enc.dst_req,
+                enc.dst_impossible,
+                with_closure=config.closure,
+            )
         jax.block_until_ready(out.reach)
         t2 = time.perf_counter()
         src_sets = np.asarray(out.src_sets)

@@ -46,9 +46,11 @@ __all__ = [
     "EncodedCluster",
     "EncodedKano",
     "PolicyDelta",
+    "EncodedKanoRelation",
     "cluster_vocab",
     "encode_cluster",
     "encode_kano",
+    "encode_kano_relation",
     "encode_policy_delta",
 ]
 
@@ -464,6 +466,84 @@ class EncodedKano:
     src_impossible: np.ndarray  # bool [P]
     dst_req: np.ndarray  # bool [P, V]
     dst_impossible: np.ndarray  # bool [P]
+
+
+@dataclass
+class EncodedKanoRelation:
+    """kano encoding under a custom :class:`~..models.core.LabelRelation`:
+    each rule label (k, v) becomes the mask of vocabulary pairs (k, v') the
+    relation accepts — an In-expression over the cluster's value set — so
+    the pluggable matcher (``kano_py/kano/model.py:59-68``) runs as the same
+    MXU selector-match contraction as everything else. The reference quirks
+    carry over: keys unknown to the whole cluster are dropped; a known key
+    whose acceptable-value set is empty matches nothing."""
+
+    n_pods: int
+    n_policies: int
+    vocab: Vocab
+    pod_kv: np.ndarray  # bool [N, V]
+    pod_key: np.ndarray  # bool [N, K]
+    src_sel: SelectorEnc  # [P]
+    dst_sel: SelectorEnc  # [P]
+
+
+def encode_kano_relation(
+    containers: Sequence[Container],
+    policies: Sequence[KanoPolicy],
+    relation,
+) -> EncodedKanoRelation:
+    vocab = Vocab.build(c.labels for c in containers)
+    pod_kv, pod_key = vocab.encode_label_matrix(c.labels for c in containers)
+    P, V = len(policies), vocab.n_pairs
+    by_key: Dict[str, List[Tuple[str, int]]] = {}
+    for (k, v), pid in vocab.pair_ids.items():
+        by_key.setdefault(k, []).append((v, pid))
+    # acceptable-pair ids memoised per distinct (key, rule_value): the
+    # relation (possibly an expensive user plugin) runs once per pair, not
+    # once per policy occurrence
+    accept_memo: Dict[Tuple[str, str], List[int]] = {}
+
+    def accepted(k: str, v: str) -> List[int]:
+        key = (k, v)
+        if key not in accept_memo:
+            accept_memo[key] = [
+                pid for v2, pid in by_key.get(k, ()) if relation.match(v, v2)
+            ]
+        return accept_memo[key]
+
+    def stack(label_sets) -> SelectorEnc:
+        E = max((len(ls) for ls in label_sets), default=0)
+        enc = SelectorEnc(
+            req_eq=np.zeros((P, V), dtype=bool),
+            req_key=np.zeros((P, vocab.n_keys), dtype=bool),
+            forbid_eq=np.zeros((P, V), dtype=bool),
+            forbid_key=np.zeros((P, vocab.n_keys), dtype=bool),
+            in_mask=np.zeros((P, E, V), dtype=bool),
+            in_valid=np.zeros((P, E), dtype=bool),
+            impossible=np.zeros(P, dtype=bool),
+        )
+        for pi, labels in enumerate(label_sets):
+            e = 0
+            for k, v in labels.items():
+                if vocab.key(k) is None:
+                    continue  # key unknown to the cluster: ignored (quirk)
+                enc.in_valid[pi, e] = True
+                for pid in accepted(k, v):
+                    enc.in_mask[pi, e, pid] = True
+                # empty mask ⇒ matches nothing, like the reference's
+                # refinement loop failing on every container
+                e += 1
+        return enc
+
+    return EncodedKanoRelation(
+        n_pods=len(containers),
+        n_policies=P,
+        vocab=vocab,
+        pod_kv=pod_kv,
+        pod_key=pod_key,
+        src_sel=stack([p.src_labels for p in policies]),
+        dst_sel=stack([p.dst_labels for p in policies]),
+    )
 
 
 def encode_kano(

@@ -54,6 +54,8 @@ __all__ = [
     "Cluster",
     "Container",
     "KanoPolicy",
+    "LabelRelation",
+    "DefaultEqualityLabelRelation",
     "INGRESS",
     "EGRESS",
     "PROTOCOLS",
@@ -377,6 +379,29 @@ class Container:
 
     def get_value_or_default(self, key: str, default: str = "") -> str:
         return self.labels.get(key, default)
+
+
+class LabelRelation:
+    """The kano matcher plugin — the reference's only extension point
+    (``kano_py/kano/model.py:59-68``, a ``LabelRelation`` Protocol consumed
+    by ``select_policy``/``allow_policy`` at ``:100,109`` and the matrix
+    refinement loop at ``:150-154``). ``match(rule_value, label_value)``
+    decides whether a policy's rule value accepts an entity's label value;
+    the default is string equality. Supply a custom relation via
+    ``VerifyConfig.label_relation`` (kano mode) — the cpu oracle applies it
+    object-level, the tensor backends re-encode each rule label into its
+    acceptable-value mask over the cluster vocabulary."""
+
+    def match(self, rule_value: str, label_value: str) -> bool:
+        raise NotImplementedError
+
+
+class DefaultEqualityLabelRelation(LabelRelation):
+    """String equality — the reference's default
+    (``kano_py/kano/model.py:64-68``)."""
+
+    def match(self, rule_value: str, label_value: str) -> bool:
+        return rule_value == label_value
 
 
 @dataclass

@@ -5,17 +5,29 @@ length ≤ 2 (``kubesv/kubesv/constraint.py:233-237``) — to the true transitiv
 closure by repeated squaring: after k squarings the matrix covers paths of
 length ≤ 2^k, so ⌈log₂N⌉ squarings suffice. Each squaring is one MXU boolean
 matmul, so the whole closure stays on device inside one ``jit``.
+
+``packed_closure`` is the ≥100k-pod form: the matrix stays a bit-packed
+``uint32 [N, N/32]`` throughout (a dense bool or f32 [N, N] cannot be
+materialised at that scale); each squaring runs as (row tile × dst tile)
+int8 MXU dots whose operands are unpacked transiently from the packed words,
+and the host loop stops as soon as a squaring adds no pair — real
+reachability graphs close in 2-3 squarings, far below the ⌈log₂N⌉ bound.
 """
 from __future__ import annotations
 
 import math
+from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
-__all__ = ["transitive_closure", "path_upto"]
+__all__ = ["transitive_closure", "path_upto", "packed_closure"]
 
 _F = jnp.float32
+_I8 = jnp.int8
+_I32 = jnp.int32
+_U32 = jnp.uint32
 
 
 def _square(reach: jnp.ndarray) -> jnp.ndarray:
@@ -32,6 +44,102 @@ def transitive_closure(reach: jnp.ndarray) -> jnp.ndarray:
     n = reach.shape[0]
     steps = max(1, math.ceil(math.log2(max(n, 2))))
     return jax.lax.fori_loop(0, steps, lambda _, r: _square(r), reach)
+
+
+def _unpack_rows_i8(words: jnp.ndarray, n_cols: int) -> jnp.ndarray:
+    """uint32 [R, W] → int8 [R, n_cols] (n_cols == 32·W)."""
+    r = words.shape[0]
+    bits = jnp.arange(32, dtype=_U32)[None, None, :]
+    out = (words[:, :, None] >> bits) & jnp.uint32(1)
+    return out.reshape(r, n_cols).astype(_I8)
+
+
+@partial(jax.jit, static_argnames=("tile",))
+def _packed_square_step(packed: jnp.ndarray, *, tile: int) -> jnp.ndarray:
+    """One squaring-with-union pass on the packed matrix:
+    ``out[s] = row_s ∨ (∨_{k ∈ row_s} row_k)`` — evaluated as tiled int8 MXU
+    dots ``A[s, k] · B[k, d]`` where A is an unpacked row tile and B an
+    unpacked dst-column tile, both transient."""
+    N, W = packed.shape
+    from ..ops.tiled import pack_bool_cols
+
+    n_row_tiles = N // tile
+    n_dst_tiles = N // tile
+
+    def row_body(rt, out):
+        s0 = rt * tile
+        a = _unpack_rows_i8(
+            jax.lax.dynamic_slice(packed, (s0, 0), (tile, W)), N
+        )  # int8 [tile, N]
+
+        def dst_body(dt, row_out):
+            d0 = dt * tile
+            b = _unpack_rows_i8(
+                jax.lax.dynamic_slice(packed, (0, d0 // 32), (N, tile // 32)),
+                tile,
+            )  # int8 [N, tile] — dst columns d0..d0+tile of every row k
+            counts = jax.lax.dot_general(
+                a, b, (((1,), (0,)), ((), ())), preferred_element_type=_I32
+            )
+            r = counts > 0
+            return jax.lax.dynamic_update_slice(
+                row_out, pack_bool_cols(r), (0, d0 // 32)
+            )
+
+        sq = jax.lax.fori_loop(
+            0, n_dst_tiles, dst_body, jnp.zeros((tile, W), dtype=_U32)
+        )
+        merged = sq | jax.lax.dynamic_slice(packed, (s0, 0), (tile, W))
+        return jax.lax.dynamic_update_slice(out, merged, (s0, 0))
+
+    return jax.lax.fori_loop(
+        0, n_row_tiles, row_body, jnp.zeros((N, W), dtype=_U32)
+    )
+
+
+@jax.jit
+def _packed_row_counts(packed: jnp.ndarray) -> jnp.ndarray:
+    """Per-row popcount (int32 — a row holds < 2³¹ bits); the grand total is
+    summed on host in int64 to avoid 32-bit truncation at 100k² pairs."""
+    return jnp.sum(
+        jax.lax.population_count(packed).astype(_I32), axis=1, dtype=_I32
+    )
+
+
+def _packed_pair_total(packed: jnp.ndarray) -> int:
+    return int(np.asarray(_packed_row_counts(packed)).astype(np.int64).sum())
+
+
+def packed_closure(packed, *, tile: int = 512, max_iter: int = 32):
+    """Transitive closure of a bit-packed reachability matrix
+    (``uint32 [Np, Np/32]``, Np a multiple of ``tile`` and 32 — the layout
+    ``tiled_k8s_reach``/``PackedReach`` produce; the caller guarantees pad
+    bits are zero — this function treats every one of the Np bit positions
+    as a real node). Returns the packed closure. The host loop squares until
+    a pass adds no reachable pair (checked by total popcount — monotone, so
+    equality means fixpoint), capped at ``max_iter``."""
+    packed = jnp.asarray(packed)
+    N, W = packed.shape
+    if N != W * 32:
+        raise ValueError(
+            f"packed matrix must be square in bits ([{N}, {N}/32]); "
+            f"got [{N}, {W}]"
+        )
+    if N == 0:
+        return packed
+    t = min(tile, N)
+    while N % t:
+        t //= 2
+    if t % 32:
+        raise ValueError("tile must reduce to a multiple of 32")
+    total = _packed_pair_total(packed)
+    for _ in range(max_iter):
+        packed = _packed_square_step(packed, tile=t)
+        new_total = _packed_pair_total(packed)
+        if new_total == total:
+            break
+        total = new_total
+    return packed
 
 
 def path_upto(reach: jnp.ndarray, hops: int) -> jnp.ndarray:

@@ -823,6 +823,26 @@ class PackedReach:
         (``kano/algorithm.py:45-55``); unpacks one row only."""
         return np.nonzero(~self.row(idx))[0].tolist()
 
+    def closure(self, tile: int = 512, max_iter: int = 32) -> "PackedReach":
+        """Transitive closure in the packed domain (``ops/closure.py``'s
+        tiled word-wise squaring) — ``path`` queries at scales where a dense
+        [N, N] cannot exist. Returns a new ``PackedReach`` on the same side
+        (host/device) as this one."""
+        from .closure import packed_closure
+
+        Np = self.packed.shape[1] * 32
+        pad = Np - self.packed.shape[0]
+        padded = jnp.pad(jnp.asarray(self.packed), ((0, pad), (0, 0)))
+        closed = packed_closure(
+            padded, tile=tile, max_iter=max_iter
+        )[: self.packed.shape[0]]
+        return PackedReach(
+            packed=np.asarray(closed) if self._on_host else closed,
+            n_pods=self.n_pods,
+            ingress_isolated=self.ingress_isolated,
+            egress_isolated=self.egress_isolated,
+        )
+
     def user_crosscheck(self, objs, label: str) -> List[int]:
         """Pods reachable from a pod of a *different* user group
         (``kano/algorithm.py:27-42``) without unpacking: dst ``j`` is flagged

@@ -1,0 +1,107 @@
+"""K3 — the pluggable kano label matcher (``LabelRelation``), the
+reference's only extension point (``kano_py/kano/model.py:59-68``). A custom
+relation must be honored identically by the object-level cpu oracle and the
+tensor tpu backend (which re-encodes rule labels into acceptable-pair
+masks), while preserving the reference's matcher quirks."""
+import numpy as np
+import pytest
+
+import kubernetes_verification_tpu as kv
+
+
+class PrefixRelation(kv.LabelRelation):
+    """rule value accepts any label value it prefixes: 'web' ~ 'web-1'."""
+
+    def match(self, rule_value: str, label_value: str) -> bool:
+        return label_value.startswith(rule_value)
+
+
+def _containers():
+    return [
+        kv.Container("w1", {"app": "web-1", "tier": "fe"}),
+        kv.Container("w2", {"app": "web-2", "tier": "fe"}),
+        kv.Container("db", {"app": "db-main", "tier": "be"}),
+        kv.Container("x", {"tier": "fe"}),  # no app key
+    ]
+
+
+def _policies():
+    # ingress: select app≈web, allow from app≈db
+    return [kv.KanoPolicy("p", select={"app": "web"}, allow={"app": "db"})]
+
+
+def test_default_equality_unchanged():
+    res = kv.verify_kano(_containers(), _policies(), kv.VerifyConfig())
+    # equality: 'web' matches no container → no edges beyond none
+    assert not res.reach.any()
+
+
+@pytest.mark.parametrize("backend", ["cpu", "tpu"])
+def test_prefix_relation(backend):
+    cfg = kv.VerifyConfig(backend=backend, label_relation=PrefixRelation())
+    res = kv.verify_kano(_containers(), _policies(), cfg)
+    # ingress direction swap: src = allow (db-main), dst = select (web-*)
+    expect = np.zeros((4, 4), dtype=bool)
+    expect[2, 0] = expect[2, 1] = True
+    np.testing.assert_array_equal(res.reach, expect)
+    # the per-policy sets honor the relation too
+    np.testing.assert_array_equal(res.src_sets[0], [False, False, True, False])
+    np.testing.assert_array_equal(res.dst_sets[0], [True, True, False, False])
+
+
+def test_cpu_tpu_agree_with_relation():
+    containers = _containers()
+    pols = [
+        kv.KanoPolicy("a", select={"tier": "f"}, allow={"app": "web"}),
+        kv.KanoPolicy("b", select={"ghost": "z"}, allow={"tier": "b"}),
+        kv.KanoPolicy("c", select={"app": "db"}, allow={}, ingress=False),
+    ]
+    rel = PrefixRelation()
+    r_cpu = kv.verify_kano(
+        containers, pols, kv.VerifyConfig(backend="cpu", label_relation=rel)
+    )
+    r_tpu = kv.verify_kano(
+        containers, pols, kv.VerifyConfig(backend="tpu", label_relation=rel)
+    )
+    np.testing.assert_array_equal(r_cpu.reach, r_tpu.reach)
+    np.testing.assert_array_equal(r_cpu.src_sets, r_tpu.src_sets)
+    np.testing.assert_array_equal(r_cpu.dst_sets, r_tpu.dst_sets)
+
+
+def test_unknown_key_quirk_preserved():
+    """Rule keys no container carries are ignored under any relation
+    (kano_py/kano/model.py:142-154); known keys still require presence."""
+    containers = _containers()
+    pols = [kv.KanoPolicy("q", select={"ghost": "x"}, allow={"app": "w"})]
+    rel = PrefixRelation()
+    for backend in ("cpu", "tpu"):
+        res = kv.verify_kano(
+            containers, pols,
+            kv.VerifyConfig(backend=backend, label_relation=rel),
+        )
+        # ghost ignored → select matches everyone; allow 'w' prefixes web-*
+        np.testing.assert_array_equal(
+            res.dst_sets[0], [True, True, True, True], err_msg=backend
+        )
+        np.testing.assert_array_equal(
+            res.src_sets[0], [True, True, False, False], err_msg=backend
+        )
+
+
+def test_k8s_mode_rejects_relation():
+    cluster = kv.Cluster(pods=[kv.Pod("a", "default", {})])
+    with pytest.raises(ValueError, match="kano"):
+        kv.verify(cluster, kv.VerifyConfig(label_relation=PrefixRelation()))
+
+
+def test_unsupported_backend_rejected():
+    containers = _containers()
+    pols = _policies()
+    for backend in ("native", "sharded", "datalog"):
+        if backend not in kv.available_backends():
+            continue
+        with pytest.raises(ValueError, match="label_relation"):
+            kv.verify_kano(
+                containers, pols,
+                kv.VerifyConfig(backend=backend, label_relation=PrefixRelation()),
+            )

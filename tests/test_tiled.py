@@ -265,3 +265,83 @@ def test_ports_range_overlap():
     assert tiled_k8s_reach(enc, tile=32, chunk=8).reachable(0, 1)
     enc = encode_cluster(mk(9100), compute_ports=True)
     assert not tiled_k8s_reach(enc, tile=32, chunk=8).reachable(0, 1)
+
+
+class TestPackedClosure:
+    """Packed-domain transitive closure (ops/closure.packed_closure) — the
+    ≥100k-pod form of the reference's ≤2-hop path relation."""
+
+    def _sparse_cluster(self, seed=3):
+        # default-allow off + chain-ish policies → multi-hop structure
+        return random_cluster(
+            GeneratorConfig(
+                n_pods=61, n_policies=15, n_namespaces=3, seed=seed,
+                p_ports=0.0,
+            )
+        )
+
+    @pytest.mark.parametrize("seed", [3, 4, 5])
+    def test_matches_dense_closure(self, seed):
+        cluster = self._sparse_cluster(seed)
+        cfg = kv.VerifyConfig(
+            backend="cpu", compute_ports=False, closure=True,
+            self_traffic=False,
+        )
+        ref = kv.verify(cluster, cfg)
+        enc = encode_cluster(cluster, compute_ports=False)
+        pr = tiled_k8s_reach(enc, tile=32, chunk=8, self_traffic=False)
+        np.testing.assert_array_equal(pr.to_bool(), ref.reach)
+        closed = pr.closure(tile=64)
+        np.testing.assert_array_equal(closed.to_bool(), ref.closure)
+
+    def test_multi_hop_chain(self):
+        # a→b→c→d chain: closure must add a→c, a→d, b→d
+        pods = [
+            kv.Pod(n, "prod", {"app": n}) for n in ("a", "b", "c", "d")
+        ]
+        pols = [
+            kv.NetworkPolicy(
+                f"hop-{s}-{d}", namespace="prod",
+                pod_selector=kv.Selector({"app": d}),
+                ingress=(
+                    kv.Rule(peers=(kv.Peer(pod_selector=kv.Selector({"app": s})),)),
+                ),
+            )
+            for s, d in (("a", "b"), ("b", "c"), ("c", "d"))
+        ] + [
+            # isolate a's ingress (absent rules = deny) so default-allow
+            # doesn't make every pod reach a and close the graph trivially
+            kv.NetworkPolicy(
+                "deny-a", namespace="prod",
+                pod_selector=kv.Selector({"app": "a"}),
+                ingress=None,
+                policy_types=("Ingress",),
+            )
+        ]
+        cluster = kv.Cluster(pods=pods, policies=pols)
+        enc = encode_cluster(cluster, compute_ports=False)
+        # egress stays default-allowed (no pod is egress-selected), the
+        # ingress chain gates hops: direct a->c is denied, closure adds it
+        pr = tiled_k8s_reach(enc, tile=32, chunk=8, self_traffic=False)
+        closed = pr.closure(tile=32)
+        got = closed.to_bool()
+        assert got[0, 1] and got[0, 2] and got[0, 3] and got[1, 3]
+        assert not got[1, 0] and not got[3, 0]
+
+    def test_device_resident_closure(self):
+        cluster = self._sparse_cluster(6)
+        enc = encode_cluster(cluster, compute_ports=False)
+        pr = tiled_k8s_reach(
+            enc, tile=32, chunk=8, fetch=False, self_traffic=False,
+        )
+        assert not pr._on_host
+        closed = pr.closure(tile=64)
+        assert not closed._on_host
+        ref = kv.verify(
+            cluster,
+            kv.VerifyConfig(
+                backend="cpu", compute_ports=False, closure=True,
+                self_traffic=False,
+            ),
+        )
+        np.testing.assert_array_equal(closed.to_bool(), ref.closure)
