@@ -836,11 +836,9 @@ class PackedReach:
         closed = packed_closure(
             padded, tile=tile, max_iter=max_iter
         )[: self.packed.shape[0]]
-        return PackedReach(
+        return dataclasses.replace(
+            self,
             packed=np.asarray(closed) if self._on_host else closed,
-            n_pods=self.n_pods,
-            ingress_isolated=self.ingress_isolated,
-            egress_isolated=self.egress_isolated,
         )
 
     def user_crosscheck(self, objs, label: str) -> List[int]:
@@ -1163,14 +1161,11 @@ def tiled_k8s_reach(
     else:
         # synchronise on a small array: per-row reachable-pair counts (the
         # total is a useful statistic) — forces execution without shipping
-        # the matrix. Row sums stay < 2³¹; the grand total is summed on host
-        # to avoid 32-bit truncation at 100k-pod scale.
-        row_counts = np.asarray(
-            jnp.sum(
-                jax.lax.population_count(packed[:n]), axis=1, dtype=jnp.int32
-            )
-        )
-        total = int(row_counts.astype(np.int64).sum())
+        # the matrix (the shared helper sums on host in int64, exact at
+        # 100k-pod scale)
+        from .closure import _packed_pair_total
+
+        total = _packed_pair_total(packed[:n])
         packed_out = packed[:n]
         label = "solve"
     t1 = time.perf_counter()

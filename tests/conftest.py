@@ -1,23 +1,46 @@
-"""Test env: force JAX onto CPU with 8 virtual devices so multi-chip sharding
-paths compile and execute without TPU hardware (the driver's real-TPU runs use
-``bench.py`` instead).
+"""Test env: by default, force JAX onto CPU with 8 virtual devices so
+multi-chip sharding paths compile and execute without TPU hardware (the
+driver's real-TPU runs use ``bench.py`` instead).
 
 The session image registers the TPU platform from a baked ``sitecustomize``
 and pins ``JAX_PLATFORMS``, so setting the env var alone is NOT enough — the
 platform must also be overridden via ``jax.config`` before any device is
-touched."""
+touched.
+
+Opt-in real-hardware tests: ``pytest -m tpu`` SKIPS the CPU pin, so the
+``tpu``-marked smoke tests (``test_on_tpu.py``) see the real chip; they
+self-skip when the active backend isn't a TPU. The pin decision must happen
+in ``pytest_configure`` (after the ``-m`` option is parsed) but before any
+test module imports jax.
+"""
 import os
 import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def pytest_configure(config):
+    markexpr = config.getoption("-m") or ""
+    if markexpr:
+        # leave the platform untouched iff the -m expression SELECTS
+        # tpu-marked items (evaluated properly, so "not (tpu)" and friends
+        # still pin); fall back to pinning on any parse failure
+        try:
+            from _pytest.mark.expression import Expression
+
+            if Expression.compile(markexpr).evaluate(
+                lambda name: name == "tpu"
+            ):
+                return
+        except Exception:
+            pass
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,91 @@
+"""Opt-in real-hardware smoke tests: ``pytest -m tpu`` on a TPU host.
+
+The rest of the suite pins JAX to a CPU-virtual-device mesh (conftest.py),
+so Mosaic/layout regressions on real hardware used to surface first in
+``bench.py``. These tests catch them in CI form instead: the tpu backend,
+one NON-interpret Pallas call, the tiled port kernel, and a packed
+incremental diff, each checked against the CPU oracle. They self-skip
+without hardware (e.g. when collected under the default CPU pin).
+"""
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.tpu
+
+
+def _on_tpu() -> bool:
+    import jax
+
+    return jax.default_backend() == "tpu"
+
+
+@pytest.fixture(scope="module")
+def tpu_guard():
+    if not _on_tpu():
+        pytest.skip("needs real TPU hardware (run: pytest -m tpu)")
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    from kubernetes_verification_tpu.harness.generate import (
+        GeneratorConfig,
+        random_cluster,
+    )
+
+    return random_cluster(
+        GeneratorConfig(
+            n_pods=200, n_policies=20, n_namespaces=3, p_ports=0.8, seed=12
+        )
+    )
+
+
+def test_tpu_backend_matches_oracle(tpu_guard, cluster):
+    import kubernetes_verification_tpu as kv
+
+    ref = kv.verify(cluster, kv.VerifyConfig(backend="cpu"))
+    got = kv.verify(cluster, kv.VerifyConfig(backend="tpu"))
+    np.testing.assert_array_equal(got.reach, ref.reach)
+    np.testing.assert_array_equal(got.reach_ports, ref.reach_ports)
+
+
+def test_pallas_kernel_non_interpret(tpu_guard, cluster):
+    """The fused Pallas kernel compiled by Mosaic on the real chip (the
+    suite otherwise only exercises interpret mode)."""
+    import kubernetes_verification_tpu as kv
+    from kubernetes_verification_tpu.encode.encoder import encode_cluster
+    from kubernetes_verification_tpu.ops.tiled import tiled_k8s_reach
+
+    enc = encode_cluster(cluster, compute_ports=False)
+    ref = kv.verify(cluster, kv.VerifyConfig(backend="cpu", compute_ports=False))
+    pr = tiled_k8s_reach(enc, use_pallas=True)  # tile 4096 → Mosaic path
+    np.testing.assert_array_equal(pr.to_bool(), ref.reach)
+
+
+def test_tiled_port_kernel(tpu_guard, cluster):
+    import kubernetes_verification_tpu as kv
+    from kubernetes_verification_tpu.encode.encoder import encode_cluster
+    from kubernetes_verification_tpu.ops.tiled import tiled_k8s_reach
+
+    enc = encode_cluster(cluster, compute_ports=True)
+    ref = kv.verify(cluster, kv.VerifyConfig(backend="cpu"))
+    pr = tiled_k8s_reach(enc, tile=128)
+    np.testing.assert_array_equal(pr.to_bool(), ref.reach)
+
+
+def test_packed_incremental_diff(tpu_guard, cluster):
+    import dataclasses
+
+    import kubernetes_verification_tpu as kv
+    from kubernetes_verification_tpu.packed_incremental import (
+        PackedIncrementalVerifier,
+    )
+
+    cfg = kv.VerifyConfig(compute_ports=False)
+    inc = PackedIncrementalVerifier(cluster, cfg)
+    pols = list(cluster.policies)
+    inc.update_policy(dataclasses.replace(pols[1], ingress=pols[2].ingress))
+    inc.remove_policy(pols[3].namespace, pols[3].name)
+    ref = kv.verify(
+        inc.as_cluster(), kv.VerifyConfig(backend="cpu", compute_ports=False)
+    )
+    np.testing.assert_array_equal(inc.reach, ref.reach)
