@@ -58,6 +58,7 @@ from .backends.base import VerifyConfig
 from .encode.encoder import (
     GrantBlock,
     SelectorEnc,
+    cluster_vocab,
     encode_cluster,
     encode_policy_delta,
 )
@@ -805,7 +806,12 @@ class PackedIncrementalVerifier:
         real diff isn't charged seconds of XLA compile: a no-op fused diff
         on a free slot (zeros in, zeros out; row 0 recomputed to its current
         value; column group fully masked) plus no-op spill patches."""
-        slot = self._free[-1] if self._free else 0
+        if not self._free:
+            # a checkpoint can be saved with zero free slots (growth happens
+            # on the NEXT allocation); writing the prewarm zeros into an
+            # occupied slot would silently erase that policy's device state
+            self._grow()
+        slot = self._free[-1]
         zeros4 = np.zeros((4, self._n_padded), dtype=np.int8)
         if self._packed is None:
             # matrix-free mode: the only diff kernel is the slot write
@@ -1138,3 +1144,153 @@ class PackedIncrementalVerifier:
             namespaces=list(self.namespaces),
             policies=list(self.policies.values()),
         )
+
+    # ---------------------------------------------------------- persistence
+    def state_dict(self) -> Dict[str, np.ndarray]:
+        """Device state as host arrays for checkpointing (``utils/persist``).
+        The int8 maps are bit-packed (8×); slot assignment travels alongside
+        so a resume restores the exact layout. The cluster manifest (pods
+        with their CURRENT labels + policies) is saved separately — the
+        maintained maps already reflect every relabel, so a resume re-freezes
+        the encoding on the current labels with an empty dirty set."""
+        keys = list(self.policies)
+        pack = lambda m: np.packbits(
+            np.asarray(m, dtype=np.uint8), axis=1, bitorder="little"
+        )
+        state = {
+            "sel_ing": pack(self._sel_ing8),
+            "sel_eg": pack(self._sel_eg8),
+            "ing_by_pol": pack(self._ing_by_pol),
+            "eg_by_pol": pack(self._eg_by_pol),
+            "ing_cnt": np.asarray(self._ing_cnt, dtype=np.int32),
+            "eg_cnt": np.asarray(self._eg_cnt, dtype=np.int32),
+            "slots": np.asarray([self._slot[k] for k in keys], dtype=np.int32),
+            "keys": np.array(keys),
+            "n_padded": np.int64(self._n_padded),
+            "capacity": np.int64(self._capacity),
+            "slot_round": np.int64(self._slot_round),
+            "update_count": np.int64(self.update_count),
+            "dirty_rows": self.dirty_rows,
+            "dirty_cols": self.dirty_cols,
+        }
+        if self._packed is not None:
+            state["packed"] = np.asarray(self._packed)
+        return state
+
+    @classmethod
+    def from_state(
+        cls,
+        cluster: Cluster,
+        state: Dict[str, np.ndarray],
+        config: Optional[VerifyConfig] = None,
+        device=None,
+        mesh: Optional[jax.sharding.Mesh] = None,
+        keep_matrix: Optional[bool] = None,
+    ) -> "PackedIncrementalVerifier":
+        """Resume from :meth:`state_dict` output WITHOUT re-solving: the
+        maps/counts/matrix upload straight to the device (or mesh), only the
+        host-side vectorizer re-freezes on the manifest's labels.
+        ``keep_matrix=False`` drops a checkpointed matrix and resumes
+        matrix-free (e.g. onto a mesh it would not fit); ``True`` requires
+        the checkpoint to contain one."""
+        self = cls.__new__(cls)
+        self.config = config or VerifyConfig()
+        self.mesh = mesh
+        self.device = device or (None if mesh else jax.devices()[0])
+        self.pods = [
+            dataclasses.replace(
+                p, labels=dict(p.labels), container_ports=dict(p.container_ports)
+            )
+            for p in cluster.pods
+        ]
+        # the manifest (dump_cluster) already lists every auto-created
+        # namespace, so no snapshot/__post_init__ pass is needed here
+        self.namespaces = list(cluster.namespaces)
+        self._ns_labels = {ns.name: ns.labels for ns in self.namespaces}
+        self.n_pods = len(self.pods)
+        Np = int(state["n_padded"])
+        self._n_padded = Np
+        self._capacity = int(state["capacity"])
+        self._slot_round = int(state["slot_round"])
+        self.update_count = int(state["update_count"])
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as PS
+
+            from .parallel.mesh import GRANT_AXIS, POD_AXIS
+
+            dp = mesh.shape[POD_AXIS]
+            mp = mesh.shape[GRANT_AXIS]
+            if Np % (128 * dp):
+                raise ValueError(
+                    f"checkpointed padding {Np} incompatible with a "
+                    f"{dp}-way pod axis"
+                )
+            if self._slot_round % mp:
+                # _grow pads the grant-sharded slot axis by slot_round; a
+                # non-divisible round would fail deep inside XLA later
+                raise ValueError(
+                    f"checkpointed slot_round={self._slot_round} not "
+                    f"divisible by the grant axis size {mp}"
+                )
+            self._sh = {
+                "maps": NamedSharding(mesh, PS(GRANT_AXIS, POD_AXIS)),
+                "vec": NamedSharding(mesh, PS(POD_AXIS)),
+                "pods": NamedSharding(mesh, PS(POD_AXIS, None)),
+                "new4": NamedSharding(mesh, PS(None, POD_AXIS)),
+                "rep": NamedSharding(mesh, PS()),
+            }
+        else:
+            self._sh = None
+        # kinds are ignored in single-device mode (self._sh is None)
+        unpack = lambda m: np.unpackbits(
+            m, axis=1, count=Np, bitorder="little"
+        ).astype(np.int8)
+        self._sel_ing8 = self._put(unpack(state["sel_ing"]), "maps")
+        self._sel_eg8 = self._put(unpack(state["sel_eg"]), "maps")
+        self._ing_by_pol = self._put(unpack(state["ing_by_pol"]), "maps")
+        self._eg_by_pol = self._put(unpack(state["eg_by_pol"]), "maps")
+        self._ing_cnt = self._put(np.asarray(state["ing_cnt"]), "vec")
+        self._eg_cnt = self._put(np.asarray(state["eg_cnt"]), "vec")
+        col_valid = np.zeros(Np, dtype=bool)
+        col_valid[: self.n_pods] = True
+        self._col_mask = self._put(
+            np.packbits(col_valid, bitorder="little").view("<u4").copy(), "rep"
+        )
+        keys = [str(k) for k in state["keys"]]
+        slots = [int(s) for s in state["slots"]]
+        by_key = {f"{p.namespace}/{p.name}": p for p in cluster.policies}
+        self.policies = {}
+        self._slot = {}
+        for key, slot in zip(keys, slots):
+            self.policies[key] = by_key[key]
+            self._slot[key] = slot
+        used = set(slots)
+        self._free = [s for s in range(self._capacity) if s not in used]
+        if keep_matrix is None:
+            keep_matrix = "packed" in state
+        elif keep_matrix and "packed" not in state:
+            raise ValueError(
+                "keep_matrix=True but the checkpoint was saved matrix-free; "
+                "re-solve (or resume matrix-free and use solve_stripe)"
+            )
+        self.keep_matrix = keep_matrix
+        self._packed = (
+            self._put(np.asarray(state["packed"]), "pods")
+            if keep_matrix
+            else None
+        )
+        self.dirty_rows = np.asarray(state["dirty_rows"]).copy()
+        self.dirty_cols = np.asarray(state["dirty_cols"]).copy()
+        self._vectorizer = PolicyVectorizer(
+            self.pods,
+            self._ns_labels,
+            cluster_vocab(self.pods, self.namespaces),
+            {ns.name: i for i, ns in enumerate(self.namespaces)},
+            self.config.direction_aware_isolation,
+        )
+        self._h_ing_cnt = np.asarray(state["ing_cnt"], dtype=np.int64)[: self.n_pods]
+        self._h_eg_cnt = np.asarray(state["eg_cnt"], dtype=np.int64)[: self.n_pods]
+        self.init_time = 0.0
+        self._prewarm()
+        return self

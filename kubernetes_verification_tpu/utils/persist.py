@@ -34,8 +34,51 @@ __all__ = [
     "load_packed",
     "save_incremental",
     "load_incremental",
+    "save_packed_incremental",
+    "load_packed_incremental",
     "export_encoding",
 ]
+
+_SEMANTIC_KEYS = (
+    "self_traffic",
+    "default_allow_unselected",
+    "direction_aware_isolation",
+    "compute_ports",
+    "closure",
+)
+
+
+def _config_json(cfg: VerifyConfig) -> str:
+    return json.dumps(
+        {"backend": cfg.backend, **{k: getattr(cfg, k) for k in _SEMANTIC_KEYS}}
+    )
+
+
+def _check_saved_config(saved: dict, config: Optional[VerifyConfig], where: str) -> VerifyConfig:
+    missing = [k for k in _SEMANTIC_KEYS if k not in saved]
+    if missing:
+        raise ValueError(
+            f"{where}: checkpoint lacks semantic config keys {missing} — "
+            "written by an incompatible framework version; re-verify from "
+            "scratch instead of resuming"
+        )
+    if config is None:
+        return VerifyConfig(
+            **{k: saved[k] for k in _SEMANTIC_KEYS},
+            backend=saved.get("backend", "cpu"),
+        )
+    mismatched = {
+        k: (saved[k], getattr(config, k))
+        for k in _SEMANTIC_KEYS
+        if getattr(config, k) != saved[k]
+    }
+    if mismatched:
+        raise ValueError(
+            f"{where}: config overrides the checkpointed semantic flags "
+            f"{mismatched}; resume with matching flags or re-verify from "
+            "scratch"
+        )
+    return config
 
 _OPT = ("reach_ports", "src_sets", "dst_sets", "selected",
         "ingress_isolated", "egress_isolated", "closure")
@@ -123,17 +166,7 @@ def save_incremental(inc, directory: str) -> None:
     vec = {
         f"vec_{i}": np.stack(inc._vectors[k]) for i, k in enumerate(keys)
     }
-    cfg = inc.config
-    config_json = json.dumps(
-        {
-            "backend": cfg.backend,
-            "self_traffic": cfg.self_traffic,
-            "default_allow_unselected": cfg.default_allow_unselected,
-            "direction_aware_isolation": cfg.direction_aware_isolation,
-            "compute_ports": cfg.compute_ports,
-            "closure": cfg.closure,
-        }
-    )
+    config_json = _config_json(inc.config)
     np.savez_compressed(
         os.path.join(directory, "state.npz"),
         ing_count=np.asarray(inc._ing_count),
@@ -159,42 +192,12 @@ def load_incremental(directory: str, config: Optional[VerifyConfig] = None,
 
     cluster, _ = load_cluster(os.path.join(directory, "cluster"))
     state_path = os.path.join(directory, "state.npz")
-    semantic_keys = (
-        "self_traffic",
-        "default_allow_unselected",
-        "direction_aware_isolation",
-        "compute_ports",
-        "closure",
-    )
     with np.load(state_path) as z:
         saved = json.loads(bytes(z["__config__"]).decode())
-        missing = [k for k in semantic_keys if k not in saved]
-        if missing:
-            raise ValueError(
-                f"load_incremental: checkpoint {state_path} lacks semantic "
-                f"config keys {missing} — written by an incompatible "
-                "framework version; re-verify from scratch instead of resuming"
-            )
-        if config is None:
-            config = VerifyConfig(
-                **{k: saved[k] for k in semantic_keys},
-                backend=saved.get("backend", "cpu"),
-            )
-        else:
-            # The checkpointed counts were derived under the saved semantic
-            # flags; reinterpreting them under different flags is silent
-            # corruption. Only the backend/device choice may differ on resume.
-            mismatched = {
-                k: (saved[k], getattr(config, k))
-                for k in semantic_keys
-                if getattr(config, k) != saved[k]
-            }
-            if mismatched:
-                raise ValueError(
-                    "load_incremental: config overrides the checkpointed "
-                    f"semantic flags {mismatched}; resume with matching flags "
-                    "or re-verify from scratch"
-                )
+        # The checkpointed counts were derived under the saved semantic
+        # flags; reinterpreting them under different flags is silent
+        # corruption. Only the backend/device choice may differ on resume.
+        config = _check_saved_config(saved, config, "load_incremental")
         inc = IncrementalVerifier(
             Cluster(pods=cluster.pods, namespaces=cluster.namespaces, policies=[]),
             config,
@@ -213,6 +216,51 @@ def load_incremental(directory: str, config: Optional[VerifyConfig] = None,
             inc._vectors[key] = tuple(row.copy() for row in v.astype(bool))
     inc._reach_dirty = True
     return inc
+
+
+def save_packed_incremental(inc, directory: str) -> None:
+    """Checkpoint a :class:`~..packed_incremental.PackedIncrementalVerifier`
+    — the config-5 diff engine: cluster manifest + bit-packed per-policy
+    maps + isolation counts + (when kept) the packed matrix + slot layout +
+    dirty bookkeeping. ~8× smaller than the device state thanks to the
+    bit-packing."""
+    from ..ingest import dump_cluster
+
+    os.makedirs(directory, exist_ok=True)
+    dump_cluster(inc.as_cluster(), os.path.join(directory, "cluster"))
+    state = inc.state_dict()
+    np.savez_compressed(
+        os.path.join(directory, "state.npz"),
+        __config__=np.frombuffer(
+            _config_json(inc.config).encode(), dtype=np.uint8
+        ),
+        **state,
+    )
+
+
+def load_packed_incremental(
+    directory: str,
+    config: Optional[VerifyConfig] = None,
+    device=None,
+    mesh=None,
+    keep_matrix: Optional[bool] = None,
+):
+    """Resume a :class:`~..packed_incremental.PackedIncrementalVerifier`
+    from a checkpoint without re-solving: state arrays upload straight to
+    the device (or mesh); only the host vectorizer re-freezes on the
+    manifest's labels."""
+    from ..ingest import load_cluster
+    from ..packed_incremental import PackedIncrementalVerifier
+
+    cluster, _ = load_cluster(os.path.join(directory, "cluster"))
+    with np.load(os.path.join(directory, "state.npz")) as z:
+        saved = json.loads(bytes(z["__config__"]).decode())
+        config = _check_saved_config(saved, config, "load_packed_incremental")
+        state = {k: z[k] for k in z.files if k != "__config__"}
+    return PackedIncrementalVerifier.from_state(
+        cluster, state, config, device=device, mesh=mesh,
+        keep_matrix=keep_matrix,
+    )
 
 
 def export_encoding(enc, path_prefix: str) -> str:

@@ -238,3 +238,96 @@ def test_packed_queries_available(setup):
     np.testing.assert_array_equal(
         pr.out_degree(), ref.sum(axis=1, dtype=np.int64)
     )
+
+
+def test_checkpoint_resume(tmp_path):
+    """save → load must restore the exact state: same reach, and diffs
+    applied after resume keep tracking the oracle (the resume re-freezes
+    the vectorizer on the manifest's current labels)."""
+    from kubernetes_verification_tpu.utils.persist import (
+        load_packed_incremental,
+        save_packed_incremental,
+    )
+
+    cluster = random_cluster(
+        GeneratorConfig(n_pods=47, n_policies=9, n_namespaces=3, seed=71)
+    )
+    cfg = kv.VerifyConfig(compute_ports=False)
+    inc = PackedIncrementalVerifier(cluster, cfg)
+    pols = list(cluster.policies)
+    inc.update_pod_labels(5, {"totally": "new"})  # dirty before the save
+    inc.update_policy(dataclasses.replace(pols[1], ingress=pols[2].ingress))
+    before = inc.reach.copy()
+
+    d = str(tmp_path / "ckpt")
+    save_packed_incremental(inc, d)
+    res = load_packed_incremental(d)
+    np.testing.assert_array_equal(res.reach, before)
+    assert res.policies.keys() == inc.policies.keys()
+    assert res.update_count == inc.update_count
+
+    # diffs continue correctly after resume — including against the
+    # relabelled pod (whose labels are now part of the re-frozen encoding)
+    res.add_policy(
+        kv.NetworkPolicy(
+            "post-resume", namespace=res.pods[5].namespace,
+            pod_selector=kv.Selector({"totally": "new"}),
+            ingress=(),
+        )
+    )
+    res.remove_policy(pols[0].namespace, pols[0].name)
+    np.testing.assert_array_equal(res.reach, _full(res.as_cluster(), cfg))
+
+    # a matrix-full checkpoint may resume matrix-free (e.g. onto a mesh the
+    # matrix would not fit) and still re-verify via stripes
+    from kubernetes_verification_tpu.ops.tiled import unpack_cols
+
+    res2 = load_packed_incremental(d, keep_matrix=False)
+    assert res2._packed is None
+    got = unpack_cols(res2.solve_stripe(0, res2._n_padded), res2.n_pods)
+    np.testing.assert_array_equal(got, before)
+
+
+def test_checkpoint_resume_matrix_free_on_mesh(tmp_path):
+    from kubernetes_verification_tpu.ops.tiled import unpack_cols
+    from kubernetes_verification_tpu.parallel.mesh import mesh_for
+    from kubernetes_verification_tpu.utils.persist import (
+        load_packed_incremental,
+        save_packed_incremental,
+    )
+
+    cluster = random_cluster(
+        GeneratorConfig(n_pods=61, n_policies=11, n_namespaces=3, seed=72)
+    )
+    cfg = kv.VerifyConfig(compute_ports=False)
+    inc = PackedIncrementalVerifier(
+        cluster, cfg, mesh=mesh_for((4, 2)), keep_matrix=False
+    )
+    pols = list(cluster.policies)
+    inc.update_policy(dataclasses.replace(pols[1], ingress=pols[2].ingress))
+    d = str(tmp_path / "ckpt")
+    save_packed_incremental(inc, d)
+    res = load_packed_incremental(d, mesh=mesh_for((2, 4)))  # new factorisation
+    assert not res.keep_matrix
+    assert res.dirty_cols.any() == inc.dirty_cols.any()
+    ref = _full(res.as_cluster(), cfg)
+    got = unpack_cols(res.solve_stripe(0, res._n_padded), res.n_pods)
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_checkpoint_flag_mismatch_rejected(tmp_path):
+    from kubernetes_verification_tpu.utils.persist import (
+        load_packed_incremental,
+        save_packed_incremental,
+    )
+
+    cluster = random_cluster(
+        GeneratorConfig(n_pods=23, n_policies=3, n_namespaces=2, seed=73)
+    )
+    inc = PackedIncrementalVerifier(cluster, kv.VerifyConfig(compute_ports=False))
+    d = str(tmp_path / "ckpt")
+    save_packed_incremental(inc, d)
+    with pytest.raises(ValueError, match="semantic"):
+        load_packed_incremental(
+            d, kv.VerifyConfig(compute_ports=False, self_traffic=False)
+        )
