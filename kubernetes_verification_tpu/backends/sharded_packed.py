@@ -18,6 +18,9 @@ Backend options (``VerifyConfig.backend_options``): ``mesh`` = (dp, mp)
 factorisation, ``tile``/``chunk`` sweep geometry, ``keep_matrix``,
 ``groups_label`` (aggregate per-group in-degrees at solve time so
 ``user_crosscheck`` works matrix-free), ``dense_reach_limit``.
+``VerifyConfig.closure`` runs the packed-domain closure on the kept matrix
+(dense ``closure`` below the dense-reach limit; the packed words stay on
+``closure_packed`` either way).
 """
 from __future__ import annotations
 
@@ -52,6 +55,9 @@ class ShardedPackedVerifyResult(VerifyResult):
     :class:`~..ops.tiled.PackedReach` at flagship scale."""
 
     packed_result: Optional[PackedShardedResult] = None
+    #: packed transitive closure (uint32 [N, W]) when config.closure ran —
+    #: present even above the dense-reach limit where ``closure`` stays None
+    closure_packed: Optional[np.ndarray] = None
 
     def _pk(self) -> PackedShardedResult:
         if self.packed_result is None:
@@ -112,11 +118,16 @@ class ShardedPackedBackend(VerifierBackend):
         return mesh_for(tuple(shape) if shape is not None else None)
 
     def verify(self, cluster: Cluster, config: VerifyConfig) -> VerifyResult:
+        keep_matrix = config.opt("keep_matrix")
         if config.closure:
-            raise ValueError(
-                "sharded-packed has no closure path yet; use the sharded or "
-                "tpu backends for transitive closure"
-            )
+            if keep_matrix is False:
+                raise ValueError(
+                    "closure needs the packed matrix; drop keep_matrix=False "
+                    "or use the sharded/tpu backends"
+                )
+            # force the matrix BEFORE the solve — the auto heuristic
+            # declining it after a full sweep would discard all that work
+            keep_matrix = True
         mesh = self._resolve_mesh(config)
         t0 = time.perf_counter()
         enc = encode_cluster(cluster, compute_ports=config.compute_ports)
@@ -135,17 +146,25 @@ class ShardedPackedBackend(VerifierBackend):
             direction_aware_isolation=config.direction_aware_isolation,
             tile=config.opt("tile", 512),
             chunk=config.opt("chunk", 1024),
-            keep_matrix=config.opt("keep_matrix"),
+            keep_matrix=keep_matrix,
             groups=groups,
             max_port_masks=config.opt("max_port_masks"),
         )
         t2 = time.perf_counter()
         dense_limit = config.opt("dense_reach_limit", 20_000)
-        reach = (
-            pk.to_bool()
-            if pk.packed is not None and cluster.n_pods <= dense_limit
-            else None
-        )
+        dense_ok = pk.packed is not None and cluster.n_pods <= dense_limit
+        reach = pk.to_bool() if dense_ok else None
+        closure = None
+        closure_packed = None
+        if config.closure:
+            from ..ops.tiled import unpack_cols
+
+            # closure_tile is its own knob: the dst-sweep "tile" shapes the
+            # broadcast geometry and is often tuned small; the squaring
+            # kernel wants its larger default
+            closure_packed = pk.closure(tile=config.opt("closure_tile", 512))
+            if dense_ok:
+                closure = unpack_cols(closure_packed, cluster.n_pods)
         return ShardedPackedVerifyResult(
             n_pods=cluster.n_pods,
             mode="k8s",
@@ -155,6 +174,7 @@ class ShardedPackedBackend(VerifierBackend):
             port_atoms=list(enc.atoms) if config.compute_ports else [],
             ingress_isolated=pk.ingress_isolated,
             egress_isolated=pk.egress_isolated,
+            closure=closure,
             timings={
                 # "solve" is the whole engine call (host prep + device
                 # sweep); the inner sweep-only figures keep their own keys
@@ -163,6 +183,7 @@ class ShardedPackedBackend(VerifierBackend):
                 **{f"sweep_{k}": v for k, v in (pk.timings or {}).items()},
             },
             packed_result=pk,
+            closure_packed=closure_packed,
         )
 
     def verify_kano(

@@ -30,6 +30,31 @@ def _add_verify_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--kano", action="store_true", help="kano-level semantics")
     p.add_argument("--output", help="save the VerifyResult as .npz")
     p.add_argument("--json", action="store_true", help="machine-readable output")
+    p.add_argument(
+        "--opt", action="append", default=[], metavar="KEY=VALUE",
+        help="backend option (repeatable), e.g. --opt mesh=4,2 "
+        "--opt tile=512 --opt keep_matrix=true for sharded-packed",
+    )
+
+
+def _parse_opt(kv_str: str):
+    key, sep, raw = kv_str.partition("=")
+    if not sep or not key:
+        raise SystemExit(f"--opt expects KEY=VALUE, got {kv_str!r}")
+    low = raw.lower()
+    if low in ("true", "false"):
+        return key, low == "true"
+    if "," in raw:
+        try:
+            return key, tuple(int(x) for x in raw.split(","))
+        except ValueError:
+            raise SystemExit(
+                f"--opt {key}: comma lists must be integers, got {raw!r}"
+            )
+    try:
+        return key, int(raw)
+    except ValueError:
+        return key, raw
 
 
 def cmd_verify(args) -> int:
@@ -41,6 +66,7 @@ def cmd_verify(args) -> int:
         compute_ports=args.ports,
         self_traffic=args.self_traffic,
         default_allow_unselected=args.default_allow,
+        backend_options=tuple(_parse_opt(o) for o in args.opt),
     )
     if args.kano:
         containers, policies = kv.load_kano(args.path)
@@ -53,11 +79,15 @@ def cmd_verify(args) -> int:
         pods = cluster.pods
     iso = res.all_isolated()
     hubs = res.all_reachable()
+    if res.reach is not None:
+        pairs = int(res.reach.sum())
+    else:  # sharded-packed above the dense-reach limit: use the aggregates
+        pairs = int(res.packed_result.total_pairs)
     out = {
         "pods": res.n_pods,
         "backend": res.backend,
         "mode": res.mode,
-        "reachable_pairs": int(res.reach.sum()),
+        "reachable_pairs": pairs,
         "all_isolated": iso,
         "all_reachable": hubs,
         "policy_shadow": (
@@ -70,6 +100,12 @@ def cmd_verify(args) -> int:
         "skipped_documents": skipped,
     }
     if args.output:
+        if res.reach is None:
+            raise SystemExit(
+                "--output saves a dense VerifyResult; this solve kept only "
+                "the packed matrix/aggregates (raise --opt "
+                "dense_reach_limit=N or use save_packed on packed_result)"
+            )
         from .utils.persist import save_result
 
         save_result(res, args.output)

@@ -1148,11 +1148,14 @@ class PackedIncrementalVerifier:
     # ---------------------------------------------------------- persistence
     def state_dict(self) -> Dict[str, np.ndarray]:
         """Device state as host arrays for checkpointing (``utils/persist``).
-        The int8 maps are bit-packed (8×); slot assignment travels alongside
-        so a resume restores the exact layout. The cluster manifest (pods
-        with their CURRENT labels + policies) is saved separately — the
-        maintained maps already reflect every relabel, so a resume re-freezes
-        the encoding on the current labels with an empty dirty set."""
+        The int8 maps are bit-packed (8×); slot assignment and the
+        ``dirty_rows``/``dirty_cols`` re-verify bookkeeping travel alongside
+        so a resume restores the exact layout AND its pending sweep work.
+        The cluster manifest (pods with their CURRENT labels + policies) is
+        saved separately — the maintained maps already reflect every
+        relabel, so the resume re-freezes the encoding on the current labels
+        and the VECTORIZER's label-drift set starts empty (distinct from the
+        preserved dirty row/col sets)."""
         keys = list(self.policies)
         pack = lambda m: np.packbits(
             np.asarray(m, dtype=np.uint8), axis=1, bitorder="little"

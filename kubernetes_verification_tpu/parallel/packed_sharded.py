@@ -187,6 +187,25 @@ class PackedShardedResult:
         self._require_full("to_bool")
         return unpack_cols(self.packed, self.n_pods)
 
+    def closure(self, tile: int = 512, max_iter: int = 32) -> np.ndarray:
+        """Packed-domain transitive closure of the kept matrix
+        (``ops/closure.packed_closure``) → uint32 [N, W]. Needs
+        ``keep_matrix=True`` and a full sweep."""
+        if self.packed is None:
+            raise ValueError(
+                "closure needs keep_matrix=True (the packed matrix is the "
+                "closure's operand); re-run with keep_matrix"
+            )
+        self._require_full("closure")
+        from ..ops.closure import packed_closure
+
+        W = self.packed.shape[1]
+        pad = W * 32 - self.packed.shape[0]
+        padded = jnp.pad(jnp.asarray(self.packed), ((0, pad), (0, 0)))
+        return np.asarray(
+            packed_closure(padded, tile=tile, max_iter=max_iter)
+        )[: self.n_pods]
+
 
 def _packed_local(
     pod_kv,

@@ -30,3 +30,24 @@ def test_generate_verify_explain(tmp_path, capsys):
 
     assert main(["backends"]) == 0
     assert "cpu" in capsys.readouterr().out
+
+
+def test_verify_sharded_packed_opts(tmp_path, capsys):
+    """--backend sharded-packed with --opt key=value passthrough, in both
+    the dense-reach and aggregates-only regimes."""
+    d = str(tmp_path / "cluster")
+    assert main(["generate", d, "--pods", "24", "--policies", "6"]) == 0
+    capsys.readouterr()
+
+    base = ["verify", d, "--backend", "sharded-packed", "--json",
+            "--opt", "mesh=4,2", "--opt", "tile=32", "--opt", "chunk=8",
+            "--opt", "keep_matrix=true"]
+    assert main(base) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["backend"] == "sharded-packed"
+    ref_pairs = out["reachable_pairs"]
+
+    # above the dense limit the CLI reports pairs from the aggregates
+    assert main(base + ["--opt", "dense_reach_limit=4"]) == 0
+    out2 = json.loads(capsys.readouterr().out)
+    assert out2["reachable_pairs"] == ref_pairs

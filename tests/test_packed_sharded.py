@@ -269,6 +269,48 @@ def test_registered_backend_routes_through_verify():
         res2.policy_shadow()
 
 
+def test_closure_through_backend_and_result():
+    """Transitive closure on the sharded-packed engine: the packed-domain
+    squaring over the kept matrix must equal the dense closure."""
+    cluster = random_cluster(
+        GeneratorConfig(
+            n_pods=53, n_policies=13, n_namespaces=3, p_ports=0.0, seed=3
+        )
+    )
+    ref = kv.verify(
+        cluster,
+        kv.VerifyConfig(
+            backend="cpu", compute_ports=False, closure=True,
+            self_traffic=False,
+        ),
+    )
+    res = kv.verify(
+        cluster,
+        kv.VerifyConfig(
+            backend="sharded-packed", compute_ports=False, closure=True,
+            self_traffic=False,
+            backend_options=(
+                ("mesh", (4, 2)), ("tile", 32), ("chunk", 8),
+                ("keep_matrix", True),
+            ),
+        ),
+    )
+    np.testing.assert_array_equal(res.closure, ref.closure)
+    assert res.closure_packed is not None
+    # matrix-free closure is refused with guidance
+    with pytest.raises(ValueError, match="keep_matrix"):
+        kv.verify(
+            cluster,
+            kv.VerifyConfig(
+                backend="sharded-packed", compute_ports=False, closure=True,
+                backend_options=(
+                    ("mesh", (4, 2)), ("tile", 32), ("chunk", 8),
+                    ("keep_matrix", False),
+                ),
+            ),
+        )
+
+
 def test_port_mask_cap_enforced():
     cluster = random_cluster(
         GeneratorConfig(
