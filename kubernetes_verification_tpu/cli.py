@@ -54,6 +54,12 @@ def _parse_opt(kv_str: str):
     try:
         return key, int(raw)
     except ValueError:
+        if raw[:1].isdigit() or raw[:1] == "-":
+            # numeric-looking but not an int (2e4, 1.5, 3x) — fail at parse
+            # time instead of as a type error deep in the backend post-solve
+            raise SystemExit(
+                f"--opt {key}: expected an integer, got {raw!r}"
+            )
         return key, raw
 
 
@@ -75,6 +81,19 @@ def cmd_verify(args) -> int:
         skipped = []
     else:
         cluster, skipped = kv.load_cluster(args.path)
+        if (
+            args.output
+            and cfg.backend == "sharded-packed"
+            and cluster.n_pods > cfg.opt("dense_reach_limit", 20_000)
+        ):
+            # fail BEFORE the (potentially hours-long) solve: --output saves
+            # a dense VerifyResult, which this scale never materialises
+            raise SystemExit(
+                f"--output saves a dense VerifyResult but {cluster.n_pods} "
+                "pods exceeds dense_reach_limit "
+                f"({cfg.opt('dense_reach_limit', 20_000)}); raise --opt "
+                "dense_reach_limit=N or drop --output"
+            )
         res = kv.verify(cluster, cfg)
         pods = cluster.pods
     iso = res.all_isolated()
@@ -100,7 +119,8 @@ def cmd_verify(args) -> int:
         "skipped_documents": skipped,
     }
     if args.output:
-        if res.reach is None:
+        if res.reach is None:  # safety net; print the summary before exiting
+            print(json.dumps(out))
             raise SystemExit(
                 "--output saves a dense VerifyResult; this solve kept only "
                 "the packed matrix/aggregates (raise --opt "

@@ -331,3 +331,37 @@ def test_checkpoint_flag_mismatch_rejected(tmp_path):
         load_packed_incremental(
             d, kv.VerifyConfig(compute_ports=False, self_traffic=False)
         )
+
+
+def test_checkpoint_resume_with_zero_free_slots(tmp_path):
+    """Regression: a checkpoint saved when every capacity slot is occupied
+    (growth happens on the NEXT allocation) must resume without the prewarm
+    writing its no-op zeros into an occupied slot — which would silently
+    erase that policy's device state."""
+    from kubernetes_verification_tpu.utils.persist import (
+        load_packed_incremental,
+        save_packed_incremental,
+    )
+
+    cluster = random_cluster(
+        GeneratorConfig(n_pods=23, n_policies=2, n_namespaces=2, seed=81)
+    )
+    cfg = kv.VerifyConfig(compute_ports=False)
+    inc = PackedIncrementalVerifier(cluster, cfg, slot_round=4)
+    donor = random_cluster(
+        GeneratorConfig(n_pods=23, n_policies=12, n_namespaces=2, seed=82)
+    )
+    # fill capacity exactly: initial capacity rounds (P+8)=10 up to 12
+    for i, p in enumerate(donor.policies[:10]):
+        inc.add_policy(dataclasses.replace(p, name=f"fill-{i}"))
+    assert not inc._free, "fixture must exercise the zero-free-slot case"
+    before = inc.reach.copy()
+
+    d = str(tmp_path / "ckpt")
+    save_packed_incremental(inc, d)
+    res = load_packed_incremental(d)
+    np.testing.assert_array_equal(res.reach, before)
+    np.testing.assert_array_equal(res.reach, _full(res.as_cluster(), cfg))
+    # and the grown capacity still allocates correctly
+    res.add_policy(dataclasses.replace(donor.policies[0], name="after"))
+    np.testing.assert_array_equal(res.reach, _full(res.as_cluster(), cfg))
