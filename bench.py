@@ -125,10 +125,14 @@ def bench_incremental(args) -> None:
     from kubernetes_verification_tpu.packed_incremental import (
         PackedIncrementalVerifier,
     )
+    from kubernetes_verification_tpu.packed_incremental_ports import (
+        PackedPortsIncrementalVerifier,
+    )
 
     dev = jax.devices()[0]
     log(f"device: {dev} ({jax.default_backend()})")
     n = args.pods
+    with_ports = not args.no_ports
     t0 = time.perf_counter()
     cluster = random_cluster(
         GeneratorConfig(
@@ -141,10 +145,15 @@ def bench_incremental(args) -> None:
         )
     )
     t1 = time.perf_counter()
-    cfg = VerifyConfig(compute_ports=False)
-    inc = PackedIncrementalVerifier(cluster, cfg, device=dev)
+    if with_ports:
+        cfg = VerifyConfig(compute_ports=True)
+        inc = PackedPortsIncrementalVerifier(cluster, cfg, device=dev, headroom=16)
+    else:
+        cfg = VerifyConfig(compute_ports=False)
+        inc = PackedIncrementalVerifier(cluster, cfg, device=dev)
     t2 = time.perf_counter()
-    log(f"generate {t1 - t0:.1f}s  init (encode+maps+solve) {t2 - t1:.1f}s")
+    log(f"generate {t1 - t0:.1f}s  init (encode+maps+solve) {t2 - t1:.1f}s  "
+        f"ports={with_ports}")
 
     pols = list(cluster.policies)
     diffs = []
@@ -229,7 +238,9 @@ def bench_incremental(args) -> None:
             {
                 "metric": (
                     f"incremental policy diff (add/update/remove, pipelined), "
-                    f"{n} pods / {args.policies} policies, packed state, 1 chip"
+                    f"{n} pods / {args.policies} policies, "
+                    f"{'port bitmaps' if with_ports else 'any-port'}, "
+                    "packed state, 1 chip"
                 ),
                 "value": round(overall_piped * 1e3, 2),
                 "unit": "ms",

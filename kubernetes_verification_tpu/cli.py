@@ -37,6 +37,13 @@ def _add_verify_flags(p: argparse.ArgumentParser) -> None:
     )
 
 
+#: options whose values must be integers (string fallthrough would surface
+#: as a confusing type error deep in the backend, after the solve)
+_INT_OPTS = frozenset(
+    {"tile", "chunk", "dense_reach_limit", "max_port_masks", "closure_tile"}
+)
+
+
 def _parse_opt(kv_str: str):
     key, sep, raw = kv_str.partition("=")
     if not sep or not key:
@@ -54,13 +61,13 @@ def _parse_opt(kv_str: str):
     try:
         return key, int(raw)
     except ValueError:
-        if raw[:1].isdigit() or raw[:1] == "-":
-            # numeric-looking but not an int (2e4, 1.5, 3x) — fail at parse
-            # time instead of as a type error deep in the backend post-solve
+        if key in _INT_OPTS:
+            # numeric option but not an int (2e4, 1.5) — fail at parse time
+            # instead of as a type error deep in the backend post-solve
             raise SystemExit(
                 f"--opt {key}: expected an integer, got {raw!r}"
             )
-        return key, raw
+        return key, raw  # string-valued options (e.g. groups_label=3tier)
 
 
 def cmd_verify(args) -> int:

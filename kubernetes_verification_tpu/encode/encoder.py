@@ -205,18 +205,32 @@ class EncodedCluster:
     #: named-port dst-restriction rows (bool [B, N]; row 0 all-True); None
     #: when no named spec resolves — see GrantBlock.dst_restrict
     restrict_bank: Optional[np.ndarray] = None
+    #: the (protocol, name) → [N, Q] resolution masks and the bank interner
+    #: behind ``restrict_bank`` — retained so incremental re-verify can
+    #: re-encode single policies against the SAME frozen universe
+    resolution: Optional[Dict] = None
+    restrict_bank_intern: Optional["_RestrictBank"] = None
 
 
 class _RestrictBank:
     """Interns named-port dst-restriction rows. Row 0 is the all-True
-    unrestricted row; one row per (protocol, name, atom) actually used."""
+    unrestricted row; one row per (protocol, name, atom) actually used.
+
+    A *frozen* bank (incremental re-verify: the bank array is resident
+    device state whose shape cannot grow per diff) resolves known keys but
+    raises on new ones — the caller falls back to a rebuild."""
 
     def __init__(self, n_pods: int) -> None:
         self.rows: List[np.ndarray] = [np.ones(n_pods, dtype=bool)]
         self._ids: Dict[Tuple[str, str, int], int] = {}
+        self.frozen = False
 
     def intern(self, key: Tuple[str, str, int], mask: np.ndarray) -> int:
         if key not in self._ids:
+            if self.frozen:
+                raise KeyError(
+                    f"named-port restriction {key} not in the frozen bank"
+                )
             self._ids[key] = len(self.rows)
             self.rows.append(mask)
         return self._ids[key]
@@ -400,6 +414,8 @@ def encode_cluster(
             resolution, bank,
         ),
         restrict_bank=bank.array() if bank is not None else None,
+        resolution=resolution,
+        restrict_bank_intern=bank,
     )
 
 
@@ -434,15 +450,24 @@ def encode_policy_delta(
     atoms: Sequence[PortAtom],
     ns_index: Dict[str, int],
     pods: Sequence,
+    resolution: Optional[Dict] = None,
+    bank: Optional[_RestrictBank] = None,
 ) -> PolicyDelta:
-    """Compile ONE policy against a frozen ``EncodedCluster`` universe."""
+    """Compile ONE policy against a frozen ``EncodedCluster`` universe.
+    ``resolution``/``bank`` (both frozen, from the init-time encoding)
+    enable named-port handling: unknown (name, atom) restrictions raise via
+    the frozen bank rather than silently changing the bank shape."""
     return PolicyDelta(
         pol_ns=ns_index.get(pol.namespace, -2),
         affects_ingress=pol.affects_ingress,
         affects_egress=pol.affects_egress,
         pod_sel=_encode_selector_stack([pol.pod_selector], vocab),
-        ingress=_encode_grants([pol], pods, "ingress", atoms, vocab),
-        egress=_encode_grants([pol], pods, "egress", atoms, vocab),
+        ingress=_encode_grants(
+            [pol], pods, "ingress", atoms, vocab, resolution, bank
+        ),
+        egress=_encode_grants(
+            [pol], pods, "egress", atoms, vocab, resolution, bank
+        ),
     )
 
 

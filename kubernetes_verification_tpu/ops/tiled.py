@@ -388,16 +388,20 @@ def _build_port_layout(
     sink_pol: int,
     ing_restrict: Optional[np.ndarray] = None,  # int32 [Gi] | None
     eg_restrict: Optional[np.ndarray] = None,  # int32 [Ge] | None
+    headroom: int = 0,  # extra free rows per segment (incremental diffs)
 ) -> Tuple[
     PortLayout,
     np.ndarray, np.ndarray, np.ndarray,
     np.ndarray, np.ndarray, np.ndarray,
+    np.ndarray,
 ]:
     """Group grants into (policy, port-mask, dst-restriction) virtual
     policies.
 
     Returns ``(layout, vp_pol_i, vp_restrict_i, vp_slot_i, vp_pol_e,
-    vp_restrict_e, vp_slot_e)`` where ``vp_pol_*[row]`` is the policy of
+    vp_restrict_e, vp_slot_e, ported_masks)`` — ``ported_masks`` is the
+    bool [R, Q] mask matrix in segment order (incremental diffs map a new
+    grant's mask to its segment through it) — where ``vp_pol_*[row]`` is the policy of
     each compact VP row (sink rows map to ``sink_pol``),
     ``vp_restrict_*[row]`` its named-port restriction-bank row (0 = none),
     and ``vp_slot_*[g]`` sends grant ``g`` to its VP row. Grants differing
@@ -458,7 +462,11 @@ def _build_port_layout(
                 vp_pol_rows.append(int(vp_pols[u]))
                 vp_res_rows.append(int(vp_restricts[u]))
             length = len(members)
-            pad = (-length) % 8 if length else 0
+            pad = (
+                (-(length + headroom)) % 8 + headroom
+                if (length or headroom)
+                else 0
+            )
             vp_pol_rows.extend([sink_pol] * pad)
             vp_res_rows.extend([0] * pad)
             seg.append((start, length + pad))
@@ -468,10 +476,11 @@ def _build_port_layout(
             row_of_vp[u] = len(vp_pol_rows)
             vp_pol_rows.append(int(vp_pols[u]))
             vp_res_rows.append(int(vp_restricts[u]))
-        pad = (-len(full_members)) % 8 if len(full_members) else 0
+        n_full = len(full_members)
+        pad = (-(n_full + headroom)) % 8 + headroom if (n_full or headroom) else 0
         vp_pol_rows.extend([sink_pol] * pad)
         vp_res_rows.extend([0] * pad)
-        full = (full_start, len(full_members) + pad)
+        full = (full_start, n_full + pad)
         sink_row = len(vp_pol_rows)
         for u in np.nonzero(vp_bucket == R + 1)[0]:
             row_of_vp[u] = sink_row
@@ -497,7 +506,10 @@ def _build_port_layout(
         seg_i=seg_i, seg_e=seg_e, full_i=full_i, full_e=full_e,
         ov_rows=ov_rows,
     )
-    return layout, vp_pol_i, vp_res_i, vp_slot_i, vp_pol_e, vp_res_e, vp_slot_e
+    return (
+        layout, vp_pol_i, vp_res_i, vp_slot_i, vp_pol_e, vp_res_e, vp_slot_e,
+        pm.astype(bool),  # the ported masks, in segment (rank) order
+    )
 
 
 def _dot_lnt(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
@@ -1091,7 +1103,7 @@ def tiled_k8s_reach(
     if with_ports:
         (
             layout, vp_pol_i, vp_res_i, vp_slot_i,
-            vp_pol_e, vp_res_e, vp_slot_e,
+            vp_pol_e, vp_res_e, vp_slot_e, _,
         ) = _build_port_layout(
             np.asarray(ingress.ports),
             np.asarray(egress.ports),

@@ -1,0 +1,851 @@
+"""Packed incremental re-verify WITH port bitmaps (config 4 ∧ config 5).
+
+:class:`~.packed_incremental.PackedIncrementalVerifier` maintains any-port
+semantics; this module maintains the full port-bitmap semantics of the tiled
+mask-group kernel (``ops/tiled.py``) under policy diffs. The state is the
+kernel's own *virtual-policy* operands, kept resident and row-addressable:
+
+* ``vp_peers_i``  int8 [Ti, Np] — src-side ingress peer map per VP row;
+* ``sel_ing_vp``  int8 [Ti, Np] — dst-side ingress selection per VP row,
+  with the policy selection, direction gating AND the named-port
+  dst-restriction bank row **baked in** (so the sweep/patch kernels need no
+  per-row gathers — a policy diff rewrites its own rows);
+* ``sel_eg_vp``   int8 [Te, Np] — src-side egress selection per VP row;
+* ``vp_peers_e``  int8 [Te, Np] — dst-side egress peer map, restriction
+  baked in;
+
+plus policy-level isolation counts and the packed reachability matrix. The
+:class:`~.ops.tiled.PortLayout` is FROZEN at init (with per-segment headroom
+rows): each (mask, restriction) group of a policy owns one VP row inside its
+mask's segment, allocation draws from the segment's free rows, and the
+mask-group conjunction (``_mask_group_conj`` — the same single copy the
+solvers use) evaluates rows/column patches exactly.
+
+A diff therefore costs: one single-policy re-encode against the frozen
+atoms/vocab/restriction universe (``encode_policy_delta``), host peer-union
+vectors per (mask, restriction) group via the posting-list vectorizer, a
+VP-row write, and port-aware row/column patches — O(total_vp · N · |touched|)
+device work.
+
+Frozen-universe boundaries (all raise ``PortUniverseChanged`` with rebuild
+guidance rather than degrade silently): a diff whose port specs need a new
+atom boundary, a new run-split mask, a new named-port restriction, or more
+rows than a segment's headroom; pod relabels (they move named-port
+resolution and every VP row's selection column); pod add/remove.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from functools import partial
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .backends.base import VerifyConfig
+from .encode.encoder import (
+    GrantBlock,
+    SelectorEnc,
+    encode_cluster,
+    encode_policy_delta,
+)
+from .models.core import Cluster, NetworkPolicy, Pod
+from .ops.tiled import (
+    PackedReach,
+    _build_port_layout,
+    _mask_group_conj,
+    _peers_by_slot,
+    _select_maps,
+    _split_and_check_port_masks,
+    _split_grant_ports,
+    pack_bool_cols,
+)
+from .packed_incremental import PolicyVectorizer, _groups
+from .parallel.sharded_ops import pad_grants, pad_pods
+
+__all__ = ["PackedPortsIncrementalVerifier", "PortUniverseChanged"]
+
+_I8 = jnp.int8
+_I32 = jnp.int32
+_U32 = jnp.uint32
+
+_ROW_GROUP = 256
+_COL_GROUP = 256
+
+
+class PortUniverseChanged(ValueError):
+    """The diff needs port atoms / masks / restrictions / capacity outside
+    the frozen layout — rebuild the verifier from the current cluster."""
+
+
+def _eval_selector_rows(sel: SelectorEnc, kv: np.ndarray, key: np.ndarray) -> np.ndarray:
+    """Host NumPy mirror of ``ops.match.match_selectors`` for SMALL entity
+    sets (namespaces): bool [S, M]."""
+    kv = kv.astype(np.int64)
+    key = key.astype(np.int64)
+    need_eq = sel.req_eq.sum(axis=1)[:, None]
+    ok = sel.req_eq.astype(np.int64) @ kv.T >= need_eq
+    need_key = sel.req_key.sum(axis=1)[:, None]
+    ok &= sel.req_key.astype(np.int64) @ key.T >= need_key
+    forbidden = (
+        sel.forbid_eq.astype(np.int64) @ kv.T
+        + sel.forbid_key.astype(np.int64) @ key.T
+    )
+    ok &= forbidden == 0
+    S, E, V = sel.in_mask.shape
+    for e in range(E):
+        hits = sel.in_mask[:, e, :].astype(np.int64) @ kv.T > 0
+        ok &= hits | ~sel.in_valid[:, e][:, None]
+    return ok & ~sel.impossible[:, None]
+
+
+@partial(
+    jax.jit,
+    static_argnames=("chunk", "direction_aware"),
+)
+def _build_vp_operands(
+    pod_kv,
+    pod_key,
+    pod_ns,
+    ns_kv,
+    ns_key,
+    pol_sel: SelectorEnc,
+    pol_ns,
+    aff_i,
+    aff_e,
+    ingress: GrantBlock,
+    egress: GrantBlock,
+    vp_pol_i,
+    vp_res_i,
+    vp_slot_i,
+    vp_pol_e,
+    vp_res_e,
+    vp_slot_e,
+    bank8,  # int8 [B, Np]
+    *,
+    chunk: int,
+    direction_aware: bool,
+):
+    """Init: the tiled port kernel's prologue, kept as row-addressable state
+    (restrictions and direction gating baked into the rows)."""
+    P = pol_ns.shape[0]
+    _, sel_ing8, sel_eg8, _, _ = _select_maps(
+        pod_kv, pod_key, pod_ns, pol_sel, pol_ns, aff_i, aff_e,
+        direction_aware,
+    )
+    zrow = jnp.zeros((1, pod_kv.shape[0]), dtype=_I8)
+    sel_ing_ext = jnp.concatenate([sel_ing8, zrow], axis=0)  # sink row P
+    sel_eg_ext = jnp.concatenate([sel_eg8, zrow], axis=0)
+    args = (pod_kv, pod_key, ns_kv, ns_key, pod_ns, pol_ns)
+    total_i = vp_pol_i.shape[0]
+    total_e = vp_pol_e.shape[0]
+    vp_peers_i = _peers_by_slot(ingress, vp_slot_i, total_i, chunk, *args)
+    vp_peers_e = (
+        _peers_by_slot(egress, vp_slot_e, total_e, chunk, *args)
+        * bank8[vp_res_e]
+    )
+    sel_ing_vp = sel_ing_ext[vp_pol_i] * bank8[vp_res_i]
+    sel_eg_vp = sel_eg_ext[vp_pol_e]
+    ing_cnt = jnp.sum(sel_ing8.astype(_I32), axis=0)
+    eg_cnt = jnp.sum(sel_eg8.astype(_I32), axis=0)
+    return vp_peers_i, sel_ing_vp, sel_eg_vp, vp_peers_e, ing_cnt, eg_cnt
+
+
+def _ports_reach_block(
+    operands, ing_cnt_d, eg_cnt_s, src_ids, dst_ids, rows=None, cols=None,
+    *, layout, self_traffic, default_allow,
+):
+    """Reach of an arbitrary (src × dst) block under port semantics — the
+    incremental counterpart of ``_reach_block``, built on the shared
+    ``_mask_group_conj``. Exactly one of ``rows`` (gather srcs, full dst
+    axis) or ``cols`` (full src axis, gather dsts) is given."""
+    vp_peers_i, sel_ing_vp, sel_eg_vp, vp_peers_e = operands
+    Np = sel_ing_vp.shape[1]
+
+    def dot_c(a, b):
+        return jax.lax.dot_general(
+            a, b, (((0,), (0,)), ((), ())), preferred_element_type=_I32
+        )
+
+    if rows is not None:
+        shape = (rows.shape[0], Np)
+
+        def ing_dot(s, l):
+            a = jnp.take(
+                jax.lax.slice(vp_peers_i, (s, 0), (s + l, Np)), rows, axis=1
+            )
+            b = jax.lax.slice(sel_ing_vp, (s, 0), (s + l, Np))
+            return dot_c(a, b) > 0
+
+        def eg_dot(s, l):
+            a = jnp.take(
+                jax.lax.slice(sel_eg_vp, (s, 0), (s + l, Np)), rows, axis=1
+            )
+            b = jax.lax.slice(vp_peers_e, (s, 0), (s + l, Np))
+            return dot_c(a, b) > 0
+
+    else:
+        shape = (Np, cols.shape[0])
+
+        def ing_dot(s, l):
+            a = jax.lax.slice(vp_peers_i, (s, 0), (s + l, Np))
+            b = jnp.take(
+                jax.lax.slice(sel_ing_vp, (s, 0), (s + l, Np)), cols, axis=1
+            )
+            return dot_c(a, b) > 0
+
+        def eg_dot(s, l):
+            a = jax.lax.slice(sel_eg_vp, (s, 0), (s + l, Np))
+            b = jnp.take(
+                jax.lax.slice(vp_peers_e, (s, 0), (s + l, Np)), cols, axis=1
+            )
+            return dot_c(a, b) > 0
+
+    false_t = jnp.zeros(shape, dtype=bool)
+    conj, gi_any, ge_any = _mask_group_conj(layout, ing_dot, eg_dot, false_t)
+    r = conj
+    if default_allow:
+        # the default-allow terms cover every port atom, so they expand the
+        # conjunction exactly as in _tiled_ports_step's tile body
+        di = ~(ing_cnt_d > 0)[None, :]  # dst side
+        de = ~(eg_cnt_s > 0)[:, None]  # src side
+        r = r | (di & de) | (di & ge_any) | (de & gi_any)
+    if self_traffic:
+        r = r | (src_ids[:, None] == dst_ids[None, :])
+    return r
+
+
+@partial(
+    jax.jit,
+    donate_argnums=(0,),
+    static_argnames=("layout", "self_traffic", "default_allow"),
+)
+def _ports_patch_rows(
+    packed, vp_peers_i, sel_ing_vp, sel_eg_vp, vp_peers_e, ing_cnt, eg_cnt,
+    col_mask, rows, *, layout, self_traffic, default_allow,
+):
+    Np = sel_ing_vp.shape[1]
+    r = _ports_reach_block(
+        (vp_peers_i, sel_ing_vp, sel_eg_vp, vp_peers_e),
+        ing_cnt, jnp.take(eg_cnt, rows),
+        rows, jnp.arange(Np, dtype=jnp.int32),
+        rows=rows,
+        layout=layout, self_traffic=self_traffic, default_allow=default_allow,
+    )
+    return packed.at[rows].set(pack_bool_cols(r) & col_mask[None, :])
+
+
+@partial(
+    jax.jit,
+    donate_argnums=(0,),
+    static_argnames=("layout", "self_traffic", "default_allow"),
+)
+def _ports_patch_cols(
+    packed, vp_peers_i, sel_ing_vp, sel_eg_vp, vp_peers_e, ing_cnt, eg_cnt,
+    cols, seg, words, wreal, clear, *, layout, self_traffic, default_allow,
+):
+    """Exact-column patch under port semantics; the word-merge tail is the
+    same delta-add scheme as the any-port ``_cols_body``."""
+    Np = sel_ing_vp.shape[1]
+    Dw = words.shape[0]
+    r = _ports_reach_block(
+        (vp_peers_i, sel_ing_vp, sel_eg_vp, vp_peers_e),
+        jnp.take(ing_cnt, cols), eg_cnt,
+        jnp.arange(Np, dtype=jnp.int32), cols,
+        cols=cols,
+        layout=layout, self_traffic=self_traffic, default_allow=default_allow,
+    )
+    bits = r.astype(_U32) << (cols % 32).astype(_U32)[None, :]
+    set_words = jax.ops.segment_sum(bits.T, seg, num_segments=Dw + 1)[:Dw].T
+    old_words = jnp.take(packed, words, axis=1)
+    new_words = (old_words & ~clear[None, :]) | set_words
+    delta = (new_words - old_words) * wreal[None, :].astype(_U32)
+    return packed.at[:, words].add(delta)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("layout", "tile", "self_traffic", "default_allow"),
+)
+def _ports_sweep(
+    vp_peers_i, sel_ing_vp, sel_eg_vp, vp_peers_e, ing_cnt, eg_cnt, col_mask,
+    *, layout, tile, self_traffic, default_allow,
+):
+    """Full dst-tile sweep from the resident VP operands → packed uint32
+    [Np, W] (init + full-resweep fallback)."""
+    Np = sel_ing_vp.shape[1]
+    W = Np // 32
+
+    def body(t, out):
+        d0 = t * tile
+        cols = d0 + jnp.arange(tile, dtype=jnp.int32)
+        r = _ports_reach_block(
+            (vp_peers_i, sel_ing_vp, sel_eg_vp, vp_peers_e),
+            jax.lax.dynamic_slice(ing_cnt, (d0,), (tile,)), eg_cnt,
+            jnp.arange(Np, dtype=jnp.int32), cols,
+            cols=cols,
+            layout=layout, self_traffic=self_traffic,
+            default_allow=default_allow,
+        )
+        return jax.lax.dynamic_update_slice(
+            out, pack_bool_cols(r), (0, d0 // 32)
+        )
+
+    out = jnp.zeros((Np, W), dtype=_U32)
+    out = jax.lax.fori_loop(0, Np // tile, body, out)
+    return out & col_mask[None, :]
+
+
+@partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5))
+def _vp_write(
+    vp_peers_i, sel_ing_vp, sel_eg_vp, vp_peers_e, ing_cnt, eg_cnt,
+    rows_i,  # int32 [Ki] — touched ingress VP rows (pad: repeat)
+    vals_i,  # int8 [2, Ki, Np] — (peer, sel) new values
+    rows_e,
+    vals_e,
+    d_ing_cnt,  # int32 [Np] — policy-level isolation count delta
+    d_eg_cnt,
+):
+    return (
+        vp_peers_i.at[rows_i].set(vals_i[0]),
+        sel_ing_vp.at[rows_i].set(vals_i[1]),
+        sel_eg_vp.at[rows_e].set(vals_e[0]),
+        vp_peers_e.at[rows_e].set(vals_e[1]),
+        ing_cnt + d_ing_cnt,
+        eg_cnt + d_eg_cnt,
+    )
+
+
+class PackedPortsIncrementalVerifier:
+    """Port-bitmap reachability under policy add/remove/update."""
+
+    def __init__(
+        self,
+        cluster: Cluster,
+        config: Optional[VerifyConfig] = None,
+        device=None,
+        headroom: int = 8,
+        tile: int = 512,
+        chunk: int = 2048,
+        max_port_masks: int = 32,
+    ) -> None:
+        self.config = config or VerifyConfig()
+        self.device = device or jax.devices()[0]
+        self.pods: List[Pod] = [
+            dataclasses.replace(
+                p, labels=dict(p.labels), container_ports=dict(p.container_ports)
+            )
+            for p in cluster.pods
+        ]
+        self.namespaces = list(cluster.namespaces)
+        self.policies: Dict[str, NetworkPolicy] = {}
+        self.update_count = 0
+        cfg = self.config
+
+        t0 = time.perf_counter()
+        snapshot = Cluster(
+            pods=self.pods, namespaces=self.namespaces,
+            policies=list(cluster.policies),
+        )
+        self._ns_labels = {ns.name: ns.labels for ns in self.namespaces}
+        enc = encode_cluster(snapshot, compute_ports=True)
+        self._atoms = list(enc.atoms)
+        self._resolution = enc.resolution
+        self._bank_intern = enc.restrict_bank_intern
+        if self._bank_intern is not None:
+            self._bank_intern.frozen = True
+        n = enc.n_pods
+        self.n_pods = n
+        Np = max(128, -(-n // 128) * 128)
+        self._n_padded = Np
+        self._tile = next(
+            t for t in (tile, 512, 256, 128) if t <= Np and Np % t == 0
+        )
+        n_pad = Np - n
+        pod_kv, pod_key, pod_ns = pad_pods(
+            enc.pod_kv, enc.pod_key, enc.pod_ns, n_pad
+        )
+        self._ns_kv = enc.ns_kv
+        self._ns_key = enc.ns_key
+        col_valid = np.zeros(Np, dtype=bool)
+        col_valid[:n] = True
+        self._col_mask = jax.device_put(
+            np.packbits(col_valid, bitorder="little").view("<u4").copy(),
+            self.device,
+        )
+        if enc.restrict_bank is not None:
+            bank8 = np.zeros((enc.restrict_bank.shape[0], Np), dtype=np.int8)
+            bank8[:, :n] = enc.restrict_bank
+        else:
+            bank8 = np.ones((1, Np), dtype=np.int8)
+        self._bank8_host = bank8
+
+        P = enc.n_policies
+        ing_block, eg_block, _ = _split_and_check_port_masks(
+            enc.ingress, enc.egress, max_port_masks
+        )
+        g_chunk = max(1, min(chunk, max(ing_block.n, eg_block.n, 1)))
+        ingress = pad_grants(ing_block, (-ing_block.n) % g_chunk, P, n_pad)
+        egress = pad_grants(eg_block, (-eg_block.n) % g_chunk, P, n_pad)
+        (
+            layout, vp_pol_i, vp_res_i, vp_slot_i,
+            vp_pol_e, vp_res_e, vp_slot_e, ported_masks,
+        ) = _build_port_layout(
+            np.asarray(ingress.ports),
+            np.asarray(egress.ports),
+            np.asarray(ingress.pol),
+            np.asarray(egress.pol),
+            sink_pol=P,
+            ing_restrict=(
+                np.asarray(ingress.dst_restrict)
+                if ingress.dst_restrict is not None else None
+            ),
+            eg_restrict=(
+                np.asarray(egress.dst_restrict)
+                if egress.dst_restrict is not None else None
+            ),
+            headroom=headroom,
+        )
+        self._layout = layout
+        self._total_rows = {"i": len(vp_pol_i), "e": len(vp_pol_e)}
+        self._mask_rank = {
+            tuple(bool(b) for b in row): r
+            for r, row in enumerate(np.asarray(ported_masks))
+        }
+        self._sink_pol = P
+
+        args = jax.device_put(
+            (
+                pod_kv, pod_key, pod_ns, enc.ns_kv, enc.ns_key,
+                enc.pol_sel, enc.pol_ns, enc.pol_affects_ingress,
+                enc.pol_affects_egress, ingress, egress,
+                vp_pol_i, vp_res_i, vp_slot_i,
+                vp_pol_e, vp_res_e, vp_slot_e, bank8,
+            ),
+            self.device,
+        )
+        out = _build_vp_operands(
+            *args, chunk=g_chunk,
+            direction_aware=cfg.direction_aware_isolation,
+        )
+        (
+            self._vp_peers_i, self._sel_ing_vp, self._sel_eg_vp,
+            self._vp_peers_e, self._ing_cnt, self._eg_cnt,
+        ) = out
+        self._packed = _ports_sweep(
+            *self._operands, self._ing_cnt, self._eg_cnt, self._col_mask,
+            layout=layout, tile=self._tile,
+            self_traffic=cfg.self_traffic,
+            default_allow=cfg.default_allow_unselected,
+        )
+
+        # ---- host bookkeeping: segment free lists + per-policy row maps
+        def seg_spans(seg, full):
+            return list(seg) + [full]  # index R == full block
+
+        self._seg_spans = {
+            "i": seg_spans(layout.seg_i, layout.full_i),
+            "e": seg_spans(layout.seg_e, layout.full_e),
+        }
+        self._free_rows = {"i": {}, "e": {}}
+        self._row_owner = {"i": {}, "e": {}}
+        self._pol_rows: Dict[str, Dict[str, List[int]]] = {}
+        keys = [self._key(p) for p in cluster.policies]
+        for d, vp_pol in (("i", np.asarray(vp_pol_i)), ("e", np.asarray(vp_pol_e))):
+            for s_idx, (start, length) in enumerate(self._seg_spans[d]):
+                free = []
+                for row in range(start, start + length):
+                    pol_id = int(vp_pol[row])
+                    if pol_id == P:
+                        free.append(row)
+                    else:
+                        key = keys[pol_id]
+                        self._row_owner[d][row] = key
+                        self._pol_rows.setdefault(key, {"i": [], "e": []})[
+                            d
+                        ].append(row)
+                self._free_rows[d][s_idx] = free
+        for i, pol in enumerate(cluster.policies):
+            key = keys[i]
+            if key in self.policies:
+                raise KeyError(f"duplicate policy {key}")
+            self.policies[key] = pol
+            self._pol_rows.setdefault(key, {"i": [], "e": []})
+
+        self._vectorizer = PolicyVectorizer(
+            self.pods,
+            self._ns_labels,
+            enc.vocab,
+            {ns.name: i for i, ns in enumerate(self.namespaces)},
+            cfg.direction_aware_isolation,
+        )
+        self._h_ing_cnt = np.asarray(self._ing_cnt, dtype=np.int64)[:n]
+        self._h_eg_cnt = np.asarray(self._eg_cnt, dtype=np.int64)[:n]
+        self.init_time = time.perf_counter() - t0
+
+    # ------------------------------------------------------------- plumbing
+    @property
+    def _operands(self):
+        return (
+            self._vp_peers_i, self._sel_ing_vp, self._sel_eg_vp,
+            self._vp_peers_e,
+        )
+
+    def _key(self, pol: NetworkPolicy) -> str:
+        return f"{pol.namespace}/{pol.name}"
+
+    @property
+    def _flags(self) -> dict:
+        return dict(
+            self_traffic=self.config.self_traffic,
+            default_allow=self.config.default_allow_unselected,
+        )
+
+    def _grant_row_peers(self, block: GrantBlock, g: int, pol_ns_idx: int) -> np.ndarray:
+        """bool [n]: pods one encoded grant row's peer clause matches —
+        host evaluation via the posting-list vectorizer (pods) and the
+        NumPy selector mirror (namespaces)."""
+        vz = self._vectorizer
+        if bool(block.match_all[g]):
+            return np.ones(self.n_pods, dtype=bool)
+        if bool(block.is_ipblock[g]):
+            return np.asarray(block.ip_match[g], dtype=bool)
+        m = vz._sel_mask(block.pod_sel, g)
+        if bool(block.ns_sel_null[g]):
+            m = m & vz._ns_mask(pol_ns_idx)
+        else:
+            ns_ok = _eval_selector_rows(
+                block.ns_sel, self._ns_kv, self._ns_key
+            )[g]
+            acc = np.zeros(self.n_pods, dtype=bool)
+            for ns_idx in np.nonzero(ns_ok)[0]:
+                acc |= vz._ns_mask(int(ns_idx))
+            m = m & acc
+        return m
+
+    def _check_ports_representable(self, pol: NetworkPolicy) -> None:
+        """A diff's port specs must be expressible in the frozen atom
+        partition EXACTLY — ``rule_port_mask`` silently narrows a spec to
+        the whole atoms it covers, which would silently verify the wrong
+        policy. Numeric specs must cover whole atoms end to end; named specs
+        must have been referenced (hence resolved) at init."""
+        for rules in (pol.ingress, pol.egress):
+            for rule in rules or ():
+                for spec in rule.ports or ():
+                    if isinstance(spec.port, str):
+                        key = (spec.protocol, spec.port)
+                        if not self._resolution or key not in self._resolution:
+                            raise PortUniverseChanged(
+                                f"policy {self._key(pol)} names port {key} "
+                                "never referenced in the frozen encoding; "
+                                "rebuild the verifier"
+                            )
+                    elif spec.port is not None:
+                        hi = (
+                            spec.end_port
+                            if spec.end_port is not None
+                            else spec.port
+                        )
+                        covered = sum(
+                            a.width
+                            for a in self._atoms
+                            if a.name is None
+                            and a.protocol == spec.protocol
+                            and spec.port <= a.lo
+                            and a.hi <= hi
+                        )
+                        if covered != hi - spec.port + 1:
+                            raise PortUniverseChanged(
+                                f"policy {self._key(pol)} port spec "
+                                f"{spec.protocol} {spec.port}-{hi} does not "
+                                "align with the frozen atom partition; "
+                                "rebuild the verifier"
+                            )
+
+    def _policy_groups(
+        self, pol: NetworkPolicy
+    ) -> Tuple[np.ndarray, np.ndarray, Dict, Dict]:
+        """Host evaluation of one policy under the frozen port universe:
+        (sel_ing, sel_eg) policy-level vectors + per-direction
+        {(segment, restrict): peer-union vector} group dicts."""
+        self._check_ports_representable(pol)
+        vz = self._vectorizer
+        try:
+            delta = encode_policy_delta(
+                pol, vz.vocab, self._atoms, vz.ns_index, self.pods,
+                self._resolution, self._bank_intern,
+            )
+        except KeyError as e:
+            raise PortUniverseChanged(
+                f"policy {self._key(pol)} needs a named-port restriction "
+                f"outside the frozen bank ({e}); rebuild the verifier"
+            )
+        sel = vz._sel_mask(delta.pod_sel, 0) & vz._ns_mask(delta.pol_ns)
+        da = self.config.direction_aware_isolation
+        aff_i = delta.affects_ingress if da else True
+        aff_e = delta.affects_egress if da else True
+        sel_ing = sel & aff_i
+        sel_eg = sel & aff_e
+
+        def direction_groups(block: GrantBlock, aff: bool) -> Dict:
+            out: Dict[Tuple[int, int], np.ndarray] = {}
+            if not aff or block.n == 0:
+                return out
+            block = _split_grant_ports(block)
+            ports = np.asarray(block.ports)
+            restricts = (
+                np.asarray(block.dst_restrict)
+                if block.dst_restrict is not None
+                else np.zeros(block.n, dtype=np.int32)
+            )
+            for g in range(block.n):
+                mask = tuple(bool(b) for b in ports[g])
+                if not any(mask):
+                    continue  # inert row (e.g. unresolvable named-only rule)
+                if all(mask):
+                    seg = len(self._mask_rank)  # full block
+                else:
+                    seg = self._mask_rank.get(mask)
+                    if seg is None:
+                        raise PortUniverseChanged(
+                            f"policy {self._key(pol)} uses a port mask "
+                            "outside the frozen layout (new atom boundaries "
+                            "or a new run mask); rebuild the verifier"
+                        )
+                key = (seg, int(restricts[g]))
+                peers = self._grant_row_peers(block, g, delta.pol_ns)
+                out[key] = out.get(key, np.zeros(self.n_pods, bool)) | peers
+            return out
+
+        groups_i = direction_groups(delta.ingress, aff_i)
+        groups_e = direction_groups(delta.egress, aff_e)
+        return sel_ing, sel_eg, groups_i, groups_e
+
+    # ---------------------------------------------------------------- diffs
+    def _seg_of_row(self, d: str, row: int) -> int:
+        for s_idx, (start, length) in enumerate(self._seg_spans[d]):
+            if start <= row < start + length:
+                return s_idx
+        raise AssertionError(f"row {row} outside every {d} segment")
+
+    def _plan_alloc(self, d: str, groups: Dict, recycled: List[int]) -> Dict:
+        """Assign one VP row per (segment, restrict) group WITHOUT mutating
+        any bookkeeping — the caller commits only after every direction's
+        plan succeeds, so a failed diff leaves the state intact. ``recycled``
+        rows (the policy's own rows about to be freed) are preferred."""
+        by_seg: Dict[int, List[int]] = {}
+        for row in recycled:
+            by_seg.setdefault(self._seg_of_row(d, row), []).append(row)
+        taken: Dict[int, int] = {}
+        assigned = {}
+        for (seg, res), vec in groups.items():
+            pool = by_seg.get(seg, [])
+            free = self._free_rows[d][seg]
+            used = taken.get(seg, 0)
+            if pool:
+                row = pool.pop()
+            elif used < len(free):
+                row = free[-1 - used]
+                taken[seg] = used + 1
+            else:
+                raise PortUniverseChanged(
+                    f"segment {seg} ({'ingress' if d == 'i' else 'egress'}) "
+                    "has no free virtual-policy rows left; rebuild the "
+                    "verifier (or construct it with more headroom)"
+                )
+            assigned[row] = (res, vec)
+        return assigned
+
+    def _commit_rows(
+        self, d: str, key: str, assigned: Dict, old_rows: List[int]
+    ) -> List[int]:
+        """Apply a planned allocation: release the policy's old rows, claim
+        the assigned ones; returns the freed-but-not-reused rows."""
+        for row in old_rows:
+            del self._row_owner[d][row]
+            self._free_rows[d][self._seg_of_row(d, row)].append(row)
+        self._pol_rows[key][d] = []
+        for row in assigned:
+            free = self._free_rows[d][self._seg_of_row(d, row)]
+            free.remove(row)
+            self._row_owner[d][row] = key
+            self._pol_rows[key][d].append(row)
+        return [r for r in old_rows if r not in assigned]
+
+    def _apply(self, key, old_sel, new_sel, assigned_i, assigned_e,
+               freed_i, freed_e) -> None:
+        n, Np = self.n_pods, self._n_padded
+        old_si, old_se = old_sel
+        new_si, new_se = new_sel
+        ing2 = self._h_ing_cnt + (new_si.astype(np.int64) - old_si)
+        eg2 = self._h_eg_cnt + (new_se.astype(np.int64) - old_se)
+        iso_chg_i = (self._h_ing_cnt > 0) != (ing2 > 0)
+        iso_chg_e = (self._h_eg_cnt > 0) != (eg2 > 0)
+        rows = np.nonzero((old_se | new_se) | iso_chg_e)[0]
+        cols = np.nonzero((old_si | new_si) | iso_chg_i)[0]
+        d_ing = np.zeros(Np, dtype=np.int32)
+        d_eg = np.zeros(Np, dtype=np.int32)
+        d_ing[:n] = (new_si.astype(np.int32) - old_si)
+        d_eg[:n] = (new_se.astype(np.int32) - old_se)
+        self._h_ing_cnt = ing2
+        self._h_eg_cnt = eg2
+
+        def safe_pack(assigned, freed, sel_vec, is_ingress, d):
+            """Touched-row indices (power-of-two padded by repetition — the
+            duplicated scatter writes carry equal values) + their new [2, K,
+            Np] operand values (freed rows → zeros)."""
+            touched = sorted(set(freed) | set(assigned))
+            if not touched:
+                # no-op write: the layout's sink row (always last, always
+                # zero, never owned) absorbs it — this cannot fail even with
+                # every segment at capacity
+                touched = [self._total_rows[d] - 1]
+            k = len(touched)
+            cap = 1 << (k - 1).bit_length()
+            touched = touched + [touched[-1]] * (cap - k)
+            vals = np.zeros((2, cap, Np), dtype=np.int8)
+            for j, row in enumerate(touched[:k]):
+                if row in assigned:
+                    res, peer_vec = assigned[row]
+                    bank_row = self._bank8_host[res][:n] > 0
+                    if is_ingress:
+                        vals[0, j, :n] = peer_vec
+                        vals[1, j, :n] = sel_vec & bank_row
+                    else:
+                        vals[0, j, :n] = sel_vec
+                        vals[1, j, :n] = peer_vec & bank_row
+            for j in range(k, cap):  # pads repeat the last real row's value
+                vals[:, j] = vals[:, k - 1]
+            return np.asarray(touched, dtype=np.int32), vals
+
+        rows_i, vals_i = safe_pack(assigned_i, freed_i, new_si, True, "i")
+        rows_e, vals_e = safe_pack(assigned_e, freed_e, new_se, False, "e")
+        out = _vp_write(
+            *self._operands, self._ing_cnt, self._eg_cnt,
+            jax.device_put(rows_i, self.device),
+            jax.device_put(vals_i, self.device),
+            jax.device_put(rows_e, self.device),
+            jax.device_put(vals_e, self.device),
+            jax.device_put(d_ing, self.device),
+            jax.device_put(d_eg, self.device),
+        )
+        (
+            self._vp_peers_i, self._sel_ing_vp, self._sel_eg_vp,
+            self._vp_peers_e, self._ing_cnt, self._eg_cnt,
+        ) = out
+        self._patch(rows, cols)
+        self.update_count += 1
+
+    def _patch(self, rows: np.ndarray, cols: np.ndarray) -> None:
+        from .packed_incremental import PackedIncrementalVerifier as _PIV
+
+        for idx, _ in _groups(rows, _ROW_GROUP):
+            self._packed = _ports_patch_rows(
+                self._packed, *self._operands, self._ing_cnt, self._eg_cnt,
+                self._col_mask, jnp.asarray(idx),
+                layout=self._layout, **self._flags,
+            )
+        for idx, creal in _groups(cols, _COL_GROUP):
+            meta = _PIV._col_meta(idx, int(creal.sum()))
+            self._packed = _ports_patch_cols(
+                self._packed, *self._operands, self._ing_cnt, self._eg_cnt,
+                jnp.asarray(idx), *(jnp.asarray(m) for m in meta),
+                layout=self._layout, **self._flags,
+            )
+
+    def _policy_sel(self, pol: NetworkPolicy) -> Tuple[np.ndarray, np.ndarray]:
+        """(sel_ing, sel_eg) only — the cheap evaluation for the OUTGOING
+        side of a diff (its VP rows are freed wholesale; only the selection
+        vectors feed the patch masks and isolation counts)."""
+        vz = self._vectorizer
+        from .encode.encoder import _encode_selector_stack
+
+        stack = _encode_selector_stack([pol.pod_selector], vz.vocab)
+        sel = vz._sel_mask(stack, 0) & vz._ns_mask(
+            vz.ns_index.get(pol.namespace, -2)
+        )
+        da = self.config.direction_aware_isolation
+        aff_i = pol.affects_ingress if da else True
+        aff_e = pol.affects_egress if da else True
+        return sel & aff_i, sel & aff_e
+
+    def add_policy(self, pol: NetworkPolicy) -> None:
+        key = self._key(pol)
+        if key in self.policies:
+            raise KeyError(f"policy {key} exists; use update_policy")
+        # every step that can raise happens BEFORE any mutation
+        new_si, new_se, gi, ge = self._policy_groups(pol)
+        assigned_i = self._plan_alloc("i", gi, [])
+        assigned_e = self._plan_alloc("e", ge, [])
+        if pol.namespace not in self._ns_labels:
+            self._ns_labels[pol.namespace] = {}
+        self._pol_rows.setdefault(key, {"i": [], "e": []})
+        self._commit_rows("i", key, assigned_i, [])
+        self._commit_rows("e", key, assigned_e, [])
+        self.policies[key] = pol
+        zeros = np.zeros(self.n_pods, dtype=bool)
+        self._apply(key, (zeros, zeros), (new_si, new_se),
+                    assigned_i, assigned_e, [], [])
+
+    def remove_policy(self, namespace: str, name: str) -> None:
+        key = f"{namespace}/{name}"
+        pol = self.policies[key]  # KeyError if absent
+        old_si, old_se = self._policy_sel(pol)
+        del self.policies[key]
+        freed_i = self._commit_rows("i", key, {}, list(self._pol_rows[key]["i"]))
+        freed_e = self._commit_rows("e", key, {}, list(self._pol_rows[key]["e"]))
+        zeros = np.zeros(self.n_pods, dtype=bool)
+        self._apply(key, (old_si, old_se), (zeros, zeros),
+                    {}, {}, freed_i, freed_e)
+
+    def update_policy(self, pol: NetworkPolicy) -> None:
+        key = self._key(pol)
+        old = self.policies[key]  # KeyError if absent
+        old_si, old_se = self._policy_sel(old)
+        new_si, new_se, gi, ge = self._policy_groups(pol)
+        old_rows_i = list(self._pol_rows[key]["i"])
+        old_rows_e = list(self._pol_rows[key]["e"])
+        # plan both directions (may raise) before mutating anything; the
+        # policy's own outgoing rows are offered back to the planner
+        assigned_i = self._plan_alloc("i", gi, list(old_rows_i))
+        assigned_e = self._plan_alloc("e", ge, list(old_rows_e))
+        freed_i = self._commit_rows("i", key, assigned_i, old_rows_i)
+        freed_e = self._commit_rows("e", key, assigned_e, old_rows_e)
+        self.policies[key] = pol
+        self._apply(key, (old_si, old_se), (new_si, new_se),
+                    assigned_i, assigned_e, freed_i, freed_e)
+
+    def update_pod_labels(self, idx: int, labels: Dict[str, str]) -> None:
+        raise PortUniverseChanged(
+            "pod relabels under port semantics move named-port resolution "
+            "and every VP row's selection column; rebuild the verifier (or "
+            "use the any-port PackedIncrementalVerifier for relabel-heavy "
+            "workloads)"
+        )
+
+    # --------------------------------------------------------------- result
+    def packed_reach(self) -> PackedReach:
+        n = self.n_pods
+        return PackedReach(
+            packed=self._packed[:n],
+            n_pods=n,
+            ingress_isolated=np.asarray(self._ing_cnt > 0)[:n],
+            egress_isolated=np.asarray(self._eg_cnt > 0)[:n],
+        )
+
+    @property
+    def reach(self) -> np.ndarray:
+        return self.packed_reach().to_bool()
+
+    def as_cluster(self) -> Cluster:
+        return Cluster(
+            pods=[
+                Pod(p.name, p.namespace, dict(p.labels), p.ip,
+                    dict(p.container_ports))
+                for p in self.pods
+            ],
+            namespaces=list(self.namespaces),
+            policies=list(self.policies.values()),
+        )

@@ -574,7 +574,7 @@ def sharded_packed_reach(
         # sharded grant stacks
         (
             layout, vp_pol_i, vp_res_i, vp_slot_i,
-            vp_pol_e, vp_res_e, vp_slot_e,
+            vp_pol_e, vp_res_e, vp_slot_e, _,
         ) = _build_port_layout(
             np.asarray(ingress.ports),
             np.asarray(egress.ports),

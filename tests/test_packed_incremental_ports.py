@@ -1,0 +1,244 @@
+"""Port-bitmap incremental re-verify (config 4 semantics under config 5's
+diff engine): every mutation must equal a from-scratch CPU-oracle solve with
+ports on, and frozen-universe boundaries must fail loudly, never silently."""
+import dataclasses
+
+import numpy as np
+import pytest
+
+import kubernetes_verification_tpu as kv
+from kubernetes_verification_tpu.harness.generate import (
+    GeneratorConfig,
+    random_cluster,
+)
+from kubernetes_verification_tpu.packed_incremental_ports import (
+    PackedPortsIncrementalVerifier,
+    PortUniverseChanged,
+)
+
+
+def _full(cluster, config):
+    return kv.verify(
+        cluster,
+        kv.VerifyConfig(
+            backend="cpu",
+            compute_ports=True,
+            self_traffic=config.self_traffic,
+            default_allow_unselected=config.default_allow_unselected,
+            direction_aware_isolation=config.direction_aware_isolation,
+        ),
+    ).reach
+
+
+def _mk(seed=7, **kw):
+    base = dict(
+        n_pods=57, n_policies=9, n_namespaces=3, p_ports=0.8,
+        p_named_port=0.3, p_container_ports=0.5, seed=seed,
+    )
+    base.update(kw)
+    return random_cluster(GeneratorConfig(**base))
+
+
+@pytest.fixture()
+def setup():
+    cluster = _mk()
+    cfg = kv.VerifyConfig(compute_ports=True)
+    return cluster, cfg, PackedPortsIncrementalVerifier(cluster, cfg)
+
+
+def test_initial_build_matches_oracle(setup):
+    cluster, cfg, inc = setup
+    np.testing.assert_array_equal(inc.reach, _full(cluster, cfg))
+
+
+def test_remove_add_update_sequence(setup):
+    cluster, cfg, inc = setup
+    pols = list(cluster.policies)
+    inc.remove_policy(pols[0].namespace, pols[0].name)
+    np.testing.assert_array_equal(inc.reach, _full(inc.as_cluster(), cfg))
+    inc.add_policy(dataclasses.replace(pols[0], name="readd"))
+    np.testing.assert_array_equal(inc.reach, _full(inc.as_cluster(), cfg))
+    inc.update_policy(dataclasses.replace(pols[1], ingress=pols[2].ingress))
+    np.testing.assert_array_equal(inc.reach, _full(inc.as_cluster(), cfg))
+    # a policy swapping to different KNOWN port specs stays in-universe
+    donor = next(
+        (p for p in pols[3:] if any(r.ports for r in (p.ingress or ()))),
+        None,
+    )
+    if donor is not None:
+        inc.update_policy(dataclasses.replace(pols[2], ingress=donor.ingress))
+        np.testing.assert_array_equal(inc.reach, _full(inc.as_cluster(), cfg))
+
+
+def test_fuzzed_diff_sequence():
+    cluster = _mk(seed=21)
+    cfg = kv.VerifyConfig(compute_ports=True)
+    inc = PackedPortsIncrementalVerifier(cluster, cfg, headroom=16)
+    donor = _mk(seed=22, n_policies=18)
+    added = []
+    for i, p in enumerate(donor.policies[:8]):
+        # donor policies reuse the same generator port library, so their
+        # masks stay inside the frozen layout
+        try:
+            p2 = dataclasses.replace(p, name=f"fuzz-{i}")
+            inc.add_policy(p2)
+            added.append(p2)
+        except PortUniverseChanged:
+            continue  # donor mask outside this cluster's universe: fine
+        np.testing.assert_array_equal(
+            inc.reach, _full(inc.as_cluster(), cfg), err_msg=f"add {i}"
+        )
+        if i % 3 == 1 and added:
+            victim = added.pop(0)
+            inc.remove_policy(victim.namespace, victim.name)
+            np.testing.assert_array_equal(
+                inc.reach, _full(inc.as_cluster(), cfg), err_msg=f"rm {i}"
+            )
+
+
+@pytest.mark.parametrize(
+    "self_traffic,default_allow,direction_aware",
+    [(False, True, True), (True, False, True), (True, True, False)],
+)
+def test_flag_variants(self_traffic, default_allow, direction_aware):
+    cluster = _mk(seed=11, n_policies=7)
+    cfg = kv.VerifyConfig(
+        compute_ports=True,
+        self_traffic=self_traffic,
+        default_allow_unselected=default_allow,
+        direction_aware_isolation=direction_aware,
+    )
+    inc = PackedPortsIncrementalVerifier(cluster, cfg)
+    np.testing.assert_array_equal(inc.reach, _full(cluster, cfg))
+    inc.update_policy(dataclasses.replace(cluster.policies[0], ingress=[]))
+    inc.remove_policy(
+        cluster.policies[1].namespace, cluster.policies[1].name
+    )
+    np.testing.assert_array_equal(inc.reach, _full(inc.as_cluster(), cfg))
+
+
+def test_named_port_diff_in_universe():
+    """Diffs reusing (name, resolved-atom) restrictions already in the
+    frozen bank patch exactly."""
+    pods = [
+        kv.Pod("web-a", "prod", {"app": "web"},
+               container_ports={"http": ("TCP", 8080)}),
+        kv.Pod("web-b", "prod", {"app": "web"},
+               container_ports={"http": ("TCP", 9090)}),
+        kv.Pod("client", "prod", {"app": "client"}),
+    ]
+    base = kv.NetworkPolicy(
+        "allow-http", namespace="prod",
+        pod_selector=kv.Selector({"app": "web"}),
+        ingress=(
+            kv.Rule(
+                peers=(kv.Peer(pod_selector=kv.Selector({"app": "client"})),),
+                ports=(kv.PortSpec("TCP", "http"),),
+            ),
+        ),
+    )
+    cluster = kv.Cluster(pods=pods, policies=[base])
+    cfg = kv.VerifyConfig(compute_ports=True)
+    inc = PackedPortsIncrementalVerifier(cluster, cfg)
+    np.testing.assert_array_equal(inc.reach, _full(cluster, cfg))
+    # narrow the peer set of the named rule — same name, same restrictions
+    upd = dataclasses.replace(
+        base,
+        ingress=(
+            kv.Rule(
+                peers=(kv.Peer(pod_selector=kv.Selector({"app": "nobody"})),),
+                ports=(kv.PortSpec("TCP", "http"),),
+            ),
+        ),
+    )
+    inc.update_policy(upd)
+    ref = _full(inc.as_cluster(), cfg)
+    np.testing.assert_array_equal(inc.reach, ref)
+    assert not inc.reach[2, 0] and not inc.reach[2, 1]
+
+
+def test_new_port_mask_rejected(setup):
+    cluster, cfg, inc = setup
+    alien = kv.NetworkPolicy(
+        "alien-port", namespace=cluster.pods[0].namespace,
+        pod_selector=kv.Selector(),
+        ingress=(
+            kv.Rule(peers=(), ports=(kv.PortSpec("TCP", 12_345),)),
+        ),
+    )
+    with pytest.raises(PortUniverseChanged, match="mask|atom"):
+        inc.add_policy(alien)
+    # the failed diff must not have corrupted state
+    np.testing.assert_array_equal(inc.reach, _full(inc.as_cluster(), cfg))
+
+
+def test_headroom_exhaustion_raises():
+    cluster = _mk(seed=31, n_policies=5)
+    cfg = kv.VerifyConfig(compute_ports=True)
+    inc = PackedPortsIncrementalVerifier(cluster, cfg, headroom=1)
+    donor_rule = next(
+        r for p in cluster.policies for r in (p.ingress or ()) if r.ports
+    )
+    with pytest.raises(PortUniverseChanged, match="free|headroom"):
+        for i in range(40):
+            inc.add_policy(
+                kv.NetworkPolicy(
+                    f"filler-{i}", namespace=cluster.pods[0].namespace,
+                    pod_selector=kv.Selector(),
+                    ingress=(donor_rule,),
+                )
+            )
+
+
+def test_relabel_rejected(setup):
+    cluster, cfg, inc = setup
+    with pytest.raises(PortUniverseChanged, match="relabel"):
+        inc.update_pod_labels(0, {"x": "y"})
+
+
+def test_failed_update_leaves_state_intact():
+    """Regression: a diff that raises mid-allocation (segment exhausted)
+    must not free the policy's live rows — subsequent diffs previously
+    reused them and silently diverged from the oracle."""
+    cluster = _mk(seed=31, n_policies=5)
+    cfg = kv.VerifyConfig(compute_ports=True)
+    inc = PackedPortsIncrementalVerifier(cluster, cfg, headroom=1)
+    ported_rule = next(
+        r for p in cluster.policies for r in (p.ingress or ()) if r.ports
+    )
+    # exhaust the rule's ingress segment(s)
+    added = 0
+    try:
+        for i in range(40):
+            inc.add_policy(
+                kv.NetworkPolicy(
+                    f"filler-{i}", namespace=cluster.pods[0].namespace,
+                    pod_selector=kv.Selector(),
+                    ingress=(ported_rule,),
+                )
+            )
+            added += 1
+    except PortUniverseChanged:
+        pass
+    assert added < 40, "fixture must exhaust a segment"
+    # updating an EXISTING policy into the exhausted segment must fail...
+    victim = next(
+        p for p in cluster.policies if not any(
+            r.ports == ported_rule.ports for r in (p.ingress or ())
+        )
+    )
+    before = inc.reach.copy()
+    try:
+        inc.update_policy(
+            dataclasses.replace(victim, ingress=(ported_rule,))
+        )
+    except PortUniverseChanged:
+        pass
+    # ...WITHOUT corrupting state: reach unchanged, and later in-universe
+    # diffs still track the oracle
+    np.testing.assert_array_equal(inc.reach, before)
+    last = f"filler-{added - 1}"
+    inc.remove_policy(cluster.pods[0].namespace, last)
+    np.testing.assert_array_equal(inc.reach, _full(inc.as_cluster(), cfg))
+    inc.remove_policy(victim.namespace, victim.name)
+    np.testing.assert_array_equal(inc.reach, _full(inc.as_cluster(), cfg))
