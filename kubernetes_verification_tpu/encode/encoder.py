@@ -212,6 +212,11 @@ class EncodedCluster:
     restrict_bank_intern: Optional["_RestrictBank"] = None
 
 
+class FrozenBankMiss(KeyError):
+    """A frozen restriction bank was asked for a new (protocol, name,
+    atom) row — the incremental caller must rebuild."""
+
+
 class _RestrictBank:
     """Interns named-port dst-restriction rows. Row 0 is the all-True
     unrestricted row; one row per (protocol, name, atom) actually used.
@@ -228,7 +233,7 @@ class _RestrictBank:
     def intern(self, key: Tuple[str, str, int], mask: np.ndarray) -> int:
         if key not in self._ids:
             if self.frozen:
-                raise KeyError(
+                raise FrozenBankMiss(
                     f"named-port restriction {key} not in the frozen bank"
                 )
             self._ids[key] = len(self.rows)

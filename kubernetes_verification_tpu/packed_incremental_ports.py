@@ -46,6 +46,7 @@ import numpy as np
 
 from .backends.base import VerifyConfig
 from .encode.encoder import (
+    FrozenBankMiss,
     GrantBlock,
     SelectorEnc,
     encode_cluster,
@@ -330,9 +331,29 @@ class PackedPortsIncrementalVerifier:
         tile: int = 512,
         chunk: int = 2048,
         max_port_masks: int = 32,
+        mesh: Optional[jax.sharding.Mesh] = None,
     ) -> None:
+        """``mesh``: shard the VP operands (VP axis over ``grants``, pod
+        axis over ``pods``), counts and the packed matrix over a (pods,
+        grants) mesh — the diff kernels then run SPMD via jit sharding
+        propagation, composing configs 4 and 5 fully."""
         self.config = config or VerifyConfig()
-        self.device = device or jax.devices()[0]
+        self.mesh = mesh
+        self.device = device or (None if mesh else jax.devices()[0])
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as PS
+
+            from .parallel.mesh import GRANT_AXIS, POD_AXIS
+
+            self._sh = {
+                "vp": NamedSharding(mesh, PS(GRANT_AXIS, POD_AXIS)),
+                "vec": NamedSharding(mesh, PS(POD_AXIS)),
+                "pods": NamedSharding(mesh, PS(POD_AXIS, None)),
+                "rep": NamedSharding(mesh, PS()),
+            }
+        else:
+            self._sh = None
         self.pods: List[Pod] = [
             dataclasses.replace(
                 p, labels=dict(p.labels), container_ports=dict(p.container_ports)
@@ -371,9 +392,9 @@ class PackedPortsIncrementalVerifier:
         self._ns_key = enc.ns_key
         col_valid = np.zeros(Np, dtype=bool)
         col_valid[:n] = True
-        self._col_mask = jax.device_put(
+        self._col_mask = self._put(
             np.packbits(col_valid, bitorder="little").view("<u4").copy(),
-            self.device,
+            "rep",
         )
         if enc.restrict_bank is not None:
             bank8 = np.zeros((enc.restrict_bank.shape[0], Np), dtype=np.int8)
@@ -410,30 +431,55 @@ class PackedPortsIncrementalVerifier:
         )
         self._layout = layout
         self._total_rows = {"i": len(vp_pol_i), "e": len(vp_pol_e)}
+        if mesh is not None:
+            # the VP axis shards over the grant axis: pad with inert rows
+            # (after the sink row, outside every segment) to a multiple of mp
+            from .parallel.mesh import GRANT_AXIS as _GA
+
+            mp = mesh.shape[_GA]
+
+            def pad_vp(pol, res):
+                pad = (-len(pol)) % mp
+                return (
+                    np.concatenate([pol, np.full(pad, P, dtype=pol.dtype)]),
+                    np.concatenate([res, np.zeros(pad, dtype=res.dtype)]),
+                )
+
+            vp_pol_i, vp_res_i = pad_vp(vp_pol_i, vp_res_i)
+            vp_pol_e, vp_res_e = pad_vp(vp_pol_e, vp_res_e)
         self._mask_rank = {
             tuple(bool(b) for b in row): r
             for r, row in enumerate(np.asarray(ported_masks))
         }
         self._sink_pol = P
 
-        args = jax.device_put(
-            (
-                pod_kv, pod_key, pod_ns, enc.ns_kv, enc.ns_key,
-                enc.pol_sel, enc.pol_ns, enc.pol_affects_ingress,
-                enc.pol_affects_egress, ingress, egress,
-                vp_pol_i, vp_res_i, vp_slot_i,
-                vp_pol_e, vp_res_e, vp_slot_e, bank8,
+        args = (
+            self._put(pod_kv, "pods"),
+            self._put(pod_key, "pods"),
+            self._put(pod_ns, "vec"),
+            *(
+                self._put(a, "rep")
+                for a in (
+                    enc.ns_kv, enc.ns_key, enc.pol_sel, enc.pol_ns,
+                    enc.pol_affects_ingress, enc.pol_affects_egress,
+                    ingress, egress, vp_pol_i, vp_res_i, vp_slot_i,
+                    vp_pol_e, vp_res_e, vp_slot_e, bank8,
+                )
             ),
-            self.device,
         )
         out = _build_vp_operands(
             *args, chunk=g_chunk,
             direction_aware=cfg.direction_aware_isolation,
         )
-        (
-            self._vp_peers_i, self._sel_ing_vp, self._sel_eg_vp,
-            self._vp_peers_e, self._ing_cnt, self._eg_cnt,
-        ) = out
+        place = lambda a, kind: (
+            jax.device_put(a, self._sh[kind]) if self._sh is not None else a
+        )
+        self._vp_peers_i = place(out[0], "vp")
+        self._sel_ing_vp = place(out[1], "vp")
+        self._sel_eg_vp = place(out[2], "vp")
+        self._vp_peers_e = place(out[3], "vp")
+        self._ing_cnt = place(out[4], "vec")
+        self._eg_cnt = place(out[5], "vec")
         self._packed = _ports_sweep(
             *self._operands, self._ing_cnt, self._eg_cnt, self._col_mask,
             layout=layout, tile=self._tile,
@@ -486,6 +532,11 @@ class PackedPortsIncrementalVerifier:
         self.init_time = time.perf_counter() - t0
 
     # ------------------------------------------------------------- plumbing
+    def _put(self, x, kind: str):
+        if self._sh is not None:
+            return jax.device_put(x, self._sh[kind])
+        return jax.device_put(x, self.device)
+
     @property
     def _operands(self):
         return (
@@ -577,7 +628,7 @@ class PackedPortsIncrementalVerifier:
                 pol, vz.vocab, self._atoms, vz.ns_index, self.pods,
                 self._resolution, self._bank_intern,
             )
-        except KeyError as e:
+        except FrozenBankMiss as e:
             raise PortUniverseChanged(
                 f"policy {self._key(pol)} needs a named-port restriction "
                 f"outside the frozen bank ({e}); rebuild the verifier"
@@ -674,7 +725,7 @@ class PackedPortsIncrementalVerifier:
             self._pol_rows[key][d].append(row)
         return [r for r in old_rows if r not in assigned]
 
-    def _apply(self, key, old_sel, new_sel, assigned_i, assigned_e,
+    def _apply(self, old_sel, new_sel, assigned_i, assigned_e,
                freed_i, freed_e) -> None:
         n, Np = self.n_pods, self._n_padded
         old_si, old_se = old_sel
@@ -724,12 +775,12 @@ class PackedPortsIncrementalVerifier:
         rows_e, vals_e = safe_pack(assigned_e, freed_e, new_se, False, "e")
         out = _vp_write(
             *self._operands, self._ing_cnt, self._eg_cnt,
-            jax.device_put(rows_i, self.device),
-            jax.device_put(vals_i, self.device),
-            jax.device_put(rows_e, self.device),
-            jax.device_put(vals_e, self.device),
-            jax.device_put(d_ing, self.device),
-            jax.device_put(d_eg, self.device),
+            self._put(rows_i, "rep"),
+            self._put(vals_i, "rep"),
+            self._put(rows_e, "rep"),
+            self._put(vals_e, "rep"),
+            self._put(d_ing, "vec"),
+            self._put(d_eg, "vec"),
         )
         (
             self._vp_peers_i, self._sel_ing_vp, self._sel_eg_vp,
@@ -744,14 +795,14 @@ class PackedPortsIncrementalVerifier:
         for idx, _ in _groups(rows, _ROW_GROUP):
             self._packed = _ports_patch_rows(
                 self._packed, *self._operands, self._ing_cnt, self._eg_cnt,
-                self._col_mask, jnp.asarray(idx),
+                self._col_mask, self._put(idx, "rep"),
                 layout=self._layout, **self._flags,
             )
         for idx, creal in _groups(cols, _COL_GROUP):
             meta = _PIV._col_meta(idx, int(creal.sum()))
             self._packed = _ports_patch_cols(
                 self._packed, *self._operands, self._ing_cnt, self._eg_cnt,
-                jnp.asarray(idx), *(jnp.asarray(m) for m in meta),
+                self._put(idx, "rep"), *(self._put(m, "rep") for m in meta),
                 layout=self._layout, **self._flags,
             )
 
@@ -786,7 +837,7 @@ class PackedPortsIncrementalVerifier:
         self._commit_rows("e", key, assigned_e, [])
         self.policies[key] = pol
         zeros = np.zeros(self.n_pods, dtype=bool)
-        self._apply(key, (zeros, zeros), (new_si, new_se),
+        self._apply((zeros, zeros), (new_si, new_se),
                     assigned_i, assigned_e, [], [])
 
     def remove_policy(self, namespace: str, name: str) -> None:
@@ -796,8 +847,9 @@ class PackedPortsIncrementalVerifier:
         del self.policies[key]
         freed_i = self._commit_rows("i", key, {}, list(self._pol_rows[key]["i"]))
         freed_e = self._commit_rows("e", key, {}, list(self._pol_rows[key]["e"]))
+        del self._pol_rows[key]  # no leak under add/remove churn
         zeros = np.zeros(self.n_pods, dtype=bool)
-        self._apply(key, (old_si, old_se), (zeros, zeros),
+        self._apply((old_si, old_se), (zeros, zeros),
                     {}, {}, freed_i, freed_e)
 
     def update_policy(self, pol: NetworkPolicy) -> None:
@@ -814,7 +866,7 @@ class PackedPortsIncrementalVerifier:
         freed_i = self._commit_rows("i", key, assigned_i, old_rows_i)
         freed_e = self._commit_rows("e", key, assigned_e, old_rows_e)
         self.policies[key] = pol
-        self._apply(key, (old_si, old_se), (new_si, new_se),
+        self._apply((old_si, old_se), (new_si, new_se),
                     assigned_i, assigned_e, freed_i, freed_e)
 
     def update_pod_labels(self, idx: int, labels: Dict[str, str]) -> None:
