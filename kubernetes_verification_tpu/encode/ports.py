@@ -129,15 +129,21 @@ def named_resolution(
     policies: Sequence[NetworkPolicy],
     atoms: Sequence[PortAtom],
     pods: Sequence,
+    keys: Optional[Sequence[Tuple[str, str]]] = None,
 ) -> Dict[Tuple[str, str], np.ndarray]:
     """Per-destination resolution masks: for each referenced (protocol,
     name), a ``bool [N, Q]`` where ``[d, q]`` is True iff dst pod ``d``
     declares a container port with that name and protocol whose number falls
     in atom ``q``. Pods not declaring the name match nothing — the real-k8s
-    behaviour the by-name approximation missed."""
+    behaviour the by-name approximation missed. ``keys`` overrides the
+    referenced-name scan (checkpoint resume reconstructs the exact frozen
+    key set, which may include names no current policy references)."""
     out: Dict[Tuple[str, str], np.ndarray] = {}
     n, Q = len(pods), len(atoms)
-    for key in sorted(_named_specs_used(policies)):
+    key_list = (
+        sorted(_named_specs_used(policies)) if keys is None else list(keys)
+    )
+    for key in key_list:
         proto, name = key
         mask = np.zeros((n, Q), dtype=bool)
         for d, pod in enumerate(pods):

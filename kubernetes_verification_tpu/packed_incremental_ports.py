@@ -76,6 +76,32 @@ _ROW_GROUP = 256
 _COL_GROUP = 256
 
 
+def _make_shardings(mesh) -> Optional[Dict[str, object]]:
+    """The placement-kind table shared by __init__ and from_state."""
+    if mesh is None:
+        return None
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as PS
+
+    from .parallel.mesh import GRANT_AXIS, POD_AXIS
+
+    return {
+        "vp": NamedSharding(mesh, PS(GRANT_AXIS, POD_AXIS)),
+        "vec": NamedSharding(mesh, PS(POD_AXIS)),
+        "pods": NamedSharding(mesh, PS(POD_AXIS, None)),
+        "rep": NamedSharding(mesh, PS()),
+    }
+
+
+def _copy_pods(pods) -> List[Pod]:
+    return [
+        dataclasses.replace(
+            p, labels=dict(p.labels), container_ports=dict(p.container_ports)
+        )
+        for p in pods
+    ]
+
+
 class PortUniverseChanged(ValueError):
     """The diff needs port atoms / masks / restrictions / capacity outside
     the frozen layout — rebuild the verifier from the current cluster."""
@@ -340,26 +366,8 @@ class PackedPortsIncrementalVerifier:
         self.config = config or VerifyConfig()
         self.mesh = mesh
         self.device = device or (None if mesh else jax.devices()[0])
-        if mesh is not None:
-            from jax.sharding import NamedSharding
-            from jax.sharding import PartitionSpec as PS
-
-            from .parallel.mesh import GRANT_AXIS, POD_AXIS
-
-            self._sh = {
-                "vp": NamedSharding(mesh, PS(GRANT_AXIS, POD_AXIS)),
-                "vec": NamedSharding(mesh, PS(POD_AXIS)),
-                "pods": NamedSharding(mesh, PS(POD_AXIS, None)),
-                "rep": NamedSharding(mesh, PS()),
-            }
-        else:
-            self._sh = None
-        self.pods: List[Pod] = [
-            dataclasses.replace(
-                p, labels=dict(p.labels), container_ports=dict(p.container_ports)
-            )
-            for p in cluster.pods
-        ]
+        self._sh = _make_shardings(mesh)
+        self.pods: List[Pod] = _copy_pods(cluster.pods)
         self.namespaces = list(cluster.namespaces)
         self.policies: Dict[str, NetworkPolicy] = {}
         self.update_count = 0
@@ -928,3 +936,207 @@ class PackedPortsIncrementalVerifier:
             namespaces=list(self.namespaces),
             policies=list(self.policies.values()),
         )
+
+    # ---------------------------------------------------------- persistence
+    def state_dict(self) -> Tuple[Dict[str, np.ndarray], Dict]:
+        """(arrays, meta) for checkpointing. Arrays: the four VP operands
+        (bit-packed, trimmed to the pre-mesh-padding row counts), counts,
+        the packed matrix, and per-direction row-ownership vectors. Meta
+        (JSON-serialisable): the frozen layout, atoms, the named-resolution
+        key set and the bank's interned key order — everything derived from
+        pods/namespaces re-derives deterministically on resume (relabels are
+        impossible in port mode, so the manifest labels ARE the frozen
+        labels)."""
+        keys = list(self.policies)
+        key_id = {k: i for i, k in enumerate(keys)}
+
+        def owners(d: str) -> np.ndarray:
+            out = np.full(self._total_rows[d], -1, dtype=np.int32)
+            for row, key in self._row_owner[d].items():
+                out[row] = key_id[key]
+            return out
+
+        pack = lambda m: np.packbits(
+            np.asarray(m, dtype=np.uint8), axis=1, bitorder="little"
+        )
+        ti, te = self._total_rows["i"], self._total_rows["e"]
+        arrays = {
+            "vp_peers_i": pack(self._vp_peers_i[:ti]),
+            "sel_ing_vp": pack(self._sel_ing_vp[:ti]),
+            "sel_eg_vp": pack(self._sel_eg_vp[:te]),
+            "vp_peers_e": pack(self._vp_peers_e[:te]),
+            "ing_cnt": np.asarray(self._ing_cnt, dtype=np.int32),
+            "eg_cnt": np.asarray(self._eg_cnt, dtype=np.int32),
+            "packed": np.asarray(self._packed),
+            "owners_i": owners("i"),
+            "owners_e": owners("e"),
+            "keys": np.array(keys),
+        }
+        bank_keys = (
+            list(self._bank_intern._ids) if self._bank_intern is not None else []
+        )
+        meta = {
+            "n_padded": self._n_padded,
+            "tile": self._tile,
+            "total_rows": dict(self._total_rows),
+            "layout": {
+                "seg_i": [list(s) for s in self._layout.seg_i],
+                "seg_e": [list(s) for s in self._layout.seg_e],
+                "full_i": list(self._layout.full_i),
+                "full_e": list(self._layout.full_e),
+                "ov_rows": [list(r) for r in self._layout.ov_rows],
+            },
+            "mask_rank": [
+                [list(mask), rank] for mask, rank in self._mask_rank.items()
+            ],
+            "atoms": [
+                [a.protocol, a.lo, a.hi, a.name] for a in self._atoms
+            ],
+            "resolution_keys": sorted(self._resolution or {}),
+            "bank_keys": [list(k) for k in bank_keys],
+            "sink_pol": self._sink_pol,
+            "update_count": self.update_count,
+        }
+        return arrays, meta
+
+    @classmethod
+    def from_state(
+        cls,
+        cluster: Cluster,
+        arrays: Dict[str, np.ndarray],
+        meta: Dict,
+        config: Optional[VerifyConfig] = None,
+        device=None,
+        mesh: Optional[jax.sharding.Mesh] = None,
+    ) -> "PackedPortsIncrementalVerifier":
+        """Resume WITHOUT re-solving: the VP operands / counts / matrix
+        upload straight to the device (or mesh, re-padding the VP axis for
+        its grant-axis factorisation); the vocab, namespace matrices,
+        posting lists, resolution masks and restriction bank re-derive
+        deterministically from the manifest."""
+        from .backends.base import PortAtom
+        from .encode.encoder import _RestrictBank, cluster_vocab
+        from .encode.ports import named_resolution
+        from .ops.tiled import PortLayout
+
+        self = cls.__new__(cls)
+        self.config = config or VerifyConfig()
+        self.mesh = mesh
+        self.device = device or (None if mesh else jax.devices()[0])
+        self._sh = _make_shardings(mesh)
+        self.pods = _copy_pods(cluster.pods)
+        self.namespaces = list(cluster.namespaces)
+        self._ns_labels = {ns.name: ns.labels for ns in self.namespaces}
+        n = len(self.pods)
+        self.n_pods = n
+        Np = int(meta["n_padded"])
+        self._n_padded = Np
+        self._tile = int(meta["tile"])
+        self.update_count = int(meta["update_count"])
+        self._sink_pol = int(meta["sink_pol"])
+        self._total_rows = {k: int(v) for k, v in meta["total_rows"].items()}
+        lay = meta["layout"]
+        self._layout = PortLayout(
+            seg_i=tuple(tuple(s) for s in lay["seg_i"]),
+            seg_e=tuple(tuple(s) for s in lay["seg_e"]),
+            full_i=tuple(lay["full_i"]),
+            full_e=tuple(lay["full_e"]),
+            ov_rows=tuple(tuple(r) for r in lay["ov_rows"]),
+        )
+        self._mask_rank = {
+            tuple(bool(b) for b in mask): int(rank)
+            for mask, rank in meta["mask_rank"]
+        }
+        self._atoms = [
+            PortAtom(protocol=p, lo=lo, hi=hi, name=name)
+            for p, lo, hi, name in meta["atoms"]
+        ]
+        # re-derive the frozen universe from the manifest (deterministic:
+        # port mode forbids relabels, so pod labels/ports are the frozen ones)
+        vocab = cluster_vocab(self.pods, self.namespaces)
+        ns_index = {ns.name: i for i, ns in enumerate(self.namespaces)}
+        self._ns_kv, self._ns_key = vocab.encode_label_matrix(
+            ns.labels for ns in self.namespaces
+        )
+        res_keys = [tuple(k) for k in meta["resolution_keys"]]
+        self._resolution = named_resolution(
+            [], self._atoms, self.pods, keys=res_keys
+        )
+        bank = None
+        bank_rows = [np.ones(n, dtype=bool)]
+        if meta["bank_keys"]:
+            bank = _RestrictBank(n)
+            for proto, name, q in (tuple(k) for k in meta["bank_keys"]):
+                bank.intern(
+                    (proto, name, int(q)),
+                    self._resolution[(proto, name)][:, int(q)].copy(),
+                )
+            bank.frozen = True
+            bank_rows = bank.rows
+        self._bank_intern = bank
+        bank8 = np.zeros((len(bank_rows), Np), dtype=np.int8)
+        for i, row in enumerate(bank_rows):
+            bank8[i, :n] = row
+        self._bank8_host = bank8
+        col_valid = np.zeros(Np, dtype=bool)
+        col_valid[:n] = True
+        self._col_mask = self._put(
+            np.packbits(col_valid, bitorder="little").view("<u4").copy(), "rep"
+        )
+
+        # ownership + free lists from the saved owner vectors
+        keys = [str(k) for k in arrays["keys"]]
+        by_key = {f"{p.namespace}/{p.name}": p for p in cluster.policies}
+        self.policies = {k: by_key[k] for k in keys}
+        self._seg_spans = {
+            "i": list(self._layout.seg_i) + [self._layout.full_i],
+            "e": list(self._layout.seg_e) + [self._layout.full_e],
+        }
+        self._free_rows = {"i": {}, "e": {}}
+        self._row_owner = {"i": {}, "e": {}}
+        self._pol_rows = {k: {"i": [], "e": []} for k in keys}
+        for d in ("i", "e"):
+            owners = np.asarray(arrays[f"owners_{d}"])
+            for s_idx, (start, length) in enumerate(self._seg_spans[d]):
+                free = []
+                for row in range(start, start + length):
+                    oid = int(owners[row])
+                    if oid < 0:
+                        free.append(row)
+                    else:
+                        key = keys[oid]
+                        self._row_owner[d][row] = key
+                        self._pol_rows[key][d].append(row)
+                self._free_rows[d][s_idx] = free
+
+        # device state (re-pad the VP axis for the target mesh)
+        unpack = lambda m: np.unpackbits(
+            m, axis=1, count=Np, bitorder="little"
+        ).astype(np.int8)
+        ops4 = {
+            k: unpack(arrays[k])
+            for k in ("vp_peers_i", "sel_ing_vp", "sel_eg_vp", "vp_peers_e")
+        }
+        if mesh is not None:
+            from .parallel.mesh import GRANT_AXIS as _GA
+            from .parallel.mesh import pad_amount, pad_rows
+
+            mp = mesh.shape[_GA]
+            for k in ops4:
+                ops4[k] = pad_rows(ops4[k], pad_amount(len(ops4[k]), mp))
+        self._vp_peers_i = self._put(ops4["vp_peers_i"], "vp")
+        self._sel_ing_vp = self._put(ops4["sel_ing_vp"], "vp")
+        self._sel_eg_vp = self._put(ops4["sel_eg_vp"], "vp")
+        self._vp_peers_e = self._put(ops4["vp_peers_e"], "vp")
+        self._ing_cnt = self._put(np.asarray(arrays["ing_cnt"]), "vec")
+        self._eg_cnt = self._put(np.asarray(arrays["eg_cnt"]), "vec")
+        self._packed = self._put(np.asarray(arrays["packed"]), "pods")
+        self._vectorizer = PolicyVectorizer(
+            self.pods, self._ns_labels, vocab, ns_index,
+            self.config.direction_aware_isolation,
+        )
+        self._h_ing_cnt = np.asarray(arrays["ing_cnt"], dtype=np.int64)[:n]
+        self._h_eg_cnt = np.asarray(arrays["eg_cnt"], dtype=np.int64)[:n]
+        self.init_time = 0.0
+        self._prewarm()
+        return self

@@ -36,6 +36,8 @@ __all__ = [
     "load_incremental",
     "save_packed_incremental",
     "load_packed_incremental",
+    "save_ports_incremental",
+    "load_ports_incremental",
     "export_encoding",
 ]
 
@@ -260,6 +262,49 @@ def load_packed_incremental(
     return PackedIncrementalVerifier.from_state(
         cluster, state, config, device=device, mesh=mesh,
         keep_matrix=keep_matrix,
+    )
+
+
+def save_ports_incremental(inc, directory: str) -> None:
+    """Checkpoint a :class:`~..packed_incremental_ports.
+    PackedPortsIncrementalVerifier`: cluster manifest + bit-packed VP
+    operands + counts + packed matrix + frozen layout/universe metadata."""
+    from ..ingest import dump_cluster
+
+    os.makedirs(directory, exist_ok=True)
+    dump_cluster(inc.as_cluster(), os.path.join(directory, "cluster"))
+    arrays, meta = inc.state_dict()
+    np.savez_compressed(
+        os.path.join(directory, "state.npz"),
+        __config__=np.frombuffer(
+            _config_json(inc.config).encode(), dtype=np.uint8
+        ),
+        __meta__=np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8),
+        **arrays,
+    )
+
+
+def load_ports_incremental(
+    directory: str,
+    config: Optional[VerifyConfig] = None,
+    device=None,
+    mesh=None,
+):
+    """Resume a port-bitmap incremental verifier without re-solving; the
+    frozen universe re-derives deterministically from the manifest."""
+    from ..ingest import load_cluster
+    from ..packed_incremental_ports import PackedPortsIncrementalVerifier
+
+    cluster, _ = load_cluster(os.path.join(directory, "cluster"))
+    with np.load(os.path.join(directory, "state.npz")) as z:
+        saved = json.loads(bytes(z["__config__"]).decode())
+        config = _check_saved_config(saved, config, "load_ports_incremental")
+        meta = json.loads(bytes(z["__meta__"]).decode())
+        arrays = {
+            k: z[k] for k in z.files if k not in ("__config__", "__meta__")
+        }
+    return PackedPortsIncrementalVerifier.from_state(
+        cluster, arrays, meta, config, device=device, mesh=mesh
     )
 
 

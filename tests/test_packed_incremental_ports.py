@@ -259,3 +259,70 @@ def test_mesh_sharded_port_diffs(shape):
     inc.add_policy(dataclasses.replace(pols[0], name="readd"))
     inc.update_policy(dataclasses.replace(pols[1], ingress=pols[2].ingress))
     np.testing.assert_array_equal(inc.reach, _full(inc.as_cluster(), cfg))
+
+
+def test_checkpoint_resume(tmp_path):
+    """save → load restores the exact port-diff state (frozen universe
+    re-derived from the manifest); diffs continue tracking the oracle —
+    including across a mesh-factorisation change."""
+    from kubernetes_verification_tpu.parallel.mesh import mesh_for
+    from kubernetes_verification_tpu.utils.persist import (
+        load_ports_incremental,
+        save_ports_incremental,
+    )
+
+    cluster = _mk(seed=7)
+    cfg = kv.VerifyConfig(compute_ports=True)
+    inc = PackedPortsIncrementalVerifier(cluster, cfg, headroom=8)
+    pols = list(cluster.policies)
+    inc.update_policy(dataclasses.replace(pols[1], ingress=pols[2].ingress))
+    inc.remove_policy(pols[3].namespace, pols[3].name)
+    before = inc.reach.copy()
+
+    d = str(tmp_path / "ckpt")
+    save_ports_incremental(inc, d)
+    res = load_ports_incremental(d)
+    np.testing.assert_array_equal(res.reach, before)
+    assert res.policies.keys() == inc.policies.keys()
+    res.add_policy(dataclasses.replace(pols[3], name="post-resume"))
+    np.testing.assert_array_equal(res.reach, _full(res.as_cluster(), cfg))
+    # resume onto a mesh
+    res2 = load_ports_incremental(d, mesh=mesh_for((4, 2)))
+    np.testing.assert_array_equal(res2.reach, before)
+    res2.remove_policy(pols[1].namespace, pols[1].name)
+    np.testing.assert_array_equal(res2.reach, _full(res2.as_cluster(), cfg))
+
+
+def test_checkpoint_preserves_named_universe(tmp_path):
+    """A named-port restriction interned at init survives resume even if no
+    CURRENT policy references the name — a diff may reintroduce it."""
+    from kubernetes_verification_tpu.utils.persist import (
+        load_ports_incremental,
+        save_ports_incremental,
+    )
+
+    pods = [
+        kv.Pod("web-a", "prod", {"app": "web"},
+               container_ports={"http": ("TCP", 8080)}),
+        kv.Pod("client", "prod", {"app": "client"}),
+    ]
+    named = kv.NetworkPolicy(
+        "allow-http", namespace="prod",
+        pod_selector=kv.Selector({"app": "web"}),
+        ingress=(
+            kv.Rule(
+                peers=(kv.Peer(pod_selector=kv.Selector({"app": "client"})),),
+                ports=(kv.PortSpec("TCP", "http"),),
+            ),
+        ),
+    )
+    cluster = kv.Cluster(pods=pods, policies=[named])
+    cfg = kv.VerifyConfig(compute_ports=True)
+    inc = PackedPortsIncrementalVerifier(cluster, cfg)
+    inc.remove_policy("prod", "allow-http")  # name now unreferenced
+    d = str(tmp_path / "ckpt")
+    save_ports_incremental(inc, d)
+    res = load_ports_incremental(d)
+    res.add_policy(named)  # reintroduces the named spec: must stay in-universe
+    np.testing.assert_array_equal(res.reach, _full(res.as_cluster(), cfg))
+    assert res.reach[1, 0]
