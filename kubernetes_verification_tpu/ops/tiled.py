@@ -785,6 +785,11 @@ class PackedReach:
     egress_isolated: np.ndarray
     selected: Optional[np.ndarray] = None
     timings: Optional[dict] = None
+    #: bool [n_pods] — live pods, when the matrix carries tombstoned slots
+    #: (the incremental engines' pod-churn state; tombstone rows/cols are
+    #: all-zero). None ⇔ every slot is a live pod. Whole-matrix queries
+    #: neutralise tombstone rows and drop tombstone dsts from answers.
+    active: Optional[np.ndarray] = None
 
     @property
     def _on_host(self) -> bool:
@@ -802,20 +807,32 @@ class PackedReach:
 
     def _word_reduce(self, op: str) -> np.ndarray:
         words = self.packed[: self.n_pods]
+        if self.active is not None:
+            # neutralise tombstone rows: identity element for the reduction
+            fill = np.uint32(0xFFFFFFFF) if op == "and" else np.uint32(0)
+            if self._on_host:
+                words = np.where(self.active[:, None], words, fill)
+            else:
+                words = jnp.where(jnp.asarray(self.active)[:, None], words, fill)
         if self._on_host:
             ufunc = np.bitwise_and if op == "and" else np.bitwise_or
             return ufunc.reduce(words, axis=0)
         return np.asarray(_device_word_reduce(words, op))
 
+    def _live_dsts(self, mask: np.ndarray) -> List[int]:
+        if self.active is not None:
+            mask = mask & self.active
+        return np.nonzero(mask)[0].tolist()
+
     def all_reachable(self) -> List[int]:
         """Pods reachable from every pod (``kano/algorithm.py:4-9``)."""
         conj = self._word_reduce("and")
-        return np.nonzero(unpack_cols(conj[None, :], self.n_pods)[0])[0].tolist()
+        return self._live_dsts(unpack_cols(conj[None, :], self.n_pods)[0])
 
     def all_isolated(self) -> List[int]:
         """Pods reachable from no pod (``kano/algorithm.py:12-17``)."""
         disj = self._word_reduce("or")
-        return np.nonzero(~unpack_cols(disj[None, :], self.n_pods)[0])[0].tolist()
+        return self._live_dsts(~unpack_cols(disj[None, :], self.n_pods)[0])
 
     def out_degree(self) -> np.ndarray:
         """popcount per source row — ``lax.population_count`` on device,

@@ -39,9 +39,23 @@ labels diverge from the frozen encoding are tracked in a dirty set and fixed
 up on every later policy re-encode, so label drift never silently decays the
 frozen-vocab device path.
 
-Scope matches the dense verifier: any-port semantics; pod add/remove changes
-N and requires a rebuild. Differentially tested against the CPU oracle and
-the dense incremental verifier in ``tests/test_packed_incremental.py``.
+**Pod churn** uses the same slot mechanism as policies, on the pod axis: the
+padded columns ``[n, Np)`` (plus an optional ``pod_headroom``) are free pod
+slots, and removed pods return their slot to a free list. One ``add_pod`` /
+``remove_pod`` is a single fused device dispatch (``_pod_step``): write the
+pod's per-policy column into the four maps, set its isolation counts, flip
+its validity bit in the packed column mask, and recompute exactly its own
+row and its own bit-column of the packed matrix — the rest of the matrix is
+untouched because a pod's existence only contributes its own row/column
+(unlike a policy, which fans out to every pod it selects). This is the
+vectorised form of the reference's per-container policy index hint
+(``kano_py/kano/model.py:16-17,161-163``): the per-pod column of the policy
+maps IS that index, kept device-resident. Exhausting the headroom grows the
+pod axis in place (a full copy — size ``pod_headroom`` to your churn rate).
+
+Scope matches the dense verifier: any-port semantics. Differentially tested
+against the CPU oracle and the dense incremental verifier in
+``tests/test_packed_incremental.py``.
 """
 from __future__ import annotations
 
@@ -182,6 +196,9 @@ class PolicyVectorizer:
         self.n = len(pods)
         #: pods whose labels changed after the encoding was frozen
         self.dirty: set = set()
+        #: removed pod slots — their vectors are forced to 0 so a later
+        #: policy re-encode can never resurrect a tombstoned pod
+        self.inactive: set = set()
         # inverted indices over the FROZEN pod labels: pair/key/ns → pod ids
         pair_pods: Dict[int, List[int]] = {}
         key_pods: Dict[int, List[int]] = {}
@@ -301,7 +318,22 @@ class PolicyVectorizer:
             )
             for v, f in zip(out, flags):
                 v[i] = f
+        for i in self.inactive:
+            for v in out:
+                v[i] = False
         return tuple(v.astype(np.int8) for v in out)
+
+    def note_pod(self, idx: int) -> None:
+        """Register pod slot ``idx`` as (re)occupied: the live ``self.pods``
+        list already holds the new Pod; it is evaluated object-level via the
+        dirty set (its labels may carry pairs the frozen vocab never saw)."""
+        self.n = len(self.pods)
+        self.dirty.add(idx)
+        self.inactive.discard(idx)
+
+    def note_removed(self, idx: int) -> None:
+        self.inactive.add(idx)
+        self.dirty.discard(idx)
 
 
 # ---------------------------------------------------------------------------
@@ -348,6 +380,7 @@ def _stripe_step(
     ing_cnt,
     eg_cnt,
     col_mask,
+    row_valid,  # int8 [Np] — 0 for removed/padded pod rows
     d0,  # stripe start (multiple of 32)
     *,
     width: int,  # stripe width (multiple of 32)
@@ -370,6 +403,7 @@ def _stripe_step(
         self_traffic,
         default_allow,
     )
+    r &= row_valid[:, None] > 0
     mask_t = jax.lax.dynamic_slice(col_mask, (d0 // 32,), (width // 32,))
     return pack_bool_cols(r) & mask_t[None, :]
 
@@ -396,6 +430,103 @@ def _apply_pod_col(
         eg_by_pol.at[:, idx].set(col_ep),
         ing_cnt.at[idx].set(jnp.sum(col_si.astype(_I32))),
         eg_cnt.at[idx].set(jnp.sum(col_se.astype(_I32))),
+    )
+
+
+@partial(
+    jax.jit,
+    donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7, 8),
+    static_argnames=("self_traffic", "default_allow"),
+)
+def _pod_step(
+    packed,
+    sel_ing8,
+    sel_eg8,
+    ing_by_pol,
+    eg_by_pol,
+    ing_cnt,
+    eg_cnt,
+    col_mask,
+    row_valid,
+    idx,  # int32 — the pod slot
+    cols4,  # int8 [4, C] — the pod's per-policy column quadruple
+    active,  # uint32 0/1 — 1 = add/occupy, 0 = remove/tombstone
+    *,
+    self_traffic: bool,
+    default_allow: bool,
+):
+    """One fused pod add/remove: write the pod's column of all four maps,
+    set its isolation counts, flip its validity bit in the column mask +
+    row-valid vector, and recompute exactly its own packed row and its own
+    bit-column — one dispatch, like ``_diff_step`` for policies (the remote
+    tunnel's per-dispatch latency dominates the math otherwise). A pod only
+    contributes its own row/column to the matrix, so nothing else changes."""
+    sel_ing8 = sel_ing8.at[:, idx].set(cols4[0])
+    sel_eg8 = sel_eg8.at[:, idx].set(cols4[1])
+    ing_by_pol = ing_by_pol.at[:, idx].set(cols4[2])
+    eg_by_pol = eg_by_pol.at[:, idx].set(cols4[3])
+    ing_cnt = ing_cnt.at[idx].set(jnp.sum(cols4[0].astype(_I32)))
+    eg_cnt = eg_cnt.at[idx].set(jnp.sum(cols4[1].astype(_I32)))
+    w = idx // 32
+    bit = jnp.uint32(1) << (idx % 32).astype(_U32)
+    col_mask = col_mask.at[w].set((col_mask[w] & ~bit) | (bit * active))
+    row_valid = row_valid.at[idx].set(active.astype(_I8))
+    Np = sel_ing8.shape[1]
+    idxv = jnp.reshape(idx, (1,))
+    ar = jnp.arange(Np, dtype=jnp.int32)
+    # the pod's own row, against the NEW maps and NEW column mask
+    r_row = _reach_block(
+        jnp.take(ing_by_pol, idxv, axis=1), sel_ing8,
+        jnp.take(sel_eg8, idxv, axis=1), eg_by_pol,
+        ing_cnt, jnp.take(eg_cnt, idxv),
+        idxv, ar, self_traffic, default_allow,
+    )  # [1, Np]
+    packed = packed.at[idxv].set(pack_bool_cols(r_row) & (col_mask[None, :] * active))
+    # the pod's own bit-column, for every (valid) source row
+    r_col = _reach_block(
+        ing_by_pol, jnp.take(sel_ing8, idxv, axis=1),
+        sel_eg8, jnp.take(eg_by_pol, idxv, axis=1),
+        jnp.take(ing_cnt, idxv), eg_cnt,
+        ar, idxv, self_traffic, default_allow,
+    )  # [Np, 1]
+    r_colb = r_col[:, 0] & (row_valid > 0)
+    newbit = (r_colb.astype(_U32) << (idx % 32).astype(_U32)) * active
+    packed = packed.at[:, w].set((packed[:, w] & ~bit) | newbit)
+    return (
+        packed, sel_ing8, sel_eg8, ing_by_pol, eg_by_pol,
+        ing_cnt, eg_cnt, col_mask, row_valid,
+    )
+
+
+@partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7))
+def _pod_step_mf(
+    sel_ing8,
+    sel_eg8,
+    ing_by_pol,
+    eg_by_pol,
+    ing_cnt,
+    eg_cnt,
+    col_mask,
+    row_valid,
+    idx,
+    cols4,
+    active,
+):
+    """Matrix-free pod add/remove: maps + counts + validity only (the packed
+    matrix is not materialised; ``solve_stripe`` re-verifies on demand)."""
+    sel_ing8 = sel_ing8.at[:, idx].set(cols4[0])
+    sel_eg8 = sel_eg8.at[:, idx].set(cols4[1])
+    ing_by_pol = ing_by_pol.at[:, idx].set(cols4[2])
+    eg_by_pol = eg_by_pol.at[:, idx].set(cols4[3])
+    ing_cnt = ing_cnt.at[idx].set(jnp.sum(cols4[0].astype(_I32)))
+    eg_cnt = eg_cnt.at[idx].set(jnp.sum(cols4[1].astype(_I32)))
+    w = idx // 32
+    bit = jnp.uint32(1) << (idx % 32).astype(_U32)
+    col_mask = col_mask.at[w].set((col_mask[w] & ~bit) | (bit * active))
+    row_valid = row_valid.at[idx].set(active.astype(_I8))
+    return (
+        sel_ing8, sel_eg8, ing_by_pol, eg_by_pol,
+        ing_cnt, eg_cnt, col_mask, row_valid,
     )
 
 
@@ -467,7 +598,7 @@ def _patch_rows(
 
 def _cols_body(
     packed, sel_ing8, sel_eg8, ing_by_pol, eg_by_pol, ing_cnt, eg_cnt,
-    cols, seg, words, wreal, clear, self_traffic, default_allow,
+    row_valid, cols, seg, words, wreal, clear, self_traffic, default_allow,
 ):
     """Recompute exactly the touched dst columns (not their whole 32-column
     words — a 32× saving on the dominant MXU contraction), fold the column
@@ -490,6 +621,10 @@ def _cols_body(
         jnp.arange(Np, dtype=jnp.int32), cols,
         self_traffic, default_allow,
     )
+    # tombstoned/padded source rows must stay zero — without this mask a
+    # later policy diff would resurrect reach bits in a removed pod's row
+    # (its eg_cnt is 0, so default-allow marks it egress-open)
+    r &= row_valid[:, None] > 0
     bits = r.astype(_U32) << (cols % 32).astype(_U32)[None, :]  # [Np, Dc]
     set_words = jax.ops.segment_sum(
         bits.T, seg, num_segments=Dw + 1
@@ -507,11 +642,12 @@ def _cols_body(
 )
 def _patch_cols(
     packed, sel_ing8, sel_eg8, ing_by_pol, eg_by_pol, ing_cnt, eg_cnt,
-    cols, seg, words, wreal, clear, *, self_traffic: bool, default_allow: bool,
+    row_valid, cols, seg, words, wreal, clear,
+    *, self_traffic: bool, default_allow: bool,
 ):
     return _cols_body(
         packed, sel_ing8, sel_eg8, ing_by_pol, eg_by_pol, ing_cnt, eg_cnt,
-        cols, seg, words, wreal, clear, self_traffic, default_allow,
+        row_valid, cols, seg, words, wreal, clear, self_traffic, default_allow,
     )
 
 
@@ -529,6 +665,7 @@ def _diff_step(
     ing_cnt,
     eg_cnt,
     col_mask,
+    row_valid,
     slot,
     new4,  # int8 [4, Np]
     rows,  # int32 [_ROW_GROUP]
@@ -565,7 +702,7 @@ def _diff_step(
     if has_cols:
         packed = _cols_body(
             packed, sel_ing8, sel_eg8, ing_by_pol, eg_by_pol, ing_cnt,
-            eg_cnt, cols, seg, words, wreal, clear, self_traffic,
+            eg_cnt, row_valid, cols, seg, words, wreal, clear, self_traffic,
             default_allow,
         )
     return packed, sel_ing8, sel_eg8, ing_by_pol, eg_by_pol, ing_cnt, eg_cnt
@@ -632,8 +769,12 @@ class PackedIncrementalVerifier:
         chunk: int = 2048,
         mesh: Optional[jax.sharding.Mesh] = None,
         keep_matrix: Optional[bool] = None,
+        pod_headroom: int = 0,
     ) -> None:
-        """``mesh``: shard the state over a ``(pods, grants)`` mesh — the
+        """``pod_headroom``: extra pod slots padded into the matrix at build
+        time so ``add_pod`` never has to grow (a grow is a full device-state
+        copy + kernel recompile) — size it to the expected churn between
+        rebuilds. ``mesh``: shard the state over a ``(pods, grants)`` mesh — the
         slot axis over ``grants``, the pod axis over ``pods`` — instead of a
         single device; every diff kernel then runs SPMD via jit sharding
         propagation. ``keep_matrix=False`` (the default on a mesh when the
@@ -692,7 +833,10 @@ class PackedIncrementalVerifier:
             dp = 1
             self._sh = None
         align = 128 * dp
-        Np = max(align, -(-n // align) * align)
+        self._pod_align = align
+        if pod_headroom < 0:
+            raise ValueError("pod_headroom must be >= 0")
+        Np = max(align, -(-(n + pod_headroom) // align) * align)
         self._n_padded = Np
         tile = next(
             t for t in (4096, 2048, 1024, 512, 256, 128) if Np % t == 0
@@ -701,11 +845,22 @@ class PackedIncrementalVerifier:
         pod_kv, pod_key, pod_ns = pad_pods(
             enc.pod_kv, enc.pod_key, enc.pod_ns, n_pad
         )
-        col_valid = np.zeros(Np, dtype=bool)
-        col_valid[:n] = True
+        # pod-slot bookkeeping: [0, n_pods) is the high-water mark of ever-
+        # occupied slots; [n_pods, Np) is headroom; removed slots recycle
+        self.pod_active = np.ones(n, dtype=bool)
+        self._pod_free: List[int] = []
+        self._pod_idx: Dict[str, int] = {}
+        for i, p in enumerate(self.pods):
+            self._pod_idx.setdefault(self._pod_key(p), i)
+        self._col_valid = np.zeros(Np, dtype=bool)
+        self._col_valid[:n] = True
         self._col_mask = self._put(
-            np.packbits(col_valid, bitorder="little").view("<u4").copy(), "rep"
+            np.packbits(self._col_valid, bitorder="little").view("<u4").copy(),
+            "rep",
         )
+        rv = np.zeros(Np, dtype=np.int8)
+        rv[:n] = 1
+        self._row_valid = self._put(rv, "vec")
 
         P = enc.n_policies
         self._slot_round = slot_round
@@ -800,6 +955,11 @@ class PackedIncrementalVerifier:
             return jax.device_put(x, self._sh["maps"])
         return x
 
+    def _place_vec(self, x):
+        if self._sh is not None:
+            return jax.device_put(x, self._sh["vec"])
+        return x
+
     def _prewarm(self) -> None:
         """Compile the diff-path kernels up front — through the exact same
         call path and argument construction real diffs use, so the first
@@ -814,7 +974,8 @@ class PackedIncrementalVerifier:
         slot = self._free[-1]
         zeros4 = np.zeros((4, self._n_padded), dtype=np.int8)
         if self._packed is None:
-            # matrix-free mode: the only diff kernel is the slot write
+            # matrix-free mode: the only diff kernels are the slot write and
+            # the pod step
             out = _slot_write(
                 *self._maps, np.int32(slot), self._put(zeros4, "new4")
             )
@@ -822,6 +983,7 @@ class PackedIncrementalVerifier:
                 self._sel_ing8, self._sel_eg8, self._ing_by_pol,
                 self._eg_by_pol, self._ing_cnt, self._eg_cnt,
             ) = out
+            self._prewarm_pod_step()
             jax.block_until_ready(self._sel_ing8)
             return
         r0 = np.zeros(_ROW_GROUP, dtype=np.int32)
@@ -831,7 +993,7 @@ class PackedIncrementalVerifier:
             (True, True), (False, True), (True, False), (False, False),
         ):
             out = _diff_step(
-                self._packed, *self._maps, self._col_mask,
+                self._packed, *self._maps, self._col_mask, self._row_valid,
                 np.int32(slot),
                 self._put(zeros4, "new4"),
                 self._put(r0, "rep"), self._put(c0, "rep"),
@@ -847,11 +1009,48 @@ class PackedIncrementalVerifier:
             [(r0, None)],
             [(c0, np.zeros(_COL_GROUP, dtype=bool))],
         )
+        self._prewarm_pod_step()
         jax.block_until_ready(self._packed)
+
+    def _prewarm_pod_step(self) -> None:
+        """Compile the pod add/remove kernel via a no-op: an ``active=0``
+        (remove-style) step on an already-invalid slot writes zeros over
+        zeros and clears bits that are already clear. Skipped when every
+        slot is valid — the first real ``add_pod`` then grows the pod axis,
+        which recompiles anyway."""
+        invalid = np.nonzero(~self._col_valid)[0]
+        if not len(invalid):
+            return
+        idx = np.int32(invalid[-1])
+        zeros_c = np.zeros((4, self._capacity), dtype=np.int8)
+        if self._packed is None:
+            out = _pod_step_mf(
+                *self._maps, self._col_mask, self._row_valid,
+                idx, self._put(zeros_c, "rep"), np.uint32(0),
+            )
+            (
+                self._sel_ing8, self._sel_eg8, self._ing_by_pol,
+                self._eg_by_pol, self._ing_cnt, self._eg_cnt,
+                self._col_mask, self._row_valid,
+            ) = out
+        else:
+            out = _pod_step(
+                self._packed, *self._maps, self._col_mask, self._row_valid,
+                idx, self._put(zeros_c, "rep"), np.uint32(0), **self._flags,
+            )
+            (
+                self._packed, self._sel_ing8, self._sel_eg8,
+                self._ing_by_pol, self._eg_by_pol, self._ing_cnt,
+                self._eg_cnt, self._col_mask, self._row_valid,
+            ) = out
 
     # ------------------------------------------------------------- plumbing
     def _key(self, pol: NetworkPolicy) -> str:
         return f"{pol.namespace}/{pol.name}"
+
+    @staticmethod
+    def _pod_key(pod: Pod) -> str:
+        return f"{pod.namespace}/{pod.name}"
 
     @property
     def _maps(self):
@@ -871,10 +1070,48 @@ class PackedIncrementalVerifier:
         )
         self._capacity += slot_round
         pad = ((0, slot_round), (0, 0))
-        self._sel_ing8 = jnp.pad(self._sel_ing8, pad)
-        self._sel_eg8 = jnp.pad(self._sel_eg8, pad)
-        self._ing_by_pol = jnp.pad(self._ing_by_pol, pad)
-        self._eg_by_pol = jnp.pad(self._eg_by_pol, pad)
+        # _place_map: a bare jnp.pad would leave grown maps with whatever
+        # sharding XLA picked, not the state's (grants, pods) layout
+        self._sel_ing8 = self._place_map(jnp.pad(self._sel_ing8, pad))
+        self._sel_eg8 = self._place_map(jnp.pad(self._sel_eg8, pad))
+        self._ing_by_pol = self._place_map(jnp.pad(self._ing_by_pol, pad))
+        self._eg_by_pol = self._place_map(jnp.pad(self._eg_by_pol, pad))
+
+    def _grow_pods(self, min_extra: int = 1) -> None:
+        """Grow the pod axis by at least ``min_extra`` slots (rounded to the
+        mesh-aligned pod padding, with a generous floor — a grow copies every
+        device buffer and recompiles the diff kernels at the new shapes, so
+        it must be rare; prefer ``pod_headroom`` at build time)."""
+        a = self._pod_align
+        grow = max(-(-min_extra // a) * a, 4 * a)
+        Np = self._n_padded
+        Np2 = Np + grow
+        pod_pad = ((0, 0), (0, grow))
+        self._sel_ing8 = self._place_map(jnp.pad(self._sel_ing8, pod_pad))
+        self._sel_eg8 = self._place_map(jnp.pad(self._sel_eg8, pod_pad))
+        self._ing_by_pol = self._place_map(jnp.pad(self._ing_by_pol, pod_pad))
+        self._eg_by_pol = self._place_map(jnp.pad(self._eg_by_pol, pod_pad))
+        self._ing_cnt = self._place_vec(jnp.pad(self._ing_cnt, (0, grow)))
+        self._eg_cnt = self._place_vec(jnp.pad(self._eg_cnt, (0, grow)))
+        self._col_valid = np.concatenate(
+            [self._col_valid, np.zeros(grow, dtype=bool)]
+        )
+        self._col_mask = self._put(
+            np.packbits(self._col_valid, bitorder="little").view("<u4").copy(),
+            "rep",
+        )
+        rv = np.zeros(Np2, dtype=np.int8)
+        rv[: self.n_pods] = self.pod_active
+        self._row_valid = self._put(rv, "vec")
+        if self._packed is not None:
+            grown = jnp.pad(self._packed, ((0, grow), (0, grow // 32)))
+            self._packed = (
+                jax.device_put(grown, self._sh["pods"])
+                if self._sh is not None
+                else grown
+            )
+        self._n_padded = Np2
+        self._prewarm()  # recompile the diff kernels at the new shapes
 
     @property
     def _flags(self) -> dict:
@@ -937,7 +1174,7 @@ class PackedIncrementalVerifier:
             c0 = np.zeros(_COL_GROUP, dtype=np.int32)
             meta0 = self._col_meta(c0, 0)
         out = _diff_step(
-            self._packed, *self._maps, self._col_mask,
+            self._packed, *self._maps, self._col_mask, self._row_valid,
             np.int32(slot),
             self._put(new4_padded, "new4"),
             self._put(r0, "rep"),
@@ -962,7 +1199,7 @@ class PackedIncrementalVerifier:
         for idx, creal in col_groups:
             meta = self._col_meta(idx, int(creal.sum()))
             self._packed = _patch_cols(
-                self._packed, *self._maps,
+                self._packed, *self._maps, self._row_valid,
                 self._put(idx, "rep"), *(self._put(m, "rep") for m in meta),
                 **self._flags,
             )
@@ -1033,21 +1270,29 @@ class PackedIncrementalVerifier:
         self.policies[key] = pol
         self._set_slot(slot, old, vecs)
 
+    def _pod_cols(self, pod: Pod) -> np.ndarray:
+        """int8 [4, C]: one pod's (sel_ing, sel_eg, ing_peer, eg_peer) flag
+        against every resident policy, slot-indexed — O(P) host evaluation
+        with object semantics (the pod may carry pairs the frozen vocab has
+        never seen)."""
+        cols = np.zeros((4, self._capacity), dtype=np.int8)
+        for key, pol in self.policies.items():
+            cols[:, self._slot[key]] = pod_policy_flags(
+                pol, pod, self._ns_labels,
+                self.config.direction_aware_isolation,
+            )
+        return cols
+
     def update_pod_labels(self, idx: int, labels: Dict[str, str]) -> None:
         """Relabel pod ``idx``: one map column + the pod's own row/word are
         patched; O(P) host evaluation of this single pod (object semantics —
         the pod may now carry pairs the frozen vocab has never seen)."""
+        if not 0 <= idx < self.n_pods or not self.pod_active[idx]:
+            raise KeyError(f"pod slot {idx} is not an active pod")
         pod = self.pods[idx]
         pod.labels = dict(labels)
         self._vectorizer.dirty.add(idx)
-        C = self._capacity
-        cols = np.zeros((4, C), dtype=np.int8)
-        for key, pol in self.policies.items():
-            flags = pod_policy_flags(
-                pol, pod, self._ns_labels,
-                self.config.direction_aware_isolation,
-            )
-            cols[:, self._slot[key]] = flags
+        cols = self._pod_cols(pod)
         out = _apply_pod_col(
             *self._maps,
             np.int32(idx),
@@ -1065,6 +1310,108 @@ class PackedIncrementalVerifier:
         else:
             self._patch(np.asarray([idx]), np.asarray([idx]))
         self.update_count += 1
+
+    # ------------------------------------------------------------ pod churn
+    def _dispatch_pod(self, idx: int, cols4: np.ndarray, active: bool) -> None:
+        """One fused pod-slot dispatch (occupy or tombstone)."""
+        if self._packed is None:
+            out = _pod_step_mf(
+                *self._maps, self._col_mask, self._row_valid,
+                np.int32(idx), self._put(cols4, "rep"),
+                np.uint32(1 if active else 0),
+            )
+            (
+                self._sel_ing8, self._sel_eg8, self._ing_by_pol,
+                self._eg_by_pol, self._ing_cnt, self._eg_cnt,
+                self._col_mask, self._row_valid,
+            ) = out
+            self.dirty_rows[idx] = True
+            self.dirty_cols[idx] = True
+        else:
+            out = _pod_step(
+                self._packed, *self._maps, self._col_mask, self._row_valid,
+                np.int32(idx), self._put(cols4, "rep"),
+                np.uint32(1 if active else 0), **self._flags,
+            )
+            (
+                self._packed, self._sel_ing8, self._sel_eg8,
+                self._ing_by_pol, self._eg_by_pol, self._ing_cnt,
+                self._eg_cnt, self._col_mask, self._row_valid,
+            ) = out
+        self.update_count += 1
+
+    def add_pod(self, pod: Pod) -> int:
+        """Add a pod in O(P + N) — one fused device dispatch. Returns the
+        pod's slot index (its row/column in the reach matrix). Reuses a
+        tombstoned slot when one exists, then the built-in headroom
+        (``pod_headroom`` + pad-to-alignment), and only then grows the pod
+        axis (expensive — full state copy + kernel recompile)."""
+        key = self._pod_key(pod)
+        if key in self._pod_idx:
+            raise KeyError(f"pod {key} exists; remove it first")
+        if pod.namespace not in self._ns_labels:
+            # auto-created namespace (empty labels) — mirrors
+            # Cluster.__post_init__; fresh ns index, no frozen pods carry it
+            self._ns_labels[pod.namespace] = {}
+            vz = self._vectorizer
+            vz.ns_index.setdefault(pod.namespace, len(vz.ns_index))
+        pod = dataclasses.replace(
+            pod, labels=dict(pod.labels), container_ports=dict(pod.container_ports)
+        )
+        if self._pod_free:
+            idx = self._pod_free.pop()
+            self.pods[idx] = pod
+            self.pod_active[idx] = True
+        else:
+            if self.n_pods >= self._n_padded:
+                self._grow_pods()
+            idx = self.n_pods
+            self.n_pods += 1
+            self.pods.append(pod)
+            self.pod_active = np.append(self.pod_active, True)
+            self._h_ing_cnt = np.append(self._h_ing_cnt, 0)
+            self._h_eg_cnt = np.append(self._h_eg_cnt, 0)
+            self.dirty_rows = np.append(self.dirty_rows, False)
+            self.dirty_cols = np.append(self.dirty_cols, False)
+        self._pod_idx[key] = idx
+        self._col_valid[idx] = True
+        self._vectorizer.note_pod(idx)
+        cols4 = self._pod_cols(pod)
+        self._h_ing_cnt[idx] = int(cols4[0].sum())
+        self._h_eg_cnt[idx] = int(cols4[1].sum())
+        self._dispatch_pod(idx, cols4, active=True)
+        return idx
+
+    def remove_pod(self, namespace: str, name: str) -> int:
+        """Remove a pod: tombstone its slot (zero column in every map, zero
+        isolation counts, clear validity, zero its packed row + bit-column)
+        in one fused dispatch. Returns the freed slot index."""
+        key = f"{namespace}/{name}"
+        idx = self._pod_idx.pop(key)  # KeyError if absent
+        self.pod_active[idx] = False
+        self._col_valid[idx] = False
+        self._pod_free.append(idx)
+        self._vectorizer.note_removed(idx)
+        self._h_ing_cnt[idx] = 0
+        self._h_eg_cnt[idx] = 0
+        zeros = np.zeros((4, self._capacity), dtype=np.int8)
+        self._dispatch_pod(idx, zeros, active=False)
+        return idx
+
+    @property
+    def n_active(self) -> int:
+        return int(self.pod_active.sum())
+
+    def active_indices(self) -> np.ndarray:
+        """Slot indices of live pods, ascending — the row/col order of
+        :meth:`reach_active` and of ``as_cluster()``'s pod list."""
+        return np.nonzero(self.pod_active)[0]
+
+    def reach_active(self) -> np.ndarray:
+        """Dense bool reach over live pods only (host) — tombstoned slots
+        dropped; aligned with ``as_cluster()`` for oracle comparison."""
+        act = self.active_indices()
+        return self.reach[np.ix_(act, act)]
 
     # --------------------------------------------------------------- result
     def dirty_stripes(self, width: int) -> List[int]:
@@ -1107,6 +1454,7 @@ class PackedIncrementalVerifier:
         out = _stripe_step(
             *self._maps,
             self._col_mask,
+            self._row_valid,
             np.int32(d0),
             width=width,
             **self._flags,
@@ -1128,6 +1476,7 @@ class PackedIncrementalVerifier:
             n_pods=n,
             ingress_isolated=np.asarray(self._ing_cnt > 0)[:n],
             egress_isolated=np.asarray(self._eg_cnt > 0)[:n],
+            active=None if self.pod_active.all() else self.pod_active.copy(),
         )
 
     @property
@@ -1135,11 +1484,16 @@ class PackedIncrementalVerifier:
         """Dense bool [N, N] view (host) — for tests and small clusters."""
         return self.packed_reach().to_bool()
 
-    def as_cluster(self) -> Cluster:
+    def as_cluster(self, include_inactive: bool = False) -> Cluster:
+        """The live cluster (pods in slot order, tombstones dropped).
+        ``include_inactive=True`` keeps tombstoned pods in place — the
+        checkpoint manifest form, where list position must equal slot
+        index (paired with ``state_dict()["pod_active"]``)."""
         return Cluster(
             pods=[
                 Pod(p.name, p.namespace, dict(p.labels), p.ip, dict(p.container_ports))
-                for p in self.pods
+                for i, p in enumerate(self.pods)
+                if include_inactive or self.pod_active[i]
             ],
             namespaces=list(self.namespaces),
             policies=list(self.policies.values()),
@@ -1175,6 +1529,7 @@ class PackedIncrementalVerifier:
             "update_count": np.int64(self.update_count),
             "dirty_rows": self.dirty_rows,
             "dirty_cols": self.dirty_cols,
+            "pod_active": self.pod_active,
         }
         if self._packed is not None:
             state["packed"] = np.asarray(self._packed)
@@ -1255,11 +1610,26 @@ class PackedIncrementalVerifier:
         self._eg_by_pol = self._put(unpack(state["eg_by_pol"]), "maps")
         self._ing_cnt = self._put(np.asarray(state["ing_cnt"]), "vec")
         self._eg_cnt = self._put(np.asarray(state["eg_cnt"]), "vec")
-        col_valid = np.zeros(Np, dtype=bool)
-        col_valid[: self.n_pods] = True
+        self._pod_align = 128 * (dp if mesh is not None else 1)
+        self.pod_active = np.asarray(
+            state.get("pod_active", np.ones(self.n_pods, dtype=bool))
+        ).copy()
+        self._pod_free = [
+            i for i in range(self.n_pods) if not self.pod_active[i]
+        ]
+        self._pod_idx = {}
+        for i, p in enumerate(self.pods):
+            if self.pod_active[i]:
+                self._pod_idx.setdefault(self._pod_key(p), i)
+        self._col_valid = np.zeros(Np, dtype=bool)
+        self._col_valid[: self.n_pods] = self.pod_active
         self._col_mask = self._put(
-            np.packbits(col_valid, bitorder="little").view("<u4").copy(), "rep"
+            np.packbits(self._col_valid, bitorder="little").view("<u4").copy(),
+            "rep",
         )
+        rv = np.zeros(Np, dtype=np.int8)
+        rv[: self.n_pods] = self.pod_active
+        self._row_valid = self._put(rv, "vec")
         keys = [str(k) for k in state["keys"]]
         slots = [int(s) for s in state["slots"]]
         by_key = {f"{p.namespace}/{p.name}": p for p in cluster.policies}
@@ -1292,6 +1662,9 @@ class PackedIncrementalVerifier:
             {ns.name: i for i, ns in enumerate(self.namespaces)},
             self.config.direction_aware_isolation,
         )
+        self._vectorizer.inactive = {
+            i for i in range(self.n_pods) if not self.pod_active[i]
+        }
         self._h_ing_cnt = np.asarray(state["ing_cnt"], dtype=np.int64)[: self.n_pods]
         self._h_eg_cnt = np.asarray(state["eg_cnt"], dtype=np.int64)[: self.n_pods]
         self.init_time = 0.0

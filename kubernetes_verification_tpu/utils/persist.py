@@ -229,7 +229,12 @@ def save_packed_incremental(inc, directory: str) -> None:
     from ..ingest import dump_cluster
 
     os.makedirs(directory, exist_ok=True)
-    dump_cluster(inc.as_cluster(), os.path.join(directory, "cluster"))
+    # include_inactive: the manifest's pod list position IS the slot index,
+    # so tombstoned pod slots must keep their place (state["pod_active"]
+    # marks them on resume)
+    dump_cluster(
+        inc.as_cluster(include_inactive=True), os.path.join(directory, "cluster")
+    )
     state = inc.state_dict()
     np.savez_compressed(
         os.path.join(directory, "state.npz"),

@@ -365,3 +365,246 @@ def test_checkpoint_resume_with_zero_free_slots(tmp_path):
     # and the grown capacity still allocates correctly
     res.add_policy(dataclasses.replace(donor.policies[0], name="after"))
     np.testing.assert_array_equal(res.reach, _full(res.as_cluster(), cfg))
+
+
+# ---------------------------------------------------------------- pod churn
+
+
+def _oracle_active(inc, cfg):
+    """Oracle reach over the live pods, in slot order (== reach_active)."""
+    return _full(inc.as_cluster(), cfg)
+
+
+def test_pod_add_matches_oracle(setup):
+    cluster, cfg, inc = setup
+    ns = inc.pods[0].namespace
+    idx = inc.add_pod(
+        kv.Pod("churn-a", ns, dict(inc.pods[0].labels), ip="10.9.9.9")
+    )
+    assert idx == len(cluster.pods)  # took the first headroom slot
+    np.testing.assert_array_equal(inc.reach_active(), _oracle_active(inc, cfg))
+    # and with labels the frozen vocab has never seen
+    inc.add_pod(kv.Pod("churn-b", ns, {"never": "seen-pair"}))
+    np.testing.assert_array_equal(inc.reach_active(), _oracle_active(inc, cfg))
+
+
+def test_pod_remove_matches_oracle(setup):
+    cluster, cfg, inc = setup
+    victim = inc.pods[3]
+    idx = inc.remove_pod(victim.namespace, victim.name)
+    assert idx == 3 and not inc.pod_active[3]
+    assert inc.n_active == len(cluster.pods) - 1
+    np.testing.assert_array_equal(inc.reach_active(), _oracle_active(inc, cfg))
+    # the tombstoned row/column must be fully zero in the raw matrix
+    raw = inc.reach
+    assert not raw[3].any() and not raw[:, 3].any()
+    # removing again raises; relabelling a tombstone raises
+    with pytest.raises(KeyError):
+        inc.remove_pod(victim.namespace, victim.name)
+    with pytest.raises(KeyError):
+        inc.update_pod_labels(3, {"a": "b"})
+
+
+def test_pod_slot_reuse_and_policy_interaction(setup):
+    """A removed slot is recycled by the next add; policies added AFTER the
+    churn must see the new pod (and never the tombstone)."""
+    cluster, cfg, inc = setup
+    victim = inc.pods[5]
+    inc.remove_pod(victim.namespace, victim.name)
+    idx = inc.add_pod(kv.Pod("recycled", victim.namespace, {"role": "fresh"}))
+    assert idx == 5  # recycled the tombstoned slot
+    pol = kv.NetworkPolicy(
+        name="sel-fresh",
+        namespace=victim.namespace,
+        pod_selector=kv.Selector({"role": "fresh"}),
+        ingress=(),
+    )
+    inc.add_policy(pol)
+    np.testing.assert_array_equal(inc.reach_active(), _oracle_active(inc, cfg))
+    assert inc.packed_reach().ingress_isolated[5]
+
+
+def test_pod_headroom_growth():
+    """Exhausting the pod headroom grows the pod axis in place."""
+    cluster = random_cluster(
+        GeneratorConfig(n_pods=120, n_policies=5, n_namespaces=2, seed=55)
+    )
+    cfg = kv.VerifyConfig(compute_ports=False)
+    inc = PackedIncrementalVerifier(cluster, cfg)
+    assert inc._n_padded == 128  # 8 headroom slots before a grow
+    before = inc._n_padded
+    for i in range(12):
+        inc.add_pod(kv.Pod(f"grow-{i}", "ns-0", {"app": f"g{i}"}))
+    assert inc._n_padded > before
+    assert inc.n_active == 132
+    np.testing.assert_array_equal(inc.reach_active(), _oracle_active(inc, cfg))
+
+
+def test_pod_headroom_param():
+    cluster = random_cluster(
+        GeneratorConfig(n_pods=100, n_policies=4, n_namespaces=2, seed=56)
+    )
+    cfg = kv.VerifyConfig(compute_ports=False)
+    inc = PackedIncrementalVerifier(cluster, cfg, pod_headroom=300)
+    assert inc._n_padded >= 400
+    for i in range(250):
+        inc.add_pod(kv.Pod(f"hr-{i}", "ns-0", {"app": "hr"}))
+    assert inc._n_padded == 512  # no growth happened
+    np.testing.assert_array_equal(inc.reach_active(), _oracle_active(inc, cfg))
+
+
+def test_fuzzed_pod_and_policy_churn():
+    """Interleaved pod add/remove/relabel + policy add/remove/update must
+    track the CPU oracle at every step."""
+    cluster = random_cluster(
+        GeneratorConfig(n_pods=37, n_policies=6, n_namespaces=3, seed=60)
+    )
+    cfg = kv.VerifyConfig(compute_ports=False)
+    inc = PackedIncrementalVerifier(cluster, cfg)
+    donor = random_cluster(
+        GeneratorConfig(n_pods=30, n_policies=18, n_namespaces=3, seed=61)
+    )
+    rng = random.Random(4)
+    added = 0
+    for step in range(16):
+        op = rng.choice(["add_pod", "rm_pod", "relabel", "add_pol", "rm_pol"])
+        if op == "add_pod":
+            src = donor.pods[added % len(donor.pods)]
+            inc.add_pod(
+                kv.Pod(f"fz-{added}", src.namespace, dict(src.labels), ip=src.ip)
+            )
+            added += 1
+        elif op == "rm_pod" and inc.n_active > 5:
+            idx = rng.choice(list(inc.active_indices()))
+            p = inc.pods[idx]
+            inc.remove_pod(p.namespace, p.name)
+        elif op == "relabel":
+            idx = rng.choice(list(inc.active_indices()))
+            inc.update_pod_labels(idx, {"fz": f"v{step}", "env": "x"})
+        elif op == "add_pol":
+            p = donor.policies[step % len(donor.policies)]
+            key = f"{p.namespace}/fzp-{step}"
+            inc.add_policy(dataclasses.replace(p, name=f"fzp-{step}"))
+        elif op == "rm_pol" and inc.policies:
+            key = rng.choice(sorted(inc.policies))
+            ns, name = key.split("/", 1)
+            inc.remove_policy(ns, name)
+        np.testing.assert_array_equal(
+            inc.reach_active(), _oracle_active(inc, cfg), err_msg=f"step {step}"
+        )
+
+
+@pytest.mark.parametrize("shape", [(4, 2), (2, 4)])
+def test_mesh_sharded_pod_churn(shape):
+    from kubernetes_verification_tpu.parallel.mesh import mesh_for
+
+    cluster = random_cluster(
+        GeneratorConfig(n_pods=61, n_policies=9, n_namespaces=3, seed=62)
+    )
+    cfg = kv.VerifyConfig(compute_ports=False)
+    inc = PackedIncrementalVerifier(cluster, cfg, mesh=mesh_for(shape))
+    inc.add_pod(kv.Pod("mesh-new", inc.pods[0].namespace, {"m": "1"}))
+    victim = inc.pods[7]
+    inc.remove_pod(victim.namespace, victim.name)
+    np.testing.assert_array_equal(inc.reach_active(), _oracle_active(inc, cfg))
+    # growth on a mesh keeps the sharded layout working
+    for i in range(80):
+        inc.add_pod(kv.Pod(f"mesh-g{i}", "ns-0", {"app": "mg"}))
+    np.testing.assert_array_equal(inc.reach_active(), _oracle_active(inc, cfg))
+
+
+def test_matrix_free_pod_churn():
+    from kubernetes_verification_tpu.ops.tiled import unpack_cols
+    from kubernetes_verification_tpu.parallel.mesh import mesh_for
+
+    cluster = random_cluster(
+        GeneratorConfig(n_pods=61, n_policies=9, n_namespaces=3, seed=63)
+    )
+    cfg = kv.VerifyConfig(compute_ports=False)
+    inc = PackedIncrementalVerifier(
+        cluster, cfg, mesh=mesh_for((4, 2)), keep_matrix=False
+    )
+    inc.add_pod(kv.Pod("mf-new", inc.pods[0].namespace, {"m": "1"}))
+    victim = inc.pods[9]
+    inc.remove_pod(victim.namespace, victim.name)
+    assert inc.dirty_rows.any() and inc.dirty_cols.any()
+    ref = _oracle_active(inc, cfg)
+    act = inc.active_indices()
+    full = unpack_cols(inc.solve_stripe(0, inc._n_padded), inc.n_pods)
+    np.testing.assert_array_equal(full[np.ix_(act, act)], ref)
+    # tombstoned row/column is zero even in a fresh stripe solve
+    assert not full[9].any() and not full[:, 9].any()
+
+
+def test_checkpoint_resume_with_pod_churn(tmp_path):
+    from kubernetes_verification_tpu.utils.persist import (
+        load_packed_incremental,
+        save_packed_incremental,
+    )
+
+    cluster = random_cluster(
+        GeneratorConfig(n_pods=43, n_policies=7, n_namespaces=3, seed=64)
+    )
+    cfg = kv.VerifyConfig(compute_ports=False)
+    inc = PackedIncrementalVerifier(cluster, cfg)
+    inc.add_pod(kv.Pod("ck-new", inc.pods[0].namespace, {"ck": "v"}))
+    victim = inc.pods[11]
+    inc.remove_pod(victim.namespace, victim.name)
+    before = inc.reach_active().copy()
+
+    d = str(tmp_path / "ckpt")
+    save_packed_incremental(inc, d)
+    res = load_packed_incremental(d)
+    assert res.n_active == inc.n_active
+    assert not res.pod_active[11]
+    np.testing.assert_array_equal(res.reach_active(), before)
+    # churn continues after resume: the tombstone slot is recycled
+    idx = res.add_pod(kv.Pod("post-ck", "ns-0", {"p": "c"}))
+    assert idx == 11
+    res.remove_policy(*sorted(res.policies)[0].split("/", 1))
+    np.testing.assert_array_equal(res.reach_active(), _oracle_active(res, cfg))
+
+
+def test_tombstone_row_stays_zero_after_policy_diff(setup):
+    """Regression (review): a policy diff's column patch recomputes every
+    source row for the touched dst columns — tombstoned rows must stay zero
+    (default-allow would otherwise mark the dead pod egress-open)."""
+    cluster, cfg, inc = setup
+    victim = inc.pods[4]
+    inc.remove_pod(victim.namespace, victim.name)
+    # broad policy: selects every pod in its ns, allows ingress from all
+    inc.add_policy(
+        kv.NetworkPolicy(
+            name="broad",
+            namespace=victim.namespace,
+            pod_selector=kv.Selector({}),
+            ingress=(kv.Rule(peers=()),),
+        )
+    )
+    raw = inc.reach
+    assert not raw[4].any() and not raw[:, 4].any()
+    np.testing.assert_array_equal(inc.reach_active(), _oracle_active(inc, cfg))
+    # relabel another pod (row+col patch path) — tombstone still zero
+    inc.update_pod_labels(6, {"re": "label"})
+    raw = inc.reach
+    assert not raw[4].any() and not raw[:, 4].any()
+    np.testing.assert_array_equal(inc.reach_active(), _oracle_active(inc, cfg))
+
+
+def test_packed_queries_tombstone_aware():
+    """all_reachable/all_isolated must neutralise tombstoned slots rather
+    than letting their all-zero rows poison the word reductions."""
+    cluster = random_cluster(
+        GeneratorConfig(n_pods=12, n_policies=0, n_namespaces=1, seed=90)
+    )
+    cfg = kv.VerifyConfig(compute_ports=False)
+    inc = PackedIncrementalVerifier(cluster, cfg)
+    pr = inc.packed_reach()
+    assert pr.all_reachable() == list(range(12))  # no policies: full mesh
+    assert pr.all_isolated() == []
+    p = inc.pods[5]
+    inc.remove_pod(p.namespace, p.name)
+    pr = inc.packed_reach()
+    live = [i for i in range(12) if i != 5]
+    assert pr.all_reachable() == live
+    assert pr.all_isolated() == []
