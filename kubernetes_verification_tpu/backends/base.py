@@ -66,8 +66,10 @@ class VerifyConfig:
     #: ``kano_py/kano/model.py:59-68``): an object with
     #: ``match(rule_value, label_value) -> bool``; None = string equality.
     #: Honored by ``verify_kano`` backends; k8s-mode selectors follow the
-    #: Kubernetes API spec and reject a custom relation.
-    label_relation: Optional[object] = None
+    #: Kubernetes API spec and reject a custom relation. Keyword-only so its
+    #: insertion (round 3) never silently reorders positional callers that
+    #: were passing ``backend_options`` by position.
+    label_relation: Optional[object] = field(default=None, kw_only=True)
     #: extra, backend-specific options (e.g. mesh shape for ``sharded``)
     backend_options: Tuple[Tuple[str, object], ...] = ()
 

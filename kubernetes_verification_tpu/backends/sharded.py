@@ -40,8 +40,8 @@ class ShardedBackend(VerifierBackend):
     def _resolve_mesh(self, config: VerifyConfig) -> jax.sharding.Mesh:
         if self._mesh is not None:
             return self._mesh
-        shape = config.opt("mesh")
-        return mesh_for(tuple(shape) if shape is not None else None)
+        # mesh_for normalises: None, a bare int (``--opt mesh=8``), or (dp, mp)
+        return mesh_for(config.opt("mesh"))
 
     def verify(self, cluster: Cluster, config: VerifyConfig) -> VerifyResult:
         mesh = self._resolve_mesh(config)

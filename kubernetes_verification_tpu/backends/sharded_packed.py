@@ -114,8 +114,8 @@ class ShardedPackedBackend(VerifierBackend):
     def _resolve_mesh(self, config: VerifyConfig) -> jax.sharding.Mesh:
         if self._mesh is not None:
             return self._mesh
-        shape = config.opt("mesh")
-        return mesh_for(tuple(shape) if shape is not None else None)
+        # mesh_for normalises: None, a bare int (``--opt mesh=8``), or (dp, mp)
+        return mesh_for(config.opt("mesh"))
 
     def verify(self, cluster: Cluster, config: VerifyConfig) -> VerifyResult:
         keep_matrix = config.opt("keep_matrix")

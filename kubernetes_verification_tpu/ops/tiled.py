@@ -849,8 +849,15 @@ class PackedReach:
 
     def system_isolation(self, idx: int) -> List[int]:
         """Pods NOT reachable from pod ``idx`` — the row complement
-        (``kano/algorithm.py:45-55``); unpacks one row only."""
-        return np.nonzero(~self.row(idx))[0].tolist()
+        (``kano/algorithm.py:45-55``); unpacks one row only. Tombstoned
+        dsts are dropped; a tombstoned src is an error, not "isolated
+        from everything"."""
+        if self.active is not None and not self.active[idx]:
+            raise ValueError(
+                f"pod slot {idx} is tombstoned (removed); "
+                "system_isolation needs a live pod"
+            )
+        return self._live_dsts(~self.row(idx))
 
     def closure(self, tile: int = 512, max_iter: int = 32) -> "PackedReach":
         """Transitive closure in the packed domain (``ops/closure.py``'s
@@ -879,7 +886,21 @@ class PackedReach:
         from .queries import user_groups
 
         gid = user_groups(objs, label)
-        if gid.shape[0] != self.n_pods:
+        if self.active is not None and gid.shape[0] != self.n_pods:
+            # churned matrix: accept the natural live-pod list (what
+            # as_cluster() yields) and map it onto the live slots; tombstone
+            # slots land in group 0 but their all-zero rows/cols can never
+            # contribute to or be flagged by the ORs
+            live = np.nonzero(self.active[: self.n_pods])[0]
+            if gid.shape[0] != live.shape[0]:
+                raise ValueError(
+                    f"user_crosscheck: {gid.shape[0]} objects != "
+                    f"{self.n_pods} pod slots or {live.shape[0]} live pods"
+                )
+            full = np.zeros(self.n_pods, dtype=gid.dtype)
+            full[live] = gid
+            gid = full
+        elif gid.shape[0] != self.n_pods:
             raise ValueError(
                 f"user_crosscheck: {gid.shape[0]} objects != {self.n_pods} pods"
             )
@@ -897,7 +918,10 @@ class PackedReach:
                     self.packed[: self.n_pods], jnp.asarray(gid), n_groups
                 )
             )
-        return _crosscheck_from_group_or(group_or, gid, self.n_pods)
+        res = _crosscheck_from_group_or(group_or, gid, self.n_pods)
+        if self.active is None:
+            return res
+        return [i for i in res if self.active[i]]
 
 
 @partial(jax.jit, static_argnames=("chunk",))

@@ -1021,28 +1021,10 @@ class PackedIncrementalVerifier:
         invalid = np.nonzero(~self._col_valid)[0]
         if not len(invalid):
             return
-        idx = np.int32(invalid[-1])
         zeros_c = np.zeros((4, self._capacity), dtype=np.int8)
-        if self._packed is None:
-            out = _pod_step_mf(
-                *self._maps, self._col_mask, self._row_valid,
-                idx, self._put(zeros_c, "rep"), np.uint32(0),
-            )
-            (
-                self._sel_ing8, self._sel_eg8, self._ing_by_pol,
-                self._eg_by_pol, self._ing_cnt, self._eg_cnt,
-                self._col_mask, self._row_valid,
-            ) = out
-        else:
-            out = _pod_step(
-                self._packed, *self._maps, self._col_mask, self._row_valid,
-                idx, self._put(zeros_c, "rep"), np.uint32(0), **self._flags,
-            )
-            (
-                self._packed, self._sel_ing8, self._sel_eg8,
-                self._ing_by_pol, self._eg_by_pol, self._ing_cnt,
-                self._eg_cnt, self._col_mask, self._row_valid,
-            ) = out
+        self._dispatch_pod(
+            int(invalid[-1]), zeros_c, active=False, bookkeep=False
+        )
 
     # ------------------------------------------------------------- plumbing
     def _key(self, pol: NetworkPolicy) -> str:
@@ -1312,8 +1294,12 @@ class PackedIncrementalVerifier:
         self.update_count += 1
 
     # ------------------------------------------------------------ pod churn
-    def _dispatch_pod(self, idx: int, cols4: np.ndarray, active: bool) -> None:
-        """One fused pod-slot dispatch (occupy or tombstone)."""
+    def _dispatch_pod(
+        self, idx: int, cols4: np.ndarray, active: bool, *, bookkeep: bool = True
+    ) -> None:
+        """One fused pod-slot dispatch (occupy or tombstone). ``bookkeep``
+        is False only for the prewarm no-op (a tombstone-over-tombstone
+        write whose slot may lie beyond the dirty arrays)."""
         if self._packed is None:
             out = _pod_step_mf(
                 *self._maps, self._col_mask, self._row_valid,
@@ -1325,8 +1311,9 @@ class PackedIncrementalVerifier:
                 self._eg_by_pol, self._ing_cnt, self._eg_cnt,
                 self._col_mask, self._row_valid,
             ) = out
-            self.dirty_rows[idx] = True
-            self.dirty_cols[idx] = True
+            if bookkeep:
+                self.dirty_rows[idx] = True
+                self.dirty_cols[idx] = True
         else:
             out = _pod_step(
                 self._packed, *self._maps, self._col_mask, self._row_valid,
@@ -1338,7 +1325,8 @@ class PackedIncrementalVerifier:
                 self._ing_by_pol, self._eg_by_pol, self._ing_cnt,
                 self._eg_cnt, self._col_mask, self._row_valid,
             ) = out
-        self.update_count += 1
+        if bookkeep:
+            self.update_count += 1
 
     def add_pod(self, pod: Pod) -> int:
         """Add a pod in O(P + N) — one fused device dispatch. Returns the

@@ -18,7 +18,7 @@ problem dimensions —
 """
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+from typing import Optional, Sequence, Tuple, Union
 
 import jax
 import numpy as np
@@ -30,7 +30,7 @@ GRANT_AXIS = "grants"
 
 
 def mesh_for(
-    shape: Optional[Tuple[int, int]] = None,
+    shape: Optional[Union[int, Tuple[int, int]]] = None,
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> jax.sharding.Mesh:
     """Build a ``(pods, grants)`` mesh.
@@ -38,11 +38,14 @@ def mesh_for(
     ``shape=None`` puts every device on the pod axis — the right default
     because the N×N matrix dominates memory and the pod axis dominates FLOPs.
     An explicit ``(dp, mp)`` factorisation spreads the grant stack too (useful
-    when P·G is the large dimension, e.g. many policies over few pods).
+    when P·G is the large dimension, e.g. many policies over few pods). A bare
+    int ``n`` (what ``--opt mesh=8`` parses to) means ``(n, 1)``.
     """
     devices = list(devices if devices is not None else jax.devices())
     if shape is None:
         shape = (len(devices), 1)
+    elif isinstance(shape, int):
+        shape = (shape, 1)
     dp, mp = shape
     if dp * mp != len(devices):
         raise ValueError(f"mesh shape {shape} != {len(devices)} devices")

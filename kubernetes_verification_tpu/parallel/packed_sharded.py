@@ -183,7 +183,11 @@ class PackedShardedResult:
 
     def to_bool(self) -> np.ndarray:
         if self.packed is None:
-            raise ValueError("solve ran with keep_matrix=False")
+            raise ValueError(
+                "solve ran matrix-free (keep_matrix=False): the dense matrix "
+                "is unavailable; re-run with keep_matrix=True or query the "
+                "aggregates"
+            )
         self._require_full("to_bool")
         return unpack_cols(self.packed, self.n_pods)
 

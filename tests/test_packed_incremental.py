@@ -608,3 +608,43 @@ def test_packed_queries_tombstone_aware():
     live = [i for i in range(12) if i != 5]
     assert pr.all_reachable() == live
     assert pr.all_isolated() == []
+
+
+def test_churned_queries_tombstone_aware():
+    # review r4: system_isolation must drop tombstoned dsts / reject a
+    # tombstoned src; user_crosscheck must accept the live-pod list.
+    cluster = random_cluster(
+        GeneratorConfig(n_pods=24, n_policies=5, n_namespaces=2, seed=71)
+    )
+    cfg = kv.VerifyConfig(compute_ports=False)
+    inc = PackedIncrementalVerifier(cluster, cfg)
+    inc.remove_pod(inc.pods[5].namespace, inc.pods[5].name)
+    pr = inc.packed_reach()
+    assert 5 not in pr.system_isolation(0)
+    with pytest.raises(ValueError, match="tombstoned"):
+        pr.system_isolation(5)
+    # live-pod list (what as_cluster() yields) maps onto slots
+    live_pods = inc.as_cluster().pods
+    assert len(live_pods) == 23
+    got = pr.user_crosscheck(live_pods, "app")
+    # slot-ordered full list answers identically
+    slot_pods = [
+        p if a else dataclasses.replace(p, labels={})
+        for p, a in zip(inc.pods, inc.pod_active)
+    ]
+    assert pr.user_crosscheck(slot_pods, "app") == got
+    # oracle: dense matrix over active pods only
+    from kubernetes_verification_tpu.ops.queries import user_groups
+
+    act = inc.active_indices()
+    dense = inc.reach_active()
+    gid = user_groups(live_pods, "app")
+    expect = []
+    for j in range(len(act)):
+        other = (gid != gid[j]) & dense[:, j]
+        if other.any():
+            expect.append(int(act[j]))
+    assert got == expect
+    assert 5 not in got
+    with pytest.raises(ValueError, match="pods"):
+        pr.user_crosscheck(live_pods[:-1], "app")

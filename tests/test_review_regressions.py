@@ -99,3 +99,37 @@ def test_load_incremental_rejects_flag_flip(tmp_path):
         ),
     )
     assert resumed.config.backend == "tpu"
+
+
+def test_mesh_opt_accepts_bare_int():
+    # ADVICE r3: ``--opt mesh=8`` parses to a bare int; mesh_for must wrap it
+    # as (n, 1) instead of crashing with "'int' object is not iterable".
+    from kubernetes_verification_tpu.parallel.mesh import mesh_for
+
+    m = mesh_for(1, devices=[__import__("jax").devices()[0]])
+    assert dict(m.shape) == {"pods": 1, "grants": 1}
+
+
+def test_matrix_free_to_bool_guided_error():
+    # ADVICE r3: edges()/to_bool() on a matrix-free result must raise the
+    # same guided keep_matrix ValueError as reachable(), not a TypeError.
+    from kubernetes_verification_tpu.parallel.mesh import mesh_for
+    from kubernetes_verification_tpu.parallel.packed_sharded import (
+        sharded_packed_reach,
+    )
+
+    cluster = kv.Cluster(
+        pods=[kv.Pod(f"p{i}", "x", {"k": str(i)}) for i in range(9)]
+    )
+    enc = encode_cluster(cluster, compute_ports=False)
+    pk = sharded_packed_reach(mesh_for(), enc, keep_matrix=False)
+    with pytest.raises(ValueError, match="keep_matrix"):
+        pk.to_bool()
+
+
+def test_verify_config_positional_tail_is_backend_options():
+    # ADVICE r3: label_relation (round 3) is keyword-only so callers passing
+    # backend_options positionally keep their pre-round-3 meaning.
+    c = kv.VerifyConfig("cpu", True, True, True, True, False, (("mesh", 2),))
+    assert c.backend_options == (("mesh", 2),)
+    assert c.label_relation is None
