@@ -228,16 +228,42 @@ def bench_incremental(args) -> None:
         inc.remove_policy(p.namespace, p.name)
     jax.block_until_ready(inc._packed)
     piped["remove"] = (time.perf_counter() - s) / k
+    # pod churn (cluster evolution): same pipelined-burst pattern — pods
+    # churn far more than policies in real clusters, so their slot-mechanism
+    # latency is part of the config-5 serving story
+    from kubernetes_verification_tpu.models.core import Pod
+
+    ns0 = cluster.pods[0].namespace
+    kp = 8
+    pipe_pods = [
+        Pod(f"bench-pod-{i}", ns0, {"app": f"bench{i % 3}", "env": "prod"})
+        for i in range(kp)
+    ]
+    s = time.perf_counter()
+    idxs = [inc.add_pod(p) for p in pipe_pods]
+    jax.block_until_ready(inc._packed)
+    piped["pod_add"] = (time.perf_counter() - s) / kp
+    s = time.perf_counter()
+    for i, idx in enumerate(idxs):
+        inc.update_pod_labels(idx, {"app": "relab", "env": f"e{i}"})
+    jax.block_until_ready(inc._packed)
+    piped["pod_relabel"] = (time.perf_counter() - s) / kp
+    s = time.perf_counter()
+    for p in pipe_pods:
+        inc.remove_pod(ns0, p.name)
+    jax.block_until_ready(inc._packed)
+    piped["pod_remove"] = (time.perf_counter() - s) / kp
     overall_piped = statistics.median(sorted(piped.values()))
     log(
-        "pipelined (burst of 10, one sync): "
+        "pipelined (bursts, one sync each): "
         + "  ".join(f"{kk} {v * 1e3:.1f}ms" for kk, v in piped.items())
     )
     print(
         json.dumps(
             {
                 "metric": (
-                    f"incremental policy diff (add/update/remove, pipelined), "
+                    f"incremental diff (policy add/update/remove + pod "
+                    f"add/relabel/remove, pipelined), "
                     f"{n} pods / {args.policies} policies, "
                     f"{'port bitmaps' if with_ports else 'any-port'}, "
                     "packed state, 1 chip"

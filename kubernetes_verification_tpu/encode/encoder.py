@@ -178,6 +178,14 @@ class GrantBlock:
     ports: np.ndarray  # bool [G, Q]
     ip_match: Optional[np.ndarray] = None  # bool [G, N] | None
     dst_restrict: Optional[np.ndarray] = None  # int32 [G] | None (= all 0)
+    #: provenance back to the policy object: originating rule index within
+    #: the policy's direction tuple, and peer index within that rule's
+    #: ``peers`` (−1 = match-all rule). Survives run-splitting and padding;
+    #: the incremental engines use it to re-evaluate single pods against a
+    #: grant row with OBJECT semantics (frozen-vocab evaluation is unsound
+    #: for labels the frozen encoding never saw).
+    rule_id: Optional[np.ndarray] = None  # int32 [G] | None
+    peer_id: Optional[np.ndarray] = None  # int32 [G] | None
 
     @property
     def n(self) -> int:
@@ -263,13 +271,16 @@ def _encode_grants(
     restricts: List[int] = []
     ip_rows: Dict[int, np.ndarray] = {}
 
+    rule_ids: List[int] = []
+    peer_ids: List[int] = []
+
     n = len(pods)
     Q = len(atoms)
     for pi, pol in enumerate(policies):
         rules = pol.ingress if direction == "ingress" else pol.egress
         if not rules:
             continue
-        for rule in rules:
+        for ri, rule in enumerate(rules):
             # rule_port_mask ignores port specs when atoms == [ALL_ATOM];
             # in resolution mode it covers the numeric specs only — named
             # specs become extra single-atom variants with a dst restriction
@@ -291,9 +302,11 @@ def _encode_grants(
                         onehot = np.zeros(Q, dtype=bool)
                         onehot[q] = True
                         variants.append((onehot, rid))
-            def emit_row(mask, rid, peer=None, ip_row=None):
+            def emit_row(mask, rid, peer=None, ip_row=None, peer_i=-1, rule_i=ri):
                 g = len(pols)
                 pols.append(pi)
+                rule_ids.append(rule_i)
+                peer_ids.append(peer_i)
                 if peer is None:  # match-all rule
                     match_all.append(True)
                     pod_sels.append(None)
@@ -320,7 +333,7 @@ def _encode_grants(
                 for mask, rid in variants:
                     emit_row(mask, rid)
             else:
-                for peer in rule.peers:
+                for qi, peer in enumerate(rule.peers):
                     # the ipBlock↔pod-IP row is O(N) Python — compute it
                     # once per peer and share it across the port variants
                     ip_row = (
@@ -332,7 +345,7 @@ def _encode_grants(
                         else None
                     )
                     for mask, rid in variants:
-                        emit_row(mask, rid, peer, ip_row)
+                        emit_row(mask, rid, peer, ip_row, peer_i=qi)
 
     G = len(pols)
     ip_match = None
@@ -355,6 +368,8 @@ def _encode_grants(
         dst_restrict=(
             np.asarray(restricts, dtype=np.int32) if any_restrict else None
         ),
+        rule_id=np.asarray(rule_ids, dtype=np.int32),
+        peer_id=np.asarray(peer_ids, dtype=np.int32),
     )
 
 

@@ -295,9 +295,10 @@ class IncrementalVerifier:
 
         old = row_col_sums()
         pod.labels = dict(labels)
-        # the frozen device encoding no longer reflects this pod; later
-        # policy re-encodes must fix its entries up on host
-        self._vectorizer.dirty.add(idx)
+        # re-index the pod in the vectorizer (or dirty-mark it when its new
+        # labels fall outside the frozen universe) so later policy
+        # re-encodes see the change
+        self._vectorizer.note_pod(idx)
         from .packed_incremental import pod_policy_flags
 
         for key, pol in self.policies.items():

@@ -45,6 +45,8 @@ jax.tree_util.register_dataclass(
         "ports",
         "ip_match",
         "dst_restrict",
+        "rule_id",
+        "peer_id",
     ],
     meta_fields=[],
 )

@@ -194,24 +194,38 @@ class PolicyVectorizer:
         self.ns_index = dict(ns_index)
         self.direction_aware = direction_aware
         self.n = len(pods)
-        #: pods whose labels changed after the encoding was frozen
+        #: pods whose labels/namespace fall outside the frozen universe —
+        #: these re-evaluate object-level on every later policy (re-)encode
         self.dirty: set = set()
         #: removed pod slots — their vectors are forced to 0 so a later
         #: policy re-encode can never resurrect a tombstoned pod
         self.inactive: set = set()
-        # inverted indices over the FROZEN pod labels: pair/key/ns → pod ids
+        #: namespaces known at freeze time: pods churned into them can be
+        #: re-indexed in place; later-created namespaces have no row in the
+        #: frozen namespace matrices, so their pods stay dirty
+        self._n_frozen_ns = len(self.ns_index)
+        # inverted indices over the (frozen, then churn-patched) pod labels:
+        # pair/key/ns → pod ids, plus the per-pod reverse entries that make
+        # single-pod re-indexing O(labels)
         pair_pods: Dict[int, List[int]] = {}
         key_pods: Dict[int, List[int]] = {}
         ns_pods: Dict[int, List[int]] = {}
+        self._pod_entries: Dict[int, Tuple[List[int], List[int], int]] = {}
         for i, pod in enumerate(pods):
-            ns_pods.setdefault(self.ns_index.get(pod.namespace, -3), []).append(i)
+            ns_idx = self.ns_index.get(pod.namespace, -3)
+            ns_pods.setdefault(ns_idx, []).append(i)
+            pairs: List[int] = []
+            keyids: List[int] = []
             for k, v in pod.labels.items():
                 pid = vocab.pair(k, v)
                 if pid is not None:
                     pair_pods.setdefault(pid, []).append(i)
+                    pairs.append(pid)
                 kid = vocab.key(k)
                 if kid is not None:
                     key_pods.setdefault(kid, []).append(i)
+                    keyids.append(kid)
+            self._pod_entries[i] = (pairs, keyids, ns_idx)
         as_arr = lambda d: {
             k: np.asarray(v, dtype=np.int64) for k, v in d.items()
         }
@@ -323,15 +337,66 @@ class PolicyVectorizer:
                 v[i] = False
         return tuple(v.astype(np.int8) for v in out)
 
+    def _strip(self, idx: int) -> None:
+        """Remove pod ``idx`` from every inverted index (O(labels) via the
+        reverse entry)."""
+        e = self._pod_entries.pop(idx, None)
+        if e is None:
+            return
+        pairs, keyids, ns_idx = e
+        for pid in pairs:
+            a = self._pair_pods.get(pid)
+            if a is not None:
+                self._pair_pods[pid] = a[a != idx]
+        for kid in keyids:
+            a = self._key_pods.get(kid)
+            if a is not None:
+                self._key_pods[kid] = a[a != idx]
+        a = self._ns_pods.get(ns_idx)
+        if a is not None:
+            self._ns_pods[ns_idx] = a[a != idx]
+
     def note_pod(self, idx: int) -> None:
-        """Register pod slot ``idx`` as (re)occupied: the live ``self.pods``
-        list already holds the new Pod; it is evaluated object-level via the
-        dirty set (its labels may carry pairs the frozen vocab never saw)."""
+        """Register pod slot ``idx`` as (re)occupied or relabeled: the live
+        ``self.pods`` list already holds the new Pod. When its namespace and
+        every label pair/key lie inside the frozen universe (the common
+        churn), the inverted indices are patched in place and the pod costs
+        NOTHING on later policy diffs; otherwise it joins the permanent
+        object-semantics dirty set (a frozen-vocab evaluation would be
+        unsound — e.g. a later policy selecting a pair the vocab never saw
+        encodes as ``impossible`` and must be fixed up against this pod)."""
         self.n = len(self.pods)
-        self.dirty.add(idx)
         self.inactive.discard(idx)
+        self._strip(idx)
+        pod = self.pods[idx]
+        ns_idx = self.ns_index.get(pod.namespace, -3)
+        clean = 0 <= ns_idx < self._n_frozen_ns
+        pairs: List[int] = []
+        keyids: List[int] = []
+        for k, v in pod.labels.items():
+            pid = self.vocab.pair(k, v)
+            kid = self.vocab.key(k)
+            if pid is None or kid is None:
+                clean = False
+                break
+            pairs.append(pid)
+            keyids.append(kid)
+        if not clean:
+            self.dirty.add(idx)
+            return
+        self.dirty.discard(idx)
+        add = lambda d, key: d.__setitem__(
+            key, np.append(d.get(key, self._empty), np.int64(idx))
+        )
+        for pid in pairs:
+            add(self._pair_pods, pid)
+        for kid in keyids:
+            add(self._key_pods, kid)
+        add(self._ns_pods, ns_idx)
+        self._pod_entries[idx] = (pairs, keyids, ns_idx)
 
     def note_removed(self, idx: int) -> None:
+        self._strip(idx)
         self.inactive.add(idx)
         self.dirty.discard(idx)
 
@@ -1273,7 +1338,7 @@ class PackedIncrementalVerifier:
             raise KeyError(f"pod slot {idx} is not an active pod")
         pod = self.pods[idx]
         pod.labels = dict(labels)
-        self._vectorizer.dirty.add(idx)
+        self._vectorizer.note_pod(idx)
         cols = self._pod_cols(pod)
         out = _apply_pod_col(
             *self._maps,
@@ -1346,6 +1411,10 @@ class PackedIncrementalVerifier:
         pod = dataclasses.replace(
             pod, labels=dict(pod.labels), container_ports=dict(pod.container_ports)
         )
+        # the host evaluation can raise (e.g. a malformed pod IP against an
+        # ipBlock peer) — run it BEFORE any bookkeeping mutation so a failed
+        # add leaves no phantom half-registered pod
+        cols4 = self._pod_cols(pod)
         if self._pod_free:
             idx = self._pod_free.pop()
             self.pods[idx] = pod
@@ -1364,7 +1433,6 @@ class PackedIncrementalVerifier:
         self._pod_idx[key] = idx
         self._col_valid[idx] = True
         self._vectorizer.note_pod(idx)
-        cols4 = self._pod_cols(pod)
         self._h_ing_cnt[idx] = int(cols4[0].sum())
         self._h_eg_cnt[idx] = int(cols4[1].sum())
         self._dispatch_pod(idx, cols4, active=True)

@@ -27,11 +27,26 @@ vectors per (mask, restriction) group via the posting-list vectorizer, a
 VP-row write, and port-aware row/column patches — O(total_vp · N · |touched|)
 device work.
 
+**Pod churn** (add / remove / relabel) mirrors the any-port engine's slot
+mechanism on the pod axis: padded columns (+ ``pod_headroom``) are free pod
+slots, removals tombstone in place, adds recycle. One churn is an O(total_vp)
+HOST evaluation of the pod against every VP row — object semantics against
+the policy objects, addressed through the grant rows' ``rule_id``/``peer_id``
+provenance (``encode/encoder.py``), because frozen-vocab evaluation is
+unsound for labels the frozen encoding never saw — followed by ONE fused
+device dispatch (``_ports_pod_step``) that writes the pod's column across the
+four VP maps, its isolation counts, its validity bits, and recomputes exactly
+its own packed row + bit-column under full port semantics. Named-port
+resolution is per-pod state: an added pod's restriction-bank column is
+re-derived from its ``container_ports`` (and baked into its VP-map column),
+and a pod whose declared ports resolve a referenced name OUTSIDE the frozen
+restriction bank raises instead of silently dropping edges.
+
 Frozen-universe boundaries (all raise ``PortUniverseChanged`` with rebuild
 guidance rather than degrade silently): a diff whose port specs need a new
 atom boundary, a new run-split mask, a new named-port restriction, or more
-rows than a segment's headroom; pod relabels (they move named-port
-resolution and every VP row's selection column); pod add/remove.
+rows than a segment's headroom; a pod whose named-port declarations resolve
+outside the frozen bank.
 """
 from __future__ import annotations
 
@@ -251,7 +266,7 @@ def _ports_reach_block(
 )
 def _ports_patch_rows(
     packed, vp_peers_i, sel_ing_vp, sel_eg_vp, vp_peers_e, ing_cnt, eg_cnt,
-    col_mask, rows, *, layout, self_traffic, default_allow,
+    col_mask, row_valid, rows, *, layout, self_traffic, default_allow,
 ):
     Np = sel_ing_vp.shape[1]
     r = _ports_reach_block(
@@ -261,6 +276,7 @@ def _ports_patch_rows(
         rows=rows,
         layout=layout, self_traffic=self_traffic, default_allow=default_allow,
     )
+    r &= (jnp.take(row_valid, rows) > 0)[:, None]
     return packed.at[rows].set(pack_bool_cols(r) & col_mask[None, :])
 
 
@@ -271,7 +287,8 @@ def _ports_patch_rows(
 )
 def _ports_patch_cols(
     packed, vp_peers_i, sel_ing_vp, sel_eg_vp, vp_peers_e, ing_cnt, eg_cnt,
-    cols, seg, words, wreal, clear, *, layout, self_traffic, default_allow,
+    row_valid, cols, seg, words, wreal, clear,
+    *, layout, self_traffic, default_allow,
 ):
     """Exact-column patch under port semantics; the word-merge tail is the
     same delta-add scheme as the any-port ``_cols_body``."""
@@ -284,6 +301,10 @@ def _ports_patch_cols(
         cols=cols,
         layout=layout, self_traffic=self_traffic, default_allow=default_allow,
     )
+    # tombstoned/padded source rows stay zero — without this a diff would
+    # resurrect bits in a removed pod's row (its eg_cnt is 0, so
+    # default-allow marks it egress-open)
+    r &= row_valid[:, None] > 0
     bits = r.astype(_U32) << (cols % 32).astype(_U32)[None, :]
     set_words = jax.ops.segment_sum(bits.T, seg, num_segments=Dw + 1)[:Dw].T
     old_words = jnp.take(packed, words, axis=1)
@@ -298,7 +319,7 @@ def _ports_patch_cols(
 )
 def _ports_sweep(
     vp_peers_i, sel_ing_vp, sel_eg_vp, vp_peers_e, ing_cnt, eg_cnt, col_mask,
-    *, layout, tile, self_traffic, default_allow,
+    row_valid, *, layout, tile, self_traffic, default_allow,
 ):
     """Full dst-tile sweep from the resident VP operands → packed uint32
     [Np, W] (init + full-resweep fallback)."""
@@ -322,6 +343,7 @@ def _ports_sweep(
 
     out = jnp.zeros((Np, W), dtype=_U32)
     out = jax.lax.fori_loop(0, Np // tile, body, out)
+    out &= jnp.where(row_valid > 0, _U32(0xFFFFFFFF), _U32(0))[:, None]
     return out & col_mask[None, :]
 
 
@@ -345,6 +367,76 @@ def _vp_write(
     )
 
 
+@partial(
+    jax.jit,
+    donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7, 8),
+    static_argnames=("layout", "self_traffic", "default_allow"),
+)
+def _ports_pod_step(
+    packed,
+    vp_peers_i,
+    sel_ing_vp,
+    sel_eg_vp,
+    vp_peers_e,
+    ing_cnt,
+    eg_cnt,
+    col_mask,
+    row_valid,
+    idx,  # int32 — the pod slot
+    ci,  # int8 [2, Ti] — (peer, sel·bank) ingress column values
+    ce,  # int8 [2, Te] — (sel, peer·bank) egress column values
+    cnt_i,  # int32 — the pod's policy-level ingress isolation count
+    cnt_e,  # int32
+    active,  # uint32 0/1 — 1 = add/occupy/relabel, 0 = remove/tombstone
+    *,
+    layout,
+    self_traffic: bool,
+    default_allow: bool,
+):
+    """One fused pod add/remove/relabel under port semantics: write the
+    pod's column across all four VP maps, set its isolation counts, flip its
+    validity bits, and recompute exactly its own packed row and its own
+    bit-column — the port-mode mirror of the any-port ``_pod_step`` (a pod
+    only contributes its own row/column to the matrix)."""
+    vp_peers_i = vp_peers_i.at[:, idx].set(ci[0])
+    sel_ing_vp = sel_ing_vp.at[:, idx].set(ci[1])
+    sel_eg_vp = sel_eg_vp.at[:, idx].set(ce[0])
+    vp_peers_e = vp_peers_e.at[:, idx].set(ce[1])
+    ing_cnt = ing_cnt.at[idx].set(cnt_i)
+    eg_cnt = eg_cnt.at[idx].set(cnt_e)
+    w = idx // 32
+    bit = jnp.uint32(1) << (idx % 32).astype(_U32)
+    col_mask = col_mask.at[w].set((col_mask[w] & ~bit) | (bit * active))
+    row_valid = row_valid.at[idx].set(active.astype(_I8))
+    Np = sel_ing_vp.shape[1]
+    idxv = jnp.reshape(idx, (1,))
+    operands = (vp_peers_i, sel_ing_vp, sel_eg_vp, vp_peers_e)
+    # the pod's own row, against the NEW operands and NEW column mask
+    r_row = _ports_reach_block(
+        operands, ing_cnt, jnp.take(eg_cnt, idxv),
+        idxv, jnp.arange(Np, dtype=jnp.int32),
+        rows=idxv,
+        layout=layout, self_traffic=self_traffic, default_allow=default_allow,
+    )  # [1, Np]
+    packed = packed.at[idxv].set(
+        pack_bool_cols(r_row) & (col_mask[None, :] * active)
+    )
+    # the pod's own bit-column, for every (valid) source row
+    r_col = _ports_reach_block(
+        operands, jnp.take(ing_cnt, idxv), eg_cnt,
+        jnp.arange(Np, dtype=jnp.int32), idxv,
+        cols=idxv,
+        layout=layout, self_traffic=self_traffic, default_allow=default_allow,
+    )  # [Np, 1]
+    r_colb = r_col[:, 0] & (row_valid > 0)
+    newbit = (r_colb.astype(_U32) << (idx % 32).astype(_U32)) * active
+    packed = packed.at[:, w].set((packed[:, w] & ~bit) | newbit)
+    return (
+        packed, vp_peers_i, sel_ing_vp, sel_eg_vp, vp_peers_e,
+        ing_cnt, eg_cnt, col_mask, row_valid,
+    )
+
+
 class PackedPortsIncrementalVerifier:
     """Port-bitmap reachability under policy add/remove/update."""
 
@@ -358,11 +450,15 @@ class PackedPortsIncrementalVerifier:
         chunk: int = 2048,
         max_port_masks: int = 32,
         mesh: Optional[jax.sharding.Mesh] = None,
+        pod_headroom: int = 0,
     ) -> None:
         """``mesh``: shard the VP operands (VP axis over ``grants``, pod
         axis over ``pods``), counts and the packed matrix over a (pods,
         grants) mesh — the diff kernels then run SPMD via jit sharding
-        propagation, composing configs 4 and 5 fully."""
+        propagation, composing configs 4 and 5 fully. ``pod_headroom``:
+        extra free pod slots padded into the matrix at build time (pod
+        churn beyond the built-in pad-to-alignment slack then avoids the
+        expensive in-place grow)."""
         self.config = config or VerifyConfig()
         self.mesh = mesh
         self.device = device or (None if mesh else jax.devices()[0])
@@ -387,7 +483,9 @@ class PackedPortsIncrementalVerifier:
             self._bank_intern.frozen = True
         n = enc.n_pods
         self.n_pods = n
-        Np = max(128, -(-n // 128) * 128)
+        if pod_headroom < 0:
+            raise ValueError("pod_headroom must be >= 0")
+        Np = max(128, -(-(n + pod_headroom) // 128) * 128)
         self._n_padded = Np
         self._tile = next(
             t for t in (tile, 512, 256, 128) if t <= Np and Np % t == 0
@@ -398,12 +496,18 @@ class PackedPortsIncrementalVerifier:
         )
         self._ns_kv = enc.ns_kv
         self._ns_key = enc.ns_key
-        col_valid = np.zeros(Np, dtype=bool)
-        col_valid[:n] = True
+        self.pod_active = np.ones(n, dtype=bool)
+        self._pod_free: List[int] = []
+        self._pod_idx = {self._pod_key(p): i for i, p in enumerate(self.pods)}
+        self._col_valid = np.zeros(Np, dtype=bool)
+        self._col_valid[:n] = True
         self._col_mask = self._put(
-            np.packbits(col_valid, bitorder="little").view("<u4").copy(),
+            np.packbits(self._col_valid, bitorder="little").view("<u4").copy(),
             "rep",
         )
+        rv = np.zeros(Np, dtype=np.int8)
+        rv[:n] = 1
+        self._row_valid = self._put(rv, "vec")
         if enc.restrict_bank is not None:
             bank8 = np.zeros((enc.restrict_bank.shape[0], Np), dtype=np.int8)
             bank8[:, :n] = enc.restrict_bank
@@ -485,6 +589,7 @@ class PackedPortsIncrementalVerifier:
         self._eg_cnt = self._put(out[5], "vec")
         self._packed = _ports_sweep(
             *self._operands, self._ing_cnt, self._eg_cnt, self._col_mask,
+            self._row_valid,
             layout=layout, tile=self._tile,
             self_traffic=cfg.self_traffic,
             default_allow=cfg.default_allow_unselected,
@@ -516,6 +621,30 @@ class PackedPortsIncrementalVerifier:
                             d
                         ].append(row)
                 self._free_rows[d][s_idx] = free
+        # per-row churn caches: the named-port restriction each row bakes in
+        # plus the (rule, peer) provenance of its peer union — a single-pod
+        # churn evaluates the pod object against exactly these (object
+        # semantics; the frozen vocab may never have seen the pod's labels)
+        self._row_res: Dict[str, Dict[int, int]] = {"i": {}, "e": {}}
+        self._row_peers: Dict[str, Dict[int, set]] = {"i": {}, "e": {}}
+        for d, vp_res, block, vp_slot in (
+            ("i", np.asarray(vp_res_i), ingress, vp_slot_i),
+            ("e", np.asarray(vp_res_e), egress, vp_slot_e),
+        ):
+            for row in self._row_owner[d]:
+                self._row_res[d][row] = int(vp_res[row])
+            gpol = np.asarray(block.pol)
+            grid = np.asarray(block.rule_id)
+            gpid = np.asarray(block.peer_id)
+            slots = np.asarray(vp_slot)
+            for g in range(len(gpol)):
+                if gpol[g] >= P:
+                    continue  # pad / sink-owned rows
+                row = int(slots[g])
+                if row in self._row_owner[d]:
+                    self._row_peers[d].setdefault(row, set()).add(
+                        (int(grid[g]), int(gpid[g]))
+                    )
         for i, pol in enumerate(cluster.policies):
             key = keys[i]
             if key in self.policies:
@@ -561,9 +690,21 @@ class PackedPortsIncrementalVerifier:
         meta0 = _PIV._col_meta(c0, 0)
         self._packed = _ports_patch_cols(
             self._packed, *self._operands, self._ing_cnt, self._eg_cnt,
+            self._row_valid,
             self._put(c0, "rep"), *(self._put(m, "rep") for m in meta0),
             layout=self._layout, **self._flags,
         )
+        # compile the pod-churn kernel via a no-op: a tombstone-over-
+        # tombstone write on an invalid slot (skipped when every slot is
+        # valid — the first real add_pod then grows, which recompiles anyway)
+        invalid = np.nonzero(~self._col_valid)[0]
+        if len(invalid):
+            self._dispatch_pod(
+                int(invalid[-1]),
+                np.zeros((2, int(self._vp_peers_i.shape[0])), dtype=np.int8),
+                np.zeros((2, int(self._sel_eg_vp.shape[0])), dtype=np.int8),
+                0, 0, active=False, bookkeep=False,
+            )
         jax.block_until_ready(self._packed)
 
     # ------------------------------------------------------------- plumbing
@@ -581,6 +722,10 @@ class PackedPortsIncrementalVerifier:
 
     def _key(self, pol: NetworkPolicy) -> str:
         return f"{pol.namespace}/{pol.name}"
+
+    @staticmethod
+    def _pod_key(pod: Pod) -> str:
+        return f"{pod.namespace}/{pod.name}"
 
     @property
     def _flags(self) -> dict:
@@ -650,12 +795,50 @@ class PackedPortsIncrementalVerifier:
                                 "rebuild the verifier"
                             )
 
+    def _object_selected(self, pol: NetworkPolicy, pod: Pod) -> bool:
+        return pod.namespace == pol.namespace and pol.pod_selector.matches(
+            pod.labels
+        )
+
+    def _peer_matches(
+        self, pol: NetworkPolicy, rules, rid: int, pid: int, pod: Pod
+    ) -> bool:
+        """Object-semantics evaluation of ONE flattened (rule, peer) against
+        ONE pod — the ports-engine counterpart of ``pod_policy_flags``'s
+        ``peer_one``, addressed through grant-row provenance."""
+        if pid < 0:  # match-all rule
+            return True
+        peer = rules[rid].peers[pid]
+        if peer.ip_block is not None:
+            return peer.ip_block.matches_ip(pod.ip)
+        if peer.namespace_selector is None:
+            ns_ok = pod.namespace == pol.namespace
+        else:
+            ns_ok = peer.namespace_selector.matches(
+                self._ns_labels.get(pod.namespace, {})
+            )
+        return ns_ok and (
+            peer.pod_selector is None or peer.pod_selector.matches(pod.labels)
+        )
+
+    def _fix_sel(self, pol: NetworkPolicy, sel: np.ndarray) -> np.ndarray:
+        """Object-semantics fixups for churned pods: the vectorizer's
+        posting lists are frozen, so dirty (relabeled/added) pods re-evaluate
+        object-level and tombstoned pods force to False."""
+        vz = self._vectorizer
+        for i in vz.dirty:
+            sel[i] = self._object_selected(pol, self.pods[i])
+        for i in vz.inactive:
+            sel[i] = False
+        return sel
+
     def _policy_groups(
         self, pol: NetworkPolicy
     ) -> Tuple[np.ndarray, np.ndarray, Dict, Dict]:
         """Host evaluation of one policy under the frozen port universe:
         (sel_ing, sel_eg) policy-level vectors + per-direction
-        {(segment, restrict): peer-union vector} group dicts."""
+        {(segment, restrict): (peer-union vector, (rule, peer) provenance)}
+        group dicts."""
         self._check_ports_representable(pol)
         vz = self._vectorizer
         try:
@@ -668,15 +851,21 @@ class PackedPortsIncrementalVerifier:
                 f"policy {self._key(pol)} needs a named-port restriction "
                 f"outside the frozen bank ({e}); rebuild the verifier"
             )
-        sel = vz._sel_mask(delta.pod_sel, 0) & vz._ns_mask(delta.pol_ns)
+        sel = self._fix_sel(
+            pol, vz._sel_mask(delta.pod_sel, 0) & vz._ns_mask(delta.pol_ns)
+        )
         da = self.config.direction_aware_isolation
         aff_i = delta.affects_ingress if da else True
         aff_e = delta.affects_egress if da else True
         sel_ing = sel & aff_i
         sel_eg = sel & aff_e
 
-        def direction_groups(block: GrantBlock, aff: bool) -> Dict:
-            out: Dict[Tuple[int, int], np.ndarray] = {}
+        def direction_groups(block: GrantBlock, aff: bool, rules) -> Dict:
+            out: Dict[Tuple[int, int], Tuple[np.ndarray, frozenset]] = {}
+            # dirty-pod fixups cache per (rule, peer, pod): a rule whose
+            # port specs split into v variants emits v grant rows sharing
+            # one (rid, pid) — evaluate each dirty pod once, not v times
+            pm_cache: Dict[Tuple[int, int, int], bool] = {}
             if not aff or block.n == 0:
                 return out
             block = _split_grant_ports(block)
@@ -702,11 +891,32 @@ class PackedPortsIncrementalVerifier:
                         )
                 key = (seg, int(restricts[g]))
                 peers = self._grant_row_peers(block, g, delta.pol_ns)
-                out[key] = out.get(key, np.zeros(self.n_pods, bool)) | peers
+                rid = int(block.rule_id[g])
+                pid = int(block.peer_id[g])
+                if vz.dirty or vz.inactive:
+                    # frozen posting lists: out-of-universe pods re-evaluate
+                    # with object semantics
+                    for i in vz.dirty:
+                        ck = (rid, pid, i)
+                        hit = pm_cache.get(ck)
+                        if hit is None:
+                            hit = self._peer_matches(
+                                pol, rules, rid, pid, self.pods[i]
+                            )
+                            pm_cache[ck] = hit
+                        peers[i] = hit
+                    for i in vz.inactive:
+                        peers[i] = False
+                prov = frozenset({(rid, pid)})
+                if key in out:
+                    ovec, oprov = out[key]
+                    out[key] = (ovec | peers, oprov | prov)
+                else:
+                    out[key] = (peers, prov)
             return out
 
-        groups_i = direction_groups(delta.ingress, aff_i)
-        groups_e = direction_groups(delta.egress, aff_e)
+        groups_i = direction_groups(delta.ingress, aff_i, pol.ingress)
+        groups_e = direction_groups(delta.egress, aff_e, pol.egress)
         return sel_ing, sel_eg, groups_i, groups_e
 
     # ---------------------------------------------------------------- diffs
@@ -726,7 +936,7 @@ class PackedPortsIncrementalVerifier:
             by_seg.setdefault(self._seg_of_row(d, row), []).append(row)
         taken: Dict[int, int] = {}
         assigned = {}
-        for (seg, res), vec in groups.items():
+        for (seg, res), (vec, prov) in groups.items():
             pool = by_seg.get(seg, [])
             free = self._free_rows[d][seg]
             used = taken.get(seg, 0)
@@ -741,23 +951,28 @@ class PackedPortsIncrementalVerifier:
                     "has no free virtual-policy rows left; rebuild the "
                     "verifier (or construct it with more headroom)"
                 )
-            assigned[row] = (res, vec)
+            assigned[row] = (res, vec, prov)
         return assigned
 
     def _commit_rows(
         self, d: str, key: str, assigned: Dict, old_rows: List[int]
     ) -> List[int]:
         """Apply a planned allocation: release the policy's old rows, claim
-        the assigned ones; returns the freed-but-not-reused rows."""
+        the assigned ones (recording their restriction + peer provenance for
+        pod churn); returns the freed-but-not-reused rows."""
         for row in old_rows:
             del self._row_owner[d][row]
             self._free_rows[d][self._seg_of_row(d, row)].append(row)
+            self._row_res[d].pop(row, None)
+            self._row_peers[d].pop(row, None)
         self._pol_rows[key][d] = []
-        for row in assigned:
+        for row, (res, _vec, prov) in assigned.items():
             free = self._free_rows[d][self._seg_of_row(d, row)]
             free.remove(row)
             self._row_owner[d][row] = key
             self._pol_rows[key][d].append(row)
+            self._row_res[d][row] = int(res)
+            self._row_peers[d][row] = set(prov)
         return [r for r in old_rows if r not in assigned]
 
     def _apply(self, old_sel, new_sel, assigned_i, assigned_e,
@@ -794,7 +1009,7 @@ class PackedPortsIncrementalVerifier:
             vals = np.zeros((2, cap, Np), dtype=np.int8)
             for j, row in enumerate(touched[:k]):
                 if row in assigned:
-                    res, peer_vec = assigned[row]
+                    res, peer_vec, _ = assigned[row]
                     bank_row = self._bank8_host[res][:n] > 0
                     if is_ingress:
                         vals[0, j, :n] = peer_vec
@@ -830,13 +1045,14 @@ class PackedPortsIncrementalVerifier:
         for idx, _ in _groups(rows, _ROW_GROUP):
             self._packed = _ports_patch_rows(
                 self._packed, *self._operands, self._ing_cnt, self._eg_cnt,
-                self._col_mask, self._put(idx, "rep"),
+                self._col_mask, self._row_valid, self._put(idx, "rep"),
                 layout=self._layout, **self._flags,
             )
         for idx, creal in _groups(cols, _COL_GROUP):
             meta = _PIV._col_meta(idx, int(creal.sum()))
             self._packed = _ports_patch_cols(
                 self._packed, *self._operands, self._ing_cnt, self._eg_cnt,
+                self._row_valid,
                 self._put(idx, "rep"), *(self._put(m, "rep") for m in meta),
                 layout=self._layout, **self._flags,
             )
@@ -849,8 +1065,10 @@ class PackedPortsIncrementalVerifier:
         from .encode.encoder import _encode_selector_stack
 
         stack = _encode_selector_stack([pol.pod_selector], vz.vocab)
-        sel = vz._sel_mask(stack, 0) & vz._ns_mask(
-            vz.ns_index.get(pol.namespace, -2)
+        sel = self._fix_sel(
+            pol,
+            vz._sel_mask(stack, 0)
+            & vz._ns_mask(vz.ns_index.get(pol.namespace, -2)),
         )
         da = self.config.direction_aware_isolation
         aff_i = pol.affects_ingress if da else True
@@ -904,13 +1122,260 @@ class PackedPortsIncrementalVerifier:
         self._apply((old_si, old_se), (new_si, new_se),
                     assigned_i, assigned_e, freed_i, freed_e)
 
-    def update_pod_labels(self, idx: int, labels: Dict[str, str]) -> None:
-        raise PortUniverseChanged(
-            "pod relabels under port semantics move named-port resolution "
-            "and every VP row's selection column; rebuild the verifier (or "
-            "use the any-port PackedIncrementalVerifier for relabel-heavy "
-            "workloads)"
+    # ------------------------------------------------------------ pod churn
+    def _pod_bank_col(self, pod: Pod, strict: bool = False) -> np.ndarray:
+        """bool [B]: which restriction-bank rows this pod belongs to — its
+        single-pod ``named_resolution`` (``encode/ports.py``). Row 0 is the
+        unrestricted row, always True. ``strict`` (the add-time check)
+        raises ``PortUniverseChanged`` when a referenced (protocol, name)
+        resolves outside the frozen bank — the bank is baked into
+        device-resident VP rows and cannot grow, so rules naming that port
+        would otherwise silently miss this destination. Non-strict callers
+        (relabels — labels cannot move resolution) never hit that case:
+        every already-admitted pod's resolution was interned at init or
+        checked at add time."""
+        col = np.zeros(self._bank8_host.shape[0], dtype=bool)
+        col[0] = True
+        ids = self._bank_intern._ids if self._bank_intern is not None else {}
+        for proto, name in self._resolution or {}:
+            entry = pod.container_ports.get(name)
+            if entry is None or entry[0] != proto:
+                continue
+            num = int(entry[1])
+            rid = None
+            for q, atom in enumerate(self._atoms):
+                if (
+                    atom.name is None
+                    and atom.protocol == proto
+                    and atom.lo <= num <= atom.hi
+                ):
+                    rid = ids.get((proto, name, q))
+                    break
+            if rid is None:
+                if strict:
+                    raise PortUniverseChanged(
+                        f"pod {self._pod_key(pod)} resolves named port "
+                        f"({proto}, {name}) -> {num} outside the frozen "
+                        "restriction bank; rebuild the verifier"
+                    )
+            else:
+                col[rid] = True
+        return col
+
+    def _pod_vp_cols(self, pod: Pod, strict_bank: bool = False):
+        """One pod's column across the four VP maps + its policy-level
+        isolation counts — O(total_vp + P) host evaluation with object
+        semantics. Peer results are cached per (policy, direction, rule,
+        peer) since one peer typically feeds several port-variant rows."""
+        Ti = int(self._vp_peers_i.shape[0])
+        Te = int(self._sel_eg_vp.shape[0])
+        ci = np.zeros((2, Ti), dtype=np.int8)  # (peer, sel·bank)
+        ce = np.zeros((2, Te), dtype=np.int8)  # (sel, peer·bank)
+        bank_col = self._pod_bank_col(pod, strict=strict_bank)
+        da = self.config.direction_aware_isolation
+        cnt_i = cnt_e = 0
+        sel_flags: Dict[str, Tuple[bool, bool, bool, bool]] = {}
+        for key, pol in self.policies.items():
+            aff_i = pol.affects_ingress if da else True
+            aff_e = pol.affects_egress if da else True
+            selected = self._object_selected(pol, pod)
+            si = selected and aff_i
+            se = selected and aff_e
+            cnt_i += si
+            cnt_e += se
+            sel_flags[key] = (si, se, aff_i, aff_e)
+        pm_cache: Dict[Tuple[str, str, int, int], bool] = {}
+        for d in ("i", "e"):
+            for row, key in self._row_owner[d].items():
+                pol = self.policies[key]
+                si, se, aff_i, aff_e = sel_flags[key]
+                res = self._row_res[d][row]
+                rules = pol.ingress if d == "i" else pol.egress
+                aff = aff_i if d == "i" else aff_e
+                pm = False
+                if aff:
+                    for rid, pid in self._row_peers[d].get(row, ()):
+                        ck = (key, d, rid, pid)
+                        hit = pm_cache.get(ck)
+                        if hit is None:
+                            hit = self._peer_matches(pol, rules, rid, pid, pod)
+                            pm_cache[ck] = hit
+                        if hit:
+                            pm = True
+                            break
+                b = bool(bank_col[res])
+                if d == "i":
+                    ci[0, row] = pm
+                    ci[1, row] = si and b
+                else:
+                    ce[0, row] = se
+                    ce[1, row] = pm and b
+        return ci, ce, int(cnt_i), int(cnt_e), bank_col
+
+    def _dispatch_pod(
+        self,
+        idx: int,
+        ci: np.ndarray,
+        ce: np.ndarray,
+        cnt_i: int,
+        cnt_e: int,
+        active: bool,
+        *,
+        bookkeep: bool = True,
+    ) -> None:
+        """One fused pod-slot dispatch (occupy, relabel or tombstone).
+        ``bookkeep`` is False only for the prewarm no-op."""
+        out = _ports_pod_step(
+            self._packed, *self._operands, self._ing_cnt, self._eg_cnt,
+            self._col_mask, self._row_valid,
+            np.int32(idx), self._put(ci, "rep"), self._put(ce, "rep"),
+            np.int32(cnt_i), np.int32(cnt_e),
+            np.uint32(1 if active else 0),
+            layout=self._layout, **self._flags,
         )
+        (
+            self._packed, self._vp_peers_i, self._sel_ing_vp,
+            self._sel_eg_vp, self._vp_peers_e, self._ing_cnt, self._eg_cnt,
+            self._col_mask, self._row_valid,
+        ) = out
+        if bookkeep:
+            self.update_count += 1
+
+    def add_pod(self, pod: Pod) -> int:
+        """Add a pod in O(total_vp + P) host work + one fused device
+        dispatch. Returns the pod's slot index. Reuses a tombstoned slot
+        when one exists, then the built-in headroom (``pod_headroom`` +
+        pad-to-alignment), and only then grows the pod axis (expensive —
+        full state copy + kernel recompile)."""
+        key = self._pod_key(pod)
+        if key in self._pod_idx:
+            raise KeyError(f"pod {key} exists; remove it first")
+        pod = dataclasses.replace(
+            pod, labels=dict(pod.labels),
+            container_ports=dict(pod.container_ports),
+        )
+        # everything that can raise — the strict bank check, and peer
+        # evaluation (e.g. a malformed pod IP against an ipBlock peer) —
+        # runs BEFORE any bookkeeping mutation, so a failed add leaves no
+        # phantom half-registered pod
+        ci, ce, cnt_i, cnt_e, bank_col = self._pod_vp_cols(
+            pod, strict_bank=True
+        )
+        if pod.namespace not in self._ns_labels:
+            # auto-created namespace (empty labels), mirroring
+            # Cluster.__post_init__; fresh index, no frozen pods carry it
+            self._ns_labels[pod.namespace] = {}
+            vz = self._vectorizer
+            vz.ns_index.setdefault(pod.namespace, len(vz.ns_index))
+        if self._pod_free:
+            idx = self._pod_free.pop()
+            self.pods[idx] = pod
+            self.pod_active[idx] = True
+        else:
+            if self.n_pods >= self._n_padded:
+                self._grow_pods()
+            idx = self.n_pods
+            self.n_pods += 1
+            self.pods.append(pod)
+            self.pod_active = np.append(self.pod_active, True)
+            self._h_ing_cnt = np.append(self._h_ing_cnt, 0)
+            self._h_eg_cnt = np.append(self._h_eg_cnt, 0)
+        self._pod_idx[key] = idx
+        self._col_valid[idx] = True
+        self._vectorizer.note_pod(idx)
+        self._bank8_host[:, idx] = bank_col
+        self._h_ing_cnt[idx] = cnt_i
+        self._h_eg_cnt[idx] = cnt_e
+        self._dispatch_pod(idx, ci, ce, cnt_i, cnt_e, active=True)
+        return idx
+
+    def remove_pod(self, namespace: str, name: str) -> int:
+        """Remove a pod: tombstone its slot (zero column in every VP map,
+        zero isolation counts, clear validity, zero its packed row +
+        bit-column) in one fused dispatch. Returns the freed slot index."""
+        key = f"{namespace}/{name}"
+        idx = self._pod_idx.pop(key)  # KeyError if absent
+        self.pod_active[idx] = False
+        self._col_valid[idx] = False
+        self._pod_free.append(idx)
+        self._vectorizer.note_removed(idx)
+        self._h_ing_cnt[idx] = 0
+        self._h_eg_cnt[idx] = 0
+        self._dispatch_pod(
+            idx,
+            np.zeros((2, int(self._vp_peers_i.shape[0])), dtype=np.int8),
+            np.zeros((2, int(self._sel_eg_vp.shape[0])), dtype=np.int8),
+            0, 0, active=False,
+        )
+        return idx
+
+    def update_pod_labels(self, idx: int, labels: Dict[str, str]) -> None:
+        """Relabel pod ``idx`` in place: selector matches and peer
+        membership move (object-semantics re-evaluation of this one pod
+        against every VP row through the grant provenance); named-port
+        resolution depends on ``container_ports``, not labels, so the
+        restriction bank is unchanged. One fused dispatch — the operation
+        the pre-round-4 engine rejected with ``PortUniverseChanged``."""
+        if not 0 <= idx < self.n_pods or not self.pod_active[idx]:
+            raise KeyError(f"pod slot {idx} is not an active pod")
+        pod = self.pods[idx]
+        pod.labels = dict(labels)
+        self._vectorizer.note_pod(idx)
+        ci, ce, cnt_i, cnt_e, bank_col = self._pod_vp_cols(pod)
+        self._bank8_host[:, idx] = bank_col
+        self._h_ing_cnt[idx] = cnt_i
+        self._h_eg_cnt[idx] = cnt_e
+        self._dispatch_pod(idx, ci, ce, cnt_i, cnt_e, active=True)
+
+    def _grow_pods(self, min_extra: int = 1) -> None:
+        """Grow the pod axis by at least ``min_extra`` slots, keeping the
+        tile / packbits / mesh alignments. A grow copies every device buffer
+        and recompiles the kernels at the new shapes — prefer
+        ``pod_headroom`` at build time."""
+        from .parallel.mesh import POD_AXIS
+
+        dp = self.mesh.shape[POD_AXIS] if self.mesh is not None else 1
+        a = int(np.lcm(np.lcm(self._tile, 128), 128 * dp))
+        grow = max(-(-min_extra // a) * a, 2 * a)
+        Np2 = self._n_padded + grow
+        pod_pad = ((0, 0), (0, grow))
+        self._vp_peers_i = self._put(jnp.pad(self._vp_peers_i, pod_pad), "vp")
+        self._sel_ing_vp = self._put(jnp.pad(self._sel_ing_vp, pod_pad), "vp")
+        self._sel_eg_vp = self._put(jnp.pad(self._sel_eg_vp, pod_pad), "vp")
+        self._vp_peers_e = self._put(jnp.pad(self._vp_peers_e, pod_pad), "vp")
+        self._ing_cnt = self._put(jnp.pad(self._ing_cnt, (0, grow)), "vec")
+        self._eg_cnt = self._put(jnp.pad(self._eg_cnt, (0, grow)), "vec")
+        self._packed = self._put(
+            jnp.pad(self._packed, ((0, grow), (0, grow // 32))), "pods"
+        )
+        self._bank8_host = np.pad(self._bank8_host, pod_pad)
+        self._col_valid = np.concatenate(
+            [self._col_valid, np.zeros(grow, dtype=bool)]
+        )
+        self._col_mask = self._put(
+            np.packbits(self._col_valid, bitorder="little").view("<u4").copy(),
+            "rep",
+        )
+        rv = np.zeros(Np2, dtype=np.int8)
+        rv[: self.n_pods] = self.pod_active
+        self._row_valid = self._put(rv, "vec")
+        self._n_padded = Np2
+        self._prewarm()  # recompile the kernels at the new shapes
+
+    @property
+    def n_active(self) -> int:
+        return int(self.pod_active.sum())
+
+    def active_indices(self) -> np.ndarray:
+        """Slot indices of live pods, ascending — the row/col order of
+        :meth:`reach_active` and of ``as_cluster()``'s pod list."""
+        return np.nonzero(self.pod_active)[0]
+
+    def reach_active(self) -> np.ndarray:
+        """Dense bool reach over live pods only (host) — tombstoned slots
+        dropped; aligned with ``as_cluster()`` for oracle comparison."""
+        act = self.active_indices()
+        return self.reach[np.ix_(act, act)]
 
     # --------------------------------------------------------------- result
     def packed_reach(self) -> PackedReach:
@@ -920,18 +1385,24 @@ class PackedPortsIncrementalVerifier:
             n_pods=n,
             ingress_isolated=np.asarray(self._ing_cnt > 0)[:n],
             egress_isolated=np.asarray(self._eg_cnt > 0)[:n],
+            active=None if self.pod_active.all() else self.pod_active.copy(),
         )
 
     @property
     def reach(self) -> np.ndarray:
         return self.packed_reach().to_bool()
 
-    def as_cluster(self) -> Cluster:
+    def as_cluster(self, include_inactive: bool = False) -> Cluster:
+        """The live cluster (pods in slot order, tombstones dropped).
+        ``include_inactive=True`` keeps tombstoned pods in place — the
+        checkpoint manifest form, where list position must equal slot
+        index (paired with ``state_dict()``'s ``pod_active``)."""
         return Cluster(
             pods=[
                 Pod(p.name, p.namespace, dict(p.labels), p.ip,
                     dict(p.container_ports))
-                for p in self.pods
+                for i, p in enumerate(self.pods)
+                if include_inactive or self.pod_active[i]
             ],
             namespaces=list(self.namespaces),
             policies=list(self.policies.values()),
@@ -941,12 +1412,14 @@ class PackedPortsIncrementalVerifier:
     def state_dict(self) -> Tuple[Dict[str, np.ndarray], Dict]:
         """(arrays, meta) for checkpointing. Arrays: the four VP operands
         (bit-packed, trimmed to the pre-mesh-padding row counts), counts,
-        the packed matrix, and per-direction row-ownership vectors. Meta
+        the packed matrix, per-direction row-ownership / restriction /
+        (rule, peer)-provenance vectors, and the pod-slot activity map. Meta
         (JSON-serialisable): the frozen layout, atoms, the named-resolution
-        key set and the bank's interned key order — everything derived from
-        pods/namespaces re-derives deterministically on resume (relabels are
-        impossible in port mode, so the manifest labels ARE the frozen
-        labels)."""
+        key set and the bank's interned key order. The cluster manifest
+        (slot-ordered, tombstones kept in place) carries the CURRENT labels
+        and container ports — the maintained operands already reflect every
+        churn, so the resume re-freezes its vectorizer on those and starts
+        with an empty label-drift set."""
         keys = list(self.policies)
         key_id = {k: i for i, k in enumerate(keys)}
 
@@ -955,6 +1428,20 @@ class PackedPortsIncrementalVerifier:
             for row, key in self._row_owner[d].items():
                 out[row] = key_id[key]
             return out
+
+        def row_res(d: str) -> np.ndarray:
+            out = np.zeros(self._total_rows[d], dtype=np.int32)
+            for row, res in self._row_res[d].items():
+                out[row] = res
+            return out
+
+        def row_prov(d: str) -> np.ndarray:
+            flat = [
+                (row, rid, pid)
+                for row, prov in self._row_peers[d].items()
+                for rid, pid in sorted(prov)
+            ]
+            return np.asarray(flat, dtype=np.int32).reshape(-1, 3)
 
         pack = lambda m: np.packbits(
             np.asarray(m, dtype=np.uint8), axis=1, bitorder="little"
@@ -970,6 +1457,11 @@ class PackedPortsIncrementalVerifier:
             "packed": np.asarray(self._packed),
             "owners_i": owners("i"),
             "owners_e": owners("e"),
+            "res_i": row_res("i"),
+            "res_e": row_res("e"),
+            "prov_i": row_prov("i"),
+            "prov_e": row_prov("e"),
+            "pod_active": self.pod_active,
             "keys": np.array(keys),
         }
         bank_keys = (
@@ -1078,11 +1570,28 @@ class PackedPortsIncrementalVerifier:
         for i, row in enumerate(bank_rows):
             bank8[i, :n] = row
         self._bank8_host = bank8
-        col_valid = np.zeros(Np, dtype=bool)
-        col_valid[:n] = True
+        if "res_i" not in arrays or "prov_i" not in arrays:
+            raise ValueError(
+                "checkpoint predates pod-churn support (missing VP row "
+                "restriction/provenance vectors); re-save from a fresh build"
+            )
+        self.pod_active = np.asarray(
+            arrays.get("pod_active", np.ones(n, dtype=bool))
+        ).copy()
+        self._pod_free = [i for i in range(n) if not self.pod_active[i]]
+        self._pod_idx = {}
+        for i, p in enumerate(self.pods):
+            if self.pod_active[i]:
+                self._pod_idx.setdefault(self._pod_key(p), i)
+        self._col_valid = np.zeros(Np, dtype=bool)
+        self._col_valid[:n] = self.pod_active
         self._col_mask = self._put(
-            np.packbits(col_valid, bitorder="little").view("<u4").copy(), "rep"
+            np.packbits(self._col_valid, bitorder="little").view("<u4").copy(),
+            "rep",
         )
+        rv = np.zeros(Np, dtype=np.int8)
+        rv[:n] = self.pod_active
+        self._row_valid = self._put(rv, "vec")
 
         # ownership + free lists from the saved owner vectors
         keys = [str(k) for k in arrays["keys"]]
@@ -1095,8 +1604,11 @@ class PackedPortsIncrementalVerifier:
         self._free_rows = {"i": {}, "e": {}}
         self._row_owner = {"i": {}, "e": {}}
         self._pol_rows = {k: {"i": [], "e": []} for k in keys}
+        self._row_res = {"i": {}, "e": {}}
+        self._row_peers = {"i": {}, "e": {}}
         for d in ("i", "e"):
             owners = np.asarray(arrays[f"owners_{d}"])
+            res = np.asarray(arrays[f"res_{d}"])
             for s_idx, (start, length) in enumerate(self._seg_spans[d]):
                 free = []
                 for row in range(start, start + length):
@@ -1107,7 +1619,12 @@ class PackedPortsIncrementalVerifier:
                         key = keys[oid]
                         self._row_owner[d][row] = key
                         self._pol_rows[key][d].append(row)
+                        self._row_res[d][row] = int(res[row])
                 self._free_rows[d][s_idx] = free
+            for row, rid, pid in np.asarray(arrays[f"prov_{d}"]).reshape(-1, 3):
+                self._row_peers[d].setdefault(int(row), set()).add(
+                    (int(rid), int(pid))
+                )
 
         # device state (re-pad the VP axis for the target mesh)
         unpack = lambda m: np.unpackbits(
@@ -1135,6 +1652,9 @@ class PackedPortsIncrementalVerifier:
             self.pods, self._ns_labels, vocab, ns_index,
             self.config.direction_aware_isolation,
         )
+        self._vectorizer.inactive = {
+            i for i in range(n) if not self.pod_active[i]
+        }
         self._h_ing_cnt = np.asarray(arrays["ing_cnt"], dtype=np.int64)[:n]
         self._h_eg_cnt = np.asarray(arrays["eg_cnt"], dtype=np.int64)[:n]
         self.init_time = 0.0

@@ -92,6 +92,16 @@ def pad_grants(block: GrantBlock, pad: int, sink_pol: int, n_pad_pods: int) -> G
             if block.dst_restrict is not None
             else None
         ),
+        rule_id=(
+            pad_rows(block.rule_id, pad, fill=-1)
+            if block.rule_id is not None
+            else None
+        ),
+        peer_id=(
+            pad_rows(block.peer_id, pad, fill=-1)
+            if block.peer_id is not None
+            else None
+        ),
     )
 
 

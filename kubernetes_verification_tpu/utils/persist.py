@@ -277,7 +277,11 @@ def save_ports_incremental(inc, directory: str) -> None:
     from ..ingest import dump_cluster
 
     os.makedirs(directory, exist_ok=True)
-    dump_cluster(inc.as_cluster(), os.path.join(directory, "cluster"))
+    # slot-ordered manifest: tombstoned pods stay in place so list position
+    # == slot index on resume (paired with the saved pod_active map)
+    dump_cluster(
+        inc.as_cluster(include_inactive=True), os.path.join(directory, "cluster")
+    )
     arrays, meta = inc.state_dict()
     np.savez_compressed(
         os.path.join(directory, "state.npz"),

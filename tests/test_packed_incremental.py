@@ -648,3 +648,51 @@ def test_churned_queries_tombstone_aware():
     assert 5 not in got
     with pytest.raises(ValueError, match="pods"):
         pr.user_crosscheck(live_pods[:-1], "app")
+
+
+def test_in_vocab_churn_reindexes_instead_of_dirtying(setup):
+    """Review r4: churn whose labels/namespace stay inside the frozen
+    universe patches the inverted indices in place — the dirty set (which
+    costs object-level loops on every later policy diff) stays empty."""
+    cluster, cfg, inc = setup
+    donor_labels = dict(inc.pods[9].labels)
+    inc.update_pod_labels(2, donor_labels)
+    assert 2 not in inc._vectorizer.dirty
+    np.testing.assert_array_equal(inc.reach, _full(inc.as_cluster(), cfg))
+    # add with frozen-vocab labels into a frozen namespace: also clean
+    idx = inc.add_pod(kv.Pod("clean", inc.pods[0].namespace, donor_labels))
+    assert idx not in inc._vectorizer.dirty
+    np.testing.assert_array_equal(inc.reach_active(), _oracle_active(inc, cfg))
+    # a policy diff relying on the patched posting lists
+    inc.update_policy(
+        dataclasses.replace(
+            cluster.policies[0],
+            pod_selector=kv.Selector(dict(list(donor_labels.items())[:1]))
+            if donor_labels else kv.Selector(),
+        )
+    )
+    np.testing.assert_array_equal(inc.reach_active(), _oracle_active(inc, cfg))
+    # out-of-vocab labels still dirty-mark
+    inc.update_pod_labels(2, {"never": "seen"})
+    assert 2 in inc._vectorizer.dirty
+    np.testing.assert_array_equal(inc.reach_active(), _oracle_active(inc, cfg))
+
+
+def test_failed_add_pod_leaves_no_state(setup):
+    """Review r4: a pod whose evaluation raises (malformed IP against an
+    ipBlock peer) must not leave a phantom half-registered pod."""
+    cluster, cfg, inc = setup
+    ns = inc.pods[0].namespace
+    inc.add_policy(
+        kv.NetworkPolicy(
+            "ip-pol", namespace=ns, pod_selector=kv.Selector(),
+            ingress=(kv.Rule(peers=(kv.Peer(ip_block=kv.IpBlock("10.0.0.0/8")),)),),
+        )
+    )
+    before_n = inc.n_pods
+    with pytest.raises(ValueError):
+        inc.add_pod(kv.Pod("badip", ns, {"a": "b"}, ip="not-an-ip"))
+    assert inc.n_pods == before_n
+    assert f"{ns}/badip" not in inc._pod_idx
+    inc.add_pod(kv.Pod("goodip", ns, {"a": "b"}, ip="10.1.2.3"))
+    np.testing.assert_array_equal(inc.reach_active(), _oracle_active(inc, cfg))

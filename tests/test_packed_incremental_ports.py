@@ -190,10 +190,36 @@ def test_headroom_exhaustion_raises():
             )
 
 
-def test_relabel_rejected(setup):
+def test_relabel_matches_oracle(setup):
+    """Pod relabels patch in place under port semantics (the operation the
+    pre-round-4 engine rejected with ``PortUniverseChanged``)."""
     cluster, cfg, inc = setup
-    with pytest.raises(PortUniverseChanged, match="relabel"):
-        inc.update_pod_labels(0, {"x": "y"})
+    inc.update_pod_labels(0, {"x": "y"})
+    np.testing.assert_array_equal(inc.reach, _full(inc.as_cluster(), cfg))
+    # relabel to the labels of another pod (likely selected by policies)
+    inc.update_pod_labels(5, dict(inc.pods[11].labels))
+    np.testing.assert_array_equal(inc.reach, _full(inc.as_cluster(), cfg))
+
+
+def test_relabel_then_policy_diff_uses_dirty_fixup(setup):
+    """A pod relabelled to pairs the frozen vocab has never seen must still
+    be matched correctly by policies (re-)encoded afterwards — verbatim the
+    any-port engine's contract (``test_packed_incremental.py``)."""
+    cluster, cfg, inc = setup
+    inc.update_pod_labels(3, {"totally": "unseen", "fresh": "pair"})
+    np.testing.assert_array_equal(inc.reach, _full(inc.as_cluster(), cfg))
+    pol = kv.NetworkPolicy(
+        name="sel-unseen",
+        namespace=inc.pods[3].namespace,
+        pod_selector=kv.Selector({"totally": "unseen"}),
+        ingress=(
+            kv.Rule(peers=(kv.Peer(pod_selector=kv.Selector({"fresh": "pair"})),)),
+        ),
+    )
+    inc.add_policy(pol)
+    np.testing.assert_array_equal(inc.reach, _full(inc.as_cluster(), cfg))
+    # the new policy must actually bite: pod 3 became ingress-isolated
+    assert inc.packed_reach().ingress_isolated[3]
 
 
 def test_failed_update_leaves_state_intact():
@@ -326,3 +352,200 @@ def test_checkpoint_preserves_named_universe(tmp_path):
     res.add_policy(named)  # reintroduces the named spec: must stay in-universe
     np.testing.assert_array_equal(res.reach, _full(res.as_cluster(), cfg))
     assert res.reach[1, 0]
+
+
+# --------------------------------------------------------------- pod churn
+
+
+def test_pod_add_remove_matches_oracle(setup):
+    cluster, cfg, inc = setup
+    ns = inc.pods[0].namespace
+    idx = inc.add_pod(kv.Pod("fresh", ns, {"app": "fresh"}))
+    assert inc.pod_active[idx]
+    np.testing.assert_array_equal(inc.reach_active(), _active_oracle(inc, cfg))
+    victim = inc.pods[9]
+    inc.remove_pod(victim.namespace, victim.name)
+    np.testing.assert_array_equal(inc.reach_active(), _active_oracle(inc, cfg))
+    # re-add into the tombstoned slot, with container ports copied from a
+    # frozen pod (resolutions stay inside the frozen bank)
+    donor_ports = next(
+        (dict(p.container_ports) for p in inc.pods if p.container_ports), {}
+    )
+    idx2 = inc.add_pod(
+        kv.Pod("recycled", ns, {"app": "web"}, container_ports=donor_ports)
+    )
+    assert idx2 == 9  # slot reuse
+    np.testing.assert_array_equal(inc.reach_active(), _active_oracle(inc, cfg))
+
+
+def _active_oracle(inc, cfg):
+    return _full(inc.as_cluster(), cfg)
+
+
+def test_pod_named_port_resolution_enforced():
+    """An added pod whose container ports resolve a referenced name to an
+    atom outside the frozen bank must raise, not silently drop edges; one
+    resolving inside the bank must gate reach per destination."""
+    pods = [
+        kv.Pod("web-a", "prod", {"app": "web"},
+               container_ports={"http": ("TCP", 8080)}),
+        kv.Pod("client", "prod", {"app": "client"}),
+    ]
+    named = kv.NetworkPolicy(
+        "allow-http", namespace="prod",
+        pod_selector=kv.Selector({"app": "web"}),
+        ingress=(
+            kv.Rule(
+                peers=(kv.Peer(pod_selector=kv.Selector({"app": "client"})),),
+                ports=(kv.PortSpec("TCP", "http"),),
+            ),
+        ),
+    )
+    cluster = kv.Cluster(pods=pods, policies=[named])
+    cfg = kv.VerifyConfig(compute_ports=True)
+    inc = PackedPortsIncrementalVerifier(cluster, cfg)
+    # same resolution as web-a: in-universe, and reachable from the client
+    inc.add_pod(
+        kv.Pod("web-b", "prod", {"app": "web"},
+               container_ports={"http": ("TCP", 8080)})
+    )
+    np.testing.assert_array_equal(inc.reach, _full(inc.as_cluster(), cfg))
+    assert inc.reach[1, 2]
+    # resolves http to a number no frozen atom/bank row covers: must raise
+    with pytest.raises(PortUniverseChanged, match="restriction bank"):
+        inc.add_pod(
+            kv.Pod("web-c", "prod", {"app": "web"},
+                   container_ports={"http": ("TCP", 9999)})
+        )
+    assert "prod/web-c" not in inc._pod_idx  # failed add left no state
+    np.testing.assert_array_equal(inc.reach, _full(inc.as_cluster(), cfg))
+    # a pod NOT declaring the name is fine and unreachable via the rule
+    inc.add_pod(kv.Pod("web-d", "prod", {"app": "web"}))
+    np.testing.assert_array_equal(inc.reach, _full(inc.as_cluster(), cfg))
+    assert not inc.reach[1, 3]
+
+
+def test_fuzzed_pod_and_policy_churn_ports():
+    import random
+
+    cluster = _mk(seed=41, n_pods=43)
+    cfg = kv.VerifyConfig(compute_ports=True)
+    inc = PackedPortsIncrementalVerifier(
+        cluster, cfg, headroom=16, pod_headroom=8
+    )
+    donor = _mk(seed=42, n_policies=18)
+    rng = random.Random(3)
+    port_lib = [dict(p.container_ports) for p in cluster.pods] + [{}]
+    for step in range(18):
+        op = rng.choice(["add", "rm", "relabel", "add_pol", "rm_pol"])
+        if op == "add":
+            inc.add_pod(
+                kv.Pod(
+                    f"fz-{step}", rng.choice(inc.namespaces).name,
+                    {"app": f"fz{step % 4}", "env": "prod"},
+                    container_ports=rng.choice(port_lib),
+                )
+            )
+        elif op == "rm" and inc.n_active > 4:
+            idx = rng.choice(list(inc.active_indices()))
+            p = inc.pods[idx]
+            inc.remove_pod(p.namespace, p.name)
+        elif op == "relabel":
+            idx = rng.choice(list(inc.active_indices()))
+            inc.update_pod_labels(idx, {"fz": f"v{step}", "env": "x"})
+        elif op == "add_pol":
+            p = donor.policies[step % len(donor.policies)]
+            try:
+                inc.add_policy(dataclasses.replace(p, name=f"fzp-{step}"))
+            except PortUniverseChanged:
+                continue  # donor mask outside this cluster's universe: fine
+        elif op == "rm_pol" and inc.policies:
+            key = rng.choice(sorted(inc.policies))
+            ns, name = key.split("/", 1)
+            inc.remove_policy(ns, name)
+        np.testing.assert_array_equal(
+            inc.reach_active(), _active_oracle(inc, cfg),
+            err_msg=f"step {step} ({op})",
+        )
+
+
+def test_pod_headroom_growth_ports():
+    """Exhausting the pod headroom grows the pod axis in place."""
+    cluster = _mk(seed=51, n_pods=120)
+    cfg = kv.VerifyConfig(compute_ports=True)
+    inc = PackedPortsIncrementalVerifier(cluster, cfg)
+    assert inc._n_padded == 128
+    for i in range(12):  # 8 pad slots, then growth
+        inc.add_pod(kv.Pod(f"grow-{i}", "ns-0", {"app": f"g{i}"}))
+    assert inc._n_padded > 128
+    assert inc.n_active == 132
+    np.testing.assert_array_equal(inc.reach_active(), _active_oracle(inc, cfg))
+    inc.update_policy(
+        dataclasses.replace(
+            cluster.policies[0], ingress=cluster.policies[1].ingress
+        )
+    )
+    np.testing.assert_array_equal(inc.reach_active(), _active_oracle(inc, cfg))
+
+
+@pytest.mark.parametrize("shape", [(4, 2), (2, 4)])
+def test_mesh_sharded_pod_churn_ports(shape):
+    from kubernetes_verification_tpu.parallel.mesh import mesh_for
+
+    cluster = _mk(seed=61)
+    cfg = kv.VerifyConfig(compute_ports=True)
+    inc = PackedPortsIncrementalVerifier(cluster, cfg, mesh=mesh_for(shape))
+    inc.add_pod(kv.Pod("mesh-new", inc.pods[0].namespace, {"m": "1"}))
+    victim = inc.pods[7]
+    inc.remove_pod(victim.namespace, victim.name)
+    inc.update_pod_labels(3, dict(inc.pods[12].labels))
+    np.testing.assert_array_equal(inc.reach_active(), _active_oracle(inc, cfg))
+
+
+def test_checkpoint_resume_with_pod_churn_ports(tmp_path):
+    from kubernetes_verification_tpu.utils.persist import (
+        load_ports_incremental,
+        save_ports_incremental,
+    )
+
+    cluster = _mk(seed=71, n_pods=45)
+    cfg = kv.VerifyConfig(compute_ports=True)
+    inc = PackedPortsIncrementalVerifier(cluster, cfg)
+    inc.add_pod(kv.Pod("ck-new", inc.pods[0].namespace, {"ck": "v"}))
+    victim = inc.pods[11]
+    inc.remove_pod(victim.namespace, victim.name)
+    inc.update_pod_labels(4, {"ck": "relabeled"})
+    before = inc.reach_active().copy()
+
+    d = str(tmp_path / "ckpt")
+    save_ports_incremental(inc, d)
+    res = load_ports_incremental(d)
+    assert res.n_active == inc.n_active
+    assert not res.pod_active[11]
+    np.testing.assert_array_equal(res.reach_active(), before)
+    # churn continues tracking the oracle after resume — incl. slot reuse
+    # and a policy diff against a relabeled pod
+    res.add_pod(kv.Pod("post-resume", res.pods[0].namespace, {"ck": "v2"}))
+    np.testing.assert_array_equal(res.reach_active(), _active_oracle(res, cfg))
+    res.update_policy(
+        dataclasses.replace(
+            cluster.policies[0],
+            pod_selector=kv.Selector({"ck": "relabeled"}),
+        )
+    )
+    np.testing.assert_array_equal(res.reach_active(), _active_oracle(res, cfg))
+
+
+def test_tombstone_row_stays_zero_after_policy_diff_ports(setup):
+    """A policy diff recomputing columns must not resurrect bits in a
+    removed pod's row (its zero counts make it default-allow-open)."""
+    cluster, cfg, inc = setup
+    victim = inc.pods[2]
+    inc.remove_pod(victim.namespace, victim.name)
+    pol = cluster.policies[0]
+    inc.update_policy(
+        dataclasses.replace(pol, pod_selector=kv.Selector())
+    )
+    full = inc.reach
+    assert not full[2].any() and not full[:, 2].any()
+    np.testing.assert_array_equal(inc.reach_active(), _active_oracle(inc, cfg))
