@@ -4,9 +4,13 @@ Routes ``kv.verify()`` / the CLI through
 :func:`~..parallel.packed_sharded.sharded_packed_reach` — the bit-packed,
 dst-tile-streaming SPMD solver (any-port AND port-bitmap semantics via the
 mask-group decomposition) — so large-N solves no longer require importing the
-function API directly. The dense ``sharded`` backend remains for small/medium
-N where a full ``[N, N]`` bool result (plus per-atom ``reach_ports``, closure,
-and the per-policy src/dst sets) is wanted.
+function API directly. All six verification queries answer here: four on the
+packed/aggregate forms, the pairwise ``policy_shadow``/``policy_conflict``
+through lazily-computed sharded Gram masks
+(:func:`~..ops.tiled.policy_pair_masks_sharded`). The dense ``sharded``
+backend remains for small/medium N where a full ``[N, N]`` bool result (plus
+per-atom ``reach_ports`` and materialised per-policy src/dst sets) is
+wanted.
 
 Result shape: a :class:`ShardedPackedVerifyResult`. ``reach`` is materialised
 densely only up to ``dense_reach_limit`` pods (default 20k — beyond that a
@@ -26,7 +30,7 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass
-from typing import List, Optional, Sequence, Tuple
+from typing import Callable, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -58,6 +62,11 @@ class ShardedPackedVerifyResult(VerifyResult):
     #: packed transitive closure (uint32 [N, W]) when config.closure ran —
     #: present even above the dense-reach limit where ``closure`` stays None
     closure_packed: Optional[np.ndarray] = None
+    #: lazy thunk installed by the backend: () -> (shadow, conflict) bool
+    #: [P, P] masks via the sharded Gram kernel (``policy_pair_masks_sharded``)
+    #: — computed on first pairwise-policy query, cached thereafter
+    pair_masks_fn: Optional[Callable] = None
+    _pair_masks: Optional[Tuple[np.ndarray, np.ndarray]] = None
 
     def _pk(self) -> PackedShardedResult:
         if self.packed_result is None:
@@ -95,14 +104,26 @@ class ShardedPackedVerifyResult(VerifyResult):
     def system_isolation(self, idx: int) -> List[int]:
         return self._pk().system_isolation(idx)
 
-    def policy_shadow(self):
-        raise ValueError(
-            "the sharded-packed engine does not build per-policy src/dst "
-            "sets; use ops.tiled.policy_pair_masks (device Gram masks) or "
-            "the dense backends for the pairwise policy queries"
-        )
+    def _masks(self) -> Tuple[np.ndarray, np.ndarray]:
+        if self._pair_masks is None:
+            if self.pair_masks_fn is None:
+                raise ValueError("no pair-mask thunk attached to this result")
+            self._pair_masks = self.pair_masks_fn()
+        return self._pair_masks
 
-    policy_conflict = policy_shadow
+    def policy_shadow(self) -> List[Tuple[int, int]]:
+        """Pairwise shadow query via the device Gram masks — the [P, N]
+        src/dst sets and their O(P²·N) contractions stay sharded on the
+        mesh (``ops.tiled.policy_pair_masks_sharded``); only [P, P] masks
+        reach the host. Lazy: the Grams run on the first call."""
+        from ..ops.queries import _pairs
+
+        return _pairs(self._masks()[0])
+
+    def policy_conflict(self) -> List[Tuple[int, int]]:
+        from ..ops.queries import _pairs
+
+        return _pairs(self._masks()[1])
 
 
 class ShardedPackedBackend(VerifierBackend):
@@ -165,6 +186,8 @@ class ShardedPackedBackend(VerifierBackend):
             closure_packed = pk.closure(tile=config.opt("closure_tile", 512))
             if dense_ok:
                 closure = unpack_cols(closure_packed, cluster.n_pods)
+        from ..ops.tiled import policy_pair_masks_sharded
+
         return ShardedPackedVerifyResult(
             n_pods=cluster.n_pods,
             mode="k8s",
@@ -184,6 +207,14 @@ class ShardedPackedBackend(VerifierBackend):
             },
             packed_result=pk,
             closure_packed=closure_packed,
+            # lazy: the O(P²·N) pairwise-policy Grams run sharded on first
+            # policy_shadow/policy_conflict call, not on every verify
+            pair_masks_fn=lambda: policy_pair_masks_sharded(
+                mesh,
+                enc,
+                direction_aware_isolation=config.direction_aware_isolation,
+                chunk=config.opt("chunk", 1024),
+            ),
         )
 
     def verify_kano(

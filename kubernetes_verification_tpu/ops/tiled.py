@@ -62,6 +62,7 @@ __all__ = [
     "pack_bool_cols",
     "unpack_cols",
     "policy_pair_masks",
+    "policy_pair_masks_sharded",
 ]
 
 _I8 = jnp.int8
@@ -937,6 +938,9 @@ def _policy_sets_step(
     gate_e,  # bool [P]
     ingress: GrantBlock,
     egress: GrantBlock,
+    valid,  # int8 [N]: 1 = real pod (0 = padding; match-all peer rows and
+    #         the sharded path's pod-axis padding would otherwise inflate
+    #         the Gram counts and break the containment tests)
     *,
     chunk: int,
 ):
@@ -963,8 +967,8 @@ def _policy_sets_step(
     )[:P]
     gi = gate_i.astype(_I8)[:, None]
     ge = gate_e.astype(_I8)[:, None]
-    src8 = jnp.maximum(ing_peers * gi, selected8 * ge)
-    dst8 = jnp.maximum(selected8 * gi, eg_peers * ge)
+    src8 = jnp.maximum(ing_peers * gi, selected8 * ge) * valid[None, :]
+    dst8 = jnp.maximum(selected8 * gi, eg_peers * ge) * valid[None, :]
 
     def gram(a):  # [P, N] ⊗ [P, N] → int32 [P, P], contract pods
         return jax.lax.dot_general(
@@ -1000,7 +1004,21 @@ def policy_pair_masks(
     only the [P, P] masks come back. Feed them to
     ``ops.queries._pairs``-style ``np.argwhere`` harvesting, or compare with
     ``VerifyResult.policy_shadow()`` at small N."""
-    from ..parallel.sharded_ops import pad_grants
+    args = _pair_mask_args(enc, direction_aware_isolation, chunk, n_pad=0)
+    if device is not None:
+        args = jax.device_put(args, device)
+    shadow, conflict = _policy_sets_step(*args, chunk=chunk)
+    return np.asarray(shadow), np.asarray(conflict)
+
+
+def _pair_mask_args(
+    enc: EncodedCluster, direction_aware_isolation: bool, chunk: int,
+    n_pad: int,
+) -> tuple:
+    """Host prologue shared by the single-device and sharded pair-mask
+    entries: grant gates, chunk-aligned grant padding, optional pod-axis
+    padding (+ its validity vector)."""
+    from ..parallel.sharded_ops import pad_grants, pad_pods
 
     P = enc.n_policies
     has_ing = np.bincount(enc.ingress.pol, minlength=P + 1)[:P] > 0
@@ -1012,27 +1030,73 @@ def policy_pair_masks(
         gate_i = has_ing
         gate_e = has_eg
     ingress = pad_grants(
-        enc.ingress, (chunk - enc.ingress.n % chunk) % chunk, P, 0
+        enc.ingress, (chunk - enc.ingress.n % chunk) % chunk, P, n_pad
     )
     egress = pad_grants(
-        enc.egress, (chunk - enc.egress.n % chunk) % chunk, P, 0
+        enc.egress, (chunk - enc.egress.n % chunk) % chunk, P, n_pad
     )
-    args = (
-        enc.pod_kv,
-        enc.pod_key,
-        enc.pod_ns,
-        enc.ns_kv,
-        enc.ns_key,
-        enc.pol_sel,
-        enc.pol_ns,
-        gate_i,
-        gate_e,
-        ingress,
-        egress,
+    pod_kv, pod_key, pod_ns = pad_pods(
+        enc.pod_kv, enc.pod_key, enc.pod_ns, n_pad
     )
-    if device is not None:
-        args = jax.device_put(args, device)
-    shadow, conflict = _policy_sets_step(*args, chunk=chunk)
+    valid = np.zeros(enc.n_pods + n_pad, dtype=np.int8)
+    valid[: enc.n_pods] = 1
+    return (
+        pod_kv, pod_key, pod_ns, enc.ns_kv, enc.ns_key,
+        enc.pol_sel, enc.pol_ns, gate_i, gate_e, ingress, egress, valid,
+    )
+
+
+def policy_pair_masks_sharded(
+    mesh,
+    enc: EncodedCluster,
+    *,
+    direction_aware_isolation: bool = True,
+    chunk: int = 2048,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """``policy_pair_masks`` over a device mesh: the [P, N] src/dst set
+    builds and the O(P²·N) Gram contractions run SPMD with the pod axis
+    sharded over ``pods`` — XLA lowers the Gram's contraction of the
+    sharded axis to per-device dots plus a ``psum``. The grant stacks
+    replicate (selector rows are small); ``ip_match`` — the one grant leaf
+    with a pod axis — shards over ``pods`` too. Only the [P, P] masks come
+    back to the host."""
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as PS
+
+    from ..parallel.mesh import POD_AXIS, pad_amount
+
+    dp = mesh.shape[POD_AXIS]
+    n_pad = pad_amount(enc.n_pods, dp)
+    (
+        pod_kv, pod_key, pod_ns, ns_kv, ns_key,
+        pol_sel, pol_ns, gate_i, gate_e, ingress, egress, valid,
+    ) = _pair_mask_args(enc, direction_aware_isolation, chunk, n_pad)
+    rep = NamedSharding(mesh, PS())
+
+    def shp(*spec):
+        return NamedSharding(mesh, PS(*spec))
+
+    def put_block(b: GrantBlock):
+        specs = jax.tree.map(lambda _: rep, b)
+        if b.ip_match is not None:
+            specs = dataclasses.replace(specs, ip_match=shp(None, POD_AXIS))
+        return jax.device_put(b, specs)
+
+    shadow, conflict = _policy_sets_step(
+        jax.device_put(pod_kv, shp(POD_AXIS, None)),
+        jax.device_put(pod_key, shp(POD_AXIS, None)),
+        jax.device_put(pod_ns, shp(POD_AXIS)),
+        jax.device_put(ns_kv, rep),
+        jax.device_put(ns_key, rep),
+        jax.device_put(pol_sel, rep),
+        jax.device_put(pol_ns, rep),
+        jax.device_put(gate_i, rep),
+        jax.device_put(gate_e, rep),
+        put_block(ingress),
+        put_block(egress),
+        jax.device_put(valid, shp(POD_AXIS)),
+        chunk=chunk,
+    )
     return np.asarray(shadow), np.asarray(conflict)
 
 

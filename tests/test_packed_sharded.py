@@ -265,8 +265,10 @@ def test_registered_backend_routes_through_verify():
     ref2 = kv.verify(cluster, kv.VerifyConfig(backend="cpu", compute_ports=False))
     assert res2.all_isolated() == ref2.all_isolated()
     np.testing.assert_array_equal(res2.packed_result.to_bool(), ref2.reach)
-    with pytest.raises(ValueError, match="policy"):
-        res2.policy_shadow()
+    # the pairwise policy queries answer through the sharded Gram masks
+    # (pre-round-4 they raised here)
+    assert res2.policy_shadow() == ref2.policy_shadow()
+    assert res2.policy_conflict() == ref2.policy_conflict()
 
 
 def test_closure_through_backend_and_result():
@@ -339,3 +341,50 @@ def test_partial_stripe_refuses_whole_matrix_queries():
     for q in (part.all_reachable, part.all_isolated):
         with pytest.raises(ValueError, match="full dst sweep"):
             q()
+
+
+def test_pairwise_policy_queries_through_backend():
+    """All SIX verification queries answer through ``sharded-packed``:
+    policy_shadow/policy_conflict route through the sharded Gram masks
+    (``policy_pair_masks_sharded``), lazily, and equal the CPU oracle."""
+    cluster = random_cluster(
+        GeneratorConfig(
+            n_pods=120, n_policies=25, n_namespaces=3, p_ports=0.7, seed=17
+        )
+    )
+    res = kv.verify(
+        cluster,
+        kv.VerifyConfig(
+            backend="sharded-packed", backend_options=(("mesh", (4, 2)),)
+        ),
+    )
+    ref = kv.verify(cluster, kv.VerifyConfig(backend="cpu"))
+    assert res.policy_shadow() == ref.policy_shadow()
+    assert res.policy_conflict() == ref.policy_conflict()
+    # the masks are computed once and cached
+    assert res._pair_masks is not None
+    # the remaining four already answered packed-side; spot-check parity
+    assert res.all_reachable() == ref.all_reachable()
+    assert res.all_isolated() == ref.all_isolated()
+    assert res.system_isolation(3) == ref.system_isolation(3)
+    assert res.user_crosscheck(cluster.pods, "app") == ref.user_crosscheck(
+        cluster.pods, "app"
+    )
+
+
+def test_pairwise_masks_respect_direction_aware_flag():
+    cluster = random_cluster(
+        GeneratorConfig(n_pods=60, n_policies=12, n_namespaces=2, seed=19)
+    )
+    cfg = kv.VerifyConfig(
+        backend="sharded-packed",
+        direction_aware_isolation=False,
+        backend_options=(("mesh", (8, 1)),),
+    )
+    res = kv.verify(cluster, cfg)
+    ref = kv.verify(
+        cluster,
+        kv.VerifyConfig(backend="cpu", direction_aware_isolation=False),
+    )
+    assert res.policy_shadow() == ref.policy_shadow()
+    assert res.policy_conflict() == ref.policy_conflict()
