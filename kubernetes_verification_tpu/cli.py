@@ -4,6 +4,12 @@ The reference has no CLI at all (both verifiers are driven by unit tests
 only, SURVEY.md §1); this exposes the full pipeline:
 
 * ``kv-tpu verify PATH``   — load manifests, verify, print queries/summary;
+* ``kv-tpu snapshot PATH DIR`` — build a packed incremental verifier from
+  manifests and checkpoint it (the serving loop's "cold start");
+* ``kv-tpu diff DIR``      — load a checkpoint, apply pod/policy diffs from
+  YAML manifests (and ``--remove`` forms), print the changed aggregates,
+  save — the checkpoint → diff → patch → save serving cycle the
+  incremental engines implement (BASELINE config 5's operational story);
 * ``kv-tpu explain PATH``  — export the encoded tensors + the Datalog
   program text (the ``get_datalog`` facility, ``kubesv/kubesv/
   constraint.py:127-128``, for both representations);
@@ -156,6 +162,237 @@ def cmd_verify(args) -> int:
     return 0
 
 
+def _mesh_from_opts(opts: dict):
+    if "mesh" not in opts:
+        return None
+    from .parallel.mesh import mesh_for
+
+    return mesh_for(opts["mesh"])
+
+
+def _load_incremental(directory: str, mesh=None):
+    """Open either packed-engine checkpoint; the ports checkpoint is the one
+    carrying a frozen-universe ``__meta__`` blob."""
+    import os
+
+    import numpy as np
+
+    from .utils.persist import (
+        load_packed_incremental,
+        load_ports_incremental,
+    )
+
+    with np.load(os.path.join(directory, "state.npz")) as z:
+        is_ports = "__meta__" in z.files
+    if is_ports:
+        return load_ports_incremental(directory, mesh=mesh)
+    return load_packed_incremental(directory, mesh=mesh)
+
+
+def _inc_aggregates(inc) -> dict:
+    import numpy as np
+
+    out = {
+        "pods": int(inc.n_active),
+        "policies": len(inc.policies),
+        "update_count": int(inc.update_count),
+    }
+    try:
+        pr = inc.packed_reach()
+    except ValueError:  # matrix-free checkpoint: aggregates need a sweep
+        out["reachable_pairs"] = None
+        return out
+    out["reachable_pairs"] = int(pr.out_degree().sum())
+    act = inc.pod_active
+    out["ingress_isolated"] = int(np.count_nonzero(pr.ingress_isolated[act]))
+    out["egress_isolated"] = int(np.count_nonzero(pr.egress_isolated[act]))
+    return out
+
+
+def cmd_snapshot(args) -> int:
+    import kubernetes_verification_tpu as kv
+
+    from .packed_incremental import PackedIncrementalVerifier
+    from .packed_incremental_ports import PackedPortsIncrementalVerifier
+    from .utils.persist import (
+        save_packed_incremental,
+        save_ports_incremental,
+    )
+
+    opts = dict(_parse_opt(o) for o in args.opt)
+    mesh = _mesh_from_opts(opts)
+    cluster, skipped = kv.load_cluster(args.path)
+    cfg = kv.VerifyConfig(
+        compute_ports=args.ports,
+        self_traffic=args.self_traffic,
+        default_allow_unselected=args.default_allow,
+    )
+    if args.ports:
+        inc = PackedPortsIncrementalVerifier(
+            cluster, cfg, mesh=mesh,
+            headroom=args.headroom, pod_headroom=args.pod_headroom,
+        )
+        save_ports_incremental(inc, args.dir)
+    else:
+        inc = PackedIncrementalVerifier(
+            cluster, cfg, mesh=mesh, pod_headroom=args.pod_headroom,
+        )
+        save_packed_incremental(inc, args.dir)
+    agg = _inc_aggregates(inc)
+    agg["engine"] = "ports" if args.ports else "any-port"
+    agg["init_s"] = round(inc.init_time, 3)
+    agg["saved"] = args.dir
+    if skipped:
+        agg["skipped_documents"] = skipped
+    print(json.dumps(agg) if args.json else (
+        f"{agg['pods']} pods / {agg['policies']} policies → "
+        f"{agg['engine']} incremental state in {agg['init_s']}s "
+        f"({agg['reachable_pairs']} reachable pairs); saved to {args.dir}"
+    ))
+    return 0
+
+
+def cmd_diff(args) -> int:
+    import time
+
+    import kubernetes_verification_tpu as kv
+
+    opts = dict(_parse_opt(o) for o in args.opt)
+    t0 = time.perf_counter()
+    inc = _load_incremental(args.dir, mesh=_mesh_from_opts(opts))
+    t1 = time.perf_counter()
+    from .packed_incremental_ports import PortUniverseChanged
+
+    before = _inc_aggregates(inc)
+    ops = []
+    skipped_docs = []
+    try:
+        _apply_diffs(args, inc, ops, skipped_docs)
+    except PortUniverseChanged as e:
+        # engine diffs are atomic and nothing is saved on this path, so the
+        # on-disk checkpoint is untouched
+        raise SystemExit(
+            f"diff outside the checkpoint's frozen port universe after "
+            f"{len(ops)} applied ops (not saved): {e}\n"
+            f"rebuild with: kv-tpu snapshot MANIFESTS {args.dir}"
+        )
+    except KeyError as e:
+        raise SystemExit(
+            f"diff references an unknown pod/policy after {len(ops)} "
+            f"applied ops (not saved): {e}"
+        )
+    except ValueError as e:  # e.g. a namespace relabel
+        raise SystemExit(
+            f"diff requires a rebuild after {len(ops)} applied ops "
+            f"(not saved): {e}"
+        )
+    t2 = time.perf_counter()
+    after = _inc_aggregates(inc)
+    out_dir = args.out or args.dir
+    if not args.no_save:
+        from .packed_incremental_ports import PackedPortsIncrementalVerifier
+        from .utils.persist import (
+            save_packed_incremental,
+            save_ports_incremental,
+        )
+
+        if isinstance(inc, PackedPortsIncrementalVerifier):
+            save_ports_incremental(inc, out_dir)
+        else:
+            save_packed_incremental(inc, out_dir)
+    summary = {
+        "ops": ops,
+        "before": before,
+        "after": after,
+        "pairs_delta": (
+            after["reachable_pairs"] - before["reachable_pairs"]
+            if before.get("reachable_pairs") is not None
+            and after.get("reachable_pairs") is not None
+            else None
+        ),
+        "load_s": round(t1 - t0, 3),
+        "diff_s": round(t2 - t1, 3),
+        "saved": None if args.no_save else out_dir,
+    }
+    if skipped_docs:
+        summary["skipped_documents"] = skipped_docs
+    if args.json:
+        print(json.dumps(summary))
+    else:
+        for kind, key in ops:
+            print(f"  {kind} {key}")
+        print(
+            f"{len(ops)} diffs in {summary['diff_s']}s: "
+            f"{before['reachable_pairs']} → {after['reachable_pairs']} "
+            f"reachable pairs ({summary['pairs_delta']:+d})"
+            if summary["pairs_delta"] is not None
+            else f"{len(ops)} diffs in {summary['diff_s']}s (matrix-free)"
+        )
+        if summary["saved"]:
+            print(f"saved to {summary['saved']}")
+    return 0
+
+
+def _apply_diffs(args, inc, ops, skipped_docs) -> None:
+    import kubernetes_verification_tpu as kv
+
+    for path in args.apply:
+        delta, skipped = kv.load_cluster(path)
+        skipped_docs += skipped
+        for ns in delta.namespaces:
+            # labeled Namespace docs must register BEFORE their pods so
+            # namespaceSelector peers see the labels; label-less entries are
+            # indistinguishable from the loader's auto-created ones and are
+            # left to add_pod's auto-create
+            if ns.labels and inc.add_namespace(ns):
+                ops.append(["add-namespace", ns.name])
+        for pod in delta.pods:
+            key = f"{pod.namespace}/{pod.name}"
+            if key in inc._pod_idx:
+                old = inc.pods[inc._pod_idx[key]]
+                if (
+                    dict(pod.container_ports) != dict(old.container_ports)
+                    or pod.ip != old.ip
+                ):
+                    # ports/ip moved: full slot recycle (labels-only diffs
+                    # patch in place)
+                    inc.remove_pod(pod.namespace, pod.name)
+                    inc.add_pod(pod)
+                    ops.append(["replace-pod", key])
+                elif dict(pod.labels) != dict(old.labels):
+                    inc.update_pod_labels(
+                        inc._pod_idx[key], dict(pod.labels)
+                    )
+                    ops.append(["relabel-pod", key])
+                # unchanged manifest: no dispatch — apply-style full-manifest
+                # reconciles must cost only the comparison
+            else:
+                inc.add_pod(pod)
+                ops.append(["add-pod", key])
+        for pol in delta.policies:
+            key = f"{pol.namespace}/{pol.name}"
+            if key in inc.policies:
+                if pol != inc.policies[key]:
+                    inc.update_policy(pol)
+                    ops.append(["update-policy", key])
+            else:
+                inc.add_policy(pol)
+                ops.append(["add-policy", key])
+    for spec in args.remove:
+        kind, _, rest = spec.partition("/")
+        ns, sep, name = rest.partition("/")
+        if kind not in ("pod", "policy") or not sep:
+            raise SystemExit(
+                f"--remove expects pod/NAMESPACE/NAME or "
+                f"policy/NAMESPACE/NAME, got {spec!r}"
+            )
+        if kind == "pod":
+            inc.remove_pod(ns, name)
+        else:
+            inc.remove_policy(ns, name)
+        ops.append([f"remove-{kind}", f"{ns}/{name}"])
+
+
 def cmd_explain(args) -> int:
     import kubernetes_verification_tpu as kv
     from .datalog import build_k8s_program
@@ -209,6 +446,55 @@ def main(argv: Optional[list] = None) -> int:
     p.add_argument("path")
     _add_verify_flags(p)
     p.set_defaults(fn=cmd_verify)
+
+    p = sub.add_parser(
+        "snapshot",
+        help="build a packed incremental verifier from manifests and "
+        "checkpoint it",
+    )
+    p.add_argument("path", help="manifest file/dir")
+    p.add_argument("dir", help="checkpoint directory to write")
+    p.add_argument(
+        "--no-ports", dest="ports", action="store_false",
+        help="any-port engine (default: port-bitmap engine)",
+    )
+    p.add_argument("--no-self-traffic", dest="self_traffic", action="store_false")
+    p.add_argument("--no-default-allow", dest="default_allow", action="store_false")
+    p.add_argument(
+        "--headroom", type=int, default=8,
+        help="free VP rows per port segment (ports engine)",
+    )
+    p.add_argument(
+        "--pod-headroom", type=int, default=0,
+        help="extra pod slots for add_pod without a grow",
+    )
+    p.add_argument("--json", action="store_true")
+    p.add_argument("--opt", action="append", default=[], metavar="KEY=VALUE")
+    p.set_defaults(fn=cmd_snapshot)
+
+    p = sub.add_parser(
+        "diff",
+        help="apply pod/policy diffs to a checkpointed verifier and save",
+    )
+    p.add_argument("dir", help="checkpoint directory (from kv-tpu snapshot)")
+    p.add_argument(
+        "--apply", action="append", default=[], metavar="PATH",
+        help="YAML manifests to add/update (repeatable); existing pods "
+        "relabel in place, existing policies update",
+    )
+    p.add_argument(
+        "--remove", action="append", default=[], metavar="KIND/NS/NAME",
+        help="remove a pod or policy, e.g. --remove pod/prod/web-1 "
+        "--remove policy/prod/allow-http (repeatable)",
+    )
+    p.add_argument("--out", help="save to a different directory")
+    p.add_argument(
+        "--no-save", action="store_true",
+        help="apply + report only; leave the checkpoint untouched",
+    )
+    p.add_argument("--json", action="store_true")
+    p.add_argument("--opt", action="append", default=[], metavar="KEY=VALUE")
+    p.set_defaults(fn=cmd_diff)
 
     p = sub.add_parser("explain", help="export encoded model + Datalog program")
     p.add_argument("path")

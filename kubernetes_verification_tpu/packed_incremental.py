@@ -77,7 +77,7 @@ from .encode.encoder import (
     encode_policy_delta,
 )
 from .encode.ports import ALL_ATOM
-from .models.core import Cluster, NetworkPolicy, Pod
+from .models.core import Cluster, Namespace, NetworkPolicy, Pod
 from .ops.tiled import (
     PackedReach,
     _peers_by_slot,
@@ -1392,6 +1392,27 @@ class PackedIncrementalVerifier:
             ) = out
         if bookkeep:
             self.update_count += 1
+
+    def add_namespace(self, ns: Namespace) -> bool:
+        """Register a namespace created after the freeze (WITH its labels)
+        before adding pods into it — pods in post-freeze namespaces
+        evaluate object-level, so the labels take effect immediately.
+        Returns True when newly registered; a no-op for a known namespace
+        with identical labels. Relabeling an EXISTING namespace moves every
+        nsSelector match inside it and raises (rebuild)."""
+        existing = self._ns_labels.get(ns.name)
+        if existing is not None:
+            if dict(existing) != dict(ns.labels):
+                raise ValueError(
+                    f"namespace {ns.name} relabel changes every "
+                    "namespaceSelector match in it; rebuild the verifier"
+                )
+            return False
+        self._ns_labels[ns.name] = dict(ns.labels)
+        self.namespaces.append(Namespace(ns.name, dict(ns.labels)))
+        vz = self._vectorizer
+        vz.ns_index.setdefault(ns.name, len(vz.ns_index))
+        return True
 
     def add_pod(self, pod: Pod) -> int:
         """Add a pod in O(P + N) — one fused device dispatch. Returns the

@@ -78,7 +78,11 @@ from .ops.tiled import (
     _split_grant_ports,
     pack_bool_cols,
 )
-from .packed_incremental import PolicyVectorizer, _groups
+from .packed_incremental import (
+    PackedIncrementalVerifier,
+    PolicyVectorizer,
+    _groups,
+)
 from .parallel.sharded_ops import pad_grants, pad_pods
 
 __all__ = ["PackedPortsIncrementalVerifier", "PortUniverseChanged"]
@@ -1240,6 +1244,10 @@ class PackedPortsIncrementalVerifier:
         ) = out
         if bookkeep:
             self.update_count += 1
+
+    # identical state surface (_ns_labels / namespaces / _vectorizer) —
+    # share the any-port engine's implementation
+    add_namespace = PackedIncrementalVerifier.add_namespace
 
     def add_pod(self, pod: Pod) -> int:
         """Add a pod in O(total_vp + P) host work + one fused device

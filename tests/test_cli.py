@@ -51,3 +51,206 @@ def test_verify_sharded_packed_opts(tmp_path, capsys):
     assert main(base + ["--opt", "dense_reach_limit=4"]) == 0
     out2 = json.loads(capsys.readouterr().out)
     assert out2["reachable_pairs"] == ref_pairs
+
+
+def _fresh_pairs(ckpt_dir):
+    """Oracle: re-verify the checkpoint's live cluster from scratch."""
+    import numpy as np
+
+    import kubernetes_verification_tpu as kv
+    from kubernetes_verification_tpu.cli import _load_incremental
+
+    inc = _load_incremental(ckpt_dir)
+    cfg = kv.VerifyConfig(
+        backend="cpu", compute_ports=inc.config.compute_ports
+    )
+    ref = kv.verify(inc.as_cluster(), cfg)
+    np.testing.assert_array_equal(inc.reach_active(), ref.reach)
+    return int(ref.reach.sum())
+
+
+def _cli_diff_round_trip(tmp_path, capsys, engine_flags, tag):
+    import dataclasses
+
+    import kubernetes_verification_tpu as kv
+    from kubernetes_verification_tpu.ingest import dump_cluster
+
+    d = str(tmp_path / f"cluster-{tag}")
+    ck = str(tmp_path / f"ckpt-{tag}")
+    assert main(["generate", d, "--pods", "30", "--policies", "8"]) == 0
+    capsys.readouterr()
+
+    # snapshot: build + save the incremental engine
+    assert main(["snapshot", d, ck, "--json", *engine_flags]) == 0
+    snap = json.loads(capsys.readouterr().out)
+    assert snap["pods"] == 30 and snap["saved"] == ck
+
+    # a diff manifest: one new pod + one policy update (reuse an existing
+    # policy's key with different ingress) + one new policy
+    cluster, _ = kv.load_cluster(d)
+    pol = cluster.policies[0]
+    delta = kv.Cluster(
+        pods=[kv.Pod("cli-new", cluster.pods[0].namespace, {"app": "cli"})],
+        policies=[
+            dataclasses.replace(pol, ingress=cluster.policies[1].ingress),
+            dataclasses.replace(pol, name="cli-added"),
+        ],
+    )
+    dd = str(tmp_path / f"delta-{tag}")
+    dump_cluster(delta, dd)
+
+    victim = cluster.pods[3]
+    assert main([
+        "diff", ck, "--apply", dd,
+        "--remove", f"pod/{victim.namespace}/{victim.name}",
+        "--remove", f"policy/{cluster.policies[2].namespace}/{cluster.policies[2].name}",
+        "--json",
+    ]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    kinds = {k for k, _ in rep["ops"]}
+    assert kinds == {
+        "add-pod", "update-policy", "add-policy", "remove-pod",
+        "remove-policy",
+    }
+    assert rep["after"]["pods"] == 30  # +1 −1
+    assert rep["after"]["policies"] == 8
+    assert rep["saved"] == ck
+
+    # the saved checkpoint equals a from-scratch verify of its live cluster
+    assert rep["after"]["reachable_pairs"] == _fresh_pairs(ck)
+
+    # relabel path: re-applying the SAME pod with new labels patches in place
+    delta2 = kv.Cluster(
+        pods=[kv.Pod("cli-new", cluster.pods[0].namespace, {"app": "relab"})]
+    )
+    dd2 = str(tmp_path / f"delta2-{tag}")
+    dump_cluster(delta2, dd2)
+    assert main(["diff", ck, "--apply", dd2, "--json"]) == 0
+    rep2 = json.loads(capsys.readouterr().out)
+    assert ["relabel-pod", f"{cluster.pods[0].namespace}/cli-new"] in rep2["ops"]
+    assert rep2["after"]["reachable_pairs"] == _fresh_pairs(ck)
+
+
+def test_cli_diff_round_trip_ports(tmp_path, capsys):
+    """generate → snapshot → diff → verify-fresh equality (ports engine)."""
+    _cli_diff_round_trip(tmp_path, capsys, [], "ports")
+
+
+def test_cli_diff_round_trip_any_port(tmp_path, capsys):
+    _cli_diff_round_trip(tmp_path, capsys, ["--no-ports"], "anyport")
+
+
+def test_cli_diff_no_save_and_bad_remove(tmp_path, capsys):
+    d = str(tmp_path / "c")
+    ck = str(tmp_path / "k")
+    assert main(["generate", d, "--pods", "12", "--policies", "3"]) == 0
+    assert main(["snapshot", d, ck, "--no-ports"]) == 0
+    capsys.readouterr()
+    assert main(["diff", ck, "--no-save", "--json"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["ops"] == [] and rep["saved"] is None
+    import pytest
+
+    with pytest.raises(SystemExit, match="--remove expects"):
+        main(["diff", ck, "--remove", "garbage"])
+
+
+def test_cli_diff_out_of_universe_aborts_cleanly(tmp_path, capsys):
+    """A ports-engine diff outside the frozen universe exits with rebuild
+    guidance instead of a traceback, and the checkpoint on disk is intact."""
+    import pytest
+
+    import kubernetes_verification_tpu as kv
+    from kubernetes_verification_tpu.cli import _load_incremental
+    from kubernetes_verification_tpu.ingest import dump_cluster
+
+    d = str(tmp_path / "c")
+    ck = str(tmp_path / "k")
+    assert main(["generate", d, "--pods", "15", "--policies", "4"]) == 0
+    assert main(["snapshot", d, ck]) == 0
+    capsys.readouterr()
+    before = _load_incremental(ck).update_count
+    cluster, _ = kv.load_cluster(d)
+    alien = kv.Cluster(policies=[
+        kv.NetworkPolicy(
+            "alien", namespace=cluster.pods[0].namespace,
+            pod_selector=kv.Selector(),
+            ingress=(kv.Rule(peers=(), ports=(kv.PortSpec("TCP", 29_999),)),),
+        )
+    ])
+    dd = str(tmp_path / "alien")
+    dump_cluster(alien, dd)
+    with pytest.raises(SystemExit, match="frozen port universe"):
+        main(["diff", ck, "--apply", dd])
+    assert _load_incremental(ck).update_count == before  # disk untouched
+
+
+def test_cli_diff_namespace_labels_respected(tmp_path, capsys):
+    """Review r4: a labeled Namespace doc in --apply must register before
+    its pods, so namespaceSelector peers match them (previously silently
+    dropped → wrong matrix persisted)."""
+    import numpy as np
+
+    import kubernetes_verification_tpu as kv
+    from kubernetes_verification_tpu.cli import _load_incremental
+    from kubernetes_verification_tpu.ingest import dump_cluster
+
+    base = kv.Cluster(
+        pods=[kv.Pod("web", "prod", {"app": "web"})],
+        namespaces=[kv.Namespace("prod", {"tier": "frontend"})],
+        policies=[
+            kv.NetworkPolicy(
+                "from-backend", namespace="prod",
+                pod_selector=kv.Selector({"app": "web"}),
+                ingress=(
+                    kv.Rule(peers=(
+                        kv.Peer(namespace_selector=kv.Selector({"tier": "backend"})),
+                    )),
+                ),
+            )
+        ],
+    )
+    d = str(tmp_path / "base")
+    ck = str(tmp_path / "ck")
+    dump_cluster(base, d)
+    assert main(["snapshot", d, ck, "--no-ports"]) == 0
+    capsys.readouterr()
+    delta = kv.Cluster(
+        pods=[kv.Pod("worker", "team-a", {"app": "worker"})],
+        namespaces=[kv.Namespace("team-a", {"tier": "backend"})],
+    )
+    dd = str(tmp_path / "delta")
+    dump_cluster(delta, dd)
+    assert main(["diff", ck, "--apply", dd, "--json"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert ["add-namespace", "team-a"] in rep["ops"]
+    inc = _load_incremental(ck)
+    ref = kv.verify(
+        inc.as_cluster(), kv.VerifyConfig(backend="cpu", compute_ports=False)
+    )
+    np.testing.assert_array_equal(inc.reach_active(), ref.reach)
+    assert ref.reach[1, 0]  # worker → web actually granted
+    # a namespace RELABEL aborts with rebuild guidance
+    delta2 = kv.Cluster(
+        namespaces=[kv.Namespace("team-a", {"tier": "other"})],
+        pods=[kv.Pod("x", "team-a", {})],
+    )
+    dd2 = str(tmp_path / "delta2")
+    dump_cluster(delta2, dd2)
+    import pytest
+
+    with pytest.raises(SystemExit, match="rebuild"):
+        main(["diff", ck, "--apply", dd2])
+
+
+def test_cli_diff_unchanged_manifests_are_noops(tmp_path, capsys):
+    """Review r4: reconciling with the SAME manifests must dispatch nothing."""
+    d = str(tmp_path / "c")
+    ck = str(tmp_path / "k")
+    assert main(["generate", d, "--pods", "14", "--policies", "4"]) == 0
+    assert main(["snapshot", d, ck, "--no-ports"]) == 0
+    capsys.readouterr()
+    assert main(["diff", ck, "--apply", d, "--json"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["ops"] == []
+    assert rep["after"]["update_count"] == rep["before"]["update_count"]
