@@ -816,6 +816,13 @@ _sweep_jit = jax.jit(
 )
 
 
+@partial(jax.jit, donate_argnums=(0,))
+def _mask_rows(packed, row_valid):
+    return packed & jnp.where(
+        row_valid > 0, jnp.uint32(0xFFFFFFFF), jnp.uint32(0)
+    )[:, None]
+
+
 class PackedIncrementalVerifier:
     """Maintains a packed reachability matrix under policy / pod-label diffs.
 
@@ -862,6 +869,10 @@ class PackedIncrementalVerifier:
         self.policies: Dict[str, NetworkPolicy] = {}
         self._slot: Dict[str, int] = {}
         self.update_count = 0
+        #: cached transitive closure + nodes touched since (closure_packed)
+        self._closure = None
+        self._closure_base = None
+        self._closure_dirty: Optional[np.ndarray] = None
         cfg = self.config
 
         t0 = time.perf_counter()
@@ -988,6 +999,12 @@ class PackedIncrementalVerifier:
                 self_traffic=cfg.self_traffic,
                 default_allow_unselected=cfg.default_allow_unselected,
             )
+            # zero the padded/invalid ROWS too (the sweep masks columns
+            # only): their junk default-allow bits never reach queries
+            # (trimmed at [:n]) but later exact column patches clear them,
+            # which the delta-closure base comparison would misread as
+            # removed pairs
+            self._packed = _mask_rows(self._packed, self._row_valid)
         else:
             self._packed = None
         self._vectorizer = PolicyVectorizer(
@@ -1158,6 +1175,8 @@ class PackedIncrementalVerifier:
                 else grown
             )
         self._n_padded = Np2
+        self._closure = None  # shape changed; next closure_packed is full
+        self._closure_base = None
         self._prewarm()  # recompile the diff kernels at the new shapes
 
     @property
@@ -1186,6 +1205,49 @@ class PackedIncrementalVerifier:
             )
         return seg, words, wreal, clear
 
+    def _mark_closure_dirty(self, rows, cols) -> None:
+        """Accumulate touched nodes since the last ``closure_packed`` — the
+        delta-closure's suspect-row seed (``ops/closure.py``)."""
+        if self._closure is None:
+            return
+        self._closure_dirty[rows] = True
+        self._closure_dirty[cols] = True
+
+    def closure_packed(self, tile: int = 512):
+        """Transitive closure of the current packed matrix (uint32 [Np, W]),
+        incremental across diffs: the first call runs the full
+        ``packed_closure``; later calls seed from the previous closure and
+        re-derive only rows whose paths could route through a node a diff
+        touched (``packed_closure_delta``) — bit-for-bit equal to a full
+        re-closure, at diff-local cost. The cached closure is invalidated by
+        pod-axis growth (shape change)."""
+        if self._packed is None:
+            raise ValueError(
+                "closure needs the packed matrix; this verifier runs "
+                "matrix-free (keep_matrix=False)"
+            )
+        from .ops.closure import packed_closure, packed_closure_delta
+
+        # _closure_base is an explicit COPY, not a reference or an
+        # arithmetic identity (XLA may alias `x + 0` to x): later diff
+        # kernels donate self._packed's buffer, and an alias would silently
+        # corrupt the stored base. Unlocks the additions-only fast path
+        # (+1 packed-matrix of device memory, ~1.25 GB at 100k pods).
+        # Taken only when the closure actually recomputes — a cache-hit
+        # call implies _packed is unchanged since the base was stored.
+        if self._closure is None:
+            self._closure = packed_closure(self._packed, tile=tile)
+            self._closure_dirty = np.zeros(self._n_padded, dtype=bool)
+            self._closure_base = jnp.array(self._packed, copy=True)
+        elif self._closure_dirty.any():
+            self._closure = packed_closure_delta(
+                self._packed, self._closure, self._closure_dirty,
+                prev_base=self._closure_base, tile=tile,
+            )
+            self._closure_dirty[:] = False
+            self._closure_base = jnp.array(self._packed, copy=True)
+        return self._closure
+
     def _dispatch_diff(
         self, slot: int, new4_padded: np.ndarray,
         rows: np.ndarray, cols: np.ndarray,
@@ -1194,6 +1256,7 @@ class PackedIncrementalVerifier:
         column groups; remaining groups spill to the standalone patches.
         (Row group no-ops recompute row 0 to its current value; column
         group no-ops are fully masked.)"""
+        self._mark_closure_dirty(rows, cols)
         if self._packed is None:
             # matrix-free: update the maps + counts; record what a later
             # solve_stripe must re-verify
@@ -1253,6 +1316,7 @@ class PackedIncrementalVerifier:
 
     def _patch(self, rows: np.ndarray, cols: np.ndarray) -> None:
         """``rows``/``cols``: unique sorted touched src rows / dst columns."""
+        self._mark_closure_dirty(rows, cols)
         self._patch_spill(
             list(_groups(rows, _ROW_GROUP)), list(_groups(cols, _COL_GROUP))
         )
@@ -1365,6 +1429,8 @@ class PackedIncrementalVerifier:
         """One fused pod-slot dispatch (occupy or tombstone). ``bookkeep``
         is False only for the prewarm no-op (a tombstone-over-tombstone
         write whose slot may lie beyond the dirty arrays)."""
+        if bookkeep:
+            self._mark_closure_dirty([idx], [idx])
         if self._packed is None:
             out = _pod_step_mf(
                 *self._maps, self._col_mask, self._row_valid,
@@ -1648,6 +1714,9 @@ class PackedIncrementalVerifier:
         self._capacity = int(state["capacity"])
         self._slot_round = int(state["slot_round"])
         self.update_count = int(state["update_count"])
+        self._closure = None
+        self._closure_base = None
+        self._closure_dirty = None
         if mesh is not None:
             from jax.sharding import NamedSharding
             from jax.sharding import PartitionSpec as PS

@@ -471,6 +471,9 @@ class PackedPortsIncrementalVerifier:
         self.namespaces = list(cluster.namespaces)
         self.policies: Dict[str, NetworkPolicy] = {}
         self.update_count = 0
+        self._closure = None
+        self._closure_base = None
+        self._closure_dirty: Optional[np.ndarray] = None
         cfg = self.config
 
         t0 = time.perf_counter()
@@ -1046,6 +1049,7 @@ class PackedPortsIncrementalVerifier:
     def _patch(self, rows: np.ndarray, cols: np.ndarray) -> None:
         from .packed_incremental import PackedIncrementalVerifier as _PIV
 
+        self._mark_closure_dirty(rows, cols)
         for idx, _ in _groups(rows, _ROW_GROUP):
             self._packed = _ports_patch_rows(
                 self._packed, *self._operands, self._ing_cnt, self._eg_cnt,
@@ -1229,6 +1233,8 @@ class PackedPortsIncrementalVerifier:
     ) -> None:
         """One fused pod-slot dispatch (occupy, relabel or tombstone).
         ``bookkeep`` is False only for the prewarm no-op."""
+        if bookkeep:
+            self._mark_closure_dirty([idx], [idx])
         out = _ports_pod_step(
             self._packed, *self._operands, self._ing_cnt, self._eg_cnt,
             self._col_mask, self._row_valid,
@@ -1245,9 +1251,11 @@ class PackedPortsIncrementalVerifier:
         if bookkeep:
             self.update_count += 1
 
-    # identical state surface (_ns_labels / namespaces / _vectorizer) —
-    # share the any-port engine's implementation
+    # identical state surface (_ns_labels / namespaces / _vectorizer /
+    # _packed / _closure) — share the any-port engine's implementations
     add_namespace = PackedIncrementalVerifier.add_namespace
+    closure_packed = PackedIncrementalVerifier.closure_packed
+    _mark_closure_dirty = PackedIncrementalVerifier._mark_closure_dirty
 
     def add_pod(self, pod: Pod) -> int:
         """Add a pod in O(total_vp + P) host work + one fused device
@@ -1368,6 +1376,8 @@ class PackedPortsIncrementalVerifier:
         rv[: self.n_pods] = self.pod_active
         self._row_valid = self._put(rv, "vec")
         self._n_padded = Np2
+        self._closure = None  # shape changed; next closure_packed is full
+        self._closure_base = None
         self._prewarm()  # recompile the kernels at the new shapes
 
     @property
@@ -1533,6 +1543,9 @@ class PackedPortsIncrementalVerifier:
         self._n_padded = Np
         self._tile = int(meta["tile"])
         self.update_count = int(meta["update_count"])
+        self._closure = None
+        self._closure_base = None
+        self._closure_dirty = None
         self._sink_pol = int(meta["sink_pol"])
         self._total_rows = {k: int(v) for k, v in meta["total_rows"].items()}
         lay = meta["layout"]

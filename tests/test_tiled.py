@@ -345,3 +345,118 @@ class TestPackedClosure:
             ),
         )
         np.testing.assert_array_equal(closed.to_bool(), ref.closure)
+
+
+def test_packed_closure_delta_random_property():
+    """Delta closure == full closure for random base mutations (adds AND
+    removals) under a correct dirty mask."""
+    import jax.numpy as jnp
+
+    from kubernetes_verification_tpu.ops.closure import (
+        packed_closure,
+        packed_closure_delta,
+    )
+
+    rng = np.random.default_rng(5)
+    N = 128
+    for trial in range(4):
+        base = (rng.random((N, N)) < 0.02)
+        prev = np.asarray(
+            packed_closure(pack_bool_cols(jnp.asarray(base)), tile=32)
+        )
+        # mutate a few rows and columns (set AND clear bits)
+        rows = rng.choice(N, size=3, replace=False)
+        cols = rng.choice(N, size=3, replace=False)
+        base2 = base.copy()
+        base2[rows] = rng.random((3, N)) < 0.02
+        base2[:, cols] = rng.random((N, 3)) < 0.02
+        dirty = np.zeros(N, dtype=bool)
+        dirty[rows] = True
+        dirty[cols] = True
+        new_packed = pack_bool_cols(jnp.asarray(base2))
+        prev_base = pack_bool_cols(jnp.asarray(base))
+        got = packed_closure_delta(
+            new_packed, prev, dirty, tile=32, row_group=64
+        )
+        want = packed_closure(new_packed, tile=32)
+        np.testing.assert_array_equal(
+            np.asarray(got), np.asarray(want), err_msg=f"trial {trial}"
+        )
+        # with the previous base supplied (engines keep it), still exact
+        got_b = packed_closure_delta(
+            new_packed, prev, dirty, prev_base=prev_base, tile=32,
+            row_group=64,
+        )
+        np.testing.assert_array_equal(np.asarray(got_b), np.asarray(want))
+        # additions-only fast path: add edges on top of the original base
+        base3 = base | (rng.random((N, N)) < 0.005)
+        d3 = np.asarray(base3 != base).any(axis=1) | np.asarray(
+            base3 != base
+        ).any(axis=0)
+        got3 = packed_closure_delta(
+            pack_bool_cols(jnp.asarray(base3)), prev, d3,
+            prev_base=prev_base, tile=32, row_group=64,
+        )
+        want3 = packed_closure(pack_bool_cols(jnp.asarray(base3)), tile=32)
+        np.testing.assert_array_equal(np.asarray(got3), np.asarray(want3))
+
+
+def test_closure_after_diff_fuzzed_both_engines():
+    """closure_packed across fuzzed policy + pod churn equals a full
+    re-closure bit-for-bit on both incremental engines."""
+    import dataclasses
+    import random as pyrandom
+
+    import kubernetes_verification_tpu as kv
+    from kubernetes_verification_tpu.harness.generate import GeneratorConfig
+    from kubernetes_verification_tpu.ops.closure import packed_closure
+    from kubernetes_verification_tpu.packed_incremental import (
+        PackedIncrementalVerifier,
+    )
+    from kubernetes_verification_tpu.packed_incremental_ports import (
+        PackedPortsIncrementalVerifier,
+        PortUniverseChanged,
+    )
+
+    for Engine, cfg in [
+        (PackedIncrementalVerifier, kv.VerifyConfig(compute_ports=False)),
+        (PackedPortsIncrementalVerifier, kv.VerifyConfig()),
+    ]:
+        cluster = random_cluster(
+            GeneratorConfig(n_pods=53, n_policies=8, n_namespaces=3, seed=44)
+        )
+        donor = random_cluster(
+            GeneratorConfig(n_pods=53, n_policies=16, n_namespaces=3, seed=45)
+        )
+        inc = Engine(cluster, cfg)
+        inc.closure_packed(tile=64)  # prime the cache
+        rng = pyrandom.Random(1)
+        for step in range(8):
+            op = rng.choice(["add_pol", "rm_pol", "pod_add", "pod_rm", "relabel"])
+            try:
+                if op == "add_pol":
+                    inc.add_policy(
+                        dataclasses.replace(
+                            donor.policies[step], name=f"cz-{step}"
+                        )
+                    )
+                elif op == "rm_pol" and inc.policies:
+                    key = rng.choice(sorted(inc.policies))
+                    inc.remove_policy(*key.split("/", 1))
+                elif op == "pod_add":
+                    inc.add_pod(
+                        kv.Pod(f"cz-{step}", "ns-0", {"c": f"v{step}"})
+                    )
+                elif op == "pod_rm" and inc.n_active > 4:
+                    idx = rng.choice(list(inc.active_indices()))
+                    inc.remove_pod(inc.pods[idx].namespace, inc.pods[idx].name)
+                else:
+                    idx = rng.choice(list(inc.active_indices()))
+                    inc.update_pod_labels(idx, {"cz": f"r{step}"})
+            except PortUniverseChanged:
+                continue
+            got = np.asarray(inc.closure_packed(tile=64))
+            want = np.asarray(packed_closure(inc._packed, tile=64))
+            np.testing.assert_array_equal(
+                got, want, err_msg=f"{Engine.__name__} step {step} ({op})"
+            )
