@@ -114,3 +114,32 @@ def test_standalone_sharded_closure():
             break
         ref = nxt
     np.testing.assert_array_equal(got, ref)
+
+
+def test_distributed_mesh_single_process_noop():
+    """The multi-host entry point degrades to the local mesh in a
+    single-process job (no coordinator env → no initialize attempt) and the
+    full verify path runs on its mesh."""
+    import kubernetes_verification_tpu as kv
+    from kubernetes_verification_tpu.harness.generate import (
+        GeneratorConfig,
+        random_cluster,
+    )
+    from kubernetes_verification_tpu.parallel.mesh import (
+        distributed_mesh,
+        init_distributed,
+    )
+
+    assert init_distributed() is False  # single process: clean no-op
+    mesh = distributed_mesh((8, 1))
+    assert mesh.devices.size == 8
+    cluster = random_cluster(GeneratorConfig(n_pods=30, n_policies=5, seed=3))
+    from kubernetes_verification_tpu.backends.sharded_packed import (
+        ShardedPackedBackend,
+    )
+
+    res = ShardedPackedBackend(mesh=mesh).verify(
+        cluster, kv.VerifyConfig(backend="sharded-packed")
+    )
+    ref = kv.verify(cluster, kv.VerifyConfig(backend="cpu"))
+    np.testing.assert_array_equal(res.reach, ref.reach)
