@@ -46,13 +46,6 @@ def bench_tiled(args) -> None:
     dev = jax.devices()[0]
     log(f"device: {dev} ({jax.default_backend()})")
     n = args.pods
-    if args.pallas and not args.no_ports:
-        # never silently change the benched semantics: the Pallas path is
-        # any-port only, so require the caller to say --no-ports explicitly
-        sys.exit(
-            "--pallas implements any-port semantics only; pass --no-ports "
-            "explicitly so the metric string reflects what actually ran"
-        )
     compute_ports = not args.no_ports
     t0 = time.perf_counter()
     cluster = random_cluster(
@@ -73,10 +66,12 @@ def bench_tiled(args) -> None:
         f"grants in/eg {enc.ingress.n}/{enc.egress.n}  "
         f"port atoms {len(enc.atoms)}"
     )
-    # --pallas forces the fused kernel; otherwise tiled_k8s_reach
-    # auto-selects (Pallas for any-port on TPU, XLA mask-group for ports)
+    # --pallas / --no-pallas force the kernel choice; otherwise
+    # tiled_k8s_reach auto-selects on TPU (fused any-port kernel; the
+    # hybrid Pallas-full-block + XLA-ported-segment kernel for ports)
+    force = True if args.pallas else (False if args.no_pallas else None)
     run = lambda: tiled_k8s_reach(
-        enc, device=dev, fetch=False, use_pallas=True if args.pallas else None
+        enc, device=dev, fetch=False, use_pallas=force
     )
     res = run()  # compile + first solve
     t3 = time.perf_counter()
@@ -277,6 +272,232 @@ def bench_incremental(args) -> None:
     )
 
 
+def bench_closure(args) -> None:
+    """Packed transitive closure at flagship scale, full AND after-a-diff:
+    the incremental engines' ``closure_packed`` primes the full closure,
+    then one policy diff + a delta re-closure (``packed_closure_delta`` —
+    bit-for-bit a full re-closure, tested in ``tests/test_tiled.py``). The
+    headline value is the after-diff latency; the full number rides along
+    as ``full_s`` (previously only README prose)."""
+    import dataclasses
+
+    import jax
+    import numpy as np
+
+    from kubernetes_verification_tpu.backends.base import VerifyConfig
+    from kubernetes_verification_tpu.harness.generate import (
+        GeneratorConfig,
+        random_cluster,
+    )
+    from kubernetes_verification_tpu.packed_incremental import (
+        PackedIncrementalVerifier,
+    )
+
+    dev = jax.devices()[0]
+    log(f"device: {dev} ({jax.default_backend()})")
+    n = args.pods
+    t0 = time.perf_counter()
+    cluster = random_cluster(
+        GeneratorConfig(
+            n_pods=n, n_policies=args.policies, n_namespaces=args.namespaces,
+            p_ipblock_peer=0.0, min_selector_labels=1, seed=0,
+        )
+    )
+    t1 = time.perf_counter()
+    inc = PackedIncrementalVerifier(
+        cluster, VerifyConfig(compute_ports=False), device=dev
+    )
+    t2 = time.perf_counter()
+    log(f"generate {t1 - t0:.1f}s  init {t2 - t1:.1f}s")
+
+    sync = lambda c: int(np.asarray(c[0, 0]))
+    s = time.perf_counter()
+    sync(inc.closure_packed(tile=args.closure_tile))
+    full_s = time.perf_counter() - s
+    log(f"full packed closure: {full_s:.1f}s")
+    pols = list(cluster.policies)
+    # adds-only diff: append a NARROW rule to an existing policy — its
+    # selection (so every isolation count) is unchanged and grants only
+    # grow, from the few pods matching one donor pod's exact labels; the
+    # delta closure takes the additions-only fast path with a diff-local
+    # changed set (a broad grant would be adds-only too, but would touch
+    # every source row and cost full-width passes). Try donors until the
+    # diff actually adds reach (a donor may already be granted).
+    import jax.numpy as jnp
+
+    from kubernetes_verification_tpu.models.core import Peer, Rule, Selector
+
+    if len(pols) < 3:
+        sys.exit("--mode closure needs at least 3 policies")
+    target = pols[3 % len(pols)]
+    for k in sorted({0, n // 97, n // 7, n // 3, n - 1}):
+        narrow = Rule(
+            peers=(Peer(pod_selector=Selector(dict(cluster.pods[k].labels))),)
+        )
+        inc.update_policy(
+            dataclasses.replace(
+                target, ingress=tuple(target.ingress or ()) + (narrow,)
+            )
+        )
+        if bool(jnp.any(inc._packed & ~jnp.asarray(inc._closure_base))):
+            break
+    s = time.perf_counter()
+    sync(inc.closure_packed(tile=args.closure_tile))
+    adds_s = time.perf_counter() - s
+    log(f"closure after an adds-only policy diff: {adds_s:.2f}s "
+        f"({full_s / adds_s:.1f}x faster than full)")
+    # mixed diff (adds AND removes reach): the hard decremental case — the
+    # suspect analysis on a densely-connected graph degrades toward one
+    # full-width pass + a frontier tail
+    inc.update_policy(
+        dataclasses.replace(pols[1], ingress=pols[2].ingress)
+    )
+    s = time.perf_counter()
+    sync(inc.closure_packed(tile=args.closure_tile))
+    mixed_s = time.perf_counter() - s
+    log(f"closure after a mixed policy diff: {mixed_s:.2f}s "
+        f"({full_s / mixed_s:.1f}x faster than full)")
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    f"packed closure after an adds-only policy diff, "
+                    f"{n} pods / {args.policies} policies (full and "
+                    "mixed-diff numbers ride along), 1 chip"
+                ),
+                "value": round(adds_s, 3),
+                "unit": "s",
+                "vs_baseline": round(full_s / adds_s, 2),
+                "full_s": round(full_s, 2),
+                "mixed_diff_s": round(mixed_s, 2),
+            }
+        )
+    )
+
+
+def bench_stripe(args) -> None:
+    """Real-chip evidence for the 1M-pod (BASELINE config 5) regime: tile a
+    base cluster's pod encoding out to 1M pods, sweep one dst-tile stripe of
+    the packed solver on the actual TPU (pairs/s), then run a matrix-free
+    incremental policy diff + stripe re-verify at 250k pods (diff latency).
+    Single-chip: this measures one chip's share of the config-5 job — the
+    multi-chip composition is validated by ``dryrun_multichip``."""
+    import dataclasses
+
+    import jax
+    import numpy as np
+
+    from kubernetes_verification_tpu.backends.base import VerifyConfig
+    from kubernetes_verification_tpu.encode.encoder import encode_cluster
+    from kubernetes_verification_tpu.harness.generate import (
+        GeneratorConfig,
+        random_cluster,
+    )
+    from kubernetes_verification_tpu.packed_incremental import (
+        PackedIncrementalVerifier,
+    )
+    from kubernetes_verification_tpu.parallel.mesh import mesh_for
+    from kubernetes_verification_tpu.parallel.packed_sharded import (
+        sharded_packed_reach,
+    )
+
+    dev = jax.devices()[0]
+    log(f"device: {dev} ({jax.default_backend()})")
+    mesh = mesh_for((1, 1), devices=[dev])
+    base_n = 2000
+    reps = args.pods // base_n  # default 1M = 2000 × 500
+    t0 = time.perf_counter()
+    base = random_cluster(
+        GeneratorConfig(
+            n_pods=base_n, n_policies=args.policies,
+            n_namespaces=args.namespaces, p_ipblock_peer=0.0,
+            min_selector_labels=1, seed=44,
+        )
+    )
+    enc_base = encode_cluster(base, compute_ports=False)
+    import dataclasses as _dc
+
+    enc_big = _dc.replace(
+        enc_base,
+        n_pods=enc_base.n_pods * reps,
+        pod_kv=np.tile(enc_base.pod_kv, (reps, 1)),
+        pod_key=np.tile(enc_base.pod_key, (reps, 1)),
+        pod_ns=np.tile(enc_base.pod_ns, reps),
+    )
+    t1 = time.perf_counter()
+    n_big = enc_big.n_pods
+    tile = 512
+    k_tiles = max(1, args.stripe_width // tile)
+    run = lambda: sharded_packed_reach(
+        mesh, enc_big, tile=tile, chunk=1024,
+        stripe=(0, k_tiles), keep_matrix=False,
+    )
+    res = run()  # compile + first sweep
+    t2 = time.perf_counter()
+    log(f"generate+tile-encode {t1 - t0:.1f}s  "
+        f"compile+first stripe {t2 - t1:.1f}s")
+    times = []
+    for _ in range(max(2, min(args.repeats, 4))):
+        r = run()
+        times.append(r.timings["solve"])
+    stripe_s = sorted(times)[len(times) // 2]
+    width = k_tiles * tile
+    stripe_rate = float(n_big) * width / stripe_s
+    log(f"1M stripe: {n_big} srcs x {width} dsts in {stripe_s:.2f}s "
+        f"median = {stripe_rate / 1e9:.2f}e9 pairs/s")
+
+    # matrix-free incremental diff at 250k pods (pod OBJECTS needed here,
+    # so a smaller tiling keeps host construction sane)
+    reps_inc = 125
+    big_pods = [
+        dataclasses.replace(p, name=f"{p.name}-r{r}")
+        for r in range(reps_inc)
+        for p in base.pods
+    ]
+    import kubernetes_verification_tpu as kv
+
+    big = kv.Cluster(
+        pods=big_pods, namespaces=list(base.namespaces),
+        policies=list(base.policies),
+    )
+    t3 = time.perf_counter()
+    inc = PackedIncrementalVerifier(
+        big, VerifyConfig(compute_ports=False), device=dev, keep_matrix=False
+    )
+    t4 = time.perf_counter()
+    log(f"250k matrix-free engine init {t4 - t3:.1f}s")
+    diff_pol = dataclasses.replace(
+        base.policies[1], ingress=base.policies[2].ingress
+    )
+    s = time.perf_counter()
+    inc.update_policy(diff_pol)
+    jax.block_until_ready(inc._ing_cnt)
+    diff_s = time.perf_counter() - s
+    s = time.perf_counter()
+    stripe_words = inc.solve_stripe(0, tile)
+    _ = int(stripe_words[0, 0])
+    restripe_s = time.perf_counter() - s
+    log(f"matrix-free diff {diff_s * 1e3:.1f}ms; "
+        f"stripe re-verify ({tile} dsts) {restripe_s:.2f}s")
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    f"config-5 single-chip share: {n_big}-pod packed stripe "
+                    f"({width} dsts) + 250k matrix-free diff, "
+                    f"{args.policies} policies, 1 chip"
+                ),
+                "value": round(stripe_rate, 1),
+                "unit": "pairs/s",
+                "vs_baseline": round(stripe_rate / BASELINE_PAIRS_PER_SEC, 4),
+                "stripe_s": round(stripe_s, 3),
+                "mf_diff_ms": round(diff_s * 1e3, 2),
+                "mf_restripe_s": round(restripe_s, 3),
+            }
+        )
+    )
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--pods", type=int, default=None)
@@ -285,17 +506,34 @@ def main() -> None:
     ap.add_argument("--repeats", type=int, default=5)
     ap.add_argument(
         "--mode",
-        choices=("tiled", "k8s", "kano", "incremental"),
+        choices=("tiled", "k8s", "kano", "incremental", "closure", "stripe"),
         default="tiled",
         help="tiled = the BASELINE north-star config (100k pods / 10k "
         "policies, packed-bitmap output); k8s/kano = dense kernels at 10k; "
-        "incremental = policy-diff latency on the packed state at 100k",
+        "incremental = policy+pod diff latency on the packed state at 100k; "
+        "closure = full + after-diff packed closure at 100k; stripe = the "
+        "1M-pod dst stripe + 250k matrix-free diff (config 5's single-chip "
+        "share)",
+    )
+    ap.add_argument(
+        "--closure-tile", type=int, default=512,
+        help="closure mode: squaring tile",
+    )
+    ap.add_argument(
+        "--stripe-width", type=int, default=32_768,
+        help="stripe mode: dst columns swept (wide enough to amortize the "
+        "per-call peer-map prologue)",
     )
     ap.add_argument(
         "--pallas",
         action="store_true",
-        help="tiled mode: use the fused Pallas kernels instead of the XLA path "
-        "(any-port only)",
+        help="tiled mode: force the fused Pallas kernels (any-port) / the "
+        "hybrid port kernel (ports)",
+    )
+    ap.add_argument(
+        "--no-pallas",
+        action="store_true",
+        help="tiled mode: force the pure-XLA kernels",
     )
     ap.add_argument(
         "--no-ports",
@@ -304,9 +542,15 @@ def main() -> None:
     )
     args = ap.parse_args()
     if args.pods is None:
-        args.pods = 100_000 if args.mode in ("tiled", "incremental") else 10_000
+        args.pods = {
+            "tiled": 100_000, "incremental": 100_000, "closure": 100_000,
+            "stripe": 1_000_000,
+        }.get(args.mode, 10_000)
     if args.policies is None:
-        args.policies = 10_000 if args.mode in ("tiled", "incremental") else 1_000
+        args.policies = {
+            "tiled": 10_000, "incremental": 10_000, "closure": 10_000,
+            "stripe": 512,
+        }.get(args.mode, 1_000)
 
     import jax
 
@@ -314,6 +558,10 @@ def main() -> None:
         return bench_tiled(args)
     if args.mode == "incremental":
         return bench_incremental(args)
+    if args.mode == "closure":
+        return bench_closure(args)
+    if args.mode == "stripe":
+        return bench_stripe(args)
 
     from kubernetes_verification_tpu.encode.encoder import (
         encode_cluster,

@@ -30,10 +30,29 @@ Measured head-to-head on real hardware (one v5e chip, 100k pods / 10k
 policies, any-port, identical outputs — 3,100,847,493 reachable pairs both
 ways): **Pallas 2.45 s (4.08e9 pairs/s) vs XLA tiled 2.53 s (3.95e9
 pairs/s)** — a ~3.4% win, so ``tiled_k8s_reach`` auto-selects this kernel
-for any-port solves on TPU. The port mask-group path stays on the XLA
-kernels: its extra work is R more segment dots feeding the same MXU, where
-fusion has proportionally less to save, and the R-segment + O(R²)-combine
-structure would need a per-layout Pallas specialisation for a sub-5% ceiling.
+for any-port solves on TPU.
+
+**Port-path decomposition** (round 4, measured at the same flagship config,
+R=19 run masks, 14,353 ingress / 5,905 egress VP rows of which 6,760 /
+2,816 are the full-coverage block): the full-mask block is ~47% of the
+port sweep's MXU MACs and is exactly this kernel's shape, so a hybrid was
+built (``ops.tiled._tiled_ports_pallas_step``): full blocks through
+``packed_dir_allow``, only the R ported segments through the XLA tile pass,
+composed exactly in the packed word domain. Head-to-head on hardware
+(identical 3,105,860,083 reachable pairs): **XLA mask-group 3.8–4.0 s vs
+hybrid 4.6–5.2 s** across interleaved same-process runs — the hybrid LOSES
+~25%. Interpretation: the port sweep is bound by the per-tile mask-group
+COMBINES and gathers (the any-port XLA path does the same 2e14 MACs in
+2.53 s; the ~1.3 s port premium is VPU/elementwise work the hybrid cannot
+remove and whose packed-domain assembly it duplicates), not by the dots
+that Pallas fuses. Pre-baking the per-tile ingress gather as a fourth
+resident operand was also measured and bought nothing. The XLA mask-group
+kernel therefore remains the port-path default; the hybrid stays available
+(``use_pallas=True`` with a multi-atom encoding) and differentially tested.
+Of r03's 3.62 s → 3.72 s drift: the generator gained named container ports
+between the rounds (extra restriction-bank gathers + more VP rows), i.e.
+config change, not regression — the same build measures 3.7–4.0 s
+run-to-run under this environment's remote-tunnel timing noise.
 """
 from __future__ import annotations
 

@@ -657,6 +657,10 @@ def _tiled_ports_step(
     vp_peers_e = vp_peers_e * bank8[vp_res_e]
     # egress src-side operand, pre-gathered once: row v = selected-by-pol(v)
     sel_eg_vp = sel_eg_ext[vp_pol_e]  # int8 [total_e, N]
+    # (the ingress dst operand stays a per-tile gather: pre-baking it as a
+    # fourth [total_i, N] resident was measured at the flagship config and
+    # bought nothing — the sweep is combine-bound, not gather-bound — so we
+    # keep the 1.4 GB)
 
     def tile_body(t, out):
         d0 = t * tile
@@ -694,6 +698,194 @@ def _tiled_ports_step(
             )
         packed = pack_bool_cols(r)
         return jax.lax.dynamic_update_slice(out, packed, (0, d0 // 32))
+
+    out = jnp.zeros((N, W), dtype=_U32)
+    out = jax.lax.fori_loop(0, n_tiles, tile_body, out)
+    out &= col_mask[None, :]
+    return out, ing_iso, eg_iso, selected8 > 0
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "layout",
+        "tile",
+        "chunk",
+        "ptn",
+        "self_traffic",
+        "default_allow_unselected",
+        "direction_aware_isolation",
+    ),
+)
+def _tiled_ports_pallas_step(
+    pod_kv,
+    pod_key,
+    pod_ns,
+    ns_kv,
+    ns_key,
+    pol_sel: SelectorEnc,
+    pol_ns,
+    aff_ing,
+    aff_eg,
+    ingress: GrantBlock,
+    egress: GrantBlock,
+    vp_pol_i,
+    vp_res_i,
+    vp_slot_i,
+    vp_pol_e,
+    vp_res_e,
+    vp_slot_e,
+    bank8,
+    col_mask,
+    *,
+    layout: PortLayout,
+    tile: int,
+    chunk: int,
+    ptn: int,
+    self_traffic: bool,
+    default_allow_unselected: bool,
+    direction_aware_isolation: bool,
+):
+    """The hybrid port kernel: the FULL-mask VP blocks — the dominant FLOPs
+    of the mask-group decomposition (portless rules and whole-universe
+    specs) — run through the fused Pallas direction kernel
+    (``pallas_kernels.packed_dir_allow``: dot + default-allow + bit-pack in
+    VMEM, one packed HBM write), while only the R ported segments sweep
+    through the XLA tile pass. The two compose EXACTLY in the packed word
+    domain:
+
+        FI = pack(gi_full ∨ DI)        FE = pack(ge_full ∨ DE)
+        r  = (FI ∧ FE) ∨ (FI ∧ pack(ge_ported_any))
+                       ∨ (FE ∧ pack(gi_ported_any))
+                       ∨ pack(∃ overlapping m1,m2: gi_m1 ∧ ge_m2) ∨ diag
+
+    which is the full expansion of ``∨_q (GI_q ∨ DI) ∧ (GE_q ∨ DE)``: the
+    FI∧FE product covers full×full plus every default-allow×full and DI∧DE
+    term, the two cross products cover full×ported AND default-allow×ported,
+    and the last is the ported-only conjunction. Requires every full-block
+    VP to carry restriction 0 (named-port variants are single-atom masks, so
+    this only fails in the degenerate one-atom universe — the caller checks
+    and falls back)."""
+    from .pallas_kernels import packed_dir_allow
+
+    N = pod_kv.shape[0]
+    P = pol_ns.shape[0]
+    W = N // 32
+    da = default_allow_unselected
+
+    selected8, sel_ing8, sel_eg8, ing_iso, eg_iso = _select_maps(
+        pod_kv, pod_key, pod_ns, pol_sel, pol_ns, aff_ing, aff_eg,
+        direction_aware_isolation,
+    )
+    zrow = jnp.zeros((1, N), dtype=_I8)
+    sel_ing_ext = jnp.concatenate([sel_ing8, zrow], axis=0)
+    sel_eg_ext = jnp.concatenate([sel_eg8, zrow], axis=0)
+    total_i = vp_pol_i.shape[0]
+    total_e = vp_pol_e.shape[0]
+    vp_peers_i = _peers_by_slot(
+        ingress, vp_slot_i, total_i, chunk,
+        pod_kv, pod_key, ns_kv, ns_key, pod_ns, pol_ns,
+    )
+    vp_peers_e = _peers_by_slot(
+        egress, vp_slot_e, total_e, chunk,
+        pod_kv, pod_key, ns_kv, ns_key, pod_ns, pol_ns,
+    ) * bank8[vp_res_e]
+    sel_eg_vp = sel_eg_ext[vp_pol_e]
+    sel_ing_vp = sel_ing_ext[vp_pol_i] * bank8[vp_res_i]
+
+    interpret = jax.default_backend() != "tpu"
+    tk = 256
+    fs_i, fl_i = layout.full_i
+    fs_e, fl_e = layout.full_e
+
+    def full_dir(a_rows, b_rows, niso, axis):
+        pf = a_rows.shape[0]
+        pad = (tk - pf % tk) % tk if pf else tk
+        padp = lambda x: jnp.pad(x, ((0, pad), (0, 0)))
+        return packed_dir_allow(
+            padp(a_rows), padp(b_rows),
+            jnp.broadcast_to(niso.astype(_I32), (8, N)),
+            tm=min(256, N), tn=ptn, tk=tk,
+            default_allow_axis=axis, interpret=interpret,
+        )
+
+    if fl_i:
+        a_i = jax.lax.slice(vp_peers_i, (fs_i, 0), (fs_i + fl_i, N))
+        b_i = jax.lax.slice(sel_ing_vp, (fs_i, 0), (fs_i + fl_i, N))
+        FI = full_dir(a_i, b_i, ~ing_iso, 1 if da else -1)
+    elif da:  # no full rows: FI degenerates to the DI broadcast
+        FI = jnp.broadcast_to(pack_bool_cols((~ing_iso)[None, :]), (N, W))
+    else:
+        FI = jnp.zeros((N, W), dtype=_U32)
+    if fl_e:
+        a_e = jax.lax.slice(sel_eg_vp, (fs_e, 0), (fs_e + fl_e, N))
+        b_e = jax.lax.slice(vp_peers_e, (fs_e, 0), (fs_e + fl_e, N))
+        FE = full_dir(a_e, b_e, ~eg_iso, 0 if da else -1)
+    elif da:  # DE is a src-side property: whole words per row
+        FE = jnp.broadcast_to(
+            jnp.where(
+                (~eg_iso)[:, None], jnp.uint32(0xFFFFFFFF), jnp.uint32(0)
+            ),
+            (N, W),
+        )
+    else:
+        FE = jnp.zeros((N, W), dtype=_U32)
+
+    R = layout.n_masks
+    if R == 0:
+        out = FI & FE
+        if self_traffic:
+            rows = jnp.arange(N)
+            bits = jnp.uint32(1) << (rows % 32).astype(_U32)
+            out = out.at[rows, rows // 32].set(
+                out[rows, rows // 32] | bits
+            )
+        return out & col_mask[None, :], ing_iso, eg_iso, selected8 > 0
+
+    # ported-only layout: same segments/overlaps, zero-length full blocks
+    layout_p = PortLayout(
+        seg_i=layout.seg_i, seg_e=layout.seg_e,
+        full_i=(fs_i, 0), full_e=(fs_e, 0), ov_rows=layout.ov_rows,
+    )
+    n_tiles = N // tile
+
+    def tile_body(t, out):
+        d0 = t * tile
+        sel_ing_vp_t = jax.lax.dynamic_slice(
+            sel_ing_vp, (0, d0), (total_i, tile)
+        )
+        vpe_t = jax.lax.dynamic_slice(vp_peers_e, (0, d0), (total_e, tile))
+        false_t = jnp.zeros((N, tile), dtype=bool)
+
+        def ing_dot(start: int, length: int) -> jnp.ndarray:
+            a = jax.lax.slice(vp_peers_i, (start, 0), (start + length, N))
+            b = jax.lax.slice(
+                sel_ing_vp_t, (start, 0), (start + length, tile)
+            )
+            return _dot_lnt(a, b) > 0
+
+        def eg_dot(start: int, length: int) -> jnp.ndarray:
+            a = jax.lax.slice(sel_eg_vp, (start, 0), (start + length, N))
+            b = jax.lax.slice(vpe_t, (start, 0), (start + length, tile))
+            return _dot_lnt(a, b) > 0
+
+        conj_p, gi_p, ge_p = _mask_group_conj(
+            layout_p, ing_dot, eg_dot, false_t
+        )
+        if self_traffic:
+            conj_p = conj_p | (
+                jnp.arange(N)[:, None] == (d0 + jnp.arange(tile))[None, :]
+            )
+        tw = tile // 32
+        FI_t = jax.lax.dynamic_slice(FI, (0, d0 // 32), (N, tw))
+        FE_t = jax.lax.dynamic_slice(FE, (0, d0 // 32), (N, tw))
+        out_t = (
+            (FI_t & FE_t)
+            | (FI_t & pack_bool_cols(ge_p))
+            | (FE_t & pack_bool_cols(gi_p))
+            | pack_bool_cols(conj_p)
+        )
+        return jax.lax.dynamic_update_slice(out, out_t, (0, d0 // 32))
 
     out = jnp.zeros((N, W), dtype=_U32)
     out = jax.lax.fori_loop(0, n_tiles, tile_body, out)
@@ -1137,17 +1329,18 @@ def tiled_k8s_reach(
 
     n = enc.n_pods
     with_ports = len(enc.atoms) > 1
+    platform = (
+        device.platform if device is not None else jax.default_backend()
+    )
     if use_pallas is None:
-        platform = (
-            device.platform if device is not None else jax.default_backend()
-        )
+        # auto: fused Pallas for ANY-PORT on TPU (measured faster). The
+        # port path keeps the XLA mask-group kernel: the hybrid
+        # (_tiled_ports_pallas_step) was measured ~25% SLOWER at the
+        # flagship config — the port sweep is combine-bound, so fusing the
+        # full-mask dots doesn't pay (see ops/pallas_kernels.py docstring);
+        # it stays available via use_pallas=True.
         use_pallas = (
             not with_ports and platform == "tpu" and tile % 4096 == 0
-        )
-    if with_ports and use_pallas:
-        raise ValueError(
-            "use_pallas supports the any-port path only; encode with "
-            "compute_ports=False or drop use_pallas"
         )
     ing_block, eg_block = enc.ingress, enc.egress
     if with_ports:
@@ -1167,13 +1360,28 @@ def tiled_k8s_reach(
             128, (_PORT_SLAB_BUDGET // max(R * max(n, 1), 1)) // 128 * 128
         )
         tile = min(tile, cap)
+        if use_pallas:
+            # the hybrid pads N to a Pallas-block multiple; keep the XLA
+            # dst tile a power of two so it divides that padding
+            tile = 1 << (max(tile, 128).bit_length() - 1)
     tile = max(32, min(tile, 1 << 20))
     if tile % 32:
         raise ValueError("tile must be a multiple of 32")
-    if use_pallas and tile % 4096:
+    if use_pallas and not with_ports and tile % 4096:
         raise ValueError("use_pallas requires tile % 4096 == 0 (pallas block)")
-    n_pad = (tile - n % tile) % tile
+    # the fused Pallas kernels need N divisible by their dst block; on TPU
+    # that block is 4096 (the packed word axis must tile to 128 lanes);
+    # interpret mode (tests) takes any 32-multiple block
+    ptn = 4096
+    pad_to = tile
+    if with_ports and use_pallas and platform == "tpu":
+        pad_to = max(tile, ptn)  # tile is a power of two, so tile | pad_to
+    n_pad = (pad_to - n % pad_to) % pad_to
     Np = n + n_pad
+    if with_ports and use_pallas and platform != "tpu":
+        ptn = Np if Np <= 4096 else 4096
+        if Np % ptn:
+            use_pallas = False  # awkward interpret-mode shape: fall back
 
     pod_kv = np.pad(enc.pod_kv, ((0, n_pad), (0, 0)))
     pod_key = np.pad(enc.pod_key, ((0, n_pad), (0, 0)))
@@ -1233,8 +1441,12 @@ def tiled_k8s_reach(
             bank8 = np.ones((1, Np), dtype=np.int8)
         # the three resident int8 operands — two [total_vp, N] peer maps plus
         # the gathered egress selection — are the port path's memory floor;
-        # catch an over-wide VP layout here rather than as a device OOM
-        resident = (len(vp_pol_i) + 2 * len(vp_pol_e)) * Np
+        # the hybrid Pallas step bakes a fourth ([total_i, N] ingress
+        # selection), counted when it may run. Catch an over-wide VP layout
+        # here rather than as a device OOM.
+        resident = (
+            (2 if use_pallas else 1) * len(vp_pol_i) + 2 * len(vp_pol_e)
+        ) * Np
         if resident > _PORT_RESIDENT_BUDGET:
             raise ValueError(
                 f"port path needs ~{resident / 1e9:.1f} GB of resident "
@@ -1250,15 +1462,36 @@ def tiled_k8s_reach(
         )
         if device is not None:
             args = jax.device_put(args, device)
-        packed, ing_iso, eg_iso, selected = _tiled_ports_step(
-            *args,
-            layout=layout,
-            tile=tile,
-            chunk=chunk,
-            self_traffic=self_traffic,
-            default_allow_unselected=default_allow_unselected,
-            direction_aware_isolation=direction_aware_isolation,
-        )
+        # the hybrid requires restriction-free full blocks (true except in
+        # a degenerate one-atom universe, where a named single-atom
+        # variant IS the full mask)
+        full_res_clean = True
+        for vr, (fs, fl) in (
+            (vp_res_i, layout.full_i), (vp_res_e, layout.full_e),
+        ):
+            if fl and np.asarray(vr[fs : fs + fl]).any():
+                full_res_clean = False
+        if use_pallas and full_res_clean:
+            packed, ing_iso, eg_iso, selected = _tiled_ports_pallas_step(
+                *args,
+                layout=layout,
+                tile=tile,
+                chunk=chunk,
+                ptn=ptn,
+                self_traffic=self_traffic,
+                default_allow_unselected=default_allow_unselected,
+                direction_aware_isolation=direction_aware_isolation,
+            )
+        else:
+            packed, ing_iso, eg_iso, selected = _tiled_ports_step(
+                *args,
+                layout=layout,
+                tile=tile,
+                chunk=chunk,
+                self_traffic=self_traffic,
+                default_allow_unselected=default_allow_unselected,
+                direction_aware_isolation=direction_aware_isolation,
+            )
     else:
         args = (*common, col_mask)
         if device is not None:

@@ -60,3 +60,47 @@ def test_tiled_pallas_flags(flags):
     enc = encode_cluster(cluster, compute_ports=False)
     got = tiled_k8s_reach(enc, tile=4096, chunk=16, use_pallas=True, **flags)
     np.testing.assert_array_equal(got.to_bool(), ref.reach)
+
+
+@pytest.mark.parametrize("seed", [7, 21])
+def test_ports_hybrid_pallas_matches_oracle(seed):
+    """The hybrid port kernel (Pallas full-mask blocks + XLA ported
+    segments, packed-domain assembly) equals the CPU oracle and the pure
+    XLA port kernel bit-for-bit — incl. named ports and restrictions."""
+    cluster = random_cluster(
+        GeneratorConfig(
+            n_pods=61, n_policies=9, n_namespaces=3, p_ports=0.8,
+            p_named_port=0.3, p_container_ports=0.5, seed=seed,
+        )
+    )
+    enc = encode_cluster(cluster, compute_ports=True)
+    if len(enc.atoms) <= 1:
+        pytest.skip("generator produced a portless cluster")
+    hybrid = tiled_k8s_reach(enc, tile=32, chunk=8, use_pallas=True)
+    xla = tiled_k8s_reach(enc, tile=32, chunk=8, use_pallas=False)
+    np.testing.assert_array_equal(hybrid.to_bool(), xla.to_bool())
+    ref = kv.verify(cluster, kv.VerifyConfig(backend="cpu"))
+    np.testing.assert_array_equal(hybrid.to_bool(), ref.reach)
+
+
+@pytest.mark.parametrize(
+    "flags",
+    [
+        dict(self_traffic=False),
+        dict(default_allow_unselected=False),
+        dict(direction_aware_isolation=False),
+    ],
+)
+def test_ports_hybrid_flags(flags):
+    cluster = random_cluster(
+        GeneratorConfig(
+            n_pods=45, n_policies=7, n_namespaces=2, p_ports=0.9,
+            p_named_port=0.25, p_container_ports=0.5, seed=13,
+        )
+    )
+    enc = encode_cluster(cluster, compute_ports=True)
+    if len(enc.atoms) <= 1:
+        pytest.skip("generator produced a portless cluster")
+    hybrid = tiled_k8s_reach(enc, tile=32, chunk=8, use_pallas=True, **flags)
+    ref = kv.verify(cluster, kv.VerifyConfig(backend="cpu", **flags))
+    np.testing.assert_array_equal(hybrid.to_bool(), ref.reach)
