@@ -75,7 +75,8 @@ def bench_tiled(args) -> None:
     )
     res = run()  # compile + first solve
     t3 = time.perf_counter()
-    log(f"compile+first solve {t3 - t2:.1f}s")
+    log(f"compile+first solve {t3 - t2:.1f}s  "
+        f"kernel={res.timings.get('kernel', '?')}")
     times = []
     for _ in range(max(2, min(args.repeats, 5))):
         r = run()
@@ -340,7 +341,12 @@ def bench_closure(args) -> None:
             )
         )
         if bool(jnp.any(inc._packed & ~jnp.asarray(inc._closure_base))):
+            adds_real = True
             break
+    else:
+        adds_real = False
+        log("WARNING: no donor diff added reach — the adds-only figure "
+            "times a no-op delta closure")
     s = time.perf_counter()
     sync(inc.closure_packed(tile=args.closure_tile))
     adds_s = time.perf_counter() - s
@@ -370,6 +376,7 @@ def bench_closure(args) -> None:
                 "vs_baseline": round(full_s / adds_s, 2),
                 "full_s": round(full_s, 2),
                 "mixed_diff_s": round(mixed_s, 2),
+                "adds_diff_real": adds_real,
             }
         )
     )
@@ -405,6 +412,11 @@ def bench_stripe(args) -> None:
     log(f"device: {dev} ({jax.default_backend()})")
     mesh = mesh_for((1, 1), devices=[dev])
     base_n = 2000
+    if args.pods < base_n or args.pods % base_n:
+        sys.exit(
+            f"--mode stripe tiles a {base_n}-pod base cluster; --pods must "
+            f"be a positive multiple of {base_n}"
+        )
     reps = args.pods // base_n  # default 1M = 2000 × 500
     t0 = time.perf_counter()
     base = random_cluster(
@@ -415,9 +427,7 @@ def bench_stripe(args) -> None:
         )
     )
     enc_base = encode_cluster(base, compute_ports=False)
-    import dataclasses as _dc
-
-    enc_big = _dc.replace(
+    enc_big = dataclasses.replace(
         enc_base,
         n_pods=enc_base.n_pods * reps,
         pod_kv=np.tile(enc_base.pod_kv, (reps, 1)),

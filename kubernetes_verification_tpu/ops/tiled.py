@@ -805,7 +805,9 @@ def _tiled_ports_pallas_step(
         return packed_dir_allow(
             padp(a_rows), padp(b_rows),
             jnp.broadcast_to(niso.astype(_I32), (8, N)),
-            tm=min(256, N), tn=ptn, tk=tk,
+            # tm must divide N; gcd keeps interpret-mode shapes like
+            # N = 384 (tile-multiple but not 256-multiple) working
+            tm=math.gcd(N, 256), tn=ptn, tk=tk,
             default_allow_axis=axis, interpret=interpret,
         )
 
@@ -1439,13 +1441,23 @@ def tiled_k8s_reach(
             bank8[:, :n] = enc.restrict_bank
         else:
             bank8 = np.ones((1, Np), dtype=np.int8)
+        # the hybrid requires restriction-free full blocks (true except in
+        # a degenerate one-atom universe, where a named single-atom
+        # variant IS the full mask)
+        full_res_clean = True
+        for vr, (fs, fl) in (
+            (vp_res_i, layout.full_i), (vp_res_e, layout.full_e),
+        ):
+            if fl and np.asarray(vr[fs : fs + fl]).any():
+                full_res_clean = False
+        hybrid = use_pallas and full_res_clean
         # the three resident int8 operands — two [total_vp, N] peer maps plus
         # the gathered egress selection — are the port path's memory floor;
         # the hybrid Pallas step bakes a fourth ([total_i, N] ingress
-        # selection), counted when it may run. Catch an over-wide VP layout
-        # here rather than as a device OOM.
+        # selection), counted only when it will actually run. Catch an
+        # over-wide VP layout here rather than as a device OOM.
         resident = (
-            (2 if use_pallas else 1) * len(vp_pol_i) + 2 * len(vp_pol_e)
+            (2 if hybrid else 1) * len(vp_pol_i) + 2 * len(vp_pol_e)
         ) * Np
         if resident > _PORT_RESIDENT_BUDGET:
             raise ValueError(
@@ -1462,16 +1474,8 @@ def tiled_k8s_reach(
         )
         if device is not None:
             args = jax.device_put(args, device)
-        # the hybrid requires restriction-free full blocks (true except in
-        # a degenerate one-atom universe, where a named single-atom
-        # variant IS the full mask)
-        full_res_clean = True
-        for vr, (fs, fl) in (
-            (vp_res_i, layout.full_i), (vp_res_e, layout.full_e),
-        ):
-            if fl and np.asarray(vr[fs : fs + fl]).any():
-                full_res_clean = False
-        if use_pallas and full_res_clean:
+        kernel = "pallas-hybrid" if hybrid else "xla-ports"
+        if hybrid:
             packed, ing_iso, eg_iso, selected = _tiled_ports_pallas_step(
                 *args,
                 layout=layout,
@@ -1496,6 +1500,7 @@ def tiled_k8s_reach(
         args = (*common, col_mask)
         if device is not None:
             args = jax.device_put(args, device)
+        kernel = "pallas" if use_pallas else "xla"
         packed, ing_iso, eg_iso, selected = _tiled_step(
             *args,
             tile=tile,
@@ -1525,7 +1530,10 @@ def tiled_k8s_reach(
         ingress_isolated=np.asarray(ing_iso[:n]),
         egress_isolated=np.asarray(eg_iso[:n]),
         selected=None,
-        timings={label: t1 - t0},
+        # "kernel" records what actually ran — a forced use_pallas can
+        # legitimately fall back (restricted full blocks, awkward
+        # interpret-mode shapes), and benchmarks must not misattribute
+        timings={label: t1 - t0, "kernel": kernel},
     )
     if not fetch:
         out.timings["reachable_pairs"] = total
