@@ -49,6 +49,10 @@ that Pallas fuses. Pre-baking the per-tile ingress gather as a fourth
 resident operand was also measured and bought nothing. The XLA mask-group
 kernel therefore remains the port-path default; the hybrid stays available
 (``use_pallas=True`` with a multi-atom encoding) and differentially tested.
+Two further levers were measured and rejected: larger dst tiles (raising
+``_PORT_SLAB_BUDGET`` so tile 576→1024: 3.71→4.04 s, →2048 OOMs HBM) and an
+int32 bit-plane overlap combine (1.8× slower — see ``_mask_group_conj``).
+The mask-group sweep is at its practical XLA optimum on this hardware.
 Of r03's 3.62 s → 3.72 s drift: the generator gained named container ports
 between the rounds (extra restriction-bank gathers + more VP rows), i.e.
 config change, not regression — the same build measures 3.7–4.0 s
