@@ -200,6 +200,27 @@ class Program:
 
 _EINSUM_LETTERS = "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ"
 
+#: jitted boolean-einsum kernels, one per einsum spec (jax's jit adds the
+#: per-shape specialisation underneath each entry)
+_RULE_EINSUM_CACHE: Dict[str, object] = {}
+
+
+def _jit_rule_einsum(expr: str):
+    fn = _RULE_EINSUM_CACHE.get(expr)
+    if fn is None:
+        import jax
+        import jax.numpy as jnp
+
+        def run(*ops, _expr=expr):
+            counts = jnp.einsum(
+                _expr, *[o.astype(jnp.float32) for o in ops]
+            )
+            return counts > 0
+
+        fn = jax.jit(run)
+        _RULE_EINSUM_CACHE[expr] = fn
+    return fn
+
 
 def _apply_rule(
     rule: RuleDef, rels: Mapping[str, "np.ndarray"], xp
@@ -248,8 +269,14 @@ def _apply_rule(
     out_letters = "".join(sub[v] for v in var_order)
     if operands:
         expr = ",".join(specs) + "->" + out_letters
-        counts = xp.einsum(expr, *[o.astype(np.float32) for o in operands])
-        val = counts > 0
+        if xp is np:
+            counts = np.einsum(expr, *[o.astype(np.float32) for o in operands])
+            val = counts > 0
+        else:
+            # jit-cached per einsum spec (jax re-specialises per operand
+            # shape under the same cache entry): repeated sweeps re-run the
+            # compiled kernel instead of re-tracing every application
+            val = _jit_rule_einsum(expr)(*operands)
     else:  # fact-like rule with only negated atoms is rejected as unsafe
         val = xp.ones((), dtype=bool)
 

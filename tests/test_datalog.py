@@ -227,3 +227,26 @@ def test_negated_atom_with_repeated_variable():
     )
     sol = solve(prog)
     np.testing.assert_array_equal(sol["no_self"], [True, False, True, True])
+
+
+def test_jax_mode_caches_rule_kernels():
+    """use_jax=True compiles one kernel per einsum spec and reuses it
+    across sweeps/solves instead of re-tracing every rule application."""
+    from kubernetes_verification_tpu.datalog import engine as E
+
+    E._RULE_EINSUM_CACHE.clear()
+    prog = Program()
+    d = prog.domain("n", 6)
+    prog.relation("e", d, d)
+    prog.relation("p", d, d)
+    for s_, t in [(0, 1), (1, 2), (2, 3), (3, 4)]:
+        prog.fact("e", s_, t)
+    prog.rule(Atom("p", ("x", "y")), Atom("e", ("x", "y")))
+    prog.rule(Atom("p", ("x", "z")), Atom("p", ("x", "y")), Atom("p", ("y", "z")))
+    a = solve(prog, use_jax=True)
+    n_kernels = len(E._RULE_EINSUM_CACHE)
+    assert 0 < n_kernels <= 2  # one per distinct einsum spec, not per sweep
+    b = solve(prog, use_jax=True)
+    assert len(E._RULE_EINSUM_CACHE) == n_kernels  # reused across solves
+    np.testing.assert_array_equal(a["p"], b["p"])
+    np.testing.assert_array_equal(a["p"], solve(prog)["p"])
