@@ -67,6 +67,34 @@ class ShardedPackedVerifyResult(VerifyResult):
     #: — computed on first pairwise-policy query, cached thereafter
     pair_masks_fn: Optional[Callable] = None
     _pair_masks: Optional[Tuple[np.ndarray, np.ndarray]] = None
+    #: lazy thunk: () -> (src_sets, dst_sets) bool [P, N] via the sharded
+    #: set build (``policy_sets_sharded``) — see materialize_policy_sets
+    policy_sets_fn: Optional[Callable] = None
+    #: host bytes the materialised sets would occupy (2·P·N), set by the
+    #: backend so the budget check runs BEFORE any device work
+    policy_sets_bytes: Optional[int] = None
+
+    def materialize_policy_sets(
+        self, max_bytes: int = 2_000_000_000
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Fetch the per-policy src/dst edge sets (kano ``working_select``/
+        ``working_allow``) from a sharded build into ``self.src_sets``/
+        ``dst_sets`` — the one result view this engine keeps implicit by
+        default (two host bool [P, N] arrays; at 100k pods × 10k policies
+        that is 2 GB, hence the explicit byte budget). The pairwise policy
+        queries do NOT need this — they run on device Gram masks."""
+        if self.src_sets is None:
+            if self.policy_sets_fn is None:
+                raise ValueError("no policy-sets thunk attached to this result")
+            need = self.policy_sets_bytes or 0
+            if need > max_bytes:
+                raise ValueError(
+                    f"policy sets need {need / 1e9:.1f} GB on host, over "
+                    f"the {max_bytes / 1e9:.1f} GB budget; raise max_bytes "
+                    "explicitly to fetch them anyway"
+                )
+            self.src_sets, self.dst_sets = self.policy_sets_fn()
+        return self.src_sets, self.dst_sets
 
     def _pk(self) -> PackedShardedResult:
         if self.packed_result is None:
@@ -186,7 +214,7 @@ class ShardedPackedBackend(VerifierBackend):
             closure_packed = pk.closure(tile=config.opt("closure_tile", 512))
             if dense_ok:
                 closure = unpack_cols(closure_packed, cluster.n_pods)
-        from ..ops.tiled import policy_pair_masks_sharded
+        from ..ops.tiled import policy_pair_masks_sharded, policy_sets_sharded
 
         return ShardedPackedVerifyResult(
             n_pods=cluster.n_pods,
@@ -215,6 +243,13 @@ class ShardedPackedBackend(VerifierBackend):
                 direction_aware_isolation=config.direction_aware_isolation,
                 chunk=config.opt("chunk", 1024),
             ),
+            policy_sets_fn=lambda: policy_sets_sharded(
+                mesh,
+                enc,
+                direction_aware_isolation=config.direction_aware_isolation,
+                chunk=config.opt("chunk", 1024),
+            ),
+            policy_sets_bytes=2 * enc.n_policies * cluster.n_pods,
         )
 
     def verify_kano(

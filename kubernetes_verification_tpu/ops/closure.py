@@ -52,11 +52,11 @@ def transitive_closure(reach: jnp.ndarray) -> jnp.ndarray:
 
 
 def _unpack_rows_i8(words: jnp.ndarray, n_cols: int) -> jnp.ndarray:
-    """uint32 [R, W] → int8 [R, n_cols] (n_cols == 32·W)."""
-    r = words.shape[0]
-    bits = jnp.arange(32, dtype=_U32)[None, None, :]
-    out = (words[:, :, None] >> bits) & jnp.uint32(1)
-    return out.reshape(r, n_cols).astype(_I8)
+    """uint32 [R, W] → int8 [R, n_cols] — the shared device unpack
+    (``ops.tiled.unpack_words_i8``)."""
+    from ..ops.tiled import unpack_words_i8
+
+    return unpack_words_i8(words, n_cols)
 
 
 @partial(jax.jit, static_argnames=("tile",))

@@ -63,6 +63,7 @@ __all__ = [
     "unpack_cols",
     "policy_pair_masks",
     "policy_pair_masks_sharded",
+    "policy_sets_sharded",
 ]
 
 _I8 = jnp.int8
@@ -94,6 +95,16 @@ def pack_bool_cols(tile: jnp.ndarray) -> jnp.ndarray:
     w = tile.reshape(r, c // 32, 32).astype(_U32)
     weights = (jnp.uint32(1) << jnp.arange(32, dtype=_U32))[None, None, :]
     return (w * weights).sum(axis=-1, dtype=_U32)
+
+
+def unpack_words_i8(words: jnp.ndarray, n_cols: int) -> jnp.ndarray:
+    """uint32 [..., W] → int8 [..., n_cols] (n_cols == 32·W, little bit
+    order — the inverse of ``pack_bool_cols`` on the last axis). The single
+    device-side unpack shared by the closure kernels and the port-diff
+    engine's bit-packed value transfers."""
+    bits = jnp.arange(32, dtype=jnp.uint32)
+    out = (words[..., None] >> bits) & jnp.uint32(1)
+    return out.reshape(*words.shape[:-1], n_cols).astype(_I8)
 
 
 def unpack_cols(packed: np.ndarray, n_cols: int) -> np.ndarray:
@@ -1154,23 +1165,11 @@ def _policy_sets_step(
     (``share`` co-selection, ``dd`` dst overlap, ``dsize`` dst popcount) are
     everything ``policy_shadow``/``policy_conflict`` need — the [P, N] sets
     never leave the device."""
+    src8, dst8 = _policy_sets(
+        pod_kv, pod_key, pod_ns, ns_kv, ns_key, pol_sel, pol_ns,
+        gate_i, gate_e, ingress, egress, valid, chunk=chunk,
+    )
     P = pol_ns.shape[0]
-    selected8 = (
-        match_selectors(pol_sel, pod_kv, pod_key)
-        & (pol_ns[:, None] == pod_ns[None, :])
-    ).astype(_I8)
-    ing_peers = _peers_by_slot(
-        ingress, ingress.pol, P + 1, chunk,
-        pod_kv, pod_key, ns_kv, ns_key, pod_ns, pol_ns,
-    )[:P]
-    eg_peers = _peers_by_slot(
-        egress, egress.pol, P + 1, chunk,
-        pod_kv, pod_key, ns_kv, ns_key, pod_ns, pol_ns,
-    )[:P]
-    gi = gate_i.astype(_I8)[:, None]
-    ge = gate_e.astype(_I8)[:, None]
-    src8 = jnp.maximum(ing_peers * gi, selected8 * ge) * valid[None, :]
-    dst8 = jnp.maximum(selected8 * gi, eg_peers * ge) * valid[None, :]
 
     def gram(a):  # [P, N] ⊗ [P, N] → int32 [P, P], contract pods
         return jax.lax.dot_general(
@@ -1190,6 +1189,34 @@ def _policy_sets_step(
         & ~eye
     )
     return shadow, conflict
+
+
+@partial(jax.jit, static_argnames=("chunk",))
+def _policy_sets(
+    pod_kv, pod_key, pod_ns, ns_kv, ns_key, pol_sel, pol_ns,
+    gate_i, gate_e, ingress: GrantBlock, egress: GrantBlock, valid,
+    *, chunk: int,
+):
+    """The [P, N] per-policy src/dst edge sets (the Gram step's operands;
+    also materialisable on demand for small-enough P·N)."""
+    P = pol_ns.shape[0]
+    selected8 = (
+        match_selectors(pol_sel, pod_kv, pod_key)
+        & (pol_ns[:, None] == pod_ns[None, :])
+    ).astype(_I8)
+    ing_peers = _peers_by_slot(
+        ingress, ingress.pol, P + 1, chunk,
+        pod_kv, pod_key, ns_kv, ns_key, pod_ns, pol_ns,
+    )[:P]
+    eg_peers = _peers_by_slot(
+        egress, egress.pol, P + 1, chunk,
+        pod_kv, pod_key, ns_kv, ns_key, pod_ns, pol_ns,
+    )[:P]
+    gi = gate_i.astype(_I8)[:, None]
+    ge = gate_e.astype(_I8)[:, None]
+    src8 = jnp.maximum(ing_peers * gi, selected8 * ge) * valid[None, :]
+    dst8 = jnp.maximum(selected8 * gi, eg_peers * ge) * valid[None, :]
+    return src8, dst8
 
 
 def policy_pair_masks(
@@ -1248,20 +1275,33 @@ def _pair_mask_args(
     )
 
 
-def policy_pair_masks_sharded(
+def policy_sets_sharded(
     mesh,
     enc: EncodedCluster,
     *,
     direction_aware_isolation: bool = True,
     chunk: int = 2048,
 ) -> Tuple[np.ndarray, np.ndarray]:
-    """``policy_pair_masks`` over a device mesh: the [P, N] src/dst set
-    builds and the O(P²·N) Gram contractions run SPMD with the pod axis
-    sharded over ``pods`` — XLA lowers the Gram's contraction of the
-    sharded axis to per-device dots plus a ``psum``. The grant stacks
-    replicate (selector rows are small); ``ip_match`` — the one grant leaf
-    with a pod axis — shards over ``pods`` too. Only the [P, P] masks come
-    back to the host."""
+    """Materialise the per-policy ``(src_sets, dst_sets)`` bool [P, N] from
+    a sharded build — the kano ``working_select``/``working_allow`` sets at
+    scales where the backend otherwise keeps them implicit. The build runs
+    SPMD like ``policy_pair_masks_sharded``; the result ships to the host,
+    so the CALLER must bound P·N (the sharded-packed result's
+    ``materialize_policy_sets`` enforces a byte budget)."""
+    src8, dst8 = _policy_sets(
+        *_sharded_set_args(mesh, enc, direction_aware_isolation, chunk),
+        chunk=chunk,
+    )
+    n = enc.n_pods
+    # slice + booleanise ON DEVICE so the host fetch is exactly the two
+    # bool [P, n] arrays the caller budgeted for (fetching the padded int8
+    # form first would double the host peak)
+    return np.asarray(src8[:, :n] > 0), np.asarray(dst8[:, :n] > 0)
+
+
+def _sharded_set_args(mesh, enc, direction_aware_isolation, chunk):
+    """Device placement shared by the sharded Gram-mask and set-materialise
+    entries: pod-axis leaves shard over ``pods``, the rest replicate."""
     from jax.sharding import NamedSharding
     from jax.sharding import PartitionSpec as PS
 
@@ -1284,7 +1324,7 @@ def policy_pair_masks_sharded(
             specs = dataclasses.replace(specs, ip_match=shp(None, POD_AXIS))
         return jax.device_put(b, specs)
 
-    shadow, conflict = _policy_sets_step(
+    return (
         jax.device_put(pod_kv, shp(POD_AXIS, None)),
         jax.device_put(pod_key, shp(POD_AXIS, None)),
         jax.device_put(pod_ns, shp(POD_AXIS)),
@@ -1297,6 +1337,25 @@ def policy_pair_masks_sharded(
         put_block(ingress),
         put_block(egress),
         jax.device_put(valid, shp(POD_AXIS)),
+    )
+
+
+def policy_pair_masks_sharded(
+    mesh,
+    enc: EncodedCluster,
+    *,
+    direction_aware_isolation: bool = True,
+    chunk: int = 2048,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """``policy_pair_masks`` over a device mesh: the [P, N] src/dst set
+    builds and the O(P²·N) Gram contractions run SPMD with the pod axis
+    sharded over ``pods`` — XLA lowers the Gram's contraction of the
+    sharded axis to per-device dots plus a ``psum``. The grant stacks
+    replicate (selector rows are small); ``ip_match`` — the one grant leaf
+    with a pod axis — shards over ``pods`` too. Only the [P, P] masks come
+    back to the host."""
+    shadow, conflict = _policy_sets_step(
+        *_sharded_set_args(mesh, enc, direction_aware_isolation, chunk),
         chunk=chunk,
     )
     return np.asarray(shadow), np.asarray(conflict)

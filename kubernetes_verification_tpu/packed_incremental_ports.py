@@ -77,6 +77,7 @@ from .ops.tiled import (
     _split_and_check_port_masks,
     _split_grant_ports,
     pack_bool_cols,
+    unpack_words_i8,
 )
 from .packed_incremental import (
     PackedIncrementalVerifier,
@@ -351,15 +352,6 @@ def _ports_sweep(
     return out & col_mask[None, :]
 
 
-def _unpack_vals(words: jnp.ndarray, n_cols: int) -> jnp.ndarray:
-    """uint32 [2, K, W] → int8 [2, K, n_cols]: the diff's new VP-row values
-    travel host→device bit-packed (8× less tunnel traffic — the transfer
-    dominated policy-add latency at flagship scale) and unpack on device."""
-    bits = jnp.arange(32, dtype=_U32)
-    out = (words[..., None] >> bits) & jnp.uint32(1)
-    return out.reshape(*words.shape[:-1], n_cols).astype(_I8)
-
-
 @partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5))
 def _vp_write(
     vp_peers_i, sel_ing_vp, sel_eg_vp, vp_peers_e, ing_cnt, eg_cnt,
@@ -370,9 +362,11 @@ def _vp_write(
     d_ing_cnt,  # int32 [Np] — policy-level isolation count delta
     d_eg_cnt,
 ):
+    # the diff's new VP-row values travel host→device bit-packed (8× less
+    # tunnel traffic) and unpack on device via the shared kernel
     Np = vp_peers_i.shape[1]
-    vi = _unpack_vals(vals_i, Np)
-    ve = _unpack_vals(vals_e, Np)
+    vi = unpack_words_i8(vals_i, Np)
+    ve = unpack_words_i8(vals_e, Np)
     return (
         vp_peers_i.at[rows_i].set(vi[0]),
         sel_ing_vp.at[rows_i].set(vi[1]),

@@ -388,3 +388,29 @@ def test_pairwise_masks_respect_direction_aware_flag():
     )
     assert res.policy_shadow() == ref.policy_shadow()
     assert res.policy_conflict() == ref.policy_conflict()
+
+
+def test_materialize_policy_sets_matches_cpu():
+    """The sharded-packed result can materialise the per-policy src/dst
+    edge sets on demand (budget-guarded); they equal the CPU oracle's."""
+    cluster = random_cluster(
+        GeneratorConfig(n_pods=80, n_policies=14, n_namespaces=3, seed=23)
+    )
+    res = kv.verify(
+        cluster,
+        kv.VerifyConfig(
+            backend="sharded-packed", backend_options=(("mesh", (4, 2)),)
+        ),
+    )
+    ref = kv.verify(cluster, kv.VerifyConfig(backend="cpu"))
+    with pytest.raises(ValueError, match="budget"):
+        res.materialize_policy_sets(max_bytes=10)
+    src, dst = res.materialize_policy_sets()
+    np.testing.assert_array_equal(src, ref.src_sets)
+    np.testing.assert_array_equal(dst, ref.dst_sets)
+    # with the sets materialised, the base-class pairwise queries agree
+    # with the Gram-mask path
+    from kubernetes_verification_tpu.backends.base import VerifyResult
+
+    assert VerifyResult.policy_shadow(res) == res.policy_shadow()
+    assert VerifyResult.policy_conflict(res) == res.policy_conflict()
