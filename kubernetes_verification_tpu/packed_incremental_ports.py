@@ -95,6 +95,17 @@ _U32 = jnp.uint32
 _ROW_GROUP = 256
 _COL_GROUP = 256
 
+#: fixed size ladder for the per-diff VP-row value buffers: one compiled
+#: _vp_write per rung (prewarmed), instead of one per novel power of two
+_VALS_CAPS = (1, 8, 64)
+
+
+def _vals_cap(k: int) -> int:
+    for c in _VALS_CAPS:
+        if k <= c:
+            return c
+    return 1 << (k - 1).bit_length()  # huge diffs: rare, compile tolerated
+
 
 def _make_shardings(mesh) -> Optional[Dict[str, object]]:
     """The placement-kind table shared by __init__ and from_state."""
@@ -682,20 +693,25 @@ class PackedPortsIncrementalVerifier:
         write to the sink rows plus no-op row/column patches (row 0 and a
         fully-masked column group recompute their current values)."""
         Np = self._n_padded
-        sink = {d: np.asarray([self._total_rows[d] - 1], dtype=np.int32)
-                for d in ("i", "e")}
-        zero_vals = np.zeros((2, 1, Np // 32), dtype=np.uint32)
         zero_cnt = np.zeros(Np, dtype=np.int32)
-        out = _vp_write(
-            *self._operands, self._ing_cnt, self._eg_cnt,
-            self._put(sink["i"], "rep"), self._put(zero_vals, "rep"),
-            self._put(sink["e"], "rep"), self._put(zero_vals, "rep"),
-            self._put(zero_cnt, "vec"), self._put(zero_cnt, "vec"),
-        )
-        (
-            self._vp_peers_i, self._sel_ing_vp, self._sel_eg_vp,
-            self._vp_peers_e, self._ing_cnt, self._eg_cnt,
-        ) = out
+        # one no-op write per ladder rung, so a serving diff never pays a
+        # _vp_write compile (sink rows: always last, always zero)
+        for cap in _VALS_CAPS:
+            sink = {
+                d: np.full(cap, self._total_rows[d] - 1, dtype=np.int32)
+                for d in ("i", "e")
+            }
+            zero_vals = np.zeros((2, cap, Np // 32), dtype=np.uint32)
+            out = _vp_write(
+                *self._operands, self._ing_cnt, self._eg_cnt,
+                self._put(sink["i"], "rep"), self._put(zero_vals, "rep"),
+                self._put(sink["e"], "rep"), self._put(zero_vals, "rep"),
+                self._put(zero_cnt, "vec"), self._put(zero_cnt, "vec"),
+            )
+            (
+                self._vp_peers_i, self._sel_ing_vp, self._sel_eg_vp,
+                self._vp_peers_e, self._ing_cnt, self._eg_cnt,
+            ) = out
         self._patch(np.zeros(1, dtype=np.int64), np.asarray([], dtype=np.int64))
         from .packed_incremental import PackedIncrementalVerifier as _PIV
 
@@ -1006,11 +1022,19 @@ class PackedPortsIncrementalVerifier:
         self._h_ing_cnt = ing2
         self._h_eg_cnt = eg2
 
+        k_i = max(1, len(set(freed_i) | set(assigned_i)))
+        k_e = max(1, len(set(freed_e) | set(assigned_e)))
+        # ONE cap for both directions, drawn from the fixed ladder the
+        # prewarm compiled: arbitrary per-diff power-of-two caps made every
+        # novel size pay a full ~1.5 s _vp_write XLA compile mid-serving
+        # (profiled at flagship: 4.4 s of a 10-add burst was compiles)
+        cap = _vals_cap(max(k_i, k_e))
+
         def safe_pack(assigned, freed, sel_vec, is_ingress, d):
-            """Touched-row indices (power-of-two padded by repetition — the
-            duplicated scatter writes carry equal values) + their new
-            operand values, bit-packed to uint32 [2, K, Np/32] for the
-            host→device transfer (freed rows → zeros)."""
+            """Touched-row indices (padded to the shared ladder cap by
+            repetition — the duplicated scatter writes carry equal values)
+            + their new operand values, bit-packed to uint32 [2, cap, Np/32]
+            for the host→device transfer (freed rows → zeros)."""
             touched = sorted(set(freed) | set(assigned))
             if not touched:
                 # no-op write: the layout's sink row (always last, always
@@ -1018,7 +1042,6 @@ class PackedPortsIncrementalVerifier:
                 # every segment at capacity
                 touched = [self._total_rows[d] - 1]
             k = len(touched)
-            cap = 1 << (k - 1).bit_length()
             touched = touched + [touched[-1]] * (cap - k)
             vals = np.zeros((2, cap, Np), dtype=np.int8)
             for j, row in enumerate(touched[:k]):
