@@ -425,13 +425,17 @@ def test_pod_named_port_resolution_enforced():
     assert not inc.reach[1, 3]
 
 
-def test_fuzzed_pod_and_policy_churn_ports():
+@pytest.mark.parametrize("mesh_shape", [None, (4, 2)])
+def test_fuzzed_pod_and_policy_churn_ports(mesh_shape):
     import random
+
+    from kubernetes_verification_tpu.parallel.mesh import mesh_for
 
     cluster = _mk(seed=41, n_pods=43)
     cfg = kv.VerifyConfig(compute_ports=True)
     inc = PackedPortsIncrementalVerifier(
-        cluster, cfg, headroom=16, pod_headroom=8
+        cluster, cfg, headroom=16, pod_headroom=8,
+        mesh=mesh_for(mesh_shape) if mesh_shape else None,
     )
     donor = _mk(seed=42, n_policies=18)
     rng = random.Random(3)
