@@ -254,3 +254,19 @@ def test_cli_diff_unchanged_manifests_are_noops(tmp_path, capsys):
     rep = json.loads(capsys.readouterr().out)
     assert rep["ops"] == []
     assert rep["after"]["update_count"] == rep["before"]["update_count"]
+
+
+def test_cli_snapshot_diff_with_mesh_opt(tmp_path, capsys):
+    """The serving loop runs mesh-sharded end to end: snapshot builds the
+    engine on a mesh, diff resumes onto a (different) mesh factorisation."""
+    d = str(tmp_path / "c")
+    ck = str(tmp_path / "k")
+    assert main(["generate", d, "--pods", "26", "--policies", "5"]) == 0
+    capsys.readouterr()
+    assert main(["snapshot", d, ck, "--opt", "mesh=4,2", "--json"]) == 0
+    snap = json.loads(capsys.readouterr().out)
+    assert snap["pods"] == 26
+    assert main(["diff", ck, "--opt", "mesh=2,4", "--json"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["ops"] == []
+    assert rep["after"]["reachable_pairs"] == _fresh_pairs(ck)
