@@ -232,15 +232,29 @@ def cmd_snapshot(args) -> int:
             cluster, cfg, mesh=mesh,
             headroom=args.headroom, pod_headroom=args.pod_headroom,
         )
-        save_ports_incremental(inc, args.dir)
     else:
         inc = PackedIncrementalVerifier(
             cluster, cfg, mesh=mesh, pod_headroom=args.pod_headroom,
         )
+    closure_s = None
+    if args.closure:
+        import time as _time
+
+        s = _time.perf_counter()
+        c = inc.closure_packed(tile=int(opts.get("closure_tile", 7168)))
+        import jax
+
+        jax.block_until_ready(c)
+        closure_s = round(_time.perf_counter() - s, 3)
+    if args.ports:
+        save_ports_incremental(inc, args.dir)
+    else:
         save_packed_incremental(inc, args.dir)
     agg = _inc_aggregates(inc)
     agg["engine"] = "ports" if args.ports else "any-port"
     agg["init_s"] = round(inc.init_time, 3)
+    if closure_s is not None:
+        agg["closure_s"] = closure_s
     agg["saved"] = args.dir
     if skipped:
         agg["skipped_documents"] = skipped
@@ -264,6 +278,11 @@ def cmd_diff(args) -> int:
     from .packed_incremental_ports import PortUniverseChanged
 
     before = _inc_aggregates(inc)
+    # closure presence is decided at LOAD time: a pod-axis grow during the
+    # diffs invalidates the cached closure (shape change), and the
+    # maintenance below must then recompute it in full rather than silently
+    # dropping it from the checkpoint
+    had_closure = getattr(inc, "_closure", None) is not None
     ops = []
     skipped_docs = []
     try:
@@ -278,14 +297,26 @@ def cmd_diff(args) -> int:
         )
     except KeyError as e:
         raise SystemExit(
-            f"diff references an unknown pod/policy after {len(ops)} "
-            f"applied ops (not saved): {e}"
+            f"diff references an unknown pod/policy/namespace after "
+            f"{len(ops)} applied ops (not saved): {e}"
         )
-    except ValueError as e:  # e.g. a namespace relabel
-        raise SystemExit(
-            f"diff requires a rebuild after {len(ops)} applied ops "
-            f"(not saved): {e}"
+    # any other ValueError is an internal invariant violation — let it
+    # propagate with its traceback instead of masquerading as an operator
+    # "rebuild required" message (advisor, round 4)
+    closure_s = None
+    if had_closure and not args.no_save:
+        # the snapshot carries a maintained closure: bring it current via
+        # the delta re-closure (diff-local; the engines marked the dirty
+        # nodes as the diffs applied) so the saved state stays
+        # query-ready for path questions across restarts. --no-save is a
+        # dry run: don't pay for a closure that would be discarded.
+        import jax
+
+        s = time.perf_counter()
+        jax.block_until_ready(
+            inc.closure_packed(tile=int(opts.get("closure_tile", 7168)))
         )
+        closure_s = round(time.perf_counter() - s, 3)
     t2 = time.perf_counter()
     after = _inc_aggregates(inc)
     out_dir = args.out or args.dir
@@ -314,6 +345,8 @@ def cmd_diff(args) -> int:
         "diff_s": round(t2 - t1, 3),
         "saved": None if args.no_save else out_dir,
     }
+    if closure_s is not None:
+        summary["closure_s"] = closure_s
     if skipped_docs:
         summary["skipped_documents"] = skipped_docs
     if args.json:
@@ -343,9 +376,18 @@ def _apply_diffs(args, inc, ops, skipped_docs) -> None:
             # labeled Namespace docs must register BEFORE their pods so
             # namespaceSelector peers see the labels; label-less entries are
             # indistinguishable from the loader's auto-created ones and are
-            # left to add_pod's auto-create
-            if ns.labels and inc.add_namespace(ns):
-                ops.append(["add-namespace", ns.name])
+            # left to add_pod's auto-create (which also means a relabel TO
+            # empty labels cannot be expressed through a manifest — only a
+            # LABELED row is treated as authoritative)
+            if not ns.labels:
+                continue
+            existing = inc._ns_labels.get(ns.name)
+            if existing is None:
+                if inc.add_namespace(ns):
+                    ops.append(["add-namespace", ns.name])
+            elif dict(existing) != dict(ns.labels):
+                inc.update_namespace_labels(ns.name, dict(ns.labels))
+                ops.append(["relabel-namespace", ns.name])
         for pod in delta.pods:
             key = f"{pod.namespace}/{pod.name}"
             if key in inc._pod_idx:
@@ -380,11 +422,25 @@ def _apply_diffs(args, inc, ops, skipped_docs) -> None:
                 ops.append(["add-policy", key])
     for spec in args.remove:
         kind, _, rest = spec.partition("/")
+        if kind == "namespace":
+            if not rest or "/" in rest:
+                raise SystemExit(
+                    f"--remove expects namespace/NAME, got {spec!r}"
+                )
+            try:
+                inc.remove_namespace(rest)
+            except ValueError as e:
+                # op-ordering error (pods/policies still inside) — a clean
+                # operator message, not a traceback; list removals for the
+                # namespace's contents FIRST
+                raise SystemExit(f"cannot remove namespace {rest}: {e}")
+            ops.append(["remove-namespace", rest])
+            continue
         ns, sep, name = rest.partition("/")
         if kind not in ("pod", "policy") or not sep:
             raise SystemExit(
-                f"--remove expects pod/NAMESPACE/NAME or "
-                f"policy/NAMESPACE/NAME, got {spec!r}"
+                f"--remove expects pod/NAMESPACE/NAME, "
+                f"policy/NAMESPACE/NAME or namespace/NAME, got {spec!r}"
             )
         if kind == "pod":
             inc.remove_pod(ns, name)
@@ -468,6 +524,12 @@ def main(argv: Optional[list] = None) -> int:
         "--pod-headroom", type=int, default=0,
         help="extra pod slots for add_pod without a grow",
     )
+    p.add_argument(
+        "--closure", action="store_true",
+        help="also compute the packed transitive closure and persist it; "
+        "later `kv-tpu diff` runs maintain it incrementally "
+        "(packed_closure_delta) instead of re-closing from scratch",
+    )
     p.add_argument("--json", action="store_true")
     p.add_argument("--opt", action="append", default=[], metavar="KEY=VALUE")
     p.set_defaults(fn=cmd_snapshot)
@@ -484,8 +546,9 @@ def main(argv: Optional[list] = None) -> int:
     )
     p.add_argument(
         "--remove", action="append", default=[], metavar="KIND/NS/NAME",
-        help="remove a pod or policy, e.g. --remove pod/prod/web-1 "
-        "--remove policy/prod/allow-http (repeatable)",
+        help="remove a pod, policy or (emptied) namespace, e.g. --remove "
+        "pod/prod/web-1 --remove policy/prod/allow-http --remove "
+        "namespace/prod (repeatable, applied in order)",
     )
     p.add_argument("--out", help="save to a different directory")
     p.add_argument(

@@ -498,6 +498,31 @@ def _apply_pod_col(
     )
 
 
+@partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5))
+def _apply_pod_cols_group(
+    sel_ing8,
+    sel_eg8,
+    ing_by_pol,
+    eg_by_pol,
+    ing_cnt,
+    eg_cnt,
+    idxs,  # int32 [G] — pod slots (pads repeat a real slot: same values)
+    cols4,  # int8 [4, C, G] — the pods' per-policy column quadruples
+):
+    """Write a GROUP of pod columns across every map + their isolation
+    counts in one dispatch — the batched ``_apply_pod_col`` a namespace
+    relabel needs (every pod in the namespace re-evaluates at once; a
+    per-pod dispatch loop would pay the tunnel latency per pod)."""
+    return (
+        sel_ing8.at[:, idxs].set(cols4[0]),
+        sel_eg8.at[:, idxs].set(cols4[1]),
+        ing_by_pol.at[:, idxs].set(cols4[2]),
+        eg_by_pol.at[:, idxs].set(cols4[3]),
+        ing_cnt.at[idxs].set(jnp.sum(cols4[0].astype(_I32), axis=0)),
+        eg_cnt.at[idxs].set(jnp.sum(cols4[1].astype(_I32), axis=0)),
+    )
+
+
 @partial(
     jax.jit,
     donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7, 8),
@@ -1213,7 +1238,7 @@ class PackedIncrementalVerifier:
         self._closure_dirty[rows] = True
         self._closure_dirty[cols] = True
 
-    def closure_packed(self, tile: int = 512):
+    def closure_packed(self, tile: int = 7168):
         """Transitive closure of the current packed matrix (uint32 [Np, W]),
         incremental across diffs: the first call runs the full
         ``packed_closure``; later calls seed from the previous closure and
@@ -1464,21 +1489,122 @@ class PackedIncrementalVerifier:
         before adding pods into it — pods in post-freeze namespaces
         evaluate object-level, so the labels take effect immediately.
         Returns True when newly registered; a no-op for a known namespace
-        with identical labels. Relabeling an EXISTING namespace moves every
-        nsSelector match inside it and raises (rebuild)."""
+        with identical labels; a label CHANGE on a known namespace
+        delegates to :meth:`update_namespace_labels` (the batched
+        incremental relabel — pre-round-5 engines raised here)."""
         existing = self._ns_labels.get(ns.name)
         if existing is not None:
             if dict(existing) != dict(ns.labels):
-                raise ValueError(
-                    f"namespace {ns.name} relabel changes every "
-                    "namespaceSelector match in it; rebuild the verifier"
-                )
+                self.update_namespace_labels(ns.name, ns.labels)
             return False
         self._ns_labels[ns.name] = dict(ns.labels)
         self.namespaces.append(Namespace(ns.name, dict(ns.labels)))
         vz = self._vectorizer
         vz.ns_index.setdefault(ns.name, len(vz.ns_index))
         return True
+
+    def _ns_pod_slots(self, name: str) -> np.ndarray:
+        """Active pod slots living in namespace ``name``, ascending."""
+        return np.asarray(
+            [
+                i
+                for i in range(self.n_pods)
+                if self.pod_active[i] and self.pods[i].namespace == name
+            ],
+            dtype=np.int32,
+        )
+
+    def _set_ns_labels(self, name: str, labels: Dict[str, str]) -> None:
+        """Swap the namespace's label set in the live ``_ns_labels`` dict
+        (shared by reference with the vectorizer, whose
+        ``_ns_selector_mask`` re-reads it on every policy (re-)encode — so
+        FUTURE policy diffs see the new labels with no other bookkeeping)
+        and in the ``namespaces`` list (checkpoint/round-trip surface)."""
+        self._ns_labels[name] = dict(labels)
+        for i, ns in enumerate(self.namespaces):
+            if ns.name == name:
+                self.namespaces[i] = Namespace(name, dict(labels))
+                return
+        self.namespaces.append(Namespace(name, dict(labels)))
+
+    def update_namespace_labels(
+        self, name: str, labels: Dict[str, str]
+    ) -> None:
+        """Relabel namespace ``name`` incrementally: a namespace label
+        change moves ``namespaceSelector`` peer matches for EVERY pod in
+        the namespace (the reference compiles those matches per namespace,
+        ``kubesv/kubesv/model.py:271-295``) — the batched form of a pod
+        relabel. Host side, each resident policy re-evaluates against the
+        namespace's pods (object semantics — same oracle as
+        ``update_pod_labels``); device side, the pods' map columns land in
+        ``_COL_GROUP``-sized fused dispatches instead of one per pod, then
+        the packed matrix re-derives just those rows ∧ columns (or the
+        dirty sets grow, matrix-free). Pod selection cannot move — a
+        policy selects by namespace IDENTITY plus pod labels — but the
+        full column quadruple is recomputed anyway: it falls out of the
+        same host pass for free and keeps one oracle."""
+        if name not in self._ns_labels:
+            raise KeyError(f"namespace {name} is not registered")
+        if dict(self._ns_labels[name]) == dict(labels):
+            return
+        self._set_ns_labels(name, labels)
+        idx_arr = self._ns_pod_slots(name)
+        if not len(idx_arr):
+            return
+        G = _COL_GROUP
+        for g0 in range(0, len(idx_arr), G):
+            g = idx_arr[g0 : g0 + G]
+            cols = np.stack(
+                [self._pod_cols(self.pods[int(i)]) for i in g], axis=-1
+            )  # int8 [4, C, k]
+            for i, c in zip(g, np.moveaxis(cols, -1, 0)):
+                self._h_ing_cnt[i] = int(c[0].sum())
+                self._h_eg_cnt[i] = int(c[1].sum())
+            pad = G - len(g)
+            gi = np.concatenate([g, np.repeat(g[-1:], pad)])
+            colsp = np.concatenate(
+                [cols, np.repeat(cols[:, :, -1:], pad, axis=2)], axis=2
+            )
+            out = _apply_pod_cols_group(
+                *self._maps,
+                self._put(gi.astype(np.int32), "rep"),
+                self._put(colsp, "rep"),
+            )
+            (
+                self._sel_ing8, self._sel_eg8, self._ing_by_pol,
+                self._eg_by_pol, self._ing_cnt, self._eg_cnt,
+            ) = out
+        if self._packed is None:
+            self._mark_closure_dirty(idx_arr, idx_arr)
+            self.dirty_rows[idx_arr] = True
+            self.dirty_cols[idx_arr] = True
+        else:
+            self._patch(idx_arr, idx_arr)
+        self.update_count += 1
+
+    def remove_namespace(self, name: str) -> None:
+        """Unregister namespace ``name``. Refuses while the namespace still
+        holds active pods or policies (remove those first — the CLI's diff
+        orders removals that way); otherwise drops it from the label dict
+        and the ``namespaces`` list. The vectorizer keeps its frozen
+        namespace row — membership masks are already empty, and a
+        same-named namespace created later simply re-registers over it."""
+        if name not in self._ns_labels:
+            raise KeyError(f"namespace {name} is not registered")
+        live = self._ns_pod_slots(name)
+        if len(live):
+            raise ValueError(
+                f"namespace {name} still holds {len(live)} active pod(s); "
+                "remove them before removing the namespace"
+            )
+        pols = [k for k in self.policies if k.split("/", 1)[0] == name]
+        if pols:
+            raise ValueError(
+                f"namespace {name} still holds {len(pols)} polic(ies); "
+                "remove them before removing the namespace"
+            )
+        del self._ns_labels[name]
+        self.namespaces = [ns for ns in self.namespaces if ns.name != name]
 
     def add_pod(self, pod: Pod) -> int:
         """Add a pod in O(P + N) — one fused device dispatch. Returns the
@@ -1673,9 +1799,22 @@ class PackedIncrementalVerifier:
             "dirty_rows": self.dirty_rows,
             "dirty_cols": self.dirty_cols,
             "pod_active": self.pod_active,
+            # authoritative namespace list: tombstoned pods still sitting in
+            # a REMOVED namespace make the manifest's auto-create resurrect
+            # it on load — from_state prunes back to this list
+            "ns_names": np.array([ns.name for ns in self.namespaces]),
         }
         if self._packed is not None:
             state["packed"] = np.asarray(self._packed)
+        if self._closure is not None:
+            # the maintained closure travels with the state so a serving
+            # restart resumes `kv-tpu diff`'s delta re-closure instead of
+            # paying a full re-closure (closure_base unlocks the
+            # additions-only fast path across the restart too)
+            state["closure"] = np.asarray(self._closure)
+            state["closure_dirty"] = self._closure_dirty
+            if self._closure_base is not None:
+                state["closure_base"] = np.asarray(self._closure_base)
         return state
 
     @classmethod
@@ -1705,8 +1844,15 @@ class PackedIncrementalVerifier:
             for p in cluster.pods
         ]
         # the manifest (dump_cluster) already lists every auto-created
-        # namespace, so no snapshot/__post_init__ pass is needed here
+        # namespace, so no snapshot/__post_init__ pass is needed here; the
+        # state's authoritative ns list prunes namespaces a tombstone pod
+        # resurrected through auto-create (see state_dict)
         self.namespaces = list(cluster.namespaces)
+        if "ns_names" in state:
+            live_ns = {str(x) for x in state["ns_names"]}
+            self.namespaces = [
+                ns for ns in self.namespaces if ns.name in live_ns
+            ]
         self._ns_labels = {ns.name: ns.labels for ns in self.namespaces}
         self.n_pods = len(self.pods)
         Np = int(state["n_padded"])
@@ -1801,6 +1947,15 @@ class PackedIncrementalVerifier:
         )
         self.dirty_rows = np.asarray(state["dirty_rows"]).copy()
         self.dirty_cols = np.asarray(state["dirty_cols"]).copy()
+        if "closure" in state and self._packed is not None:
+            self._closure = self._put(np.asarray(state["closure"]), "pods")
+            self._closure_dirty = np.asarray(
+                state["closure_dirty"], dtype=bool
+            ).copy()
+            if "closure_base" in state:
+                self._closure_base = self._put(
+                    np.asarray(state["closure_base"]), "pods"
+                )
         self._vectorizer = PolicyVectorizer(
             self.pods,
             self._ns_labels,

@@ -458,6 +458,34 @@ def _ports_pod_step(
     )
 
 
+@partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5))
+def _ports_apply_pod_cols_group(
+    vp_peers_i,
+    sel_ing_vp,
+    sel_eg_vp,
+    vp_peers_e,
+    ing_cnt,
+    eg_cnt,
+    idxs,  # int32 [G] — pod slots (pads repeat a real slot: same values)
+    ci_g,  # int8 [2, Ti, G]
+    ce_g,  # int8 [2, Te, G]
+    cnt_i_g,  # int32 [G]
+    cnt_e_g,  # int32 [G]
+):
+    """Write a GROUP of pod columns across the four VP maps + isolation
+    counts in one dispatch — the port-mode mirror of
+    ``_apply_pod_cols_group`` (namespace relabels re-evaluate every pod in
+    the namespace; the matrix patch rides the shared ``_patch`` groups)."""
+    return (
+        vp_peers_i.at[:, idxs].set(ci_g[0]),
+        sel_ing_vp.at[:, idxs].set(ci_g[1]),
+        sel_eg_vp.at[:, idxs].set(ce_g[0]),
+        vp_peers_e.at[:, idxs].set(ce_g[1]),
+        ing_cnt.at[idxs].set(cnt_i_g),
+        eg_cnt.at[idxs].set(cnt_e_g),
+    )
+
+
 class PackedPortsIncrementalVerifier:
     """Port-bitmap reachability under policy add/remove/update."""
 
@@ -1290,6 +1318,64 @@ class PackedPortsIncrementalVerifier:
     add_namespace = PackedIncrementalVerifier.add_namespace
     closure_packed = PackedIncrementalVerifier.closure_packed
     _mark_closure_dirty = PackedIncrementalVerifier._mark_closure_dirty
+    _ns_pod_slots = PackedIncrementalVerifier._ns_pod_slots
+    _set_ns_labels = PackedIncrementalVerifier._set_ns_labels
+    remove_namespace = PackedIncrementalVerifier.remove_namespace
+
+    def update_namespace_labels(
+        self, name: str, labels: Dict[str, str]
+    ) -> None:
+        """Relabel namespace ``name`` under full port semantics — the
+        batched pod relabel (see the any-port engine's docstring; reference
+        namespace-selector compilation ``kubesv/kubesv/model.py:271-295``).
+        Each pod in the namespace re-evaluates object-level against every
+        VP row (``_pod_vp_cols`` — named-port resolution depends on
+        container ports, not namespace labels, so the restriction bank
+        cannot move); the columns land in ``_COL_GROUP``-sized fused VP-map
+        writes, then one ``_patch`` re-derives the pods' matrix rows ∧
+        columns in the existing row/column groups."""
+        if name not in self._ns_labels:
+            raise KeyError(f"namespace {name} is not registered")
+        if dict(self._ns_labels[name]) == dict(labels):
+            return
+        self._set_ns_labels(name, labels)
+        idx_arr = self._ns_pod_slots(name)
+        if not len(idx_arr):
+            return
+        G = _COL_GROUP
+        for g0 in range(0, len(idx_arr), G):
+            g = idx_arr[g0 : g0 + G]
+            ci_l, ce_l, cnti_l, cnte_l = [], [], [], []
+            for i in g:
+                ci, ce, cnt_i, cnt_e, _bank = self._pod_vp_cols(self.pods[int(i)])
+                ci_l.append(ci)
+                ce_l.append(ce)
+                cnti_l.append(cnt_i)
+                cnte_l.append(cnt_e)
+                self._h_ing_cnt[i] = cnt_i
+                self._h_eg_cnt[i] = cnt_e
+            pad = G - len(g)
+            gi = np.concatenate([g, np.repeat(g[-1:], pad)]).astype(np.int32)
+            ci_g = np.stack(ci_l + [ci_l[-1]] * pad, axis=-1)
+            ce_g = np.stack(ce_l + [ce_l[-1]] * pad, axis=-1)
+            cnt_i_g = np.asarray(
+                cnti_l + [cnti_l[-1]] * pad, dtype=np.int32
+            )
+            cnt_e_g = np.asarray(
+                cnte_l + [cnte_l[-1]] * pad, dtype=np.int32
+            )
+            out = _ports_apply_pod_cols_group(
+                *self._operands, self._ing_cnt, self._eg_cnt,
+                self._put(gi, "rep"),
+                self._put(ci_g, "rep"), self._put(ce_g, "rep"),
+                self._put(cnt_i_g, "rep"), self._put(cnt_e_g, "rep"),
+            )
+            (
+                self._vp_peers_i, self._sel_ing_vp, self._sel_eg_vp,
+                self._vp_peers_e, self._ing_cnt, self._eg_cnt,
+            ) = out
+        self._patch(idx_arr, idx_arr)
+        self.update_count += 1
 
     def add_pod(self, pod: Pod) -> int:
         """Add a pod in O(total_vp + P) host work + one fused device
@@ -1515,7 +1601,17 @@ class PackedPortsIncrementalVerifier:
             "prov_e": row_prov("e"),
             "pod_active": self.pod_active,
             "keys": np.array(keys),
+            # authoritative namespace list — see the any-port engine's
+            # state_dict: tombstones resurrect removed namespaces otherwise
+            "ns_names": np.array([ns.name for ns in self.namespaces]),
         }
+        if self._closure is not None:
+            # maintained closure travels with the state (see the any-port
+            # engine's state_dict)
+            arrays["closure"] = np.asarray(self._closure)
+            arrays["closure_dirty"] = self._closure_dirty
+            if self._closure_base is not None:
+                arrays["closure_base"] = np.asarray(self._closure_base)
         bank_keys = (
             list(self._bank_intern._ids) if self._bank_intern is not None else []
         )
@@ -1570,6 +1666,11 @@ class PackedPortsIncrementalVerifier:
         self._sh = _make_shardings(mesh)
         self.pods = _copy_pods(cluster.pods)
         self.namespaces = list(cluster.namespaces)
+        if "ns_names" in arrays:
+            live_ns = {str(x) for x in arrays["ns_names"]}
+            self.namespaces = [
+                ns for ns in self.namespaces if ns.name in live_ns
+            ]
         self._ns_labels = {ns.name: ns.labels for ns in self.namespaces}
         n = len(self.pods)
         self.n_pods = n
@@ -1703,6 +1804,15 @@ class PackedPortsIncrementalVerifier:
         self._ing_cnt = self._put(np.asarray(arrays["ing_cnt"]), "vec")
         self._eg_cnt = self._put(np.asarray(arrays["eg_cnt"]), "vec")
         self._packed = self._put(np.asarray(arrays["packed"]), "pods")
+        if "closure" in arrays:
+            self._closure = self._put(np.asarray(arrays["closure"]), "pods")
+            self._closure_dirty = np.asarray(
+                arrays["closure_dirty"], dtype=bool
+            ).copy()
+            if "closure_base" in arrays:
+                self._closure_base = self._put(
+                    np.asarray(arrays["closure_base"]), "pods"
+                )
         self._vectorizer = PolicyVectorizer(
             self.pods, self._ns_labels, vocab, ns_index,
             self.config.direction_aware_isolation,

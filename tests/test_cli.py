@@ -2,6 +2,8 @@
 import json
 import os
 
+import pytest
+
 from kubernetes_verification_tpu.cli import main
 
 
@@ -149,8 +151,6 @@ def test_cli_diff_no_save_and_bad_remove(tmp_path, capsys):
     assert main(["diff", ck, "--no-save", "--json"]) == 0
     rep = json.loads(capsys.readouterr().out)
     assert rep["ops"] == [] and rep["saved"] is None
-    import pytest
-
     with pytest.raises(SystemExit, match="--remove expects"):
         main(["diff", ck, "--remove", "garbage"])
 
@@ -158,8 +158,6 @@ def test_cli_diff_no_save_and_bad_remove(tmp_path, capsys):
 def test_cli_diff_out_of_universe_aborts_cleanly(tmp_path, capsys):
     """A ports-engine diff outside the frozen universe exits with rebuild
     guidance instead of a traceback, and the checkpoint on disk is intact."""
-    import pytest
-
     import kubernetes_verification_tpu as kv
     from kubernetes_verification_tpu.cli import _load_incremental
     from kubernetes_verification_tpu.ingest import dump_cluster
@@ -230,17 +228,83 @@ def test_cli_diff_namespace_labels_respected(tmp_path, capsys):
     )
     np.testing.assert_array_equal(inc.reach_active(), ref.reach)
     assert ref.reach[1, 0]  # worker → web actually granted
-    # a namespace RELABEL aborts with rebuild guidance
+    # a namespace RELABEL applies incrementally (round 5 — the pre-r5 CLI
+    # aborted here with rebuild guidance) and the persisted matrix tracks
+    # the oracle: tier=backend moves off team-a, so worker → web is revoked
     delta2 = kv.Cluster(
         namespaces=[kv.Namespace("team-a", {"tier": "other"})],
         pods=[kv.Pod("x", "team-a", {})],
     )
     dd2 = str(tmp_path / "delta2")
     dump_cluster(delta2, dd2)
-    import pytest
+    assert main(["diff", ck, "--apply", dd2, "--json"]) == 0
+    rep2 = json.loads(capsys.readouterr().out)
+    assert ["relabel-namespace", "team-a"] in rep2["ops"]
+    inc2 = _load_incremental(ck)
+    ref2 = kv.verify(
+        inc2.as_cluster(), kv.VerifyConfig(backend="cpu", compute_ports=False)
+    )
+    np.testing.assert_array_equal(inc2.reach_active(), ref2.reach)
+    assert not ref2.reach[1, 0]  # the grant moved away with the labels
+    # and namespace REMOVAL works once its contents are gone
+    with pytest.raises(SystemExit, match="cannot remove namespace"):
+        main(["diff", ck, "--remove", "namespace/team-a", "--no-save"])
+    assert main([
+        "diff", ck, "--remove", "pod/team-a/worker", "--remove",
+        "pod/team-a/x", "--remove", "namespace/team-a", "--json",
+    ]) == 0
+    rep3 = json.loads(capsys.readouterr().out)
+    assert ["remove-namespace", "team-a"] in rep3["ops"]
+    inc3 = _load_incremental(ck)
+    assert all(ns.name != "team-a" for ns in inc3.namespaces)
 
-    with pytest.raises(SystemExit, match="rebuild"):
-        main(["diff", ck, "--apply", dd2])
+
+@pytest.mark.parametrize("ports", [False, True])
+def test_cli_closure_maintained_across_diffs(tmp_path, capsys, ports):
+    """Round 5: `kv-tpu snapshot --closure` persists the packed closure and
+    `kv-tpu diff` maintains it via the delta re-closure — after a mixed diff
+    sequence the maintained closure must equal a from-scratch
+    ``packed_closure`` of the current matrix bit-for-bit, both engines."""
+    import dataclasses
+
+    import numpy as np
+
+    import kubernetes_verification_tpu as kv
+    from kubernetes_verification_tpu.cli import _load_incremental
+    from kubernetes_verification_tpu.ingest import dump_cluster
+    from kubernetes_verification_tpu.ops.closure import packed_closure
+
+    d = str(tmp_path / "c")
+    ck = str(tmp_path / "k")
+    assert main(["generate", d, "--pods", "24", "--policies", "6"]) == 0
+    snap = ["snapshot", d, ck, "--closure"] + ([] if ports else ["--no-ports"])
+    assert main(snap) == 0
+    capsys.readouterr()
+    cluster, _ = kv.load_cluster(d)
+    delta = kv.Cluster(
+        pods=[kv.Pod("cz-new", cluster.pods[0].namespace, {"cz": "x"})],
+        policies=[
+            dataclasses.replace(
+                cluster.policies[0], ingress=cluster.policies[1].ingress
+            )
+        ],
+    )
+    dd = str(tmp_path / "delta")
+    dump_cluster(delta, dd)
+    victim = cluster.pods[3]
+    assert main([
+        "diff", ck, "--apply", dd,
+        "--remove", f"pod/{victim.namespace}/{victim.name}", "--json",
+    ]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert "closure_s" in rep  # the diff maintained the closure
+    assert len(rep["ops"]) >= 2
+    inc = _load_incremental(ck)
+    assert inc._closure is not None  # ...and it survived the round-trip
+    fresh = packed_closure(inc._packed)
+    np.testing.assert_array_equal(
+        np.asarray(inc._closure), np.asarray(fresh)
+    )
 
 
 def test_cli_diff_unchanged_manifests_are_noops(tmp_path, capsys):

@@ -467,7 +467,9 @@ def test_fuzzed_pod_and_policy_churn():
     rng = random.Random(4)
     added = 0
     for step in range(16):
-        op = rng.choice(["add_pod", "rm_pod", "relabel", "add_pol", "rm_pol"])
+        op = rng.choice(
+            ["add_pod", "rm_pod", "relabel", "add_pol", "rm_pol", "relabel_ns"]
+        )
         if op == "add_pod":
             src = donor.pods[added % len(donor.pods)]
             inc.add_pod(
@@ -489,6 +491,12 @@ def test_fuzzed_pod_and_policy_churn():
             key = rng.choice(sorted(inc.policies))
             ns, name = key.split("/", 1)
             inc.remove_policy(ns, name)
+        elif op == "relabel_ns":
+            tgt = rng.choice(inc.namespaces)
+            donor_ns = rng.choice(cluster.namespaces)
+            inc.update_namespace_labels(
+                tgt.name, {**dict(donor_ns.labels), "fzns": f"s{step}"}
+            )
         np.testing.assert_array_equal(
             inc.reach_active(), _oracle_active(inc, cfg), err_msg=f"step {step}"
         )
@@ -534,6 +542,121 @@ def test_matrix_free_pod_churn():
     np.testing.assert_array_equal(full[np.ix_(act, act)], ref)
     # tombstoned row/column is zero even in a fresh stripe solve
     assert not full[9].any() and not full[:, 9].any()
+
+
+def test_namespace_relabel_matches_oracle(setup):
+    """A namespace label change moves namespaceSelector matches for every
+    pod in it — round 5's incremental op (pre-round-5 engines raised)."""
+    cluster, cfg, inc = setup
+    ns = cluster.namespaces[0]
+    # another namespace's labels, fresh labels, then empty — each step
+    # must track the oracle exactly
+    for new in (
+        dict(cluster.namespaces[1].labels),
+        {"completely": "fresh", "tier": "x"},
+        {},
+    ):
+        inc.update_namespace_labels(ns.name, new)
+        np.testing.assert_array_equal(
+            inc.reach_active(), _oracle_active(inc, cfg), err_msg=str(new)
+        )
+    # add_namespace with changed labels delegates to the relabel
+    assert inc.add_namespace(kv.Namespace(ns.name, {"via": "add"})) is False
+    assert inc._ns_labels[ns.name] == {"via": "add"}
+    np.testing.assert_array_equal(inc.reach_active(), _oracle_active(inc, cfg))
+    # relabeling an unknown namespace raises
+    with pytest.raises(KeyError):
+        inc.update_namespace_labels("no-such-ns", {"a": "b"})
+
+
+def test_namespace_relabel_then_policy_diff(setup):
+    """Policies (re-)encoded AFTER a namespace relabel must see the new
+    labels (the vectorizer reads the live ns-label dict)."""
+    cluster, cfg, inc = setup
+    ns = cluster.namespaces[0]
+    inc.update_namespace_labels(ns.name, {"team": "fresh-after-freeze"})
+    pol = kv.NetworkPolicy(
+        name="ns-sel-new",
+        namespace=cluster.namespaces[1].name,
+        pod_selector=kv.Selector({}),
+        ingress=(
+            kv.Rule(
+                peers=(
+                    kv.Peer(
+                        namespace_selector=kv.Selector(
+                            {"team": "fresh-after-freeze"}
+                        )
+                    ),
+                )
+            ),
+        ),
+    )
+    inc.add_policy(pol)
+    np.testing.assert_array_equal(inc.reach_active(), _oracle_active(inc, cfg))
+    # and the relabel moves matches for a policy added before it, too
+    inc.update_namespace_labels(ns.name, {"team": "other"})
+    np.testing.assert_array_equal(inc.reach_active(), _oracle_active(inc, cfg))
+
+
+def test_namespace_remove(setup):
+    cluster, cfg, inc = setup
+    ns = cluster.namespaces[2]
+    # refuses while pods remain
+    with pytest.raises(ValueError, match="active pod"):
+        inc.remove_namespace(ns.name)
+    for i in list(inc.active_indices()):
+        if inc.pods[i].namespace == ns.name:
+            inc.remove_pod(ns.name, inc.pods[i].name)
+    # refuses while policies remain
+    if any(k.split("/", 1)[0] == ns.name for k in inc.policies):
+        with pytest.raises(ValueError, match="polic"):
+            inc.remove_namespace(ns.name)
+        for key in [
+            k for k in list(inc.policies) if k.split("/", 1)[0] == ns.name
+        ]:
+            inc.remove_policy(*key.split("/", 1))
+    inc.remove_namespace(ns.name)
+    assert ns.name not in inc._ns_labels
+    assert all(n.name != ns.name for n in inc.namespaces)
+    np.testing.assert_array_equal(inc.reach_active(), _oracle_active(inc, cfg))
+    with pytest.raises(KeyError):
+        inc.remove_namespace(ns.name)
+    # a same-named namespace can be re-created with different labels
+    assert inc.add_namespace(kv.Namespace(ns.name, {"re": "born"})) is True
+    inc.add_pod(kv.Pod("reborn", ns.name, {"app": "rb"}))
+    np.testing.assert_array_equal(inc.reach_active(), _oracle_active(inc, cfg))
+
+
+def test_mesh_sharded_namespace_relabel():
+    from kubernetes_verification_tpu.parallel.mesh import mesh_for
+
+    cluster = random_cluster(
+        GeneratorConfig(n_pods=61, n_policies=9, n_namespaces=3, seed=66)
+    )
+    cfg = kv.VerifyConfig(compute_ports=False)
+    inc = PackedIncrementalVerifier(cluster, cfg, mesh=mesh_for((4, 2)))
+    inc.update_namespace_labels(
+        cluster.namespaces[0].name, dict(cluster.namespaces[2].labels)
+    )
+    np.testing.assert_array_equal(inc.reach_active(), _oracle_active(inc, cfg))
+
+
+def test_matrix_free_namespace_relabel():
+    from kubernetes_verification_tpu.ops.tiled import unpack_cols
+
+    cluster = random_cluster(
+        GeneratorConfig(n_pods=61, n_policies=9, n_namespaces=3, seed=67)
+    )
+    cfg = kv.VerifyConfig(compute_ports=False)
+    inc = PackedIncrementalVerifier(cluster, cfg, keep_matrix=False)
+    inc.update_namespace_labels(
+        cluster.namespaces[0].name, {"mf": "relabel"}
+    )
+    assert inc.dirty_rows.any() and inc.dirty_cols.any()
+    ref = _oracle_active(inc, cfg)
+    act = inc.active_indices()
+    full = unpack_cols(inc.solve_stripe(0, inc._n_padded), inc.n_pods)
+    np.testing.assert_array_equal(full[np.ix_(act, act)], ref)
 
 
 def test_checkpoint_resume_with_pod_churn(tmp_path):

@@ -441,7 +441,9 @@ def test_fuzzed_pod_and_policy_churn_ports(mesh_shape):
     rng = random.Random(3)
     port_lib = [dict(p.container_ports) for p in cluster.pods] + [{}]
     for step in range(18):
-        op = rng.choice(["add", "rm", "relabel", "add_pol", "rm_pol"])
+        op = rng.choice(
+            ["add", "rm", "relabel", "add_pol", "rm_pol", "relabel_ns"]
+        )
         if op == "add":
             inc.add_pod(
                 kv.Pod(
@@ -467,6 +469,12 @@ def test_fuzzed_pod_and_policy_churn_ports(mesh_shape):
             key = rng.choice(sorted(inc.policies))
             ns, name = key.split("/", 1)
             inc.remove_policy(ns, name)
+        elif op == "relabel_ns":
+            tgt = rng.choice(inc.namespaces)
+            donor_ns = rng.choice(cluster.namespaces)
+            inc.update_namespace_labels(
+                tgt.name, {**dict(donor_ns.labels), "fzns": f"s{step}"}
+            )
         np.testing.assert_array_equal(
             inc.reach_active(), _active_oracle(inc, cfg),
             err_msg=f"step {step} ({op})",
@@ -503,6 +511,57 @@ def test_mesh_sharded_pod_churn_ports(shape):
     victim = inc.pods[7]
     inc.remove_pod(victim.namespace, victim.name)
     inc.update_pod_labels(3, dict(inc.pods[12].labels))
+    np.testing.assert_array_equal(inc.reach_active(), _active_oracle(inc, cfg))
+
+
+def test_namespace_relabel_ports(setup):
+    """Namespace relabel under full port semantics: peer matches move per
+    VP row; bank/resolution cannot (labels don't touch container ports)."""
+    cluster, cfg, inc = setup
+    ns = cluster.namespaces[0]
+    for new in (
+        dict(cluster.namespaces[1].labels),
+        {"completely": "fresh"},
+        {},
+    ):
+        inc.update_namespace_labels(ns.name, new)
+        np.testing.assert_array_equal(
+            inc.reach_active(), _active_oracle(inc, cfg), err_msg=str(new)
+        )
+    # add_namespace with changed labels delegates to the relabel
+    assert inc.add_namespace(kv.Namespace(ns.name, {"via": "add"})) is False
+    np.testing.assert_array_equal(inc.reach_active(), _active_oracle(inc, cfg))
+    with pytest.raises(KeyError):
+        inc.update_namespace_labels("no-such-ns", {"a": "b"})
+
+
+def test_namespace_remove_ports(setup):
+    cluster, cfg, inc = setup
+    ns = cluster.namespaces[2]
+    with pytest.raises(ValueError, match="active pod"):
+        inc.remove_namespace(ns.name)
+    for i in list(inc.active_indices()):
+        if inc.pods[i].namespace == ns.name:
+            inc.remove_pod(ns.name, inc.pods[i].name)
+    for key in [
+        k for k in list(inc.policies) if k.split("/", 1)[0] == ns.name
+    ]:
+        inc.remove_policy(*key.split("/", 1))
+    inc.remove_namespace(ns.name)
+    assert ns.name not in inc._ns_labels
+    np.testing.assert_array_equal(inc.reach_active(), _active_oracle(inc, cfg))
+
+
+@pytest.mark.parametrize("shape", [(4, 2)])
+def test_mesh_sharded_namespace_relabel_ports(shape):
+    from kubernetes_verification_tpu.parallel.mesh import mesh_for
+
+    cluster = _mk(seed=81)
+    cfg = kv.VerifyConfig(compute_ports=True)
+    inc = PackedPortsIncrementalVerifier(cluster, cfg, mesh=mesh_for(shape))
+    inc.update_namespace_labels(
+        cluster.namespaces[0].name, dict(cluster.namespaces[2].labels)
+    )
     np.testing.assert_array_equal(inc.reach_active(), _active_oracle(inc, cfg))
 
 
