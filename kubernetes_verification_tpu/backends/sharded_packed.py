@@ -94,7 +94,19 @@ class ShardedPackedVerifyResult(VerifyResult):
                     "explicitly to fetch them anyway"
                 )
             self.src_sets, self.dst_sets = self.policy_sets_fn()
+            self.policy_sets_fn = None  # result cached — release the thunk
         return self.src_sets, self.dst_sets
+
+    def release_policy_queries(self) -> None:
+        """Drop the lazy pairwise/policy-set thunks. Each thunk closes over
+        the full host ``EncodedCluster``, pinning it for the result's
+        lifetime; the thunks self-release once their result is cached, but
+        a caller that will never run the pairwise policy queries can call
+        this to let a large encoding be garbage-collected immediately.
+        Already-materialised masks/sets survive; un-materialised ones
+        raise their usual "no thunk attached" error afterwards."""
+        self.pair_masks_fn = None
+        self.policy_sets_fn = None
 
     def _pk(self) -> PackedShardedResult:
         if self.packed_result is None:
@@ -137,6 +149,7 @@ class ShardedPackedVerifyResult(VerifyResult):
             if self.pair_masks_fn is None:
                 raise ValueError("no pair-mask thunk attached to this result")
             self._pair_masks = self.pair_masks_fn()
+            self.pair_masks_fn = None  # result cached — release the thunk
         return self._pair_masks
 
     def policy_shadow(self) -> List[Tuple[int, int]]:
@@ -211,7 +224,7 @@ class ShardedPackedBackend(VerifierBackend):
             # closure_tile is its own knob: the dst-sweep "tile" shapes the
             # broadcast geometry and is often tuned small; the squaring
             # kernel wants its larger default
-            closure_packed = pk.closure(tile=config.opt("closure_tile", 512))
+            closure_packed = pk.closure(tile=config.opt("closure_tile", 7168))
             if dense_ok:
                 closure = unpack_cols(closure_packed, cluster.n_pods)
         from ..ops.tiled import policy_pair_masks_sharded, policy_sets_sharded

@@ -191,7 +191,7 @@ class PackedShardedResult:
         self._require_full("to_bool")
         return unpack_cols(self.packed, self.n_pods)
 
-    def closure(self, tile: int = 512, max_iter: int = 32) -> np.ndarray:
+    def closure(self, tile: int = 7168, max_iter: int = 32) -> np.ndarray:
         """Packed-domain transitive closure of the kept matrix
         (``ops/closure.packed_closure``) → uint32 [N, W]. Needs
         ``keep_matrix=True`` and a full sweep."""
@@ -232,6 +232,9 @@ def _packed_local(
     vp_res_i,  # int32 [total_i] — VP row → restriction-bank row
     vp_res_e,
     bank8,  # int8 [B, N] replicated — named-port dst restrictions
+    stripe_t0,  # int32 scalar (replicated, TRACED) — first dst tile index;
+    # traced so one compiled executable serves every equal-width stripe of
+    # a checkpointed / full-aggregate sweep instead of recompiling per stripe
     *,
     self_traffic: bool,
     default_allow_unselected: bool,
@@ -240,7 +243,7 @@ def _packed_local(
     tile: int,
     n_total: int,
     mp: int,
-    stripe: Tuple[int, int],
+    tiles_per_dev: int,
     keep_matrix: bool,
     layout: Optional["PortLayout"],
 ):
@@ -400,8 +403,7 @@ def _packed_local(
     valid_full = jax.lax.all_gather(valid, POD_AXIS, axis=0, tiled=True)
 
     # --- dst-tile sweep --------------------------------------------------
-    t0, t1 = stripe
-    tiles_per_dev = (t1 - t0) // mp
+    t0 = stripe_t0
     W = n_total // 32
 
     U = grp8.shape[0]
@@ -473,6 +475,20 @@ def _packed_local(
     return out, row_deg, col_deg, grp_deg, ing_iso_loc & valid, eg_iso_loc & valid
 
 
+def _fetch_global(x) -> np.ndarray:
+    """Host-fetch a (possibly multi-process) global array. Single-process
+    arrays are fully addressable and fetch directly; under a
+    ``jax.distributed`` job a ``P(POD_AXIS)``-sharded output spans
+    processes, so each host allgathers the full value (tiny aggregate
+    vectors — the packed matrix itself stays device-resident via
+    ``keep_matrix`` policy at multi-host scale)."""
+    if getattr(x, "is_fully_addressable", True):
+        return np.asarray(x)
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+
+
 def sharded_packed_reach(
     mesh: jax.sharding.Mesh,
     enc: EncodedCluster,
@@ -486,12 +502,21 @@ def sharded_packed_reach(
     keep_matrix: Optional[bool] = None,
     groups: Optional[np.ndarray] = None,
     max_port_masks: Optional[int] = None,
+    sweep_chunk_tiles: Optional[int] = None,
 ) -> PackedShardedResult:
     """Pad, shard, sweep. ``stripe=(t0, t1)`` limits the sweep to a dst tile
     range (default: all tiles); aggregates then cover only the swept dsts.
     ``keep_matrix=None`` keeps the packed matrix when it is ≤ ~1 GB/device.
     ``groups`` (int [N] user-group ids) additionally aggregates per-group
     in-degrees so ``user_crosscheck`` works without the matrix.
+
+    ``sweep_chunk_tiles=k`` runs the FULL dst sweep as a sequence of
+    k-tile stripes (aggregate-only — the matrix is never kept): the stripe
+    start is a traced scalar, so every equal-width stripe reuses ONE
+    compiled executable (at most one extra compile for the remainder).
+    This is how config 5's single-chip share is measured end-to-end on the
+    real chip (``bench.py --mode stripe --full-sweep``) instead of
+    extrapolated from one stripe.
 
     A multi-atom encoding (``compute_ports=True`` with port-bearing rules)
     runs the port-aware SPMD body: the mask-group decomposition of
@@ -624,6 +649,8 @@ def sharded_packed_reach(
         bank8 = np.ones((1, Np), dtype=np.int8)
 
     n_tiles_total = Np // tile
+    if sweep_chunk_tiles is not None and stripe is not None:
+        raise ValueError("sweep_chunk_tiles sweeps ALL tiles; drop stripe")
     if stripe is None:
         stripe = (0, n_tiles_total)
     t0, t1 = stripe
@@ -632,24 +659,18 @@ def sharded_packed_reach(
     if (t1 - t0) % mp:
         raise ValueError(f"stripe width {t1 - t0} not a multiple of mp={mp}")
     full_sweep = (t0, t1) == (0, n_tiles_total)
-    if keep_matrix is None:
+    if sweep_chunk_tiles is not None:
+        if keep_matrix:
+            raise ValueError(
+                "sweep_chunk_tiles is aggregate-only; it cannot keep the "
+                "matrix"
+            )
+        keep_matrix = False
+    elif keep_matrix is None:
         # a partial stripe would leave unswept words zero — only aggregates
         # are meaningful there, so never auto-keep a partial matrix
         keep_matrix = full_sweep and Np * (Np // 32) * 4 // dp <= (1 << 30)
 
-    body = partial(
-        _packed_local,
-        self_traffic=self_traffic,
-        default_allow_unselected=default_allow_unselected,
-        direction_aware_isolation=direction_aware_isolation,
-        chunk=chunk,
-        tile=tile,
-        n_total=Np,
-        mp=mp,
-        stripe=(t0, t1),
-        keep_matrix=keep_matrix,
-        layout=layout,
-    )
     in_specs = (
         P(POD_AXIS, None),  # pod_kv
         P(POD_AXIS, None),  # pod_key
@@ -671,6 +692,7 @@ def sharded_packed_reach(
         P(),  # vp_res_i (replicated)
         P(),  # vp_res_e
         P(),  # bank8 (replicated — B is small)
+        P(),  # stripe_t0 (replicated traced scalar)
     )
     out_specs = (
         P(POD_AXIS, None),  # packed block (or stub)
@@ -680,14 +702,28 @@ def sharded_packed_reach(
         P(POD_AXIS),  # ing_iso
         P(POD_AXIS),  # eg_iso
     )
-    fn = jax.jit(
-        jax.shard_map(
-            body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-            check_vma=False,
+    def make_fn(tpd: int):
+        b = partial(
+            _packed_local,
+            self_traffic=self_traffic,
+            default_allow_unselected=default_allow_unselected,
+            direction_aware_isolation=direction_aware_isolation,
+            chunk=chunk,
+            tile=tile,
+            n_total=Np,
+            mp=mp,
+            tiles_per_dev=tpd,
+            keep_matrix=keep_matrix,
+            layout=layout,
         )
-    )
-    t_start = time.perf_counter()
-    packed, row_deg, col_deg, grp_deg, ing_iso, eg_iso = fn(
+        return jax.jit(
+            jax.shard_map(
+                b, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                check_vma=False,
+            )
+        )
+
+    call_args = (
         pod_kv,
         pod_key,
         pod_ns,
@@ -709,21 +745,82 @@ def sharded_packed_reach(
         np.asarray(vp_res_e, dtype=np.int32),
         bank8,
     )
-    row_deg = np.asarray(row_deg)[:n].astype(np.int64)
-    col_deg = np.asarray(col_deg)[:n].astype(np.int64)
+    if sweep_chunk_tiles is not None:
+        # full-aggregate sweep: ALL dst tiles, in equal-width stripes that
+        # REUSE one compiled executable (stripe start is traced), plus at
+        # most one remainder executable. Aggregates accumulate on host in
+        # int64; the matrix is never kept (config-5 scale by definition).
+        if sweep_chunk_tiles % mp:
+            raise ValueError(
+                f"sweep_chunk_tiles must be a multiple of mp={mp}"
+            )
+        fn_main = make_fn(sweep_chunk_tiles // mp)
+        rem = n_tiles_total % sweep_chunk_tiles
+        fn_rem = make_fn(rem // mp) if rem else None
+        acc_row = np.zeros(Np, dtype=np.int64)
+        acc_col = np.zeros(Np, dtype=np.int64)
+        acc_grp = np.zeros((grp8.shape[0], Np), dtype=np.int64)
+        chunk_times: List[float] = []
+        t_start = time.perf_counter()
+        ing_iso = eg_iso = None
+        for s0 in range(0, n_tiles_total, sweep_chunk_tiles):
+            f = (
+                fn_main
+                if s0 + sweep_chunk_tiles <= n_tiles_total
+                else fn_rem
+            )
+            c0 = time.perf_counter()
+            _, row_deg, col_deg, grp_deg, ing_iso, eg_iso = f(
+                *call_args, np.int32(s0)
+            )
+            acc_row += _fetch_global(row_deg).astype(np.int64)
+            acc_col += _fetch_global(col_deg).astype(np.int64)
+            acc_grp += _fetch_global(grp_deg).astype(np.int64)
+            chunk_times.append(time.perf_counter() - c0)
+        elapsed = time.perf_counter() - t_start
+        ct = sorted(chunk_times)
+        return PackedShardedResult(
+            n_pods=n,
+            total_pairs=int(acc_row[:n].sum()),
+            out_degree=acc_row[:n],
+            in_degree=acc_col[:n],
+            ingress_isolated=_fetch_global(ing_iso)[:n],
+            egress_isolated=_fetch_global(eg_iso)[:n],
+            full_sweep=True,
+            packed=None,
+            groups=groups if groups is not None else None,
+            group_in_degree=(
+                acc_grp[:, :n] if groups is not None else None
+            ),
+            timings={
+                "solve": elapsed,
+                "tiles": n_tiles_total,
+                "n_chunks": len(chunk_times),
+                "chunk_s_min": ct[0],
+                "chunk_s_median": ct[len(ct) // 2],
+                "chunk_s_max": ct[-1],
+            },
+        )
+    fn = make_fn((t1 - t0) // mp)
+    t_start = time.perf_counter()
+    packed, row_deg, col_deg, grp_deg, ing_iso, eg_iso = fn(
+        *call_args, np.int32(t0)
+    )
+    row_deg = _fetch_global(row_deg)[:n].astype(np.int64)
+    col_deg = _fetch_global(col_deg)[:n].astype(np.int64)
     elapsed = time.perf_counter() - t_start
     return PackedShardedResult(
         n_pods=n,
         total_pairs=int(row_deg.sum()),
         out_degree=row_deg,
         in_degree=col_deg,
-        ingress_isolated=np.asarray(ing_iso)[:n],
-        egress_isolated=np.asarray(eg_iso)[:n],
+        ingress_isolated=_fetch_global(ing_iso)[:n],
+        egress_isolated=_fetch_global(eg_iso)[:n],
         full_sweep=full_sweep,
-        packed=np.asarray(packed)[:n] if keep_matrix else None,
+        packed=_fetch_global(packed)[:n] if keep_matrix else None,
         groups=groups if groups is not None else None,
         group_in_degree=(
-            np.asarray(grp_deg)[:, :n].astype(np.int64)
+            _fetch_global(grp_deg)[:, :n].astype(np.int64)
             if groups is not None
             else None
         ),

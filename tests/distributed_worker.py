@@ -1,0 +1,80 @@
+"""Subprocess worker for the 2-process ``jax.distributed`` integration test
+(``tests/test_distributed.py``). Each process joins the job via
+``distributed_mesh`` (the explicit-args path, ``parallel/mesh.py``), runs the
+same small ``sharded-packed`` solve over the GLOBAL 8-device mesh (2 processes
+× 4 local CPU devices), checks the aggregates against the in-process CPU
+oracle, and prints one JSON line for the parent to compare across processes.
+
+Run as:  python distributed_worker.py COORD_ADDR NUM_PROCS PROC_ID
+with JAX_PLATFORMS=cpu and XLA_FLAGS=--xla_force_host_platform_device_count=4.
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    coord, n_procs, pid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from kubernetes_verification_tpu.parallel.mesh import distributed_mesh
+
+    mesh = distributed_mesh(
+        (8, 1),
+        coordinator_address=coord,
+        num_processes=n_procs,
+        process_id=pid,
+    )
+    assert jax.process_count() == n_procs, jax.process_count()
+    assert len(jax.devices()) == 8, jax.devices()
+
+    import numpy as np
+
+    import kubernetes_verification_tpu as kv
+    from kubernetes_verification_tpu.encode.encoder import encode_cluster
+    from kubernetes_verification_tpu.harness.generate import (
+        GeneratorConfig,
+        random_cluster,
+    )
+    from kubernetes_verification_tpu.parallel.packed_sharded import (
+        sharded_packed_reach,
+    )
+
+    # deterministic host encode: every process builds identical operands
+    cluster = random_cluster(
+        GeneratorConfig(n_pods=24, n_policies=5, n_namespaces=2, seed=5)
+    )
+    enc = encode_cluster(cluster, compute_ports=False)
+    pk = sharded_packed_reach(mesh, enc, tile=32, chunk=32, keep_matrix=False)
+
+    ref = kv.verify(
+        cluster, kv.VerifyConfig(backend="cpu", compute_ports=False)
+    ).reach
+    ok = (
+        pk.total_pairs == int(ref.sum())
+        and bool((pk.out_degree == ref.sum(axis=1)).all())
+        and bool((pk.in_degree == ref.sum(axis=0)).all())
+    )
+    print(
+        json.dumps(
+            {
+                "pid": pid,
+                "process_count": jax.process_count(),
+                "n_devices": len(jax.devices()),
+                "total_pairs": pk.total_pairs,
+                "in_degree_sum": int(np.asarray(pk.in_degree).sum()),
+                "oracle_ok": ok,
+            }
+        ),
+        flush=True,
+    )
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,86 @@
+"""A REAL 2-process ``jax.distributed`` integration test (SURVEY §5.8).
+
+Round 4 shipped ``init_distributed``/``distributed_mesh`` with only the
+single-process no-op tested; this spawns two local CPU processes (a
+coordinator on 127.0.0.1 + one peer), each of which joins the job through the
+explicit-args path, builds the GLOBAL (8, 1) ``(pods, grants)`` mesh from 2×4
+virtual CPU devices, runs the same ``sharded-packed`` solve, and checks the
+aggregates against the CPU oracle. The parent asserts both processes agreed
+with the oracle and with each other.
+
+Skips cleanly where multi-process JAX cannot run (no free port / coordination
+service unavailable) — but a solver-side failure FAILS, it does not skip.
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_WORKER = os.path.join(_HERE, "distributed_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_two_process_distributed_solve():
+    try:
+        port = _free_port()
+    except OSError as e:  # pragma: no cover - sandboxed CI without sockets
+        pytest.skip(f"cannot bind a localhost port: {e}")
+    coord = f"127.0.0.1:{port}"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["PYTHONPATH"] = os.path.dirname(_HERE)
+    # the TPU image pins JAX_PLATFORMS via sitecustomize; the explicit env
+    # var above wins, but drop any axon-specific vars that could interfere
+    env.pop("JAX_PLATFORM_NAME", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _WORKER, coord, "2", str(pid)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+            env=env,
+        )
+        for pid in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=300)
+            outs.append((p.returncode, out, err))
+    except subprocess.TimeoutExpired:  # pragma: no cover
+        for p in procs:
+            p.kill()
+        pytest.skip("distributed workers hung (coordination service "
+                    "unavailable in this environment)")
+    reports = []
+    for rc, out, err in outs:
+        lines = [l for l in out.splitlines() if l.startswith("{")]
+        if rc != 0 and not lines:
+            # startup-level failure (e.g. the coordination service cannot
+            # listen in this sandbox): skip; anything with a report is a
+            # REAL result and must pass below
+            if "DEADLINE_EXCEEDED" in err or "UNAVAILABLE" in err or (
+                "Failed to connect" in err
+            ):
+                pytest.skip(f"jax.distributed unavailable: {err[-300:]}")
+            raise AssertionError(f"worker died without a report: {err[-2000:]}")
+        assert rc == 0, f"worker failed: {err[-2000:]}"
+        reports.append(json.loads(lines[-1]))
+    assert len(reports) == 2
+    for r in reports:
+        assert r["process_count"] == 2
+        assert r["n_devices"] == 8
+        assert r["oracle_ok"] is True
+    assert reports[0]["total_pairs"] == reports[1]["total_pairs"]
+    assert reports[0]["in_degree_sum"] == reports[1]["in_degree_sum"]

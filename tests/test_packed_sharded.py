@@ -104,6 +104,33 @@ def test_stripes_compose():
     assert a.total_pairs + b.total_pairs == int(ref.reach.sum())
 
 
+def test_full_aggregate_sweep_chunked():
+    """``sweep_chunk_tiles``: the in-function full sweep (reused-executable
+    stripes + remainder) must reproduce the one-shot solve's aggregates
+    exactly — the path ``bench.py --mode stripe --full-sweep`` uses to
+    measure config 5's single-chip share end-to-end."""
+    cluster = random_cluster(
+        GeneratorConfig(n_pods=70, n_policies=9, n_namespaces=2, seed=11)
+    )
+    ref = kv.verify(cluster, kv.VerifyConfig(backend="cpu", compute_ports=False))
+    enc = encode_cluster(cluster, compute_ports=False)
+    mesh = mesh_for((8, 1))
+    # 70 pods / tile 32 / dp 8 → 8 dst tiles; chunks of 3 → two 3-tile
+    # stripes + a 2-tile remainder: both executables exercised
+    swept = sharded_packed_reach(
+        mesh, enc, tile=32, chunk=8, sweep_chunk_tiles=3
+    )
+    assert swept.full_sweep and swept.packed is None
+    assert swept.timings["n_chunks"] == 3
+    np.testing.assert_array_equal(swept.out_degree, ref.reach.sum(axis=1))
+    np.testing.assert_array_equal(swept.in_degree, ref.reach.sum(axis=0))
+    assert swept.total_pairs == int(ref.reach.sum())
+    with pytest.raises(ValueError, match="drop stripe"):
+        sharded_packed_reach(
+            mesh, enc, tile=32, chunk=8, sweep_chunk_tiles=3, stripe=(0, 2)
+        )
+
+
 def test_user_crosscheck_and_system_isolation():
     """Crosscheck from the packed matrix AND from the matrix-free per-group
     in-degree aggregates; system_isolation from the matrix (and a clear
