@@ -30,6 +30,22 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+def _band(times) -> dict:
+    """min/median/max + spread over repeated timings — the axon tunnel's
+    run-to-run noise is ±30%, so a single scalar cannot distinguish a real
+    regression from a noisy run; every mode reports its band and the
+    emitted JSON carries it for the round-over-round record."""
+    ts = sorted(float(t) for t in times)
+    med = ts[len(ts) // 2]
+    return {
+        "n": len(ts),
+        "min_s": round(ts[0], 4),
+        "median_s": round(med, 4),
+        "max_s": round(ts[-1], 4),
+        "spread_pct": round(100.0 * (ts[-1] - ts[0]) / med, 1) if med else 0.0,
+    }
+
+
 def bench_tiled(args) -> None:
     """The BASELINE config-4 run: 100k pods / 10k policies, ingress+egress
     **with port-range bitmaps**, one chip, packed-bitmap output kept on
@@ -76,15 +92,18 @@ def bench_tiled(args) -> None:
     res = run()  # compile + first solve
     t3 = time.perf_counter()
     log(f"compile+first solve {t3 - t2:.1f}s  "
-        f"kernel={res.timings.get('kernel', '?')}")
+        f"kernel={(res.meta or {}).get('kernel', '?')}")
     times = []
     for _ in range(max(2, min(args.repeats, 5))):
         r = run()
         times.append(r.timings["solve"])
-    solve = sorted(times)[len(times) // 2]
+    band = _band(times)
+    solve = band["median_s"]
     value = float(n) * float(n) / solve
     log(
-        f"solve median {solve:.2f}s; {value / 1e9:.2f}e9 pairs/s; "
+        f"solve median {solve:.2f}s (min {band['min_s']:.2f} max "
+        f"{band['max_s']:.2f}, spread {band['spread_pct']}%); "
+        f"{value / 1e9:.2f}e9 pairs/s; "
         f"{r.timings['reachable_pairs']} reachable pairs"
     )
     ports_tag = "port bitmaps" if compute_ports else "any-port"
@@ -98,6 +117,7 @@ def bench_tiled(args) -> None:
                 "value": round(value, 1),
                 "unit": "pairs/s",
                 "vs_baseline": round(value / BASELINE_PAIRS_PER_SEC, 4),
+                "band": band,
             }
         )
     )
@@ -254,6 +274,7 @@ def bench_incremental(args) -> None:
         "pipelined (bursts, one sync each): "
         + "  ".join(f"{kk} {v * 1e3:.1f}ms" for kk, v in piped.items())
     )
+    sync_band = _band([t for v in samples.values() for t in v])
     print(
         json.dumps(
             {
@@ -268,6 +289,10 @@ def bench_incremental(args) -> None:
                 "unit": "ms",
                 # target: ≤100 ms per diff → >1.0 means better than target
                 "vs_baseline": round(0.1 / overall_piped, 4),
+                "sync_band": sync_band,
+                "piped_ms": {
+                    k: round(v * 1e3, 2) for k, v in piped.items()
+                },
             }
         )
     )
@@ -314,8 +339,23 @@ def bench_closure(args) -> None:
     sync = lambda c: int(np.asarray(c[0, 0]))
     s = time.perf_counter()
     sync(inc.closure_packed(tile=args.closure_tile))
-    full_s = time.perf_counter() - s
-    log(f"full packed closure: {full_s:.1f}s")
+    full_first = time.perf_counter() - s
+    log(f"full packed closure (compile+run): {full_first:.1f}s")
+    # band: re-run the full closure on the same matrix (the engine caches
+    # its closure, so repeats go straight at the kernel). The compile+first
+    # sample stays OUT of the band — mixing one-time compile cost into it
+    # would misread a stable kernel as noisy.
+    from kubernetes_verification_tpu.ops.closure import packed_closure
+
+    full_times = []
+    for _ in range(3):
+        s = time.perf_counter()
+        sync(packed_closure(inc._packed, tile=args.closure_tile))
+        full_times.append(time.perf_counter() - s)
+    full_band = _band(full_times)
+    full_s = full_band["median_s"]
+    log(f"full packed closure: median {full_s:.1f}s "
+        f"(min {full_band['min_s']:.1f} max {full_band['max_s']:.1f})")
     pols = list(cluster.policies)
     # adds-only diff: append a NARROW rule to an existing policy — its
     # selection (so every isolation count) is unchanged and grants only
@@ -331,7 +371,11 @@ def bench_closure(args) -> None:
     if len(pols) < 3:
         sys.exit("--mode closure needs at least 3 policies")
     target = pols[3 % len(pols)]
-    for k in sorted({0, n // 97, n // 7, n // 3, n - 1}):
+    donor_ks = sorted(
+        {0, n // 97, n // 7, n // 3, n - 1}
+        | {(37 * j + 11) % n for j in range(11)}
+    )
+    for k in donor_ks:
         narrow = Rule(
             peers=(Peer(pod_selector=Selector(dict(cluster.pods[k].labels))),)
         )
@@ -375,6 +419,7 @@ def bench_closure(args) -> None:
                 "unit": "s",
                 "vs_baseline": round(full_s / adds_s, 2),
                 "full_s": round(full_s, 2),
+                "full_band": full_band,
                 "mixed_diff_s": round(mixed_s, 2),
                 "adds_diff_real": adds_real,
             }
@@ -450,11 +495,71 @@ def bench_stripe(args) -> None:
     for _ in range(max(2, min(args.repeats, 4))):
         r = run()
         times.append(r.timings["solve"])
-    stripe_s = sorted(times)[len(times) // 2]
+    stripe_band = _band(times)
+    stripe_s = stripe_band["median_s"]
     width = k_tiles * tile
     stripe_rate = float(n_big) * width / stripe_s
     log(f"1M stripe: {n_big} srcs x {width} dsts in {stripe_s:.2f}s "
-        f"median = {stripe_rate / 1e9:.2f}e9 pairs/s")
+        f"median (min {stripe_band['min_s']:.2f} max "
+        f"{stripe_band['max_s']:.2f}) = {stripe_rate / 1e9:.2f}e9 pairs/s")
+
+    sweep_extra = {}
+    if args.full_sweep:
+        # config 5's single-chip share END-TO-END: every dst tile of the
+        # n_big-pod matrix-free solve on the real chip, aggregates
+        # accumulated across reused-executable stripes, then cross-checked
+        # against the CPU oracle via the replication periodicity:
+        # reach(i, j) = P_base(i % B, j % B) ∨ (i == j), so
+        # total = reps² · |P_base| + reps · #{a : ¬P_base(a, a)}.
+        t5 = time.perf_counter()
+        full = sharded_packed_reach(
+            mesh, enc_big, tile=tile, chunk=1024,
+            sweep_chunk_tiles=k_tiles,
+        )
+        sweep_s = time.perf_counter() - t5
+        rate = float(n_big) * float(n_big) / sweep_s
+        log(f"FULL 1M sweep: {n_big}² pairs in {sweep_s:.1f}s = "
+            f"{rate / 1e9:.2f}e9 pairs/s over "
+            f"{full.timings['n_chunks']} stripes (chunk median "
+            f"{full.timings['chunk_s_median']:.2f}s, max "
+            f"{full.timings['chunk_s_max']:.2f}s)")
+        import kubernetes_verification_tpu as kv
+
+        p_base = kv.verify(
+            base,
+            kv.VerifyConfig(
+                backend="cpu", compute_ports=False, self_traffic=False
+            ),
+        ).reach
+        diag_missing = int((~np.diag(p_base)).sum())
+        expected_total = (
+            reps * reps * int(p_base.sum()) + reps * diag_missing
+        )
+        row_base = p_base.sum(axis=1).astype(np.int64)
+        ok_total = full.total_pairs == expected_total
+        # spot-check out-degrees on a sample of rows
+        rows = np.arange(0, n_big, max(1, n_big // 97))
+        exp_rows = reps * row_base[rows % base_n] + (
+            ~np.diag(p_base)[rows % base_n]
+        ).astype(np.int64)
+        ok_rows = bool((full.out_degree[rows] == exp_rows).all())
+        log(f"oracle cross-check: total {full.total_pairs} "
+            f"{'==' if ok_total else '!='} expected {expected_total}; "
+            f"out-degree sample {'ok' if ok_rows else 'MISMATCH'}")
+        if not (ok_total and ok_rows):
+            sys.exit("full-sweep aggregates disagree with the CPU oracle")
+        sweep_extra = {
+            "full_sweep_s": round(sweep_s, 2),
+            "full_sweep_pairs_per_s": round(rate, 1),
+            "full_sweep_total_pairs": full.total_pairs,
+            "full_sweep_chunks": full.timings["n_chunks"],
+            "full_sweep_chunk_band": {
+                "min_s": round(full.timings["chunk_s_min"], 3),
+                "median_s": round(full.timings["chunk_s_median"], 3),
+                "max_s": round(full.timings["chunk_s_max"], 3),
+            },
+            "oracle_checked": True,
+        }
 
     # matrix-free incremental diff at 250k pods (pod OBJECTS needed here,
     # so a smaller tiling keeps host construction sane)
@@ -501,8 +606,90 @@ def bench_stripe(args) -> None:
                 "unit": "pairs/s",
                 "vs_baseline": round(stripe_rate / BASELINE_PAIRS_PER_SEC, 4),
                 "stripe_s": round(stripe_s, 3),
+                "stripe_band": stripe_band,
                 "mf_diff_ms": round(diff_s * 1e3, 2),
                 "mf_restripe_s": round(restripe_s, 3),
+                **sweep_extra,
+            }
+        )
+    )
+
+
+def bench_headtohead(args) -> None:
+    """Interleaved kernel A/B at the north-star config — the discipline the
+    ±30% tunnel noise demands (same process, alternating variants, bands
+    not scalars). Variants: the auto-selected kernel vs the hybrid Pallas
+    port kernel (``use_pallas=True``) — the comparison that justified
+    keeping XLA as the default port path (``ops/pallas_kernels.py``)."""
+    import jax
+
+    from kubernetes_verification_tpu.encode.encoder import encode_cluster
+    from kubernetes_verification_tpu.harness.generate import (
+        GeneratorConfig,
+        random_cluster,
+    )
+    from kubernetes_verification_tpu.ops.tiled import tiled_k8s_reach
+
+    dev = jax.devices()[0]
+    log(f"device: {dev} ({jax.default_backend()})")
+    n = args.pods
+    t0 = time.perf_counter()
+    cluster = random_cluster(
+        GeneratorConfig(
+            n_pods=n, n_policies=args.policies, n_namespaces=args.namespaces,
+            p_ipblock_peer=0.0, min_selector_labels=1, seed=0,
+        )
+    )
+    enc = encode_cluster(cluster, compute_ports=not args.no_ports)
+    t1 = time.perf_counter()
+    log(f"generate+encode {t1 - t0:.1f}s")
+    variants = {
+        "xla": lambda: tiled_k8s_reach(
+            enc, device=dev, fetch=False, use_pallas=False
+        ),
+        "pallas": lambda: tiled_k8s_reach(
+            enc, device=dev, fetch=False, use_pallas=True
+        ),
+    }
+    kernels = {}
+    for name, fn in variants.items():
+        r = fn()  # compile
+        kernels[name] = (r.meta or {}).get("kernel", "?")
+        log(f"{name}: compiled (kernel={kernels[name]})")
+    reps = max(3, min(args.repeats, 7))
+    times = {k: [] for k in variants}
+    for i in range(reps):
+        for name, fn in variants.items():
+            times[name].append(fn().timings["solve"])
+        log(f"rep {i + 1}/{reps} done")
+    bands = {k: _band(v) for k, v in times.items()}
+    for name, b in bands.items():
+        log(f"{name} ({kernels[name]}): median {b['median_s']:.2f}s "
+            f"min {b['min_s']:.2f} max {b['max_s']:.2f} "
+            f"spread {b['spread_pct']}%")
+    delta_pct = 100.0 * (
+        bands["pallas"]["median_s"] / bands["xla"]["median_s"] - 1.0
+    )
+    log(f"pallas vs xla: {delta_pct:+.1f}% median "
+        f"({'pallas slower' if delta_pct > 0 else 'pallas faster'})")
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    f"interleaved kernel A/B (xla vs pallas), {n} pods / "
+                    f"{args.policies} policies, "
+                    f"{'any-port' if args.no_ports else 'port bitmaps'}, "
+                    "1 chip"
+                ),
+                "value": round(delta_pct, 1),
+                "unit": "pallas_vs_xla_median_pct",
+                "vs_baseline": round(
+                    (float(n) * n / bands["xla"]["median_s"])
+                    / BASELINE_PAIRS_PER_SEC,
+                    4,
+                ),
+                "bands": bands,
+                "kernels": kernels,
             }
         )
     )
@@ -516,18 +703,28 @@ def main() -> None:
     ap.add_argument("--repeats", type=int, default=5)
     ap.add_argument(
         "--mode",
-        choices=("tiled", "k8s", "kano", "incremental", "closure", "stripe"),
+        choices=(
+            "tiled", "k8s", "kano", "incremental", "closure", "stripe",
+            "headtohead",
+        ),
         default="tiled",
         help="tiled = the BASELINE north-star config (100k pods / 10k "
         "policies, packed-bitmap output); k8s/kano = dense kernels at 10k; "
         "incremental = policy+pod diff latency on the packed state at 100k; "
         "closure = full + after-diff packed closure at 100k; stripe = the "
         "1M-pod dst stripe + 250k matrix-free diff (config 5's single-chip "
-        "share)",
+        "share; --full-sweep runs ALL dst tiles with an oracle cross-check); "
+        "headtohead = interleaved xla-vs-pallas kernel A/B with bands",
     )
     ap.add_argument(
-        "--closure-tile", type=int, default=512,
-        help="closure mode: squaring tile",
+        "--full-sweep", action="store_true",
+        help="stripe mode: additionally sweep EVERY dst tile of the 1M "
+        "matrix-free solve (~4 min on chip) and cross-check aggregates "
+        "against the CPU oracle via replication periodicity",
+    )
+    ap.add_argument(
+        "--closure-tile", type=int, default=7168,
+        help="closure mode: squaring row tile (dst stripe auto-picks ~14336)",
     )
     ap.add_argument(
         "--stripe-width", type=int, default=32_768,
@@ -554,12 +751,12 @@ def main() -> None:
     if args.pods is None:
         args.pods = {
             "tiled": 100_000, "incremental": 100_000, "closure": 100_000,
-            "stripe": 1_000_000,
+            "stripe": 1_000_000, "headtohead": 100_000,
         }.get(args.mode, 10_000)
     if args.policies is None:
         args.policies = {
             "tiled": 10_000, "incremental": 10_000, "closure": 10_000,
-            "stripe": 512,
+            "stripe": 512, "headtohead": 10_000,
         }.get(args.mode, 1_000)
 
     import jax
@@ -572,6 +769,8 @@ def main() -> None:
         return bench_closure(args)
     if args.mode == "stripe":
         return bench_stripe(args)
+    if args.mode == "headtohead":
+        return bench_headtohead(args)
 
     from kubernetes_verification_tpu.encode.encoder import (
         encode_cluster,

@@ -32,6 +32,7 @@ The engine evaluates with NumPy by default (exact, host-side) or with JAX
 """
 from __future__ import annotations
 
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Dict, List, Mapping, Optional, Sequence, Tuple, Union
 
@@ -201,8 +202,13 @@ class Program:
 _EINSUM_LETTERS = "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ"
 
 #: jitted boolean-einsum kernels, one per einsum spec (jax's jit adds the
-#: per-shape specialisation underneath each entry)
-_RULE_EINSUM_CACHE: Dict[str, object] = {}
+#: per-shape specialisation underneath each entry). LRU-bounded: a
+#: long-lived process evaluating many dynamically generated programs would
+#: otherwise accumulate one jitted function (and its per-shape XLA
+#: executables) per distinct spec forever — a program's rule set touches a
+#: handful of specs, so a small bound never thrashes in practice.
+_RULE_EINSUM_CACHE: "OrderedDict[str, object]" = OrderedDict()
+_RULE_EINSUM_CACHE_MAX = 128
 
 
 def _jit_rule_einsum(expr: str):
@@ -219,6 +225,10 @@ def _jit_rule_einsum(expr: str):
 
         fn = jax.jit(run)
         _RULE_EINSUM_CACHE[expr] = fn
+        while len(_RULE_EINSUM_CACHE) > _RULE_EINSUM_CACHE_MAX:
+            _RULE_EINSUM_CACHE.popitem(last=False)
+    else:
+        _RULE_EINSUM_CACHE.move_to_end(expr)
     return fn
 
 

@@ -998,7 +998,12 @@ class PackedReach:
     ingress_isolated: np.ndarray
     egress_isolated: np.ndarray
     selected: Optional[np.ndarray] = None
+    #: float-valued phase timings (plus the integer ``reachable_pairs``
+    #: byproduct) — numeric only, safe to sum/max
     timings: Optional[dict] = None
+    #: non-numeric provenance (e.g. which kernel actually ran) — kept out
+    #: of ``timings`` so numeric consumers never trip on a string
+    meta: Optional[dict] = None
     #: bool [n_pods] — live pods, when the matrix carries tombstoned slots
     #: (the incremental engines' pod-churn state; tombstone rows/cols are
     #: all-zero). None ⇔ every slot is a live pod. Whole-matrix queries
@@ -1597,10 +1602,11 @@ def tiled_k8s_reach(
         ingress_isolated=np.asarray(ing_iso[:n]),
         egress_isolated=np.asarray(eg_iso[:n]),
         selected=None,
+        timings={label: t1 - t0},
         # "kernel" records what actually ran — a forced use_pallas can
         # legitimately fall back (restricted full blocks, awkward
         # interpret-mode shapes), and benchmarks must not misattribute
-        timings={label: t1 - t0, "kernel": kernel},
+        meta={"kernel": kernel},
     )
     if not fetch:
         out.timings["reachable_pairs"] = total
