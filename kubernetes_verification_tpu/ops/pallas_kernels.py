@@ -32,30 +32,56 @@ ways): **Pallas 2.45 s (4.08e9 pairs/s) vs XLA tiled 2.53 s (3.95e9
 pairs/s)** — a ~3.4% win, so ``tiled_k8s_reach`` auto-selects this kernel
 for any-port solves on TPU.
 
-**Port-path decomposition** (round 4, measured at the same flagship config,
-R=19 run masks, 14,353 ingress / 5,905 egress VP rows of which 6,760 /
-2,816 are the full-coverage block): the full-mask block is ~47% of the
-port sweep's MXU MACs and is exactly this kernel's shape, so a hybrid was
-built (``ops.tiled._tiled_ports_pallas_step``): full blocks through
-``packed_dir_allow``, only the R ported segments through the XLA tile pass,
-composed exactly in the packed word domain. Head-to-head on hardware
-(identical 3,105,860,083 reachable pairs): **XLA mask-group 3.8–4.0 s vs
-hybrid 4.6–5.2 s** across interleaved same-process runs — the hybrid LOSES
-~25%. Interpretation: the port sweep is bound by the per-tile mask-group
-COMBINES and gathers (the any-port XLA path does the same 2e14 MACs in
-2.53 s; the ~1.3 s port premium is VPU/elementwise work the hybrid cannot
-remove and whose packed-domain assembly it duplicates), not by the dots
-that Pallas fuses. Pre-baking the per-tile ingress gather as a fourth
-resident operand was also measured and bought nothing. The XLA mask-group
-kernel therefore remains the port-path default; the hybrid stays available
-(``use_pallas=True`` with a multi-atom encoding) and differentially tested.
-Two further levers were measured and rejected: larger dst tiles (raising
-``_PORT_SLAB_BUDGET`` so tile 576→1024: 3.71→4.04 s, →2048 OOMs HBM) and an
-int32 bit-plane overlap combine (1.8× slower — see ``_mask_group_conj``).
-The mask-group sweep is at its practical XLA optimum on this hardware.
+**Port-path decomposition — round-5 ablation (supersedes round 4's
+reading).** Measured at the flagship config (100k pods / 10k policies,
+R=19 run masks) by swapping doctored static ``PortLayout``s into the SAME
+compiled sweep — each variant deletes one class of work — interleaved in
+one process, 3 reps, medians:
+
+====================  ========  =============================================
+variant               median    what it removes
+====================  ========  =============================================
+real                  4.13 s    —
+self-overlap only     4.34 s    every cross-mask combine OR
+ov_rows emptied       4.21 s    ALL combine ORs (cross + self)
+ported segs zeroed    2.61 s    the R segment dots + their [N, tile] planes
+any-port encoding     2.70 s    the whole port machinery (the floor)
+====================  ========  =============================================
+
+So the ~1.4 s port premium is ENTIRELY the ported segment dots and their
+per-mask plane materialisations; the combine ORs that round 4 blamed cost
+nothing measurable (XLA fuses the OR chains). Round 4's hybrid — full
+blocks through ``packed_dir_allow``, ported segments in XLA — targeted
+the wrong half and lost ~25% (4.6–5.2 s vs 3.8–4.0 s, same-process).
+
+Acting on the corrected diagnosis, round 5 built the opposite kernel:
+``fused_ports_stripe`` runs EVERY segment — ported and full, both
+directions — inside one Pallas K-sweep with the per-mask planes in VMEM
+scratch and the combine folded in at statically-scheduled segment
+boundaries (no per-mask plane ever touches HBM; dst-side operands
+pre-gathered + bank-gated, so restricted full blocks need no fallback).
+It is differentially correct (``tests/test_pallas.py``) and LOSES ~50%
+head-to-head: 6.35 s vs 4.20 s at (tm=128, tk=256, stripe=2048), 6.45 s
+vs 4.36 s at (256, 512, 1024) — ``bench.py --mode headtohead``. The XLA
+sweep's advantage is its fat-M dots: each ``[l, N]·[l, tile]`` contraction
+streams all 100k rows through the MXU per mask, while any
+accumulator-carrying Pallas schedule is forced to small M blocks (scratch
+ties one (i, j) block to the whole sequential K walk) and pays per-program
+overhead × 67k programs. With dots ~2.5 s of the 4.2 s total and the
+plane traffic only ~0.5–1 s, a fused schedule must match XLA's dot
+efficiency to win — and at these shapes it cannot. The XLA mask-group
+kernel therefore remains the port-path default, now on five measured
+formulations rather than four data points: XLA 4.1–4.4 s, hybrid +25%,
+fused +50%, int32 bit-plane combine +80% (see ``_mask_group_conj``),
+larger XLA dst tiles slower (576→1024: 3.71→4.04 s, 2048 OOMs). The fused
+kernel stays available (``use_pallas=True`` with a multi-atom encoding)
+and differentially tested. Mosaic notes for future attempts: 3-D VMEM
+scratch indexed per plane check-fails layout inference (use separate 2-D
+refs), as does a rank-1 ``[:, None]`` reshape in this kernel (feed
+column-form operands instead).
 Of r03's 3.62 s → 3.72 s drift: the generator gained named container ports
 between the rounds (extra restriction-bank gathers + more VP rows), i.e.
-config change, not regression — the same build measures 3.7–4.0 s
+config change, not regression — the same build measures 3.7–4.4 s
 run-to-run under this environment's remote-tunnel timing noise.
 """
 from __future__ import annotations
@@ -68,7 +94,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["packed_dir_allow", "packed_reach"]
+__all__ = ["packed_dir_allow", "packed_reach", "fused_ports_stripe"]
 
 _I32 = jnp.int32
 _U32 = jnp.uint32
@@ -224,6 +250,166 @@ def packed_reach(
         bits = jnp.uint32(1) << (rows % 32).astype(_U32)
         out = out.at[rows, cols].set(out[rows, cols] | bits)
     return out
+
+
+def _fused_ports_kernel(
+    a_ref,  # int8 [TK, TM] — src-side K rows (both directions concatenated)
+    b_ref,  # int8 [TK, TN] — dst-side K rows for this dst stripe
+    niso_i_ref,  # int32 [8, TN] — 1 where dst NOT ingress-isolated (row 0)
+    niso_e_ref,  # int32 [TM, 128] — 1 where src NOT egress-isolated,
+    # lane-replicated COLUMN form (col 0 read): rank-2 slices avoid the
+    # rank-1 [:, None] reshape that check-fails Mosaic layout inference here
+    out_ref,  # int8 [TM, TN] — the reach bool tile (pre diag/col-mask)
+    *scratch,  # counts i32 [TM, TN]; R+1 int8 egress planes (separate 2D
+    # refs — a 3D slab scratch trips Mosaic layout inference on the
+    # plane-indexing reshape); ge_any, gi_any, conj int8 [TM, TN]
+    tm: int,
+    tn: int,
+    r_masks: int,
+    plan: tuple,  # ((end_chunk, kind, slab), ...) kinds: 0=eg seg, 1=eg
+    # full, 2=ing seg, 3=ing full — K-axis order is all egress first
+    ov_rows: tuple,  # per ported mask: overlapping ported masks
+    default_allow: bool,
+):
+    """The whole port-path reach for one (src block × dst stripe), fused.
+
+    The K grid axis walks [egress ported segments | egress full block |
+    ingress ported segments | ingress full block] (each padded to a TK
+    multiple with inert rows). Every segment's dot accumulates into ONE
+    int32 scratch; at its statically-known last chunk the segment flushes:
+    egress planes park in the per-mask slab scratch, ingress planes
+    immediately combine against the (complete, this is why egress goes
+    first) slabs through the static overlap rows. No per-mask [N, tile]
+    plane ever touches HBM — the round-4 ablation showed those slab
+    round-trips, not the combine ORs, are the port premium."""
+    counts = scratch[0]
+    slabs = scratch[1 : 2 + r_masks]
+    ge_any, gi_any, conj = scratch[2 + r_masks :]
+    k = pl.program_id(1)
+    i8 = jnp.int8
+
+    @pl.when(k == 0)
+    def _():
+        counts[:] = jnp.zeros((tm, tn), dtype=_I32)
+        for s in range(r_masks + 1):
+            slabs[s][:] = jnp.zeros((tm, tn), dtype=i8)
+        ge_any[:] = jnp.zeros((tm, tn), dtype=i8)
+        gi_any[:] = jnp.zeros((tm, tn), dtype=i8)
+        conj[:] = jnp.zeros((tm, tn), dtype=i8)
+
+    counts[:] += jax.lax.dot_general(
+        a_ref[:], b_ref[:], (((0,), (0,)), ((), ())),
+        preferred_element_type=_I32,
+    )
+
+    for end_chunk, kind, slab in plan:
+
+        @pl.when(k == end_chunk - 1)
+        def _(kind=kind, slab=slab):
+            ok = (counts[:] > 0).astype(i8)
+            if kind == 0:  # egress ported mask `slab`
+                slabs[slab][:] = ok
+                ge_any[:] = ge_any[:] | ok
+            elif kind == 1:  # egress full block
+                slabs[r_masks][:] = ok
+                ge_any[:] = ge_any[:] | ok
+            elif kind == 2:  # ingress ported mask `slab`
+                comp = slabs[r_masks][:]  # full-mask egress overlaps all
+                for m2 in ov_rows[slab]:
+                    comp = comp | slabs[m2][:]
+                conj[:] = conj[:] | (ok & comp)
+                gi_any[:] = gi_any[:] | ok
+            else:  # ingress full block: overlaps every egress mask
+                conj[:] = conj[:] | (ok & ge_any[:])
+                gi_any[:] = gi_any[:] | ok
+            counts[:] = jnp.zeros((tm, tn), dtype=_I32)
+
+    @pl.when(k == pl.num_programs(1) - 1)
+    def _():
+        r = conj[:]
+        if default_allow:
+            di = jnp.broadcast_to(
+                (niso_i_ref[0:1, :] > 0).astype(i8), (tm, tn)
+            )
+            de = jnp.broadcast_to(
+                (niso_e_ref[:, 0:1] > 0).astype(i8), (tm, tn)
+            )
+            r = r | (di & de) | (di & ge_any[:]) | (de & gi_any[:])
+        out_ref[:] = r
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "tm", "tk", "r_masks", "plan", "ov_rows", "default_allow",
+        "interpret",
+    ),
+)
+def fused_ports_stripe(
+    a_all,  # int8 [Ktot, N] — src-side operand rows in K order
+    b_t,  # int8 [Ktot, TN] — dst-side operand rows, this stripe's columns
+    niso_i_t,  # int32 [8, TN]
+    niso_e,  # int32 [N, 128] — column form (see kernel)
+    *,
+    tm: int = 128,
+    tk: int = 256,
+    r_masks: int,
+    plan: tuple,
+    ov_rows: tuple,
+    default_allow: bool,
+    interpret: bool = False,
+):
+    """int8 [N, TN]: the port-path reach bool stripe (before self-traffic /
+    validity masking / packing, which stay in XLA). See ``_fused_ports_kernel``."""
+    Ktot, N = a_all.shape
+    tn = b_t.shape[1]
+    if N % tm or Ktot % tk:
+        raise ValueError(f"shapes ({Ktot}, {N}) need tm|{tm} tk|{tk}")
+    # VMEM scratch: counts (int32) + (R+4) int8 slabs of [tm, tn] — unlike
+    # the XLA path, which shrinks its dst tile as R grows, the fused
+    # stripe is fixed, so reject an R that cannot fit rather than failing
+    # deep inside Mosaic with a VMEM-exhaustion error
+    scratch_bytes = (4 + r_masks + 4) * tm * tn
+    if not interpret and scratch_bytes > 11 << 20:
+        raise ValueError(
+            f"fused port kernel needs ~{scratch_bytes / 2**20:.1f} MiB of "
+            f"VMEM scratch for R={r_masks} ported masks at ({tm}, {tn}) "
+            "blocks — over the ~11 MiB budget; use the XLA port path "
+            "(use_pallas=False) or coarsen the port specs"
+        )
+    grid = (N // tm, Ktot // tk)
+    return pl.pallas_call(
+        partial(
+            _fused_ports_kernel,
+            tm=tm, tn=tn, r_masks=r_masks, plan=plan, ov_rows=ov_rows,
+            default_allow=default_allow,
+        ),
+        out_shape=jax.ShapeDtypeStruct((N, tn), jnp.int8),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tk, tm), lambda i, k: (k, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tk, tn), lambda i, k: (k, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((8, tn), lambda i, k: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec(
+                (tm, 128), lambda i, k: (i, 0), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (tm, tn), lambda i, k: (i, 0), memory_space=pltpu.VMEM
+        ),
+        scratch_shapes=[pltpu.VMEM((tm, tn), _I32)]
+        + [pltpu.VMEM((tm, tn), jnp.int8) for _ in range(r_masks + 1)]
+        + [pltpu.VMEM((tm, tn), jnp.int8) for _ in range(3)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * Ktot * N * tn,
+            bytes_accessed=Ktot * (N + tn) + N * tn,
+            transcendentals=0,
+        ),
+        interpret=pltpu.InterpretParams() if interpret else False,
+    )(a_all, b_t, niso_i_t, niso_e)
 
 
 def _pack_matrices(tn: int):

@@ -728,15 +728,17 @@ def _tiled_ports_step(
     jax.jit,
     static_argnames=(
         "layout",
-        "tile",
+        "stripe",
         "chunk",
-        "ptn",
+        "tm",
+        "tk",
         "self_traffic",
         "default_allow_unselected",
         "direction_aware_isolation",
+        "interp",
     ),
 )
-def _tiled_ports_pallas_step(
+def _tiled_ports_fused_step(
     pod_kv,
     pod_key,
     pod_ns,
@@ -758,47 +760,49 @@ def _tiled_ports_pallas_step(
     col_mask,
     *,
     layout: PortLayout,
-    tile: int,
+    stripe: int,
     chunk: int,
-    ptn: int,
+    tm: int,
+    tk: int,
     self_traffic: bool,
     default_allow_unselected: bool,
     direction_aware_isolation: bool,
+    interp: bool,
 ):
-    """The hybrid port kernel: the FULL-mask VP blocks — the dominant FLOPs
-    of the mask-group decomposition (portless rules and whole-universe
-    specs) — run through the fused Pallas direction kernel
-    (``pallas_kernels.packed_dir_allow``: dot + default-allow + bit-pack in
-    VMEM, one packed HBM write), while only the R ported segments sweep
-    through the XLA tile pass. The two compose EXACTLY in the packed word
-    domain:
+    """The FULLY-FUSED port kernel (round 5): every segment dot — ported
+    masks AND full blocks, both directions — runs inside one Pallas kernel
+    per dst stripe, with the per-mask planes living in VMEM scratch and the
+    mask-group combine folded into the statically-scheduled K sweep
+    (``pallas_kernels.fused_ports_stripe``).
 
-        FI = pack(gi_full ∨ DI)        FE = pack(ge_full ∨ DE)
-        r  = (FI ∧ FE) ∨ (FI ∧ pack(ge_ported_any))
-                       ∨ (FE ∧ pack(gi_ported_any))
-                       ∨ pack(∃ overlapping m1,m2: gi_m1 ∧ ge_m2) ∨ diag
+    Rationale: the round-5 ablation (doctored static layouts, interleaved
+    one-process reps at the flagship config) split the ~1.4 s port premium
+    as ~1.6 s in the ported segment dots + their [N, tile] plane
+    materialisations and ~0 s in the combine ORs — overturning round 4's
+    "combine-bound" reading (removing every cross-mask OR changed nothing:
+    4.13 s vs 4.21 s median). Fusing the planes into VMEM is therefore the
+    lever the round-4 hybrid (full blocks only) could not reach.
 
-    which is the full expansion of ``∨_q (GI_q ∨ DI) ∧ (GE_q ∨ DE)``: the
-    FI∧FE product covers full×full plus every default-allow×full and DI∧DE
-    term, the two cross products cover full×ported AND default-allow×ported,
-    and the last is the ported-only conjunction. Requires every full-block
-    VP to carry restriction 0 (named-port variants are single-atom masks, so
-    this only fails in the degenerate one-atom universe — the caller checks
-    and falls back)."""
-    from .pallas_kernels import packed_dir_allow
+    Unlike the hybrid this path needs NO restriction-free full blocks: the
+    dst-side operands are pre-gathered per VP row with the named-port bank
+    gating folded in, so restricted VPs fuse like any others. The resident
+    cost is the two [Ktot, N] K-ordered operand copies (~2·Ktot·N int8);
+    the per-VP originals die inside the jit once the copies are built."""
+    from .pallas_kernels import fused_ports_stripe
 
     N = pod_kv.shape[0]
     P = pol_ns.shape[0]
     W = N // 32
-    da = default_allow_unselected
+    R = layout.n_masks
 
     selected8, sel_ing8, sel_eg8, ing_iso, eg_iso = _select_maps(
         pod_kv, pod_key, pod_ns, pol_sel, pol_ns, aff_ing, aff_eg,
         direction_aware_isolation,
     )
     zrow = jnp.zeros((1, N), dtype=_I8)
-    sel_ing_ext = jnp.concatenate([sel_ing8, zrow], axis=0)
+    sel_ing_ext = jnp.concatenate([sel_ing8, zrow], axis=0)  # [P+1, N]
     sel_eg_ext = jnp.concatenate([sel_eg8, zrow], axis=0)
+
     total_i = vp_pol_i.shape[0]
     total_e = vp_pol_e.shape[0]
     vp_peers_i = _peers_by_slot(
@@ -808,108 +812,77 @@ def _tiled_ports_pallas_step(
     vp_peers_e = _peers_by_slot(
         egress, vp_slot_e, total_e, chunk,
         pod_kv, pod_key, ns_kv, ns_key, pod_ns, pol_ns,
-    ) * bank8[vp_res_e]
-    sel_eg_vp = sel_eg_ext[vp_pol_e]
-    sel_ing_vp = sel_ing_ext[vp_pol_i] * bank8[vp_res_i]
-
-    interpret = jax.default_backend() != "tpu"
-    tk = 256
-    fs_i, fl_i = layout.full_i
-    fs_e, fl_e = layout.full_e
-
-    def full_dir(a_rows, b_rows, niso, axis):
-        pf = a_rows.shape[0]
-        pad = (tk - pf % tk) % tk if pf else tk
-        padp = lambda x: jnp.pad(x, ((0, pad), (0, 0)))
-        return packed_dir_allow(
-            padp(a_rows), padp(b_rows),
-            jnp.broadcast_to(niso.astype(_I32), (8, N)),
-            # tm must divide N; gcd keeps interpret-mode shapes like
-            # N = 384 (tile-multiple but not 256-multiple) working
-            tm=math.gcd(N, 256), tn=ptn, tk=tk,
-            default_allow_axis=axis, interpret=interpret,
-        )
-
-    if fl_i:
-        a_i = jax.lax.slice(vp_peers_i, (fs_i, 0), (fs_i + fl_i, N))
-        b_i = jax.lax.slice(sel_ing_vp, (fs_i, 0), (fs_i + fl_i, N))
-        FI = full_dir(a_i, b_i, ~ing_iso, 1 if da else -1)
-    elif da:  # no full rows: FI degenerates to the DI broadcast
-        FI = jnp.broadcast_to(pack_bool_cols((~ing_iso)[None, :]), (N, W))
-    else:
-        FI = jnp.zeros((N, W), dtype=_U32)
-    if fl_e:
-        a_e = jax.lax.slice(sel_eg_vp, (fs_e, 0), (fs_e + fl_e, N))
-        b_e = jax.lax.slice(vp_peers_e, (fs_e, 0), (fs_e + fl_e, N))
-        FE = full_dir(a_e, b_e, ~eg_iso, 0 if da else -1)
-    elif da:  # DE is a src-side property: whole words per row
-        FE = jnp.broadcast_to(
-            jnp.where(
-                (~eg_iso)[:, None], jnp.uint32(0xFFFFFFFF), jnp.uint32(0)
-            ),
-            (N, W),
-        )
-    else:
-        FE = jnp.zeros((N, W), dtype=_U32)
-
-    R = layout.n_masks
-    if R == 0:
-        out = FI & FE
-        if self_traffic:
-            rows = jnp.arange(N)
-            bits = jnp.uint32(1) << (rows % 32).astype(_U32)
-            out = out.at[rows, rows // 32].set(
-                out[rows, rows // 32] | bits
-            )
-        return out & col_mask[None, :], ing_iso, eg_iso, selected8 > 0
-
-    # ported-only layout: same segments/overlaps, zero-length full blocks
-    layout_p = PortLayout(
-        seg_i=layout.seg_i, seg_e=layout.seg_e,
-        full_i=(fs_i, 0), full_e=(fs_e, 0), ov_rows=layout.ov_rows,
     )
-    n_tiles = N // tile
+    vp_peers_e = vp_peers_e * bank8[vp_res_e]
+    sel_eg_vp = sel_eg_ext[vp_pol_e]  # int8 [total_e, N] (src side, egress)
+    # ingress dst side, pre-gathered + bank-gated (the hybrid kept this as
+    # a per-tile gather; the fused K sweep needs it resident)
+    sel_ing_vp = sel_ing_ext[vp_pol_i] * bank8[vp_res_i]  # [total_i, N]
 
-    def tile_body(t, out):
-        d0 = t * tile
-        sel_ing_vp_t = jax.lax.dynamic_slice(
-            sel_ing_vp, (0, d0), (total_i, tile)
+    # --- K-axis layout: [eg segs | eg full | ing segs | ing full], each
+    # padded to a tk multiple (pad rows are zeros — inert) ---------------
+    entries = []  # (dirn, start, length, kind, slab)
+    for m, (s, l) in enumerate(layout.seg_e):
+        if l:
+            entries.append(("e", s, l, 0, m))
+    fs, fl = layout.full_e
+    if fl:
+        entries.append(("e", fs, fl, 1, R))
+    for m, (s, l) in enumerate(layout.seg_i):
+        if l:
+            entries.append(("i", s, l, 2, m))
+    fs, fl = layout.full_i
+    if fl:
+        entries.append(("i", fs, fl, 3, R))
+    a_parts, b_parts, plan = [], [], []
+    chunks = 0
+    for dirn, s, l, kind, slab in entries:
+        pad = (-l) % tk
+        a_src = sel_eg_vp if dirn == "e" else vp_peers_i
+        b_src = vp_peers_e if dirn == "e" else sel_ing_vp
+        a_parts.append(
+            jnp.pad(jax.lax.slice(a_src, (s, 0), (s + l, N)), ((0, pad), (0, 0)))
         )
-        vpe_t = jax.lax.dynamic_slice(vp_peers_e, (0, d0), (total_e, tile))
-        false_t = jnp.zeros((N, tile), dtype=bool)
-
-        def ing_dot(start: int, length: int) -> jnp.ndarray:
-            a = jax.lax.slice(vp_peers_i, (start, 0), (start + length, N))
-            b = jax.lax.slice(
-                sel_ing_vp_t, (start, 0), (start + length, tile)
-            )
-            return _dot_lnt(a, b) > 0
-
-        def eg_dot(start: int, length: int) -> jnp.ndarray:
-            a = jax.lax.slice(sel_eg_vp, (start, 0), (start + length, N))
-            b = jax.lax.slice(vpe_t, (start, 0), (start + length, tile))
-            return _dot_lnt(a, b) > 0
-
-        conj_p, gi_p, ge_p = _mask_group_conj(
-            layout_p, ing_dot, eg_dot, false_t
+        b_parts.append(
+            jnp.pad(jax.lax.slice(b_src, (s, 0), (s + l, N)), ((0, pad), (0, 0)))
         )
+        chunks += (l + pad) // tk
+        plan.append((chunks, kind, slab))
+    if not entries:  # no grants at all: one inert chunk keeps shapes legal
+        a_parts = [jnp.zeros((tk, N), dtype=_I8)]
+        b_parts = [jnp.zeros((tk, N), dtype=_I8)]
+        plan = [(1, 0, 0)]
+    a_all = jnp.concatenate(a_parts, axis=0)
+    b_all = jnp.concatenate(b_parts, axis=0)
+    plan = tuple(plan)
+
+    niso_i = jnp.repeat((~ing_iso).astype(_I32)[None, :], 8, axis=0)
+    # column form, lane-replicated (the kernel reads col 0): a row-form
+    # [8, TM] block would need a rank-1 [:, None] reshape in-kernel, which
+    # Mosaic's layout inference rejects
+    niso_e = jnp.repeat((~eg_iso).astype(_I32)[:, None], 128, axis=1)
+
+    def stripe_body(t, out):
+        d0 = t * stripe
+        b_t = jax.lax.dynamic_slice(b_all, (0, d0), (a_all.shape[0], stripe))
+        niso_i_t = jax.lax.dynamic_slice(niso_i, (0, d0), (8, stripe))
+        rb = fused_ports_stripe(
+            a_all, b_t, niso_i_t, niso_e,
+            tm=tm, tk=tk, r_masks=R, plan=plan, ov_rows=layout.ov_rows,
+            default_allow=default_allow_unselected, interpret=interp,
+        )
+        r = rb > 0
         if self_traffic:
-            conj_p = conj_p | (
-                jnp.arange(N)[:, None] == (d0 + jnp.arange(tile))[None, :]
+            r = r | (
+                jnp.arange(N)[:, None] == (d0 + jnp.arange(stripe))[None, :]
             )
-        tw = tile // 32
-        FI_t = jax.lax.dynamic_slice(FI, (0, d0 // 32), (N, tw))
-        FE_t = jax.lax.dynamic_slice(FE, (0, d0 // 32), (N, tw))
-        out_t = (
-            (FI_t & FE_t)
-            | (FI_t & pack_bool_cols(ge_p))
-            | (FE_t & pack_bool_cols(gi_p))
-            | pack_bool_cols(conj_p)
+        return jax.lax.dynamic_update_slice(
+            out, pack_bool_cols(r), (0, d0 // 32)
         )
-        return jax.lax.dynamic_update_slice(out, out_t, (0, d0 // 32))
 
-    out = jnp.zeros((N, W), dtype=_U32)
-    out = jax.lax.fori_loop(0, n_tiles, tile_body, out)
+    out = jax.lax.fori_loop(
+        0, N // stripe, stripe_body, jnp.zeros((N, W), dtype=_U32)
+    )
     out &= col_mask[None, :]
     return out, ing_iso, eg_iso, selected8 > 0
 
@@ -1408,11 +1381,12 @@ def tiled_k8s_reach(
     )
     if use_pallas is None:
         # auto: fused Pallas for ANY-PORT on TPU (measured faster). The
-        # port path keeps the XLA mask-group kernel: the hybrid
-        # (_tiled_ports_pallas_step) was measured ~25% SLOWER at the
-        # flagship config — the port sweep is combine-bound, so fusing the
-        # full-mask dots doesn't pay (see ops/pallas_kernels.py docstring);
-        # it stays available via use_pallas=True.
+        # port path keeps the XLA mask-group kernel: both Pallas port
+        # formulations lost head-to-head at the flagship config — round
+        # 4's full-block hybrid by ~25%, round 5's fully-fused segment
+        # sweep by ~50% (see ops/pallas_kernels.py for the measured
+        # decomposition); the fused kernel stays available via
+        # use_pallas=True.
         use_pallas = (
             not with_ports and platform == "tpu" and tile % 4096 == 0
         )
@@ -1434,28 +1408,20 @@ def tiled_k8s_reach(
             128, (_PORT_SLAB_BUDGET // max(R * max(n, 1), 1)) // 128 * 128
         )
         tile = min(tile, cap)
-        if use_pallas:
-            # the hybrid pads N to a Pallas-block multiple; keep the XLA
-            # dst tile a power of two so it divides that padding
-            tile = 1 << (max(tile, 128).bit_length() - 1)
     tile = max(32, min(tile, 1 << 20))
     if tile % 32:
         raise ValueError("tile must be a multiple of 32")
     if use_pallas and not with_ports and tile % 4096:
         raise ValueError("use_pallas requires tile % 4096 == 0 (pallas block)")
-    # the fused Pallas kernels need N divisible by their dst block; on TPU
-    # that block is 4096 (the packed word axis must tile to 128 lanes);
+    # the Pallas kernels need N divisible by their dst block: 4096 for the
+    # any-port kernel (the packed word axis must tile to 128 lanes), 2048
+    # (the fused stripe, a tm=128 multiple) for the fused port kernel;
     # interpret mode (tests) takes any 32-multiple block
-    ptn = 4096
     pad_to = tile
-    if with_ports and use_pallas and platform == "tpu":
-        pad_to = max(tile, ptn)  # tile is a power of two, so tile | pad_to
+    if with_ports and use_pallas:
+        pad_to = 2048 if platform == "tpu" else 32
     n_pad = (pad_to - n % pad_to) % pad_to
     Np = n + n_pad
-    if with_ports and use_pallas and platform != "tpu":
-        ptn = Np if Np <= 4096 else 4096
-        if Np % ptn:
-            use_pallas = False  # awkward interpret-mode shape: fall back
 
     pod_kv = np.pad(enc.pod_kv, ((0, n_pad), (0, 0)))
     pod_key = np.pad(enc.pod_key, ((0, n_pad), (0, 0)))
@@ -1513,23 +1479,16 @@ def tiled_k8s_reach(
             bank8[:, :n] = enc.restrict_bank
         else:
             bank8 = np.ones((1, Np), dtype=np.int8)
-        # the hybrid requires restriction-free full blocks (true except in
-        # a degenerate one-atom universe, where a named single-atom
-        # variant IS the full mask)
-        full_res_clean = True
-        for vr, (fs, fl) in (
-            (vp_res_i, layout.full_i), (vp_res_e, layout.full_e),
-        ):
-            if fl and np.asarray(vr[fs : fs + fl]).any():
-                full_res_clean = False
-        hybrid = use_pallas and full_res_clean
-        # the three resident int8 operands — two [total_vp, N] peer maps plus
-        # the gathered egress selection — are the port path's memory floor;
-        # the hybrid Pallas step bakes a fourth ([total_i, N] ingress
-        # selection), counted only when it will actually run. Catch an
-        # over-wide VP layout here rather than as a device OOM.
+        # the resident int8 operands — the [total_vp, N] peer maps plus the
+        # gathered selections — are the port path's memory floor. The
+        # fused kernel's transient PEAK is ~4·(total_i+total_e)·N: both
+        # directions' src AND dst operands are live while their K-ordered
+        # copies are built. Catch an over-wide VP layout here rather than
+        # as a device OOM.
         resident = (
-            (2 if hybrid else 1) * len(vp_pol_i) + 2 * len(vp_pol_e)
+            4 * (len(vp_pol_i) + len(vp_pol_e))
+            if use_pallas
+            else len(vp_pol_i) + 2 * len(vp_pol_e)
         ) * Np
         if resident > _PORT_RESIDENT_BUDGET:
             raise ValueError(
@@ -1546,17 +1505,20 @@ def tiled_k8s_reach(
         )
         if device is not None:
             args = jax.device_put(args, device)
-        kernel = "pallas-hybrid" if hybrid else "xla-ports"
-        if hybrid:
-            packed, ing_iso, eg_iso, selected = _tiled_ports_pallas_step(
+        kernel = "pallas-fused" if use_pallas else "xla-ports"
+        if use_pallas:
+            on_tpu = platform == "tpu"
+            packed, ing_iso, eg_iso, selected = _tiled_ports_fused_step(
                 *args,
                 layout=layout,
-                tile=tile,
+                stripe=2048 if on_tpu else Np,
                 chunk=chunk,
-                ptn=ptn,
+                tm=128 if on_tpu else 32,
+                tk=256 if on_tpu else 8,
                 self_traffic=self_traffic,
                 default_allow_unselected=default_allow_unselected,
                 direction_aware_isolation=direction_aware_isolation,
+                interp=not on_tpu,
             )
         else:
             packed, ing_iso, eg_iso, selected = _tiled_ports_step(

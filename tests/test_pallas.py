@@ -13,6 +13,7 @@ from kubernetes_verification_tpu.ops.pallas_kernels import packed_dir_allow
 from kubernetes_verification_tpu.ops.tiled import tiled_k8s_reach, unpack_cols
 
 
+@pytest.mark.slow
 def test_packed_dir_allow_kernel():
     rng = np.random.default_rng(0)
     P, N = 64, 256
@@ -35,6 +36,7 @@ def test_packed_dir_allow_kernel():
         )
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("seed", [1, 2])
 def test_tiled_pallas_matches_cpu(seed):
     cluster = random_cluster(
@@ -50,6 +52,7 @@ def test_tiled_pallas_matches_cpu(seed):
     "flags",
     [dict(self_traffic=False), dict(default_allow_unselected=False)],
 )
+@pytest.mark.slow
 def test_tiled_pallas_flags(flags):
     cluster = random_cluster(
         GeneratorConfig(n_pods=150, n_policies=9, n_namespaces=2, seed=5)
@@ -62,9 +65,10 @@ def test_tiled_pallas_flags(flags):
     np.testing.assert_array_equal(got.to_bool(), ref.reach)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("seed", [7, 21])
-def test_ports_hybrid_pallas_matches_oracle(seed):
-    """The hybrid port kernel (Pallas full-mask blocks + XLA ported
+def test_ports_fused_pallas_matches_oracle(seed):
+    """The fused port kernel (every segment dot + the mask-group combine in
     segments, packed-domain assembly) equals the CPU oracle and the pure
     XLA port kernel bit-for-bit — incl. named ports and restrictions."""
     cluster = random_cluster(
@@ -76,11 +80,11 @@ def test_ports_hybrid_pallas_matches_oracle(seed):
     enc = encode_cluster(cluster, compute_ports=True)
     if len(enc.atoms) <= 1:
         pytest.skip("generator produced a portless cluster")
-    hybrid = tiled_k8s_reach(enc, tile=32, chunk=8, use_pallas=True)
+    fused = tiled_k8s_reach(enc, tile=32, chunk=8, use_pallas=True)
     xla = tiled_k8s_reach(enc, tile=32, chunk=8, use_pallas=False)
-    np.testing.assert_array_equal(hybrid.to_bool(), xla.to_bool())
+    np.testing.assert_array_equal(fused.to_bool(), xla.to_bool())
     ref = kv.verify(cluster, kv.VerifyConfig(backend="cpu"))
-    np.testing.assert_array_equal(hybrid.to_bool(), ref.reach)
+    np.testing.assert_array_equal(fused.to_bool(), ref.reach)
 
 
 @pytest.mark.parametrize(
@@ -91,7 +95,8 @@ def test_ports_hybrid_pallas_matches_oracle(seed):
         dict(direction_aware_isolation=False),
     ],
 )
-def test_ports_hybrid_flags(flags):
+@pytest.mark.slow
+def test_ports_fused_flags(flags):
     cluster = random_cluster(
         GeneratorConfig(
             n_pods=45, n_policies=7, n_namespaces=2, p_ports=0.9,
@@ -101,6 +106,6 @@ def test_ports_hybrid_flags(flags):
     enc = encode_cluster(cluster, compute_ports=True)
     if len(enc.atoms) <= 1:
         pytest.skip("generator produced a portless cluster")
-    hybrid = tiled_k8s_reach(enc, tile=32, chunk=8, use_pallas=True, **flags)
+    fused = tiled_k8s_reach(enc, tile=32, chunk=8, use_pallas=True, **flags)
     ref = kv.verify(cluster, kv.VerifyConfig(backend="cpu", **flags))
-    np.testing.assert_array_equal(hybrid.to_bool(), ref.reach)
+    np.testing.assert_array_equal(fused.to_bool(), ref.reach)
