@@ -22,15 +22,19 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 def pytest_configure(config):
     markexpr = config.getoption("-m") or ""
     if markexpr:
-        # leave the platform untouched iff the -m expression SELECTS
-        # tpu-marked items (evaluated properly, so "not (tpu)" and friends
-        # still pin); fall back to pinning on any parse failure
+        # leave the platform untouched iff the -m expression REQUIRES the
+        # tpu marker — a tpu-only item matches AND an unmarked item does
+        # not. (Just asking "would a tpu item match?" wrongly classified
+        # `-m "not slow"` as a hardware run: a tpu item matches that too,
+        # and the whole unmarked suite then hit the 1-chip axon backend.)
+        # Fall back to pinning on any parse failure.
         try:
             from _pytest.mark.expression import Expression
 
-            if Expression.compile(markexpr).evaluate(
-                lambda name: name == "tpu"
-            ):
+            expr = Expression.compile(markexpr)
+            tpu_selected = expr.evaluate(lambda name: name == "tpu")
+            unmarked_selected = expr.evaluate(lambda name: False)
+            if tpu_selected and not unmarked_selected:
                 return
         except Exception:
             pass

@@ -133,6 +133,7 @@ def _cli_diff_round_trip(tmp_path, capsys, engine_flags, tag):
     assert rep2["after"]["reachable_pairs"] == _fresh_pairs(ck)
 
 
+@pytest.mark.slow
 def test_cli_diff_round_trip_ports(tmp_path, capsys):
     """generate → snapshot → diff → verify-fresh equality (ports engine)."""
     _cli_diff_round_trip(tmp_path, capsys, [], "ports")
@@ -259,6 +260,7 @@ def test_cli_diff_namespace_labels_respected(tmp_path, capsys):
     assert all(ns.name != "team-a" for ns in inc3.namespaces)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("ports", [False, True])
 def test_cli_closure_maintained_across_diffs(tmp_path, capsys, ports):
     """Round 5: `kv-tpu snapshot --closure` persists the packed closure and
@@ -320,6 +322,7 @@ def test_cli_diff_unchanged_manifests_are_noops(tmp_path, capsys):
     assert rep["after"]["update_count"] == rep["before"]["update_count"]
 
 
+@pytest.mark.slow
 def test_cli_snapshot_diff_with_mesh_opt(tmp_path, capsys):
     """The serving loop runs mesh-sharded end to end: snapshot builds the
     engine on a mesh, diff resumes onto a (different) mesh factorisation."""

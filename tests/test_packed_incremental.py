@@ -137,6 +137,7 @@ def test_flag_variants(self_traffic, default_allow, direction_aware):
     np.testing.assert_array_equal(inc.reach, _full(inc.as_cluster(), cfg))
 
 
+@pytest.mark.slow
 def test_capacity_growth():
     cluster = random_cluster(
         GeneratorConfig(n_pods=23, n_policies=3, n_namespaces=2, seed=31)
@@ -167,6 +168,7 @@ def test_empty_policy_cluster():
     np.testing.assert_array_equal(inc.reach, _full(inc.as_cluster(), cfg))
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("shape", [(8, 1), (4, 2), (2, 4)])
 def test_mesh_sharded_state_diffs(shape):
     """Config-5 composition: the same verifier with its state sharded over a
@@ -424,6 +426,7 @@ def test_pod_slot_reuse_and_policy_interaction(setup):
     assert inc.packed_reach().ingress_isolated[5]
 
 
+@pytest.mark.slow
 def test_pod_headroom_growth():
     """Exhausting the pod headroom grows the pod axis in place."""
     cluster = random_cluster(
@@ -440,6 +443,7 @@ def test_pod_headroom_growth():
     np.testing.assert_array_equal(inc.reach_active(), _oracle_active(inc, cfg))
 
 
+@pytest.mark.slow
 def test_pod_headroom_param():
     cluster = random_cluster(
         GeneratorConfig(n_pods=100, n_policies=4, n_namespaces=2, seed=56)
@@ -453,6 +457,7 @@ def test_pod_headroom_param():
     np.testing.assert_array_equal(inc.reach_active(), _oracle_active(inc, cfg))
 
 
+@pytest.mark.slow
 def test_fuzzed_pod_and_policy_churn():
     """Interleaved pod add/remove/relabel + policy add/remove/update must
     track the CPU oracle at every step."""
@@ -502,6 +507,7 @@ def test_fuzzed_pod_and_policy_churn():
         )
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("shape", [(4, 2), (2, 4)])
 def test_mesh_sharded_pod_churn(shape):
     from kubernetes_verification_tpu.parallel.mesh import mesh_for
@@ -627,6 +633,7 @@ def test_namespace_remove(setup):
     np.testing.assert_array_equal(inc.reach_active(), _oracle_active(inc, cfg))
 
 
+@pytest.mark.slow
 def test_mesh_sharded_namespace_relabel():
     from kubernetes_verification_tpu.parallel.mesh import mesh_for
 
@@ -659,6 +666,7 @@ def test_matrix_free_namespace_relabel():
     np.testing.assert_array_equal(full[np.ix_(act, act)], ref)
 
 
+@pytest.mark.slow
 def test_checkpoint_resume_with_pod_churn(tmp_path):
     from kubernetes_verification_tpu.utils.persist import (
         load_packed_incremental,

@@ -6,6 +6,8 @@ import dataclasses
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 import kubernetes_verification_tpu as kv
 from kubernetes_verification_tpu.harness.generate import (
     GeneratorConfig,

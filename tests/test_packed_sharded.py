@@ -204,6 +204,7 @@ def test_ports_match_tiled_packed():
     np.testing.assert_array_equal(got.to_bool(), tiled.to_bool())
 
 
+@pytest.mark.slow
 def test_ports_stripes_and_groups():
     """Striped port sweeps compose, and the per-group in-degree aggregates
     (matrix-free user_crosscheck) stay exact under the port kernel."""
@@ -245,6 +246,7 @@ def test_ports_stripes_and_groups():
         )
 
 
+@pytest.mark.slow
 def test_registered_backend_routes_through_verify():
     """The config-5 engine must be reachable through the plugin boundary:
     kv.verify(backend='sharded-packed') — with and without ports, dense
@@ -370,6 +372,7 @@ def test_partial_stripe_refuses_whole_matrix_queries():
             q()
 
 
+@pytest.mark.slow
 def test_pairwise_policy_queries_through_backend():
     """All SIX verification queries answer through ``sharded-packed``:
     policy_shadow/policy_conflict route through the sharded Gram masks
@@ -399,6 +402,7 @@ def test_pairwise_policy_queries_through_backend():
     )
 
 
+@pytest.mark.slow
 def test_pairwise_masks_respect_direction_aware_flag():
     cluster = random_cluster(
         GeneratorConfig(n_pods=60, n_policies=12, n_namespaces=2, seed=19)

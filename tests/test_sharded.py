@@ -116,6 +116,7 @@ def test_standalone_sharded_closure():
     np.testing.assert_array_equal(got, ref)
 
 
+@pytest.mark.slow
 def test_distributed_mesh_single_process_noop():
     """The multi-host entry point degrades to the local mesh in a
     single-process job (no coordinator env → no initialize attempt) and the

@@ -54,6 +54,7 @@ def test_matches_cpu_oracle(seed):
         dict(direction_aware_isolation=False),
     ],
 )
+@pytest.mark.slow
 def test_semantic_flags(flags):
     cluster = random_cluster(
         GeneratorConfig(n_pods=45, n_policies=9, n_namespaces=2, seed=7)
@@ -164,6 +165,7 @@ def test_policy_pair_masks_match_oracle(seed, dai):
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("seed", [1, 2, 5])
 def test_ports_matches_cpu_oracle(seed):
     """The flagship port-aware kernel vs the CPU oracle: reach under full
@@ -191,6 +193,7 @@ def test_ports_matches_cpu_oracle(seed):
         dict(direction_aware_isolation=False),
     ],
 )
+@pytest.mark.slow
 def test_ports_semantic_flags(flags):
     cluster = random_cluster(
         GeneratorConfig(
@@ -401,6 +404,7 @@ def test_packed_closure_delta_random_property():
         np.testing.assert_array_equal(np.asarray(got3), np.asarray(want3))
 
 
+@pytest.mark.slow
 def test_closure_after_diff_fuzzed_both_engines():
     """closure_packed across fuzzed policy + pod churn equals a full
     re-closure bit-for-bit on both incremental engines."""

@@ -76,6 +76,7 @@ class TestK8sParity:
             verify(kano_paper_example_as_cluster(), TPU),
         )
 
+    @pytest.mark.slow
     def test_kubesv_paper_example_all_flag_combos(self):
         cluster = kubesv_paper_example()
         for self_traffic in (True, False):
@@ -204,6 +205,7 @@ class TestK8sParity:
         )
         _assert_same(verify(cluster, CPU), verify(cluster, TPU))
 
+    @pytest.mark.slow
     def test_queries_match(self):
         cluster = random_cluster(
             GeneratorConfig(n_pods=40, n_policies=20, n_namespaces=3, seed=7)
