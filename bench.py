@@ -370,10 +370,25 @@ def bench_closure(args) -> None:
 
     if len(pols) < 3:
         sys.exit("--mode closure needs at least 3 policies")
-    target = pols[3 % len(pols)]
-    donor_ks = sorted(
-        {0, n // 97, n // 7, n // 3, n - 1}
-        | {(37 * j + 11) % n for j in range(11)}
+    # the target must actually SELECT pods (a vacuous selector makes every
+    # donor grant a no-op), and donors must be egress-open srcs (their
+    # eg_ok side is already true via default-allow, so a fresh ingress
+    # grant is sufficient to add reach)
+    target = next(
+        (
+            p for p in pols
+            if int(inc._vectorizer.vectors(p)[0].sum()) > 0
+        ),
+        pols[3 % len(pols)],
+    )
+    open_srcs = [
+        int(k)
+        for k in np.nonzero(np.asarray(inc._h_eg_cnt) == 0)[0][:64]
+    ]
+    donor_ks = list(
+        dict.fromkeys(
+            (open_srcs or [0]) + sorted({0, n // 97, n // 7, n // 3, n - 1})
+        )
     )
     for k in donor_ks:
         narrow = Rule(
