@@ -83,8 +83,8 @@ def bench_tiled(args) -> None:
         f"port atoms {len(enc.atoms)}"
     )
     # --pallas / --no-pallas force the kernel choice; otherwise
-    # tiled_k8s_reach auto-selects on TPU (fused any-port kernel; the
-    # hybrid Pallas-full-block + XLA-ported-segment kernel for ports)
+    # tiled_k8s_reach auto-selects on TPU (fused Pallas kernel for
+    # any-port; the XLA mask-group kernel for ports)
     force = True if args.pallas else (False if args.no_pallas else None)
     run = lambda: tiled_k8s_reach(
         enc, device=dev, fetch=False, use_pallas=force
@@ -633,7 +633,7 @@ def bench_stripe(args) -> None:
 def bench_headtohead(args) -> None:
     """Interleaved kernel A/B at the north-star config — the discipline the
     ±30% tunnel noise demands (same process, alternating variants, bands
-    not scalars). Variants: the auto-selected kernel vs the hybrid Pallas
+    not scalars). Variants: the auto-selected kernel vs the fused Pallas
     port kernel (``use_pallas=True``) — the comparison that justified
     keeping XLA as the default port path (``ops/pallas_kernels.py``)."""
     import jax
@@ -749,8 +749,8 @@ def main() -> None:
     ap.add_argument(
         "--pallas",
         action="store_true",
-        help="tiled mode: force the fused Pallas kernels (any-port) / the "
-        "hybrid port kernel (ports)",
+        help="tiled mode: force the fused Pallas kernels (any-port / the "
+        "fused port kernel)",
     )
     ap.add_argument(
         "--no-pallas",

@@ -323,6 +323,58 @@ class IncrementalVerifier:
         self._reach_dirty = True
         self.update_count += 1
 
+    # ----------------------------------------------------------- namespaces
+    # registration bookkeeping (live _ns_labels dict + namespaces list +
+    # vectorizer ns row) is identical across engines — share the packed
+    # engine's implementations rather than keeping three copies in sync
+    def _shared_ns(name):
+        from .packed_incremental import PackedIncrementalVerifier
+
+        return getattr(PackedIncrementalVerifier, name)
+
+    add_namespace = _shared_ns("add_namespace")
+    _set_ns_labels = _shared_ns("_set_ns_labels")
+    del _shared_ns
+
+    def update_namespace_labels(
+        self, name: str, labels: Dict[str, str]
+    ) -> None:
+        """Relabel namespace ``name``: namespaceSelector peer matches can
+        move for EVERY policy, so this small-N oracle engine simply
+        re-derives each policy's vectors and swaps the changed ones —
+        clarity over cleverness (the packed engines own the batched form)."""
+        if name not in self._ns_labels:
+            raise KeyError(f"namespace {name} is not registered")
+        if dict(self._ns_labels[name]) == dict(labels):
+            return
+        self._set_ns_labels(name, labels)
+        for key, pol in self.policies.items():
+            old = self._vectors[key]
+            new = self._policy_vectors(pol)
+            if any((a != b).any() for a, b in zip(old, new)):
+                self._apply(old, -1)
+                self._apply(new, +1)
+                self._vectors[key] = new
+
+    def remove_namespace(self, name: str) -> None:
+        """Same contract as the packed engines' (this engine has no pod
+        churn, so only resident policies can block the removal)."""
+        if name not in self._ns_labels:
+            raise KeyError(f"namespace {name} is not registered")
+        pols = [k for k in self.policies if k.split("/", 1)[0] == name]
+        if pols:
+            raise ValueError(
+                f"namespace {name} still holds {len(pols)} polic(ies); "
+                "remove them before removing the namespace"
+            )
+        if any(p.namespace == name for p in self.pods):
+            raise ValueError(
+                f"namespace {name} still holds pods; this engine cannot "
+                "remove them — rebuild without the namespace"
+            )
+        del self._ns_labels[name]
+        self.namespaces = [ns for ns in self.namespaces if ns.name != name]
+
     # --------------------------------------------------------------- result
     @property
     def reach(self) -> np.ndarray:

@@ -109,6 +109,36 @@ def test_mutation_storm_stays_consistent(setup):
     assert inc.update_count >= 11
 
 
+def test_namespace_relabel_and_remove(setup):
+    """Dense-engine parity with the packed engines' round-5 namespace ops:
+    relabel re-derives affected policy vectors; removal refuses while the
+    namespace holds policies."""
+    cluster, cfg, inc = setup
+    ns = cluster.namespaces[0]
+    for new in (dict(cluster.namespaces[1].labels), {"fresh": "x"}, {}):
+        inc.update_namespace_labels(ns.name, new)
+        np.testing.assert_array_equal(
+            inc.reach, _full(inc.as_cluster(), cfg), err_msg=str(new)
+        )
+    assert inc.add_namespace(kv.Namespace(ns.name, {"via": "add"})) is False
+    np.testing.assert_array_equal(inc.reach, _full(inc.as_cluster(), cfg))
+    with pytest.raises(KeyError):
+        inc.update_namespace_labels("no-such", {})
+    # add_namespace's NEW-namespace path, then removal of the empty ns
+    assert inc.add_namespace(kv.Namespace("fresh-ns", {"a": "b"})) is True
+    inc.remove_namespace("fresh-ns")
+    assert all(n2.name != "fresh-ns" for n2 in inc.namespaces)
+    # a namespace with pods refuses removal even once its policies are gone
+    for key in [
+        k for k in list(inc.policies) if k.split("/", 1)[0] == ns.name
+    ]:
+        inc.remove_policy(*key.split("/", 1))
+    assert any(p.namespace == ns.name for p in inc.pods)
+    with pytest.raises(ValueError, match="pods"):
+        inc.remove_namespace(ns.name)
+    np.testing.assert_array_equal(inc.reach, _full(inc.as_cluster(), cfg))
+
+
 @pytest.mark.parametrize(
     "flags",
     [
