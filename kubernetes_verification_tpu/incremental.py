@@ -125,7 +125,12 @@ class IncrementalVerifier:
             pods=self.pods, namespaces=self.namespaces,
             policies=list(cluster.policies),
         )
-        self._ns_labels = {ns.name: ns.labels for ns in self.namespaces}
+        # label dicts are COPIED: an aliased caller dict mutated in place
+        # would satisfy the relabel no-op guard and silently skip the
+        # re-derivation (pods are deep-copied for the same reason)
+        self._ns_labels = {
+            ns.name: dict(ns.labels) for ns in self.namespaces
+        }
 
         def seed_vectorizer(vocab) -> None:
             self._vectorizer = PolicyVectorizer(

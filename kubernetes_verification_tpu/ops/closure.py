@@ -324,7 +324,7 @@ def packed_closure_delta(
     dirty,
     *,
     prev_base=None,
-    tile: int = 512,
+    tile: int = 7168,
     max_iter: int = 64,
     row_group: int = 2048,
 ):
@@ -365,10 +365,16 @@ def packed_closure_delta(
     dirty = np.asarray(dirty, dtype=bool)
     if dirty.shape != (N,):
         raise ValueError(f"dirty mask must be bool [{N}]")
+    # ``t`` is the ROW tile of the dense-suspect fallback's full squaring
+    # (same semantics as packed_closure's ``tile``); the frontier kernels
+    # below take their own dst stripes. ``_closure_rows_step``'s counts
+    # transient is [K, stripe] (tiny), so it gets the full-closure stripe
+    # optimum; ``_add_edges_round``'s upd_body counts is [N, stripe] int32
+    # — 4·N·stripe bytes — so its stripe is bounded to keep the transient
+    # under ~1 GB at flagship N rather than 5.7 GB at the wide stripe.
     t = _fit_tile(N, tile)
-    # the delta kernels use their tile purely as a dst stripe — wide is
-    # strictly better (fewer dispatches, same N²-per-call unpack traffic)
-    dstt = _fit_tile(N, 8192)
+    dstt = _fit_tile(N, 14336)
+    dstt_add = _fit_tile(N, 2048)
 
     pack_mask = lambda m: jnp.asarray(
         np.packbits(m, bitorder="little").view("<u4").copy()
@@ -396,7 +402,9 @@ def packed_closure_delta(
                 idx = np.concatenate(
                     [g, np.repeat(g[-1:], pad)]
                 ).astype(np.int32)
-                C = _add_edges_round(C, added, jnp.asarray(idx), tile=dstt)
+                C = _add_edges_round(
+                    C, added, jnp.asarray(idx), tile=dstt_add
+                )
             new_total = _packed_pair_total(C)
             if new_total == total:
                 break

@@ -906,7 +906,12 @@ class PackedIncrementalVerifier:
             namespaces=self.namespaces,  # __post_init__ appends missing ns
             policies=list(cluster.policies),
         )
-        self._ns_labels = {ns.name: ns.labels for ns in self.namespaces}
+        # label dicts are COPIED: an aliased caller dict mutated in place
+        # would satisfy the relabel no-op guard and silently skip the
+        # re-derivation (pods are deep-copied for the same reason)
+        self._ns_labels = {
+            ns.name: dict(ns.labels) for ns in self.namespaces
+        }
         enc = encode_cluster(snapshot, compute_ports=False)
         n = enc.n_pods
         self.n_pods = n
@@ -1853,7 +1858,12 @@ class PackedIncrementalVerifier:
             self.namespaces = [
                 ns for ns in self.namespaces if ns.name in live_ns
             ]
-        self._ns_labels = {ns.name: ns.labels for ns in self.namespaces}
+        # label dicts are COPIED: an aliased caller dict mutated in place
+        # would satisfy the relabel no-op guard and silently skip the
+        # re-derivation (pods are deep-copied for the same reason)
+        self._ns_labels = {
+            ns.name: dict(ns.labels) for ns in self.namespaces
+        }
         self.n_pods = len(self.pods)
         Np = int(state["n_padded"])
         self._n_padded = Np

@@ -526,7 +526,12 @@ class PackedPortsIncrementalVerifier:
             pods=self.pods, namespaces=self.namespaces,
             policies=list(cluster.policies),
         )
-        self._ns_labels = {ns.name: ns.labels for ns in self.namespaces}
+        # label dicts are COPIED: an aliased caller dict mutated in place
+        # would satisfy the relabel no-op guard and silently skip the
+        # re-derivation (pods are deep-copied for the same reason)
+        self._ns_labels = {
+            ns.name: dict(ns.labels) for ns in self.namespaces
+        }
         enc = encode_cluster(snapshot, compute_ports=True)
         self._atoms = list(enc.atoms)
         self._resolution = enc.resolution
@@ -1671,7 +1676,12 @@ class PackedPortsIncrementalVerifier:
             self.namespaces = [
                 ns for ns in self.namespaces if ns.name in live_ns
             ]
-        self._ns_labels = {ns.name: ns.labels for ns in self.namespaces}
+        # label dicts are COPIED: an aliased caller dict mutated in place
+        # would satisfy the relabel no-op guard and silently skip the
+        # re-derivation (pods are deep-copied for the same reason)
+        self._ns_labels = {
+            ns.name: dict(ns.labels) for ns in self.namespaces
+        }
         n = len(self.pods)
         self.n_pods = n
         Np = int(meta["n_padded"])

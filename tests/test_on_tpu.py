@@ -61,6 +61,20 @@ def test_pallas_kernel_non_interpret(tpu_guard, cluster):
     np.testing.assert_array_equal(pr.to_bool(), ref.reach)
 
 
+def test_fused_port_kernel_non_interpret(tpu_guard, cluster):
+    """The fused port kernel (round 5) compiled by Mosaic on the real chip
+    — interpret mode cannot catch Mosaic layout-inference failures (two of
+    which shaped this kernel; see ops/pallas_kernels.py)."""
+    import kubernetes_verification_tpu as kv
+    from kubernetes_verification_tpu.encode.encoder import encode_cluster
+    from kubernetes_verification_tpu.ops.tiled import tiled_k8s_reach
+
+    enc = encode_cluster(cluster, compute_ports=True)
+    ref = kv.verify(cluster, kv.VerifyConfig(backend="cpu"))
+    pr = tiled_k8s_reach(enc, use_pallas=True)
+    np.testing.assert_array_equal(pr.to_bool(), ref.reach)
+
+
 def test_tiled_port_kernel(tpu_guard, cluster):
     import kubernetes_verification_tpu as kv
     from kubernetes_verification_tpu.encode.encoder import encode_cluster
