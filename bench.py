@@ -30,6 +30,20 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+def _dumps_with_metrics(obj: dict) -> str:
+    """Serialise a benchmark result line, attaching the observability
+    registry dump under ``metrics`` (span timings, kernel/closure counters,
+    recompiles) so BENCH_*.json carries a breakdown alongside the headline
+    ``metric``/``value`` — which stay exactly as before."""
+    try:
+        from kubernetes_verification_tpu.observe import dump_registry
+
+        obj = {**obj, "metrics": dump_registry(include_buckets=False)}
+    except Exception:
+        pass  # a broken registry must never cost a benchmark result line
+    return json.dumps(obj)
+
+
 def _band(times) -> dict:
     """min/median/max + spread over repeated timings — the axon tunnel's
     run-to-run noise is ±30%, so a single scalar cannot distinguish a real
@@ -108,7 +122,7 @@ def bench_tiled(args) -> None:
     )
     ports_tag = "port bitmaps" if compute_ports else "any-port"
     print(
-        json.dumps(
+        _dumps_with_metrics(
             {
                 "metric": (
                     f"all-pairs reachability, {n} pods / {args.policies} "
@@ -276,7 +290,7 @@ def bench_incremental(args) -> None:
     )
     sync_band = _band([t for v in samples.values() for t in v])
     print(
-        json.dumps(
+        _dumps_with_metrics(
             {
                 "metric": (
                     f"incremental diff (policy add/update/remove + pod "
@@ -423,7 +437,7 @@ def bench_closure(args) -> None:
     log(f"closure after a mixed policy diff: {mixed_s:.2f}s "
         f"({full_s / mixed_s:.1f}x faster than full)")
     print(
-        json.dumps(
+        _dumps_with_metrics(
             {
                 "metric": (
                     f"packed closure after an adds-only policy diff, "
@@ -610,7 +624,7 @@ def bench_stripe(args) -> None:
     log(f"matrix-free diff {diff_s * 1e3:.1f}ms; "
         f"stripe re-verify ({tile} dsts) {restripe_s:.2f}s")
     print(
-        json.dumps(
+        _dumps_with_metrics(
             {
                 "metric": (
                     f"config-5 single-chip share: {n_big}-pod packed stripe "
@@ -688,7 +702,7 @@ def bench_headtohead(args) -> None:
     log(f"pallas vs xla: {delta_pct:+.1f}% median "
         f"({'pallas slower' if delta_pct > 0 else 'pallas faster'})")
     print(
-        json.dumps(
+        _dumps_with_metrics(
             {
                 "metric": (
                     f"interleaved kernel A/B (xla vs pallas), {n} pods / "
@@ -884,7 +898,7 @@ def main() -> None:
         f"{value / 1e9:.2f}e9 pairs/s")
 
     print(
-        json.dumps(
+        _dumps_with_metrics(
             {
                 "metric": (
                     f"all-pairs reachability throughput "

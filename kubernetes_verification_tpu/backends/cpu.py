@@ -31,6 +31,8 @@ from ..models.core import (
     Pod,
     Rule,
 )
+from ..observe import Phases
+from ..observe.metrics import BYTES_TRANSFERRED, CLOSURE_ITERATIONS
 from .base import (
     VerifierBackend,
     VerifyConfig,
@@ -80,36 +82,40 @@ class CpuBackend(VerifierBackend):
         config: VerifyConfig,
     ) -> VerifyResult:
         n = len(containers)
-        cluster_keys: Set[str] = set()
-        for c in containers:
-            cluster_keys.update(c.labels)
+        ph = Phases()
+        with ph("encode"):
+            cluster_keys: Set[str] = set()
+            for c in containers:
+                cluster_keys.update(c.labels)
 
-        reach = np.zeros((n, n), dtype=bool)
-        src_sets = np.zeros((len(policies), n), dtype=bool)
-        dst_sets = np.zeros((len(policies), n), dtype=bool)
+            reach = np.zeros((n, n), dtype=bool)
+            src_sets = np.zeros((len(policies), n), dtype=bool)
+            dst_sets = np.zeros((len(policies), n), dtype=bool)
 
-        for c in containers:  # rebuild the per-container policy indices
-            c.select_policies.clear()
-            c.allow_policies.clear()
+            for c in containers:  # rebuild the per-container policy indices
+                c.select_policies.clear()
+                c.allow_policies.clear()
 
-        relation = config.label_relation
-        for pi, pol in enumerate(policies):
-            for i, c in enumerate(containers):
-                src_sets[pi, i] = _kano_match(
-                    c.labels, pol.src_labels, cluster_keys, relation
-                )
-                dst_sets[pi, i] = _kano_match(
-                    c.labels, pol.dst_labels, cluster_keys, relation
-                )
-            # matrix[src] |= dst_set for every selected src
-            # (kano_py/kano/model.py:158-163)
-            reach |= np.outer(src_sets[pi], dst_sets[pi])
-            for i in range(n):
-                if src_sets[pi, i]:
-                    containers[i].select_policies.append(pi)
-                if dst_sets[pi, i]:
-                    containers[i].allow_policies.append(pi)
+        with ph("solve", backend=self.name):
+            relation = config.label_relation
+            for pi, pol in enumerate(policies):
+                for i, c in enumerate(containers):
+                    src_sets[pi, i] = _kano_match(
+                        c.labels, pol.src_labels, cluster_keys, relation
+                    )
+                    dst_sets[pi, i] = _kano_match(
+                        c.labels, pol.dst_labels, cluster_keys, relation
+                    )
+                # matrix[src] |= dst_set for every selected src
+                # (kano_py/kano/model.py:158-163)
+                reach |= np.outer(src_sets[pi], dst_sets[pi])
+                for i in range(n):
+                    if src_sets[pi, i]:
+                        containers[i].select_policies.append(pi)
+                    if dst_sets[pi, i]:
+                        containers[i].allow_policies.append(pi)
 
+        BYTES_TRANSFERRED.labels(backend=self.name).set(0)  # pure host
         return VerifyResult(
             n_pods=n,
             mode="kano",
@@ -119,6 +125,7 @@ class CpuBackend(VerifierBackend):
             src_sets=src_sets,
             dst_sets=dst_sets,
             closure=_transitive_closure(reach) if config.closure else None,
+            timings=ph.timings,
         )
 
     # ------------------------------------------------------------------- k8s
@@ -126,12 +133,14 @@ class CpuBackend(VerifierBackend):
         pods, policies, namespaces = cluster.pods, cluster.policies, cluster.namespaces
         n, P = len(pods), len(policies)
         ns_labels = {ns.name: ns.labels for ns in namespaces}
+        ph = Phases()
 
-        atoms = (
-            compute_port_atoms(policies, pods)
-            if config.compute_ports
-            else [ALL_ATOM]
-        )
+        with ph("encode"):
+            atoms = (
+                compute_port_atoms(policies, pods)
+                if config.compute_ports
+                else [ALL_ATOM]
+            )
         Q = len(atoms)
 
         def rule_dst_ports(rule: Rule) -> np.ndarray:
@@ -160,38 +169,40 @@ class CpuBackend(VerifierBackend):
                             out[d, q] = True
             return out
 
-        selected = np.zeros((P, n), dtype=bool)
-        for pi, pol in enumerate(policies):
-            for i, pod in enumerate(pods):
-                selected[pi, i] = (
-                    pod.namespace == pol.namespace
-                    and pol.pod_selector.matches(pod.labels)
-                )
+        with ph("encode"):
+            selected = np.zeros((P, n), dtype=bool)
+            for pi, pol in enumerate(policies):
+                for i, pod in enumerate(pods):
+                    selected[pi, i] = (
+                        pod.namespace == pol.namespace
+                        and pol.pod_selector.matches(pod.labels)
+                    )
 
         # Direction gating: with direction_aware_isolation=False (reference
         # compat, kubesv never consults policyTypes) every selecting policy
         # isolates AND its rules apply in both directions.
-        affects_in = np.array(
-            [
-                pol.affects_ingress if config.direction_aware_isolation else True
-                for pol in policies
-            ],
-            dtype=bool,
-        )
-        affects_eg = np.array(
-            [
-                pol.affects_egress if config.direction_aware_isolation else True
-                for pol in policies
-            ],
-            dtype=bool,
-        )
-        ing_iso = np.zeros(n, dtype=bool)
-        eg_iso = np.zeros(n, dtype=bool)
-        for pi in range(P):
-            if affects_in[pi]:
-                ing_iso |= selected[pi]
-            if affects_eg[pi]:
-                eg_iso |= selected[pi]
+        with ph("compile"):
+            affects_in = np.array(
+                [
+                    pol.affects_ingress if config.direction_aware_isolation else True
+                    for pol in policies
+                ],
+                dtype=bool,
+            )
+            affects_eg = np.array(
+                [
+                    pol.affects_egress if config.direction_aware_isolation else True
+                    for pol in policies
+                ],
+                dtype=bool,
+            )
+            ing_iso = np.zeros(n, dtype=bool)
+            eg_iso = np.zeros(n, dtype=bool)
+            for pi in range(P):
+                if affects_in[pi]:
+                    ing_iso |= selected[pi]
+                if affects_eg[pi]:
+                    eg_iso |= selected[pi]
 
         def peer_match(peer: Peer, pol: NetworkPolicy) -> np.ndarray:
             """bool[N]: pods this peer matches (see Peer docstring)."""
@@ -222,47 +233,49 @@ class CpuBackend(VerifierBackend):
 
         # Single pass over rules: compute each rule's peer set once and use it
         # both for the allow tensors and the per-policy src/dst edge sets.
-        ingress_allow = np.zeros((n, n, Q), dtype=bool)
-        egress_allow = np.zeros((n, n, Q), dtype=bool)
-        src_sets = np.zeros((P, n), dtype=bool)
-        dst_sets = np.zeros((P, n), dtype=bool)
-        for pi, pol in enumerate(policies):
-            tgt = selected[pi]
-            if affects_in[pi] and pol.ingress:
-                for rule in pol.ingress:
-                    srcs = rule_peer_set(rule, pol)
-                    dmask = rule_dst_ports(rule)  # [N, Q], dst = selected
-                    ingress_allow |= (
-                        srcs[:, None, None] & (tgt[:, None] & dmask)[None, :, :]
-                    )
-                    src_sets[pi] |= srcs
-                dst_sets[pi] |= tgt
-            if affects_eg[pi] and pol.egress:
-                for rule in pol.egress:
-                    dsts = rule_peer_set(rule, pol)
-                    dmask = rule_dst_ports(rule)  # [N, Q], dst = peers
-                    egress_allow |= (
-                        tgt[:, None, None] & (dsts[:, None] & dmask)[None, :, :]
-                    )
-                    dst_sets[pi] |= dsts
-                src_sets[pi] |= tgt
+        with ph("solve", backend=self.name):
+            ingress_allow = np.zeros((n, n, Q), dtype=bool)
+            egress_allow = np.zeros((n, n, Q), dtype=bool)
+            src_sets = np.zeros((P, n), dtype=bool)
+            dst_sets = np.zeros((P, n), dtype=bool)
+            for pi, pol in enumerate(policies):
+                tgt = selected[pi]
+                if affects_in[pi] and pol.ingress:
+                    for rule in pol.ingress:
+                        srcs = rule_peer_set(rule, pol)
+                        dmask = rule_dst_ports(rule)  # [N, Q], dst = selected
+                        ingress_allow |= (
+                            srcs[:, None, None] & (tgt[:, None] & dmask)[None, :, :]
+                        )
+                        src_sets[pi] |= srcs
+                    dst_sets[pi] |= tgt
+                if affects_eg[pi] and pol.egress:
+                    for rule in pol.egress:
+                        dsts = rule_peer_set(rule, pol)
+                        dmask = rule_dst_ports(rule)  # [N, Q], dst = peers
+                        egress_allow |= (
+                            tgt[:, None, None] & (dsts[:, None] & dmask)[None, :, :]
+                        )
+                        dst_sets[pi] |= dsts
+                    src_sets[pi] |= tgt
 
-        # default-allow: pods unselected in a direction allow everything in it
-        # iff the flag is on (real k8s True; reference's default False,
-        # kubesv/kubesv/constraint.py:202-223).
-        if config.default_allow_unselected:
-            ingress_ok = ingress_allow | ~ing_iso[None, :, None]
-            egress_ok = egress_allow | ~eg_iso[:, None, None]
-        else:
-            ingress_ok = ingress_allow
-            egress_ok = egress_allow
+            # default-allow: pods unselected in a direction allow everything in
+            # it iff the flag is on (real k8s True; reference's default False,
+            # kubesv/kubesv/constraint.py:202-223).
+            if config.default_allow_unselected:
+                ingress_ok = ingress_allow | ~ing_iso[None, :, None]
+                egress_ok = egress_allow | ~eg_iso[:, None, None]
+            else:
+                ingress_ok = ingress_allow
+                egress_ok = egress_allow
 
-        reach_pq = ingress_ok & egress_ok
-        if config.self_traffic:
-            di = np.arange(n)
-            reach_pq[di, di, :] = True
-        reach = reach_pq.any(axis=2)
+            reach_pq = ingress_ok & egress_ok
+            if config.self_traffic:
+                di = np.arange(n)
+                reach_pq[di, di, :] = True
+            reach = reach_pq.any(axis=2)
 
+        BYTES_TRANSFERRED.labels(backend=self.name).set(0)  # pure host
         return VerifyResult(
             n_pods=n,
             mode="k8s",
@@ -277,6 +290,7 @@ class CpuBackend(VerifierBackend):
             ingress_isolated=ing_iso,
             egress_isolated=eg_iso,
             closure=_transitive_closure(reach) if config.closure else None,
+            timings=ph.timings,
         )
 
 
@@ -286,6 +300,7 @@ def _transitive_closure(reach: np.ndarray) -> np.ndarray:
     (``kubesv/kubesv/constraint.py:233-237``)."""
     closure = reach.copy()
     while True:
+        CLOSURE_ITERATIONS.inc()
         nxt = closure | ((closure.astype(np.int64) @ closure.astype(np.int64)) > 0)
         if np.array_equal(nxt, closure):
             return closure

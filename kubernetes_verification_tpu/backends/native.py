@@ -18,7 +18,6 @@ compiler exists.
 """
 from __future__ import annotations
 
-import time
 from typing import List, Optional, Sequence
 
 import numpy as np
@@ -32,6 +31,8 @@ from ..encode.encoder import (
 )
 from ..models.core import Cluster, Container, KanoPolicy
 from ..native.binding import BitMatrix, pack, words
+from ..observe import Phases
+from ..observe.metrics import BYTES_TRANSFERRED
 from .base import (
     VerifierBackend,
     VerifyConfig,
@@ -98,30 +99,32 @@ class NativeBackend(VerifierBackend):
         policies: Sequence[KanoPolicy],
         config: VerifyConfig,
     ) -> VerifyResult:
-        t0 = time.perf_counter()
-        enc = encode_kano(containers, policies)
-        kv_bm = BitMatrix.from_bool(enc.pod_kv)
-        t1 = time.perf_counter()
-        src_sets = (
-            BitMatrix.from_bool(enc.src_req).subset_of(kv_bm)
-            & ~enc.src_impossible[:, None]
-        )
-        dst_sets = (
-            BitMatrix.from_bool(enc.dst_req).subset_of(kv_bm)
-            & ~enc.dst_impossible[:, None]
-        )
-        n = len(containers)
-        reach_bm = BitMatrix.zeros(n, n)
-        reach_bm.or_scatter_into(
-            BitMatrix.from_bool(src_sets), BitMatrix.from_bool(dst_sets)
-        )
-        closure = None
-        if config.closure:
-            cbm = BitMatrix(reach_bm.data.copy(), n)
-            cbm.closure_inplace()
-            closure = cbm.to_bool()
-        reach = reach_bm.to_bool()
-        t2 = time.perf_counter()
+        ph = Phases()
+        with ph("encode"):
+            enc = encode_kano(containers, policies)
+        with ph("compile", backend=self.name):
+            kv_bm = BitMatrix.from_bool(enc.pod_kv)
+        with ph("solve", backend=self.name):
+            src_sets = (
+                BitMatrix.from_bool(enc.src_req).subset_of(kv_bm)
+                & ~enc.src_impossible[:, None]
+            )
+            dst_sets = (
+                BitMatrix.from_bool(enc.dst_req).subset_of(kv_bm)
+                & ~enc.dst_impossible[:, None]
+            )
+            n = len(containers)
+            reach_bm = BitMatrix.zeros(n, n)
+            reach_bm.or_scatter_into(
+                BitMatrix.from_bool(src_sets), BitMatrix.from_bool(dst_sets)
+            )
+            closure = None
+            if config.closure:
+                cbm = BitMatrix(reach_bm.data.copy(), n)
+                cbm.closure_inplace()
+                closure = cbm.to_bool()
+            reach = reach_bm.to_bool()
+        BYTES_TRANSFERRED.labels(backend=self.name).set(0)  # host C++ engine
         for i, c in enumerate(containers):
             c.select_policies.clear()
             c.allow_policies.clear()
@@ -136,118 +139,121 @@ class NativeBackend(VerifierBackend):
             src_sets=src_sets,
             dst_sets=dst_sets,
             closure=closure,
-            timings={"encode": t1 - t0, "solve": t2 - t1},
+            timings=ph.timings,
         )
 
     # ------------------------------------------------------------------- k8s
     def verify(self, cluster: Cluster, config: VerifyConfig) -> VerifyResult:
-        t0 = time.perf_counter()
-        enc = encode_cluster(cluster, compute_ports=config.compute_ports)
-        t1 = time.perf_counter()
+        ph = Phases()
+        with ph("encode"):
+            enc = encode_cluster(cluster, compute_ports=config.compute_ports)
         n, P = enc.n_pods, enc.n_policies
         Q = len(enc.atoms)
         W = words(n)
 
-        kv_bm = BitMatrix.from_bool(enc.pod_kv)
-        key_bm = BitMatrix.from_bool(enc.pod_key)
-        ns_kv_bm = BitMatrix.from_bool(enc.ns_kv)
-        ns_key_bm = BitMatrix.from_bool(enc.ns_key)
+        with ph("compile", backend=self.name):
+            kv_bm = BitMatrix.from_bool(enc.pod_kv)
+            key_bm = BitMatrix.from_bool(enc.pod_key)
+            ns_kv_bm = BitMatrix.from_bool(enc.ns_kv)
+            ns_key_bm = BitMatrix.from_bool(enc.ns_key)
 
-        selected = _match_selectors(enc.pol_sel, kv_bm, key_bm)
-        selected &= enc.pol_ns[:, None] == enc.pod_ns[None, :]
-        if config.direction_aware_isolation:
-            sel_ing = selected & enc.pol_affects_ingress[:, None]
-            sel_eg = selected & enc.pol_affects_egress[:, None]
-        else:
-            sel_ing = selected
-            sel_eg = selected
-        ing_iso = sel_ing.any(axis=0)
-        eg_iso = sel_eg.any(axis=0)
+        with ph("solve", backend=self.name):
+            selected = _match_selectors(enc.pol_sel, kv_bm, key_bm)
+            selected &= enc.pol_ns[:, None] == enc.pod_ns[None, :]
+            if config.direction_aware_isolation:
+                sel_ing = selected & enc.pol_affects_ingress[:, None]
+                sel_eg = selected & enc.pol_affects_egress[:, None]
+            else:
+                sel_ing = selected
+                sel_eg = selected
+            ing_iso = sel_ing.any(axis=0)
+            eg_iso = sel_eg.any(axis=0)
 
-        ing_peers = _grant_peers(
-            enc.ingress, kv_bm, key_bm, ns_kv_bm, ns_key_bm, enc.pod_ns, enc.pol_ns
-        )
-        eg_peers = _grant_peers(
-            enc.egress, kv_bm, key_bm, ns_kv_bm, ns_key_bm, enc.pod_ns, enc.pol_ns
-        )
-        ing_targets = sel_ing[enc.ingress.pol]  # [G, N]
-        eg_targets = sel_eg[enc.egress.pol]
-        # named-port resolution: AND each grant's dst-restriction bank row
-        # into its dst-side operand (ingress dst = targets, egress dst =
-        # peers); the unrestricted eg_peers still feed the edge sets below
-        eg_peers_dst = eg_peers
-        if enc.ingress.dst_restrict is not None:
-            ing_targets = ing_targets & enc.restrict_bank[enc.ingress.dst_restrict]
-        if enc.egress.dst_restrict is not None:
-            eg_peers_dst = eg_peers & enc.restrict_bank[enc.egress.dst_restrict]
-
-        ing_peers_p = pack(ing_peers) if ing_peers.size else np.zeros((0, W), np.uint64)
-        ing_targets_p = pack(ing_targets) if ing_targets.size else np.zeros((0, W), np.uint64)
-        eg_peers_p = pack(eg_peers) if eg_peers.size else np.zeros((0, W), np.uint64)
-        eg_peers_dst_p = (
-            pack(eg_peers_dst) if eg_peers_dst.size else np.zeros((0, W), np.uint64)
-        )
-        eg_targets_p = pack(eg_targets) if eg_targets.size else np.zeros((0, W), np.uint64)
-
-        not_ing_iso_row = pack(~ing_iso[None, :])[0]
-        ones_row = pack(np.ones((1, n), dtype=bool))[0]
-        all_pods = np.ones(n, dtype=np.uint8)
-
-        reach_bm = BitMatrix.zeros(n, n)
-        reach_pq = (
-            np.zeros((n, n, Q), dtype=bool) if config.compute_ports else None
-        )
-        for q in range(Q):
-            gi = np.nonzero(enc.ingress.ports[:, q])[0]
-            ge = np.nonzero(enc.egress.ports[:, q])[0]
-            ing_q = BitMatrix.zeros(n, n)  # rows: src over dst
-            ing_q.or_scatter_into(
-                BitMatrix(np.ascontiguousarray(ing_peers_p[gi]), n),
-                BitMatrix(np.ascontiguousarray(ing_targets_p[gi]), n),
+            ing_peers = _grant_peers(
+                enc.ingress, kv_bm, key_bm, ns_kv_bm, ns_key_bm, enc.pod_ns, enc.pol_ns
             )
-            eg_q = BitMatrix.zeros(n, n)
-            eg_q.or_scatter_into(
-                BitMatrix(np.ascontiguousarray(eg_targets_p[ge]), n),
-                BitMatrix(np.ascontiguousarray(eg_peers_dst_p[ge]), n),
+            eg_peers = _grant_peers(
+                enc.egress, kv_bm, key_bm, ns_kv_bm, ns_key_bm, enc.pod_ns, enc.pol_ns
             )
-            if config.default_allow_unselected:
-                # unselected dst accept from anyone; unselected src send anywhere
-                ing_q.row_or_mask(all_pods, not_ing_iso_row)
-                eg_q.row_or_mask((~eg_iso).astype(np.uint8), ones_row)
-            rq = ing_q.and_with(eg_q)
-            if config.self_traffic:
-                rq.set_diagonal()
-            reach_bm.or_into(rq)
-            if reach_pq is not None:
-                reach_pq[:, :, q] = rq.to_bool()
-        reach = reach_bm.to_bool()
+            ing_targets = sel_ing[enc.ingress.pol]  # [G, N]
+            eg_targets = sel_eg[enc.egress.pol]
+            # named-port resolution: AND each grant's dst-restriction bank row
+            # into its dst-side operand (ingress dst = targets, egress dst =
+            # peers); the unrestricted eg_peers still feed the edge sets below
+            eg_peers_dst = eg_peers
+            if enc.ingress.dst_restrict is not None:
+                ing_targets = ing_targets & enc.restrict_bank[enc.ingress.dst_restrict]
+            if enc.egress.dst_restrict is not None:
+                eg_peers_dst = eg_peers & enc.restrict_bank[enc.egress.dst_restrict]
 
-        closure = None
-        if config.closure:
-            cbm = BitMatrix(reach_bm.data.copy(), n)
-            cbm.closure_inplace()
-            closure = cbm.to_bool()
+            ing_peers_p = pack(ing_peers) if ing_peers.size else np.zeros((0, W), np.uint64)
+            ing_targets_p = pack(ing_targets) if ing_targets.size else np.zeros((0, W), np.uint64)
+            eg_peers_p = pack(eg_peers) if eg_peers.size else np.zeros((0, W), np.uint64)
+            eg_peers_dst_p = (
+                pack(eg_peers_dst) if eg_peers_dst.size else np.zeros((0, W), np.uint64)
+            )
+            eg_targets_p = pack(eg_targets) if eg_targets.size else np.zeros((0, W), np.uint64)
 
-        # per-policy src/dst edge sets (kernel formulas, ops/reach.py:186-202)
-        n_seg = P + 1
-        seg_i = enc.ingress.pol.astype(np.int64)
-        seg_e = enc.egress.pol.astype(np.int64)
-        ing_src = _segment_or_packed(ing_peers_p, seg_i, n_seg)[:P]
-        eg_dst = _segment_or_packed(eg_peers_p, seg_e, n_seg)[:P]
-        ing_src = (
-            BitMatrix(ing_src, n).to_bool() if P else np.zeros((0, n), bool)
-        )
-        eg_dst = BitMatrix(eg_dst, n).to_bool() if P else np.zeros((0, n), bool)
-        has_ing = np.zeros(P, dtype=bool)
-        has_eg = np.zeros(P, dtype=bool)
-        np.logical_or.at(has_ing, seg_i[seg_i < P], True)
-        np.logical_or.at(has_eg, seg_e[seg_e < P], True)
-        if config.direction_aware_isolation:
-            ing_src &= enc.pol_affects_ingress[:, None]
-            eg_dst &= enc.pol_affects_egress[:, None]
-        src_sets = ing_src | (sel_eg & has_eg[:, None])
-        dst_sets = eg_dst | (sel_ing & has_ing[:, None])
-        t2 = time.perf_counter()
+            not_ing_iso_row = pack(~ing_iso[None, :])[0]
+            ones_row = pack(np.ones((1, n), dtype=bool))[0]
+            all_pods = np.ones(n, dtype=np.uint8)
+
+            reach_bm = BitMatrix.zeros(n, n)
+            reach_pq = (
+                np.zeros((n, n, Q), dtype=bool) if config.compute_ports else None
+            )
+            for q in range(Q):
+                gi = np.nonzero(enc.ingress.ports[:, q])[0]
+                ge = np.nonzero(enc.egress.ports[:, q])[0]
+                ing_q = BitMatrix.zeros(n, n)  # rows: src over dst
+                ing_q.or_scatter_into(
+                    BitMatrix(np.ascontiguousarray(ing_peers_p[gi]), n),
+                    BitMatrix(np.ascontiguousarray(ing_targets_p[gi]), n),
+                )
+                eg_q = BitMatrix.zeros(n, n)
+                eg_q.or_scatter_into(
+                    BitMatrix(np.ascontiguousarray(eg_targets_p[ge]), n),
+                    BitMatrix(np.ascontiguousarray(eg_peers_dst_p[ge]), n),
+                )
+                if config.default_allow_unselected:
+                    # unselected dst accept from anyone; unselected src send anywhere
+                    ing_q.row_or_mask(all_pods, not_ing_iso_row)
+                    eg_q.row_or_mask((~eg_iso).astype(np.uint8), ones_row)
+                rq = ing_q.and_with(eg_q)
+                if config.self_traffic:
+                    rq.set_diagonal()
+                reach_bm.or_into(rq)
+                if reach_pq is not None:
+                    reach_pq[:, :, q] = rq.to_bool()
+            reach = reach_bm.to_bool()
+
+            closure = None
+            if config.closure:
+                cbm = BitMatrix(reach_bm.data.copy(), n)
+                cbm.closure_inplace()
+                closure = cbm.to_bool()
+
+            # per-policy src/dst edge sets (kernel formulas, ops/reach.py:186-202)
+            n_seg = P + 1
+            seg_i = enc.ingress.pol.astype(np.int64)
+            seg_e = enc.egress.pol.astype(np.int64)
+            ing_src = _segment_or_packed(ing_peers_p, seg_i, n_seg)[:P]
+            eg_dst = _segment_or_packed(eg_peers_p, seg_e, n_seg)[:P]
+            ing_src = (
+                BitMatrix(ing_src, n).to_bool() if P else np.zeros((0, n), bool)
+            )
+            eg_dst = BitMatrix(eg_dst, n).to_bool() if P else np.zeros((0, n), bool)
+            has_ing = np.zeros(P, dtype=bool)
+            has_eg = np.zeros(P, dtype=bool)
+            np.logical_or.at(has_ing, seg_i[seg_i < P], True)
+            np.logical_or.at(has_eg, seg_e[seg_e < P], True)
+            if config.direction_aware_isolation:
+                ing_src &= enc.pol_affects_ingress[:, None]
+                eg_dst &= enc.pol_affects_egress[:, None]
+            src_sets = ing_src | (sel_eg & has_eg[:, None])
+            dst_sets = eg_dst | (sel_ing & has_ing[:, None])
+
+        BYTES_TRANSFERRED.labels(backend=self.name).set(0)  # host C++ engine
 
         return VerifyResult(
             n_pods=n,
@@ -263,7 +269,7 @@ class NativeBackend(VerifierBackend):
             ingress_isolated=ing_iso,
             egress_isolated=eg_iso,
             closure=closure,
-            timings={"encode": t1 - t0, "solve": t2 - t1},
+            timings=ph.timings,
         )
 
 

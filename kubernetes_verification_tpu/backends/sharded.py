@@ -11,7 +11,6 @@ default is all visible devices on the pod axis.
 """
 from __future__ import annotations
 
-import time
 from typing import Optional, Sequence, Tuple
 
 import jax
@@ -19,6 +18,8 @@ import numpy as np
 
 from ..encode.encoder import encode_cluster, encode_kano
 from ..models.core import Cluster, Container, KanoPolicy
+from ..observe import Phases, tree_nbytes
+from ..observe.metrics import BYTES_TRANSFERRED
 from ..parallel.mesh import mesh_for
 from ..parallel.sharded_ops import sharded_k8s_reach, sharded_kano_reach
 from .base import (
@@ -44,19 +45,23 @@ class ShardedBackend(VerifierBackend):
         return mesh_for(config.opt("mesh"))
 
     def verify(self, cluster: Cluster, config: VerifyConfig) -> VerifyResult:
-        mesh = self._resolve_mesh(config)
-        t0 = time.perf_counter()
-        enc = encode_cluster(cluster, compute_ports=config.compute_ports)
-        t1 = time.perf_counter()
-        out, closure = sharded_k8s_reach(
-            mesh,
-            enc,
-            self_traffic=config.self_traffic,
-            default_allow_unselected=config.default_allow_unselected,
-            direction_aware_isolation=config.direction_aware_isolation,
-            with_closure=config.closure,
+        ph = Phases()
+        with ph("compile", backend=self.name):
+            mesh = self._resolve_mesh(config)
+        with ph("encode"):
+            enc = encode_cluster(cluster, compute_ports=config.compute_ports)
+        with ph("solve", backend=self.name):
+            out, closure = sharded_k8s_reach(
+                mesh,
+                enc,
+                self_traffic=config.self_traffic,
+                default_allow_unselected=config.default_allow_unselected,
+                direction_aware_isolation=config.direction_aware_isolation,
+                with_closure=config.closure,
+            )
+        BYTES_TRANSFERRED.labels(backend=self.name).set(
+            tree_nbytes(enc) + tree_nbytes(out) + tree_nbytes(closure)
         )
-        t2 = time.perf_counter()
         return VerifyResult(
             n_pods=cluster.n_pods,
             mode="k8s",
@@ -71,7 +76,7 @@ class ShardedBackend(VerifierBackend):
             ingress_isolated=out.ingress_isolated,
             egress_isolated=out.egress_isolated,
             closure=closure,
-            timings={"encode": t1 - t0, "solve": t2 - t1},
+            timings=ph.timings,
         )
 
     def verify_kano(
@@ -80,12 +85,18 @@ class ShardedBackend(VerifierBackend):
         policies: Sequence[KanoPolicy],
         config: VerifyConfig,
     ) -> VerifyResult:
-        mesh = self._resolve_mesh(config)
-        t0 = time.perf_counter()
-        enc = encode_kano(containers, policies)
-        t1 = time.perf_counter()
-        out, closure = sharded_kano_reach(mesh, enc, with_closure=config.closure)
-        t2 = time.perf_counter()
+        ph = Phases()
+        with ph("compile", backend=self.name):
+            mesh = self._resolve_mesh(config)
+        with ph("encode"):
+            enc = encode_kano(containers, policies)
+        with ph("solve", backend=self.name):
+            out, closure = sharded_kano_reach(
+                mesh, enc, with_closure=config.closure
+            )
+        BYTES_TRANSFERRED.labels(backend=self.name).set(
+            tree_nbytes(enc) + tree_nbytes(out) + tree_nbytes(closure)
+        )
         for i, c in enumerate(containers):
             c.select_policies.clear()
             c.allow_policies.clear()
@@ -100,7 +111,7 @@ class ShardedBackend(VerifierBackend):
             src_sets=out.src_sets,
             dst_sets=out.dst_sets,
             closure=closure,
-            timings={"encode": t1 - t0, "solve": t2 - t1},
+            timings=ph.timings,
         )
 
 

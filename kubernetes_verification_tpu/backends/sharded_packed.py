@@ -28,7 +28,6 @@ factorisation, ``tile``/``chunk`` sweep geometry, ``keep_matrix``,
 """
 from __future__ import annotations
 
-import time
 from dataclasses import dataclass
 from typing import Callable, List, Optional, Sequence, Tuple
 
@@ -37,6 +36,8 @@ import numpy as np
 
 from ..encode.encoder import encode_cluster
 from ..models.core import Cluster, Container, KanoPolicy
+from ..observe import Phases, tree_nbytes
+from ..observe.metrics import BYTES_TRANSFERRED
 from ..parallel.mesh import mesh_for
 from ..parallel.packed_sharded import PackedShardedResult, sharded_packed_reach
 from .base import (
@@ -190,29 +191,33 @@ class ShardedPackedBackend(VerifierBackend):
             # force the matrix BEFORE the solve — the auto heuristic
             # declining it after a full sweep would discard all that work
             keep_matrix = True
-        mesh = self._resolve_mesh(config)
-        t0 = time.perf_counter()
-        enc = encode_cluster(cluster, compute_ports=config.compute_ports)
-        t1 = time.perf_counter()
+        ph = Phases()
+        with ph("compile", backend=self.name):
+            mesh = self._resolve_mesh(config)
+        with ph("encode"):
+            enc = encode_cluster(cluster, compute_ports=config.compute_ports)
         groups = None
         glabel = config.opt("groups_label")
         if glabel is not None:
             from ..ops.queries import user_groups
 
             groups = user_groups(cluster.pods, glabel)
-        pk = sharded_packed_reach(
-            mesh,
-            enc,
-            self_traffic=config.self_traffic,
-            default_allow_unselected=config.default_allow_unselected,
-            direction_aware_isolation=config.direction_aware_isolation,
-            tile=config.opt("tile", 512),
-            chunk=config.opt("chunk", 1024),
-            keep_matrix=keep_matrix,
-            groups=groups,
-            max_port_masks=config.opt("max_port_masks"),
+        with ph("solve", backend=self.name):
+            pk = sharded_packed_reach(
+                mesh,
+                enc,
+                self_traffic=config.self_traffic,
+                default_allow_unselected=config.default_allow_unselected,
+                direction_aware_isolation=config.direction_aware_isolation,
+                tile=config.opt("tile", 512),
+                chunk=config.opt("chunk", 1024),
+                keep_matrix=keep_matrix,
+                groups=groups,
+                max_port_masks=config.opt("max_port_masks"),
+            )
+        BYTES_TRANSFERRED.labels(backend=self.name).set(
+            tree_nbytes(enc) + tree_nbytes(pk.packed)
         )
-        t2 = time.perf_counter()
         dense_limit = config.opt("dense_reach_limit", 20_000)
         dense_ok = pk.packed is not None and cluster.n_pods <= dense_limit
         reach = pk.to_bool() if dense_ok else None
@@ -242,8 +247,7 @@ class ShardedPackedBackend(VerifierBackend):
             timings={
                 # "solve" is the whole engine call (host prep + device
                 # sweep); the inner sweep-only figures keep their own keys
-                "encode": t1 - t0,
-                "solve": t2 - t1,
+                **ph.timings,
                 **{f"sweep_{k}": v for k, v in (pk.timings or {}).items()},
             },
             packed_result=pk,

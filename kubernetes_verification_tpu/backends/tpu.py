@@ -8,7 +8,6 @@ compiled executable.
 """
 from __future__ import annotations
 
-import time
 from functools import partial
 from typing import Sequence
 
@@ -17,6 +16,8 @@ import numpy as np
 
 from ..encode.encoder import encode_cluster, encode_kano
 from ..models.core import Cluster, Container, KanoPolicy
+from ..observe import DispatchTracker, Phases, tree_nbytes
+from ..observe.metrics import BYTES_TRANSFERRED
 from ..ops.closure import transitive_closure
 from ..ops.reach import k8s_reach, kano_reach
 from .base import (
@@ -27,6 +28,9 @@ from .base import (
 )
 
 __all__ = ["TpuBackend"]
+
+#: jit caches are per-function and process-global, so one tracker per module
+_TRACKER = DispatchTracker("tpu")
 
 
 @partial(jax.jit, static_argnames=("with_closure",))
@@ -107,29 +111,42 @@ class TpuBackend(VerifierBackend):
     supports_label_relation = True
 
     def verify(self, cluster: Cluster, config: VerifyConfig) -> VerifyResult:
-        t0 = time.perf_counter()
-        enc = encode_cluster(cluster, compute_ports=config.compute_ports)
-        t1 = time.perf_counter()
-        out, closure = _k8s_step(
-            enc.pod_kv,
-            enc.pod_key,
-            enc.pod_ns,
-            enc.ns_kv,
-            enc.ns_key,
-            enc.pol_sel,
-            enc.pol_ns,
-            enc.pol_affects_ingress,
-            enc.pol_affects_egress,
-            enc.ingress,
-            enc.egress,
-            enc.restrict_bank,
-            self_traffic=config.self_traffic,
-            default_allow_unselected=config.default_allow_unselected,
-            direction_aware_isolation=config.direction_aware_isolation,
-            with_closure=config.closure,
+        ph = Phases()
+        with ph("encode"):
+            enc = encode_cluster(cluster, compute_ports=config.compute_ports)
+        flags = (
+            config.self_traffic,
+            config.default_allow_unselected,
+            config.direction_aware_isolation,
+            config.closure,
         )
-        jax.block_until_ready(out.reach)
-        t2 = time.perf_counter()
+        _TRACKER.track("_k8s_step", enc, static=flags)
+        # "compile" covers the jitted dispatch: trace+compile on a novel
+        # signature, cache-hit dispatch otherwise (execution is async)
+        with ph("compile", backend=self.name):
+            out, closure = _k8s_step(
+                enc.pod_kv,
+                enc.pod_key,
+                enc.pod_ns,
+                enc.ns_kv,
+                enc.ns_key,
+                enc.pol_sel,
+                enc.pol_ns,
+                enc.pol_affects_ingress,
+                enc.pol_affects_egress,
+                enc.ingress,
+                enc.egress,
+                enc.restrict_bank,
+                self_traffic=config.self_traffic,
+                default_allow_unselected=config.default_allow_unselected,
+                direction_aware_isolation=config.direction_aware_isolation,
+                with_closure=config.closure,
+            )
+        with ph("solve", backend=self.name):
+            jax.block_until_ready(out.reach)
+        BYTES_TRANSFERRED.labels(backend=self.name).set(
+            tree_nbytes(enc) + tree_nbytes(out) + tree_nbytes(closure)
+        )
         return VerifyResult(
             n_pods=cluster.n_pods,
             mode="k8s",
@@ -144,7 +161,7 @@ class TpuBackend(VerifierBackend):
             ingress_isolated=np.asarray(out.ingress_isolated),
             egress_isolated=np.asarray(out.egress_isolated),
             closure=np.asarray(closure) if closure is not None else None,
-            timings={"encode": t1 - t0, "solve": t2 - t1},
+            timings=ph.timings,
         )
 
     def verify_kano(
@@ -153,34 +170,45 @@ class TpuBackend(VerifierBackend):
         policies: Sequence[KanoPolicy],
         config: VerifyConfig,
     ) -> VerifyResult:
-        t0 = time.perf_counter()
+        ph = Phases()
         if config.label_relation is not None:
             from ..encode.encoder import encode_kano_relation
 
-            enc_r = encode_kano_relation(
-                containers, policies, config.label_relation
+            with ph("encode"):
+                enc_r = encode_kano_relation(
+                    containers, policies, config.label_relation
+                )
+            _TRACKER.track(
+                "_kano_relation_step", enc_r, static=(config.closure,)
             )
-            t1 = time.perf_counter()
-            out, closure = _kano_relation_step(
-                enc_r.pod_kv,
-                enc_r.pod_key,
-                enc_r.src_sel,
-                enc_r.dst_sel,
-                with_closure=config.closure,
-            )
+            with ph("compile", backend=self.name):
+                out, closure = _kano_relation_step(
+                    enc_r.pod_kv,
+                    enc_r.pod_key,
+                    enc_r.src_sel,
+                    enc_r.dst_sel,
+                    with_closure=config.closure,
+                )
+            enc_bytes = tree_nbytes(enc_r)
         else:
-            enc = encode_kano(containers, policies)
-            t1 = time.perf_counter()
-            out, closure = _kano_step(
-                enc.pod_kv,
-                enc.src_req,
-                enc.src_impossible,
-                enc.dst_req,
-                enc.dst_impossible,
-                with_closure=config.closure,
-            )
-        jax.block_until_ready(out.reach)
-        t2 = time.perf_counter()
+            with ph("encode"):
+                enc = encode_kano(containers, policies)
+            _TRACKER.track("_kano_step", enc, static=(config.closure,))
+            with ph("compile", backend=self.name):
+                out, closure = _kano_step(
+                    enc.pod_kv,
+                    enc.src_req,
+                    enc.src_impossible,
+                    enc.dst_req,
+                    enc.dst_impossible,
+                    with_closure=config.closure,
+                )
+            enc_bytes = tree_nbytes(enc)
+        with ph("solve", backend=self.name):
+            jax.block_until_ready(out.reach)
+        BYTES_TRANSFERRED.labels(backend=self.name).set(
+            enc_bytes + tree_nbytes(out) + tree_nbytes(closure)
+        )
         src_sets = np.asarray(out.src_sets)
         dst_sets = np.asarray(out.dst_sets)
         # maintain the reference's per-container policy index lists
@@ -199,7 +227,7 @@ class TpuBackend(VerifierBackend):
             src_sets=src_sets,
             dst_sets=dst_sets,
             closure=np.asarray(closure) if closure is not None else None,
-            timings={"encode": t1 - t0, "solve": t2 - t1},
+            timings=ph.timings,
         )
 
 

@@ -19,9 +19,47 @@ only, SURVEY.md §1); this exposes the full pipeline:
 from __future__ import annotations
 
 import argparse
+import contextlib
 import json
 import sys
 from typing import Optional
+
+
+def _add_obs_flags(p: argparse.ArgumentParser) -> None:
+    p.add_argument(
+        "--metrics-out", metavar="FILE",
+        help="write the metrics registry dump on exit (.json; .prom/.txt "
+        "for Prometheus text exposition)",
+    )
+    p.add_argument(
+        "--profile", metavar="DIR",
+        help="capture a jax.profiler device trace into DIR "
+        "(view with TensorBoard's profile plugin)",
+    )
+    p.add_argument(
+        "--log-json", action="store_true",
+        help="emit one JSON event line per span/phase on stderr",
+    )
+
+
+@contextlib.contextmanager
+def _observed(args):
+    """Honour the shared observability flags around a command body."""
+    from .observe import configure_logging, profile_to, write_metrics
+
+    if getattr(args, "log_json", False):
+        configure_logging()
+    profile_dir = getattr(args, "profile", None)
+    ctx = profile_to(profile_dir) if profile_dir else contextlib.nullcontext()
+    try:
+        with ctx:
+            yield
+    finally:
+        # written even when the command raises: a failed solve's partial
+        # spans/counters are exactly what a post-mortem wants
+        out = getattr(args, "metrics_out", None)
+        if out:
+            write_metrics(out)
 
 
 def _add_verify_flags(p: argparse.ArgumentParser) -> None:
@@ -77,6 +115,11 @@ def _parse_opt(kv_str: str):
 
 
 def cmd_verify(args) -> int:
+    with _observed(args):
+        return _run_verify(args)
+
+
+def _run_verify(args) -> int:
     import kubernetes_verification_tpu as kv
 
     cfg = kv.VerifyConfig(
@@ -267,6 +310,11 @@ def cmd_snapshot(args) -> int:
 
 
 def cmd_diff(args) -> int:
+    with _observed(args):
+        return _run_diff(args)
+
+
+def _run_diff(args) -> int:
     import time
 
     import kubernetes_verification_tpu as kv
@@ -494,6 +542,33 @@ def cmd_backends(_args) -> int:
     return 0
 
 
+def cmd_metrics(args) -> int:
+    from .observe import dump_registry, to_prometheus
+
+    if args.file:
+        if args.format == "prom":
+            raise SystemExit(
+                "--format prom renders the live registry; saved dumps are "
+                "JSON — point --metrics-out at a .prom path to get "
+                "Prometheus text directly"
+            )
+        with open(args.file) as fh:
+            print(json.dumps(json.load(fh), indent=2, sort_keys=True))
+        return 0
+    # live registry: freshly-started process, so values are zero — this is
+    # the metric-name/label schema reference (all families register at
+    # import time)
+    if args.format == "prom":
+        print(to_prometheus(), end="")
+    else:
+        print(
+            json.dumps(
+                dump_registry(include_buckets=False), indent=2, sort_keys=True
+            )
+        )
+    return 0
+
+
 def main(argv: Optional[list] = None) -> int:
     ap = argparse.ArgumentParser(prog="kv-tpu", description=__doc__)
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -501,6 +576,7 @@ def main(argv: Optional[list] = None) -> int:
     p = sub.add_parser("verify", help="verify manifests under PATH")
     p.add_argument("path")
     _add_verify_flags(p)
+    _add_obs_flags(p)
     p.set_defaults(fn=cmd_verify)
 
     p = sub.add_parser(
@@ -557,6 +633,7 @@ def main(argv: Optional[list] = None) -> int:
     )
     p.add_argument("--json", action="store_true")
     p.add_argument("--opt", action="append", default=[], metavar="KEY=VALUE")
+    _add_obs_flags(p)
     p.set_defaults(fn=cmd_diff)
 
     p = sub.add_parser("explain", help="export encoded model + Datalog program")
@@ -575,6 +652,18 @@ def main(argv: Optional[list] = None) -> int:
 
     p = sub.add_parser("backends", help="list available backends")
     p.set_defaults(fn=cmd_backends)
+
+    p = sub.add_parser(
+        "metrics",
+        help="print the metric schema (live registry) or a saved "
+        "--metrics-out dump",
+    )
+    p.add_argument("file", nargs="?", help="a saved --metrics-out JSON dump")
+    p.add_argument(
+        "--format", choices=("json", "prom"), default="json",
+        help="live-registry output format",
+    )
+    p.set_defaults(fn=cmd_metrics)
 
     args = ap.parse_args(argv)
     return args.fn(args)

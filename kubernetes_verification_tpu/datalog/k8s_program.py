@@ -35,7 +35,6 @@ tensor backends for scale.
 """
 from __future__ import annotations
 
-import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -48,6 +47,8 @@ from ..backends.base import (
 )
 from ..encode.vocab import Vocab
 from ..models.core import Cluster, Container, KanoPolicy, Selector
+from ..observe import Phases
+from ..observe.metrics import BYTES_TRANSFERRED
 from .engine import Atom, Program, Solution, solve
 
 __all__ = ["build_k8s_program", "build_kano_program", "DatalogBackend"]
@@ -416,11 +417,12 @@ class DatalogBackend(VerifierBackend):
     name = "datalog"
 
     def verify(self, cluster: Cluster, config: VerifyConfig) -> VerifyResult:
-        t0 = time.perf_counter()
-        prog, _, atoms = build_k8s_program(cluster, config)
-        t1 = time.perf_counter()
-        sol = solve(prog, use_jax=bool(config.opt("use_jax", False)))
-        t2 = time.perf_counter()
+        ph = Phases()
+        with ph("encode"):
+            prog, _, atoms = build_k8s_program(cluster, config)
+        with ph("solve", backend=self.name):
+            sol = solve(prog, use_jax=bool(config.opt("use_jax", False)))
+        BYTES_TRANSFERRED.labels(backend=self.name).set(0)  # host engine
 
         N, P = cluster.n_pods, len(cluster.policies)
         selected = sol["selected"][:, :P].T  # [P, N]
@@ -453,7 +455,7 @@ class DatalogBackend(VerifierBackend):
             ingress_isolated=sel_ing.any(axis=0),
             egress_isolated=sel_eg.any(axis=0),
             closure=sol["path"] if config.closure else None,
-            timings={"encode": t1 - t0, "solve": t2 - t1},
+            timings=ph.timings,
         )
 
     def verify_kano(
@@ -462,11 +464,12 @@ class DatalogBackend(VerifierBackend):
         policies: Sequence[KanoPolicy],
         config: VerifyConfig,
     ) -> VerifyResult:
-        t0 = time.perf_counter()
-        prog, _ = build_kano_program(containers, policies)
-        t1 = time.perf_counter()
-        sol = solve(prog, use_jax=bool(config.opt("use_jax", False)))
-        t2 = time.perf_counter()
+        ph = Phases()
+        with ph("encode"):
+            prog, _ = build_kano_program(containers, policies)
+        with ph("solve", backend=self.name):
+            sol = solve(prog, use_jax=bool(config.opt("use_jax", False)))
+        BYTES_TRANSFERRED.labels(backend=self.name).set(0)  # host engine
         P = len(policies)
         src_sets = sol["src_set"][:, :P].T
         dst_sets = sol["dst_set"][:, :P].T
@@ -490,7 +493,7 @@ class DatalogBackend(VerifierBackend):
             src_sets=src_sets,
             dst_sets=dst_sets,
             closure=closure,
-            timings={"encode": t1 - t0, "solve": t2 - t1},
+            timings=ph.timings,
         )
 
 

@@ -33,10 +33,15 @@ import numpy as np
 
 from .backends.base import VerifyConfig
 from .models.core import Cluster, NetworkPolicy, Pod
+from .observe import DispatchTracker
+from .observe.metrics import INCREMENTAL_OPS
 
 __all__ = ["IncrementalVerifier"]
 
 _I32 = jnp.int32
+
+#: jit caches are per-function and process-global — one tracker per module
+_TRACKER = DispatchTracker("dense")
 
 
 @partial(jax.jit, donate_argnums=(0,))
@@ -81,6 +86,13 @@ def _derive_reach(
 
 class IncrementalVerifier:
     """Maintains a cluster's reachability under policy/pod-label diffs."""
+
+    #: engine label on kvtpu_incremental_ops_total et al.; methods the
+    #: engines share (namespace bookkeeping below) label per-class via this
+    metrics_engine = "dense"
+
+    def _count_op(self, op: str) -> None:
+        INCREMENTAL_OPS.labels(engine=self.metrics_engine, op=op).inc()
 
     def __init__(
         self,
@@ -235,6 +247,7 @@ class IncrementalVerifier:
 
     def _apply(self, vecs, sign: int) -> None:
         sel_ing, sel_eg, ing_peers, eg_peers = (jnp.asarray(v) for v in vecs)
+        _TRACKER.track("_rank1_add", self._ing_count, ing_peers, sel_ing)
         self._ing_count = _rank1_add(self._ing_count, ing_peers, sel_ing, sign)
         self._eg_count = _rank1_add(self._eg_count, sel_eg, eg_peers, sign)
         self._ing_iso += sign * np.asarray(vecs[0], dtype=np.int64)
@@ -252,12 +265,14 @@ class IncrementalVerifier:
         self.policies[key] = pol
         self._vectors[key] = vecs
         self._apply(vecs, +1)
+        self._count_op("policy_add")
 
     def remove_policy(self, namespace: str, name: str) -> None:
         key = f"{namespace}/{name}"
         pol = self.policies.pop(key)  # KeyError if absent
         vecs = self._vectors.pop(key)
         self._apply(vecs, -1)
+        self._count_op("policy_remove")
 
     def update_policy(self, pol: NetworkPolicy) -> None:
         self.remove_policy(pol.namespace, pol.name)
@@ -313,6 +328,7 @@ class IncrementalVerifier:
             for vec, f in zip(self._vectors[key], flags):
                 vec[idx] = f
         new = row_col_sums()
+        _TRACKER.track("_row_col_patch", self._ing_count)
         self._ing_count = _row_col_patch(
             self._ing_count, idx,
             jnp.asarray(new[0] - old[0], dtype=_I32),
@@ -327,6 +343,7 @@ class IncrementalVerifier:
         self._eg_iso[idx] += new[5] - old[5]
         self._reach_dirty = True
         self.update_count += 1
+        self._count_op("pod_relabel")
 
     # ----------------------------------------------------------- namespaces
     # registration bookkeeping (live _ns_labels dict + namespaces list +
@@ -360,6 +377,7 @@ class IncrementalVerifier:
                 self._apply(old, -1)
                 self._apply(new, +1)
                 self._vectors[key] = new
+        self._count_op("namespace_relabel")
 
     def remove_namespace(self, name: str) -> None:
         """Same contract as the packed engines' (this engine has no pod
@@ -379,6 +397,7 @@ class IncrementalVerifier:
             )
         del self._ns_labels[name]
         self.namespaces = [ns for ns in self.namespaces if ns.name != name]
+        self._count_op("namespace_remove")
 
     # --------------------------------------------------------------- result
     @property
@@ -386,6 +405,14 @@ class IncrementalVerifier:
         """Current reachability matrix (derived from counts on demand)."""
         if self._reach_dirty:
             t0 = time.perf_counter()
+            _TRACKER.track(
+                "_derive_reach",
+                self._ing_count,
+                static=(
+                    self.config.self_traffic,
+                    self.config.default_allow_unselected,
+                ),
+            )
             self._reach = np.asarray(
                 _derive_reach(
                     self._ing_count,

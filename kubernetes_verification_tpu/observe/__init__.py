@@ -1,0 +1,61 @@
+"""Observability for kubernetes-verification-tpu.
+
+One import surface for the whole stack:
+
+* ``REGISTRY`` / ``MetricsRegistry`` — process-global counters, gauges,
+  fixed-bucket histograms (``registry``); the shared families live in
+  ``metrics``.
+* ``trace`` / ``Span`` / ``Phases`` — nested wall-clock spans that feed the
+  registry, emit JSON event lines, and annotate device profiler traces
+  (``spans``).
+* ``log_event`` / ``configure_logging`` — the JSON event stream
+  (``events``).
+* ``DispatchTracker`` — jit-recompile detection by abstract-shape hashing
+  (``jit``).
+* ``dump_registry`` / ``write_metrics`` / ``to_prometheus`` — exporters
+  (``export``).
+
+``utils.observe`` re-exports the seed-era names from here for backward
+compatibility.
+"""
+from __future__ import annotations
+
+from . import metrics
+from .events import configure_logging, log_event, logger
+from .export import dump_registry, to_prometheus, write_metrics
+from .jit import DispatchTracker, abstract_signature, tree_nbytes
+from .registry import (
+    DEFAULT_BUCKETS,
+    METRIC_NAME_RE,
+    REGISTRY,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+)
+from .spans import Phases, Span, current_span, profile_to, trace
+
+__all__ = [
+    "metrics",
+    "configure_logging",
+    "log_event",
+    "logger",
+    "dump_registry",
+    "to_prometheus",
+    "write_metrics",
+    "DispatchTracker",
+    "abstract_signature",
+    "tree_nbytes",
+    "DEFAULT_BUCKETS",
+    "METRIC_NAME_RE",
+    "REGISTRY",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "Phases",
+    "Span",
+    "current_span",
+    "profile_to",
+    "trace",
+]

@@ -1,0 +1,54 @@
+"""Structured JSON event lines on the ``kvtpu`` logger.
+
+One event per line, ``{"event": ..., "ts": ..., **fields}`` — grep-able from
+a pod log, parse-able by anything. The logger stays silent until either the
+host configures logging itself or ``configure_logging()`` attaches the
+stderr handler (idempotently: calling it twice must not double-print, which
+the seed version did).
+"""
+from __future__ import annotations
+
+import json
+import logging
+import sys
+import time
+from typing import Optional
+
+__all__ = ["logger", "configure_logging", "log_event"]
+
+logger = logging.getLogger("kvtpu")
+
+#: marker attribute stamped on handlers we own, so repeat calls (and tests)
+#: can find and skip/remove them
+_HANDLER_MARK = "_kvtpu_handler"
+
+
+def configure_logging(level: int = logging.INFO, stream=None) -> logging.Handler:
+    """Attach a line-per-event stream handler to the ``kvtpu`` logger.
+
+    Idempotent: a handler this function attached earlier is reused (its
+    level/stream updated) instead of stacking a duplicate that would print
+    every event twice. Returns the handler so callers can detach it.
+    """
+    handler: Optional[logging.Handler] = None
+    for h in logger.handlers:
+        if getattr(h, _HANDLER_MARK, False):
+            handler = h
+            break
+    if handler is None:
+        handler = logging.StreamHandler(stream if stream is not None else sys.stderr)
+        setattr(handler, _HANDLER_MARK, True)
+        handler.setFormatter(logging.Formatter("%(message)s"))
+        logger.addHandler(handler)
+    elif stream is not None:
+        handler.setStream(stream)
+    handler.setLevel(level)
+    logger.setLevel(level)
+    return handler
+
+
+def log_event(event: str, **fields) -> None:
+    """Emit one JSON event line (INFO) on the ``kvtpu`` logger."""
+    if not logger.isEnabledFor(logging.INFO):
+        return
+    logger.info(json.dumps({"event": event, "ts": time.time(), **fields}))

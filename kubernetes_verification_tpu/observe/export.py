@@ -1,0 +1,89 @@
+"""Exporters: JSON registry dumps and Prometheus text exposition."""
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from .registry import REGISTRY, MetricsRegistry, _format_le
+
+__all__ = ["dump_registry", "write_metrics", "to_prometheus"]
+
+
+def dump_registry(
+    registry: Optional[MetricsRegistry] = None, include_buckets: bool = True
+) -> dict:
+    """JSON-ready snapshot of a registry (the process-global one by
+    default)."""
+    reg = REGISTRY if registry is None else registry
+    return reg.dump(include_buckets=include_buckets)
+
+
+def write_metrics(
+    path: str, registry: Optional[MetricsRegistry] = None
+) -> None:
+    """Write the registry to ``path`` — Prometheus text when the suffix is
+    ``.prom``/``.txt``, a JSON dump otherwise."""
+    reg = REGISTRY if registry is None else registry
+    if path.endswith((".prom", ".txt")):
+        body = to_prometheus(reg)
+    else:
+        body = json.dumps(reg.dump(), indent=2, sort_keys=True) + "\n"
+    with open(path, "w") as fh:
+        fh.write(body)
+
+
+def _escape(value: str) -> str:
+    return (
+        value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
+
+
+def _labels_text(child_key: str, extra: str = "") -> str:
+    """``backend=cpu,mode=k8s`` (registry child key) -> ``{backend="cpu",mode="k8s"}``."""
+    parts = []
+    if child_key:
+        for pair in child_key.split(","):
+            k, _, v = pair.partition("=")
+            parts.append(f'{k}="{_escape(v)}"')
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def _num(v: float) -> str:
+    if v == float("inf"):
+        return "+Inf"
+    if float(v).is_integer():
+        return str(int(v))
+    return repr(float(v))
+
+
+def to_prometheus(registry: Optional[MetricsRegistry] = None) -> str:
+    """Render the registry in the Prometheus text exposition format
+    (version 0.0.4): HELP/TYPE headers, one sample per line, histograms as
+    cumulative ``_bucket{le=...}`` plus ``_sum``/``_count``."""
+    reg = REGISTRY if registry is None else registry
+    lines = []
+    for m in reg.collect():
+        lines.append(f"# HELP {m.name} {_escape(m.help)}")
+        lines.append(f"# TYPE {m.name} {m.kind}")
+        children = m.children()
+        for key in sorted(children):
+            child = children[key]
+            if m.kind == "histogram":
+                for ub, n in child.cumulative_buckets():
+                    le = f'le="{_format_le(ub)}"'
+                    lines.append(
+                        f"{m.name}_bucket{_labels_text(key, le)} {n}"
+                    )
+                lines.append(
+                    f"{m.name}_sum{_labels_text(key)} {_num(child.sum)}"
+                )
+                lines.append(
+                    f"{m.name}_count{_labels_text(key)} {child.count}"
+                )
+            else:
+                lines.append(
+                    f"{m.name}{_labels_text(key)} {_num(child.value)}"
+                )
+    return "\n".join(lines) + "\n"

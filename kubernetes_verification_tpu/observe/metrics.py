@@ -1,0 +1,108 @@
+"""Every shared metric family, registered at import time.
+
+Keeping the declarations in one module (rather than scattered next to each
+increment site) gives three things: the registry dump names the full
+instrument set even on runs that exercise one backend, the
+``scripts/check_metrics_names.py`` lint has a single import to validate,
+and grep-for-a-metric lands here with the help string.
+
+Naming: ``kvtpu_`` prefix, ``_total`` suffix on counters, base units in the
+name (``_seconds``, ``_per_second``) — Prometheus conventions.
+"""
+from __future__ import annotations
+
+from .registry import Counter, Gauge, Histogram
+
+__all__ = [
+    "SPAN_SECONDS",
+    "VERIFY_TOTAL",
+    "PAIRS_PER_SECOND",
+    "BYTES_TRANSFERRED",
+    "CLOSURE_ITERATIONS",
+    "DELTA_CLOSURE_ROUNDS",
+    "INCREMENTAL_OPS",
+    "STRIPE_WIDTH",
+    "STRIPES_SOLVED",
+    "JIT_RECOMPILES",
+    "KERNEL_INVOCATIONS",
+    "KERNEL_TILES",
+]
+
+SPAN_SECONDS = Histogram(
+    "kvtpu_span_seconds",
+    "Wall-clock seconds per span/phase, labeled by span name. The registry "
+    "dump derives its `spans` section (count/total/last) from this family.",
+    ("name",),
+)
+
+VERIFY_TOTAL = Counter(
+    "kvtpu_verify_total",
+    "Verification runs dispatched through the backend registry.",
+    ("backend", "mode"),
+)
+
+PAIRS_PER_SECOND = Gauge(
+    "kvtpu_pairs_per_second",
+    "Pod pairs decided per second of solve time in the most recent run "
+    "(n_pods^2 / solve seconds) — the roofline-style throughput number.",
+    ("backend",),
+)
+
+BYTES_TRANSFERRED = Gauge(
+    "kvtpu_bytes_transferred",
+    "Host<->device bytes moved by the most recent run (encoded operands in "
+    "plus fetched results out; 0 for pure-host backends).",
+    ("backend",),
+)
+
+CLOSURE_ITERATIONS = Counter(
+    "kvtpu_closure_iterations_total",
+    "Boolean matrix squarings executed by host-driven transitive-closure "
+    "loops (packed fixpoint + NumPy oracle). Unlabeled so it appears in "
+    "every dump.",
+)
+
+DELTA_CLOSURE_ROUNDS = Counter(
+    "kvtpu_delta_closure_rounds_total",
+    "Frontier/suspect-row propagation rounds run by packed_closure_delta "
+    "instead of full re-closures.",
+)
+
+INCREMENTAL_OPS = Counter(
+    "kvtpu_incremental_ops_total",
+    "Mutations applied to an incremental verifier, by engine and operation "
+    "(pod_add, policy_remove, namespace_relabel, ...).",
+    ("engine", "op"),
+)
+
+STRIPE_WIDTH = Gauge(
+    "kvtpu_stripe_width",
+    "Destination-stripe width (pods) used by the most recent solve_stripe "
+    "call, per engine.",
+    ("engine",),
+)
+
+STRIPES_SOLVED = Counter(
+    "kvtpu_stripes_solved_total",
+    "Dirty destination stripes re-solved by the incremental engines.",
+    ("engine",),
+)
+
+JIT_RECOMPILES = Counter(
+    "kvtpu_jit_recompiles_total",
+    "Novel abstract-shape signatures seen at jit dispatch sites — each one "
+    "is an XLA trace+compile, the usual silent latency cliff.",
+    ("engine", "fn"),
+)
+
+KERNEL_INVOCATIONS = Counter(
+    "kvtpu_kernel_invocations_total",
+    "tiled_k8s_reach launches, by selected kernel (xla, pallas, ...).",
+    ("kernel",),
+)
+
+KERNEL_TILES = Counter(
+    "kvtpu_kernel_tiles_total",
+    "Destination tiles/stripes processed by tiled_k8s_reach, by kernel.",
+    ("kernel",),
+)

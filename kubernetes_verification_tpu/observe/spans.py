@@ -1,0 +1,137 @@
+"""Spans: nested wall-clock regions that feed the registry, the JSON event
+stream, and — when jax is already loaded — the device profiler.
+
+``trace("solve", backend="tpu")`` is the one instrumentation primitive the
+rest of the codebase uses. Each span:
+
+* times the region and observes ``kvtpu_span_seconds{name=...}``;
+* emits one JSON event line (with ``ok: false`` added when the body raised,
+  instead of pretending the phase completed);
+* nests via a thread-local stack, so events carry ``parent`` and depth;
+* wraps ``jax.profiler.TraceAnnotation`` when jax is importable, so the
+  same names line up in a TensorBoard TPU trace captured via
+  ``profile_to``. jax is looked up in ``sys.modules`` — tracing never
+  forces the heavyweight import on pure-host paths.
+
+``Phases`` keeps the seed's accumulate-into-a-dict API (backends still hand
+``VerifyResult.timings`` to callers) but is now a thin layer over spans.
+"""
+from __future__ import annotations
+
+import contextlib
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, Optional
+
+from .events import log_event
+from .metrics import SPAN_SECONDS
+
+__all__ = ["Span", "trace", "current_span", "Phases", "profile_to"]
+
+_state = threading.local()
+
+
+def _stack() -> list:
+    st = getattr(_state, "spans", None)
+    if st is None:
+        st = _state.spans = []
+    return st
+
+
+@dataclass
+class Span:
+    """One timed region. ``seconds``/``ok`` are filled when it closes."""
+
+    name: str
+    attrs: Dict[str, object] = field(default_factory=dict)
+    parent: Optional["Span"] = None
+    seconds: Optional[float] = None
+    ok: bool = True
+
+    @property
+    def depth(self) -> int:
+        return 0 if self.parent is None else self.parent.depth + 1
+
+
+def current_span() -> Optional[Span]:
+    st = _stack()
+    return st[-1] if st else None
+
+
+def _device_annotation(name: str):
+    # only annotate if jax is already imported — never pull it in ourselves
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return contextlib.nullcontext()
+    try:
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:
+        return contextlib.nullcontext()
+
+
+@contextlib.contextmanager
+def trace(name: str, _event: str = "span", **attrs) -> Iterator[Span]:
+    """Open a nested span; yields the live ``Span`` so callers can attach
+    attrs mid-flight (``span.attrs["rounds"] = r``)."""
+    span = Span(name=name, attrs=dict(attrs), parent=current_span())
+    _stack().append(span)
+    t0 = time.perf_counter()
+    try:
+        with _device_annotation(name):
+            yield span
+    except BaseException:
+        span.ok = False
+        raise
+    finally:
+        span.seconds = time.perf_counter() - t0
+        _stack().pop()
+        SPAN_SECONDS.labels(name=name).observe(span.seconds)
+        fields = dict(span.attrs)
+        fields.update(name=name, seconds=span.seconds)
+        if span.parent is not None:
+            fields["parent"] = span.parent.name
+            fields["depth"] = span.depth
+        if not span.ok:
+            fields["ok"] = False
+        log_event(_event, **fields)
+
+
+class Phases:
+    """Accumulate named phase timings (``encode``/``compile``/``solve``)
+    into a dict — the shape ``VerifyResult.timings`` has always carried —
+    while each phase also runs as a full span (registry + events + device
+    annotation). Timings accumulate even when the body raises, and the
+    emitted ``phase`` event then carries ``ok: false``.
+    """
+
+    def __init__(self) -> None:
+        self.timings: Dict[str, float] = {}
+
+    @contextlib.contextmanager
+    def __call__(self, name: str, **attrs) -> Iterator[Span]:
+        t0 = time.perf_counter()
+        try:
+            with trace(name, _event="phase", **attrs) as span:
+                yield span
+        finally:
+            self.timings[name] = self.timings.get(name, 0.0) + (
+                time.perf_counter() - t0
+            )
+
+
+@contextlib.contextmanager
+def profile_to(log_dir: str) -> Iterator[None]:
+    """Capture a jax profiler trace into ``log_dir`` (TensorBoard format).
+    No-op (with a warning event) when jax is unavailable."""
+    try:
+        import jax
+    except Exception:  # pragma: no cover - exercised only without jax
+        log_event("profile_skipped", reason="jax unavailable", log_dir=log_dir)
+        yield
+        return
+    with jax.profiler.trace(log_dir):
+        log_event("profile_start", log_dir=log_dir)
+        yield
+    log_event("profile_done", log_dir=log_dir)

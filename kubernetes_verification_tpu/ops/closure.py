@@ -22,6 +22,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..observe.metrics import CLOSURE_ITERATIONS, DELTA_CLOSURE_ROUNDS
+
 __all__ = [
     "transitive_closure",
     "path_upto",
@@ -189,6 +191,7 @@ def packed_closure(
     dt = _fit_tile(N, dst_tile)
     total = _packed_pair_total(packed)
     for _ in range(max_iter):
+        CLOSURE_ITERATIONS.inc()
         packed = _packed_square_step(packed, row_tile=t, dst_tile=dt)
         new_total = _packed_pair_total(packed)
         if new_total == total:
@@ -396,6 +399,7 @@ def packed_closure_delta(
         kg = max(32, min(row_group, N))
         total = _packed_pair_total(C)
         for _ in range(max_iter):
+            DELTA_CLOSURE_ROUNDS.inc()
             for i in range(0, len(rows_np), kg):
                 g = rows_np[i : i + kg]
                 pad = kg - len(g)
@@ -425,6 +429,7 @@ def packed_closure_delta(
     for _ in range(max_iter):
         if not changed.any():
             break
+        DELTA_CLOSURE_ROUNDS.inc()
         frontier = (
             np.asarray(_rows_touching(packed, pack_mask(changed))) | changed
         )

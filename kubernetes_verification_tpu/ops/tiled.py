@@ -54,6 +54,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..encode.encoder import EncodedCluster, GrantBlock, SelectorEnc
+from ..observe.metrics import KERNEL_INVOCATIONS, KERNEL_TILES
 from .match import match_selectors
 
 __all__ = [
@@ -1509,6 +1510,7 @@ def tiled_k8s_reach(
         kernel = "pallas-fused" if use_pallas else "xla-ports"
         if use_pallas:
             on_tpu = platform == "tpu"
+            n_tiles = max(1, Np // (2048 if on_tpu else Np))
             packed, ing_iso, eg_iso, selected = _tiled_ports_fused_step(
                 *args,
                 layout=layout,
@@ -1522,6 +1524,7 @@ def tiled_k8s_reach(
                 interp=not on_tpu,
             )
         else:
+            n_tiles = max(1, Np // tile)
             packed, ing_iso, eg_iso, selected = _tiled_ports_step(
                 *args,
                 layout=layout,
@@ -1536,6 +1539,7 @@ def tiled_k8s_reach(
         if device is not None:
             args = jax.device_put(args, device)
         kernel = "pallas" if use_pallas else "xla"
+        n_tiles = max(1, Np // tile)
         packed, ing_iso, eg_iso, selected = _tiled_step(
             *args,
             tile=tile,
@@ -1559,6 +1563,8 @@ def tiled_k8s_reach(
         packed_out = packed[:n]
         label = "solve"
     t1 = time.perf_counter()
+    KERNEL_INVOCATIONS.labels(kernel=kernel).inc()
+    KERNEL_TILES.labels(kernel=kernel).inc(n_tiles)
     out = PackedReach(
         packed=packed_out,
         n_pods=n,

@@ -78,6 +78,8 @@ from .encode.encoder import (
 )
 from .encode.ports import ALL_ATOM
 from .models.core import Cluster, Namespace, NetworkPolicy, Pod
+from .observe import DispatchTracker
+from .observe.metrics import INCREMENTAL_OPS, STRIPE_WIDTH, STRIPES_SOLVED
 from .ops.tiled import (
     PackedReach,
     _peers_by_slot,
@@ -97,6 +99,9 @@ _U32 = jnp.uint32
 _ROW_GROUP = 512
 #: max dst columns recomputed per call (bounds the [Np, Dc] transients)
 _COL_GROUP = 256
+
+#: jit caches are per-function and process-global — one tracker per module
+_TRACKER = DispatchTracker("packed")
 
 
 def _groups(
@@ -857,6 +862,13 @@ class PackedIncrementalVerifier:
     the 100k-pod flagship scale the dense counts cannot reach.
     """
 
+    #: engine label on kvtpu_incremental_ops_total et al.; the namespace
+    #: methods the dense engine borrows from this class label per-class
+    metrics_engine = "packed"
+
+    def _count_op(self, op: str) -> None:
+        INCREMENTAL_OPS.labels(engine=self.metrics_engine, op=op).inc()
+
     def __init__(
         self,
         cluster: Cluster,
@@ -1290,6 +1302,7 @@ class PackedIncrementalVerifier:
         if self._packed is None:
             # matrix-free: update the maps + counts; record what a later
             # solve_stripe must re-verify
+            _TRACKER.track("_slot_write", self._maps)
             out = _slot_write(
                 *self._maps, np.int32(slot), self._put(new4_padded, "new4")
             )
@@ -1313,6 +1326,11 @@ class PackedIncrementalVerifier:
         else:
             c0 = np.zeros(_COL_GROUP, dtype=np.int32)
             meta0 = self._col_meta(c0, 0)
+        _TRACKER.track(
+            "_diff_step", self._packed, self._maps,
+            static=(bool(row_groups), bool(col_groups))
+            + tuple(sorted(self._flags.items())),
+        )
         out = _diff_step(
             self._packed, *self._maps, self._col_mask, self._row_valid,
             np.int32(slot),
@@ -1393,6 +1411,7 @@ class PackedIncrementalVerifier:
         self.policies[key] = pol
         self._slot[key] = slot
         self._set_slot(slot, None, vecs)
+        self._count_op("policy_add")
 
     def remove_policy(self, namespace: str, name: str) -> None:
         key = f"{namespace}/{name}"
@@ -1402,6 +1421,7 @@ class PackedIncrementalVerifier:
         zero = np.zeros(self.n_pods, dtype=np.int8)
         self._set_slot(slot, old, (zero, zero, zero, zero))
         self._free.append(slot)
+        self._count_op("policy_remove")
 
     def update_policy(self, pol: NetworkPolicy) -> None:
         key = self._key(pol)
@@ -1410,6 +1430,7 @@ class PackedIncrementalVerifier:
         vecs = self._vectorizer.vectors(pol)
         self.policies[key] = pol
         self._set_slot(slot, old, vecs)
+        self._count_op("policy_update")
 
     def _pod_cols(self, pod: Pod) -> np.ndarray:
         """int8 [4, C]: one pod's (sel_ing, sel_eg, ing_peer, eg_peer) flag
@@ -1451,6 +1472,7 @@ class PackedIncrementalVerifier:
         else:
             self._patch(np.asarray([idx]), np.asarray([idx]))
         self.update_count += 1
+        self._count_op("pod_relabel")
 
     # ------------------------------------------------------------ pod churn
     def _dispatch_pod(
@@ -1462,6 +1484,7 @@ class PackedIncrementalVerifier:
         if bookkeep:
             self._mark_closure_dirty([idx], [idx])
         if self._packed is None:
+            _TRACKER.track("_pod_step_mf", self._maps)
             out = _pod_step_mf(
                 *self._maps, self._col_mask, self._row_valid,
                 np.int32(idx), self._put(cols4, "rep"),
@@ -1476,6 +1499,10 @@ class PackedIncrementalVerifier:
                 self.dirty_rows[idx] = True
                 self.dirty_cols[idx] = True
         else:
+            _TRACKER.track(
+                "_pod_step", self._packed, self._maps,
+                static=tuple(sorted(self._flags.items())),
+            )
             out = _pod_step(
                 self._packed, *self._maps, self._col_mask, self._row_valid,
                 np.int32(idx), self._put(cols4, "rep"),
@@ -1506,6 +1533,7 @@ class PackedIncrementalVerifier:
         self.namespaces.append(Namespace(ns.name, dict(ns.labels)))
         vz = self._vectorizer
         vz.ns_index.setdefault(ns.name, len(vz.ns_index))
+        self._count_op("namespace_add")
         return True
 
     def _ns_pod_slots(self, name: str) -> np.ndarray:
@@ -1553,6 +1581,7 @@ class PackedIncrementalVerifier:
         if dict(self._ns_labels[name]) == dict(labels):
             return
         self._set_ns_labels(name, labels)
+        self._count_op("namespace_relabel")
         idx_arr = self._ns_pod_slots(name)
         if not len(idx_arr):
             return
@@ -1610,6 +1639,7 @@ class PackedIncrementalVerifier:
             )
         del self._ns_labels[name]
         self.namespaces = [ns for ns in self.namespaces if ns.name != name]
+        self._count_op("namespace_remove")
 
     def add_pod(self, pod: Pod) -> int:
         """Add a pod in O(P + N) — one fused device dispatch. Returns the
@@ -1654,6 +1684,7 @@ class PackedIncrementalVerifier:
         self._h_ing_cnt[idx] = int(cols4[0].sum())
         self._h_eg_cnt[idx] = int(cols4[1].sum())
         self._dispatch_pod(idx, cols4, active=True)
+        self._count_op("pod_add")
         return idx
 
     def remove_pod(self, namespace: str, name: str) -> int:
@@ -1670,6 +1701,7 @@ class PackedIncrementalVerifier:
         self._h_eg_cnt[idx] = 0
         zeros = np.zeros((4, self._capacity), dtype=np.int8)
         self._dispatch_pod(idx, zeros, active=False)
+        self._count_op("pod_remove")
         return idx
 
     @property
@@ -1725,6 +1757,12 @@ class PackedIncrementalVerifier:
                 f"stripe [{d0}, {d0 + width}) outside the padded pod range "
                 f"{self._n_padded}"
             )
+        STRIPE_WIDTH.labels(engine=self.metrics_engine).set(width)
+        STRIPES_SOLVED.labels(engine=self.metrics_engine).inc()
+        _TRACKER.track(
+            "_stripe_step", self._maps,
+            static=(width,) + tuple(sorted(self._flags.items())),
+        )
         out = _stripe_step(
             *self._maps,
             self._col_mask,

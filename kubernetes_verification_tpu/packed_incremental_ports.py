@@ -79,6 +79,8 @@ from .ops.tiled import (
     pack_bool_cols,
     unpack_words_i8,
 )
+from .observe import DispatchTracker
+from .observe.metrics import INCREMENTAL_OPS
 from .packed_incremental import (
     PackedIncrementalVerifier,
     PolicyVectorizer,
@@ -94,6 +96,9 @@ _U32 = jnp.uint32
 
 _ROW_GROUP = 256
 _COL_GROUP = 256
+
+#: jit caches are per-function and process-global — one tracker per module
+_TRACKER = DispatchTracker("packed-ports")
 
 #: fixed size ladder for the per-diff VP-row value buffers: one compiled
 #: _vp_write per rung (prewarmed), instead of one per novel power of two
@@ -488,6 +493,13 @@ def _ports_apply_pod_cols_group(
 
 class PackedPortsIncrementalVerifier:
     """Port-bitmap reachability under policy add/remove/update."""
+
+    #: engine label on kvtpu_incremental_ops_total et al. — also used by
+    #: the namespace methods borrowed from the any-port engine
+    metrics_engine = "packed-ports"
+
+    def _count_op(self, op: str) -> None:
+        INCREMENTAL_OPS.labels(engine=self.metrics_engine, op=op).inc()
 
     def __init__(
         self,
@@ -1097,6 +1109,7 @@ class PackedPortsIncrementalVerifier:
 
         rows_i, vals_i = safe_pack(assigned_i, freed_i, new_si, True, "i")
         rows_e, vals_e = safe_pack(assigned_e, freed_e, new_se, False, "e")
+        _TRACKER.track("_vp_write", self._operands, vals_i, vals_e)
         out = _vp_write(
             *self._operands, self._ing_cnt, self._eg_cnt,
             self._put(rows_i, "rep"),
@@ -1167,6 +1180,7 @@ class PackedPortsIncrementalVerifier:
         zeros = np.zeros(self.n_pods, dtype=bool)
         self._apply((zeros, zeros), (new_si, new_se),
                     assigned_i, assigned_e, [], [])
+        self._count_op("policy_add")
 
     def remove_policy(self, namespace: str, name: str) -> None:
         key = f"{namespace}/{name}"
@@ -1179,6 +1193,7 @@ class PackedPortsIncrementalVerifier:
         zeros = np.zeros(self.n_pods, dtype=bool)
         self._apply((old_si, old_se), (zeros, zeros),
                     {}, {}, freed_i, freed_e)
+        self._count_op("policy_remove")
 
     def update_policy(self, pol: NetworkPolicy) -> None:
         key = self._key(pol)
@@ -1196,6 +1211,7 @@ class PackedPortsIncrementalVerifier:
         self.policies[key] = pol
         self._apply((old_si, old_se), (new_si, new_se),
                     assigned_i, assigned_e, freed_i, freed_e)
+        self._count_op("policy_update")
 
     # ------------------------------------------------------------ pod churn
     def _pod_bank_col(self, pod: Pod, strict: bool = False) -> np.ndarray:
@@ -1302,6 +1318,10 @@ class PackedPortsIncrementalVerifier:
         ``bookkeep`` is False only for the prewarm no-op."""
         if bookkeep:
             self._mark_closure_dirty([idx], [idx])
+        _TRACKER.track(
+            "_ports_pod_step", self._packed, self._operands,
+            static=tuple(sorted(self._flags.items())),
+        )
         out = _ports_pod_step(
             self._packed, *self._operands, self._ing_cnt, self._eg_cnt,
             self._col_mask, self._row_valid,
@@ -1344,6 +1364,7 @@ class PackedPortsIncrementalVerifier:
         if dict(self._ns_labels[name]) == dict(labels):
             return
         self._set_ns_labels(name, labels)
+        self._count_op("namespace_relabel")
         idx_arr = self._ns_pod_slots(name)
         if not len(idx_arr):
             return
@@ -1428,6 +1449,7 @@ class PackedPortsIncrementalVerifier:
         self._h_ing_cnt[idx] = cnt_i
         self._h_eg_cnt[idx] = cnt_e
         self._dispatch_pod(idx, ci, ce, cnt_i, cnt_e, active=True)
+        self._count_op("pod_add")
         return idx
 
     def remove_pod(self, namespace: str, name: str) -> int:
@@ -1448,6 +1470,7 @@ class PackedPortsIncrementalVerifier:
             np.zeros((2, int(self._sel_eg_vp.shape[0])), dtype=np.int8),
             0, 0, active=False,
         )
+        self._count_op("pod_remove")
         return idx
 
     def update_pod_labels(self, idx: int, labels: Dict[str, str]) -> None:
@@ -1467,6 +1490,7 @@ class PackedPortsIncrementalVerifier:
         self._h_ing_cnt[idx] = cnt_i
         self._h_eg_cnt[idx] = cnt_e
         self._dispatch_pod(idx, ci, ce, cnt_i, cnt_e, active=True)
+        self._count_op("pod_relabel")
 
     def _grow_pods(self, min_extra: int = 1) -> None:
         """Grow the pod axis by at least ``min_extra`` slots, keeping the

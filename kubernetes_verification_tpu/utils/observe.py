@@ -1,70 +1,23 @@
-"""Observability: structured logging, phase timing, device profiling.
-
-The reference's observability is bare ``print`` statements
-(``kano_py/kano/parser.py:22,33,47,85-89``; SURVEY.md §5.5). Here:
-
-* ``log_event(name, **fields)`` — one JSON line per event on the ``kvtpu``
-  logger (enable with ``configure_logging()`` or any ``logging`` setup);
-* ``phase(name)`` / ``Phases`` — nested wall-clock phase timing that
-  accumulates into a dict (the backends' ``timings`` fields use the same
-  encode/solve phase names);
-* ``profile_to(dir)`` — context manager around ``jax.profiler.trace`` for
-  real device traces (TensorBoard-compatible), SURVEY.md §5.1.
+"""Backward-compatible shim: the observability layer grew into the
+``kubernetes_verification_tpu.observe`` package (metrics registry, spans,
+exporters). The seed-era names keep importing from here.
 """
 from __future__ import annotations
 
-import contextlib
-import json
-import logging
-import time
-from typing import Dict, Iterator, Optional
+from ..observe import (  # noqa: F401
+    Phases,
+    configure_logging,
+    log_event,
+    logger,
+    profile_to,
+    trace,
+)
 
-__all__ = ["logger", "configure_logging", "log_event", "Phases", "profile_to"]
-
-logger = logging.getLogger("kvtpu")
-
-
-def configure_logging(level: int = logging.INFO) -> None:
-    """Attach a stderr handler emitting the raw JSON event lines."""
-    h = logging.StreamHandler()
-    h.setFormatter(logging.Formatter("%(message)s"))
-    logger.addHandler(h)
-    logger.setLevel(level)
-
-
-def log_event(event: str, **fields) -> None:
-    if logger.isEnabledFor(logging.INFO):
-        logger.info(json.dumps({"event": event, "ts": time.time(), **fields}))
-
-
-class Phases:
-    """Accumulating phase timer.
-
-    >>> ph = Phases()
-    >>> with ph("encode"): ...
-    >>> with ph("solve"): ...
-    >>> ph.timings  # {"encode": ..., "solve": ...}
-    """
-
-    def __init__(self) -> None:
-        self.timings: Dict[str, float] = {}
-
-    @contextlib.contextmanager
-    def __call__(self, name: str) -> Iterator[None]:
-        t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            dt = time.perf_counter() - t0
-            self.timings[name] = self.timings.get(name, 0.0) + dt
-            log_event("phase", name=name, seconds=dt)
-
-
-@contextlib.contextmanager
-def profile_to(log_dir: str, host_tracer_level: int = 2) -> Iterator[None]:
-    """Capture a JAX device/host profile under ``log_dir`` (view with
-    TensorBoard's profile plugin or xprof)."""
-    import jax
-
-    with jax.profiler.trace(log_dir, create_perfetto_link=False):
-        yield
+__all__ = [
+    "logger",
+    "configure_logging",
+    "log_event",
+    "Phases",
+    "profile_to",
+    "trace",
+]

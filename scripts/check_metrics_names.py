@@ -1,0 +1,51 @@
+#!/usr/bin/env python
+"""Lint the metric namespace: every family registered at import time must
+match ``^kvtpu_[a-z0-9_]+$`` so the Prometheus/JSON exporter output stays
+stable (dashboards and scrape configs key on these names).
+
+Importing the modules below covers every registration site: the shared
+families live in ``observe/metrics.py``, and any module that registered a
+private family would do so at its own import. Run directly (exit 1 on a bad
+name) — tier-1 runs it via ``tests/test_observe.py``.
+"""
+from __future__ import annotations
+
+import importlib
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: modules that register metric families at import time (observe.metrics is
+#: pulled in transitively, listed anyway so the lint stays explicit)
+MODULES = (
+    "kubernetes_verification_tpu.observe",
+    "kubernetes_verification_tpu.observe.metrics",
+)
+
+
+def check() -> list:
+    from kubernetes_verification_tpu.observe import METRIC_NAME_RE, REGISTRY
+
+    for mod in MODULES:
+        importlib.import_module(mod)
+    return [n for n in REGISTRY.names() if not METRIC_NAME_RE.match(n)]
+
+
+def main() -> int:
+    bad = check()
+    if bad:
+        print(
+            "metric names must match ^kvtpu_[a-z0-9_]+$ — offending: "
+            + ", ".join(sorted(bad)),
+            file=sys.stderr,
+        )
+        return 1
+    from kubernetes_verification_tpu.observe import REGISTRY
+
+    print(f"{len(REGISTRY.names())} metric families OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,288 @@
+"""The observability layer: registry semantics, Prometheus golden text,
+span nesting, event logging idempotency, dispatch tracking, and the CLI
+``--metrics-out`` / ``--log-json`` round trip."""
+import io
+import json
+import logging
+import numpy as np
+import pytest
+
+from kubernetes_verification_tpu.observe import (
+    DEFAULT_BUCKETS,
+    METRIC_NAME_RE,
+    REGISTRY,
+    Counter,
+    DispatchTracker,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    Phases,
+    abstract_signature,
+    configure_logging,
+    current_span,
+    dump_registry,
+    to_prometheus,
+    trace,
+    tree_nbytes,
+    write_metrics,
+)
+from kubernetes_verification_tpu.observe.events import _HANDLER_MARK, logger
+
+
+@pytest.fixture()
+def reg():
+    return MetricsRegistry()
+
+
+@pytest.fixture()
+def clean_kvtpu_logger():
+    """Detach any handler the tests (or earlier code) attached, restoring
+    the logger afterwards so later tests never write to a closed buffer."""
+    yield logger
+    for h in list(logger.handlers):
+        if getattr(h, _HANDLER_MARK, False):
+            logger.removeHandler(h)
+    logger.setLevel(logging.NOTSET)
+
+
+# ---------------------------------------------------------------- registry
+def test_counter_semantics(reg):
+    c = Counter("kvtpu_test_total", "t", ("kind",), registry=reg)
+    c.labels(kind="a").inc()
+    c.labels(kind="a").inc(2)
+    c.labels(kind="b").inc()
+    d = reg.dump()["counters"]["kvtpu_test_total"]
+    assert d == {"kind=a": 3.0, "kind=b": 1.0}
+    with pytest.raises(ValueError):
+        c.labels(kind="a").inc(-1)  # counters only go up
+    with pytest.raises(ValueError):
+        c.labels(wrong="a")  # label schema enforced
+
+
+def test_gauge_and_unlabeled_default_child(reg):
+    g = Gauge("kvtpu_test_level", "t", registry=reg)
+    # unlabeled family appears in the dump at 0 before any use
+    assert reg.dump()["gauges"]["kvtpu_test_level"] == {"": 0.0}
+    g.set(4.5)
+    g.inc()
+    g.dec(2.0)
+    assert reg.dump()["gauges"]["kvtpu_test_level"] == {"": 3.5}
+
+
+def test_histogram_buckets_cumulative(reg):
+    h = Histogram(
+        "kvtpu_test_seconds", "t", registry=reg, buckets=(0.1, 1.0, 10.0)
+    )
+    for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+        h.observe(v)
+    entry = reg.dump()["histograms"]["kvtpu_test_seconds"][""]
+    assert entry["count"] == 5
+    assert entry["sum"] == pytest.approx(56.05)
+    assert entry["last"] == pytest.approx(50.0)
+    assert entry["buckets"] == {"0.1": 1, "1.0": 3, "10.0": 4, "+Inf": 5}
+
+
+def test_registry_rejects_duplicates_and_bad_names(reg):
+    Counter("kvtpu_once_total", registry=reg)
+    with pytest.raises(ValueError):
+        Counter("kvtpu_once_total", registry=reg)
+    for bad in ("closure_iterations", "kvtpu_Upper", "kvtpu_dash-ed"):
+        with pytest.raises(ValueError):
+            Counter(bad, registry=reg)
+
+
+def test_registry_reset_keeps_families(reg):
+    c = Counter("kvtpu_reset_total", "t", ("k",), registry=reg)
+    c.labels(k="x").inc(7)
+    reg.reset()
+    assert reg.names() == ["kvtpu_reset_total"]
+    assert reg.dump()["counters"]["kvtpu_reset_total"] == {}
+
+
+def test_prometheus_golden_text(reg):
+    c = Counter("kvtpu_ops_total", "Operations applied.", ("op",), registry=reg)
+    c.labels(op="add").inc(3)
+    g = Gauge("kvtpu_width", "Stripe width.", registry=reg)
+    g.set(512)
+    h = Histogram("kvtpu_lat_seconds", "Latency.", registry=reg, buckets=(0.1,))
+    h.observe(0.05)
+    h.observe(0.2)
+    assert to_prometheus(reg) == (
+        "# HELP kvtpu_lat_seconds Latency.\n"
+        "# TYPE kvtpu_lat_seconds histogram\n"
+        'kvtpu_lat_seconds_bucket{le="0.1"} 1\n'
+        'kvtpu_lat_seconds_bucket{le="+Inf"} 2\n'
+        "kvtpu_lat_seconds_sum 0.25\n"
+        "kvtpu_lat_seconds_count 2\n"
+        "# HELP kvtpu_ops_total Operations applied.\n"
+        "# TYPE kvtpu_ops_total counter\n"
+        'kvtpu_ops_total{op="add"} 3\n'
+        "# HELP kvtpu_width Stripe width.\n"
+        "# TYPE kvtpu_width gauge\n"
+        "kvtpu_width 512\n"
+    )
+
+
+def test_all_registered_names_pass_the_lint():
+    # the tier-1 hook for scripts/check_metrics_names.py: every family the
+    # package registered at import time obeys the naming contract
+    import importlib.util
+    from pathlib import Path
+
+    script = (
+        Path(__file__).resolve().parent.parent
+        / "scripts"
+        / "check_metrics_names.py"
+    )
+    spec = importlib.util.spec_from_file_location("check_metrics_names", script)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.check() == []
+    assert all(METRIC_NAME_RE.match(n) for n in REGISTRY.names())
+
+
+# ------------------------------------------------------------------- spans
+def test_trace_nests_and_feeds_registry():
+    before = REGISTRY.get("kvtpu_span_seconds").labels(name="outer_t").count
+    with trace("outer_t") as outer:
+        assert current_span() is outer
+        with trace("inner_t") as inner:
+            assert inner.parent is outer
+            assert inner.depth == 1
+    assert current_span() is None
+    fam = REGISTRY.get("kvtpu_span_seconds")
+    assert fam.labels(name="outer_t").count == before + 1
+    assert outer.seconds is not None and outer.seconds >= 0
+
+
+def test_phases_accumulate_and_mark_failures(clean_kvtpu_logger):
+    buf = io.StringIO()
+    configure_logging(stream=buf)
+    ph = Phases()
+    with ph("encode"):
+        pass
+    with ph("solve"):
+        pass
+    with ph("solve"):  # repeat accumulates into the same key
+        pass
+    with pytest.raises(RuntimeError):
+        with ph("explode"):
+            raise RuntimeError("boom")
+    assert set(ph.timings) == {"encode", "solve", "explode"}
+    events = [json.loads(line) for line in buf.getvalue().splitlines()]
+    by_name = {}
+    for e in events:
+        by_name.setdefault(e["name"], []).append(e)
+    assert all(e["event"] == "phase" for e in events)
+    assert len(by_name["solve"]) == 2
+    assert "ok" not in by_name["encode"][0]  # success omits the flag
+    assert by_name["explode"][0]["ok"] is False
+    # timings accumulated even for the raising phase
+    assert ph.timings["explode"] >= 0
+    # the raising span was popped: the stack is clean for the next caller
+    assert current_span() is None
+
+
+def test_configure_logging_idempotent(clean_kvtpu_logger):
+    buf = io.StringIO()
+    h1 = configure_logging(stream=buf)
+    h2 = configure_logging(stream=buf)
+    assert h1 is h2
+    marked = [h for h in logger.handlers if getattr(h, _HANDLER_MARK, False)]
+    assert len(marked) == 1
+    with trace("idem_t"):
+        pass
+    lines = [l for l in buf.getvalue().splitlines() if '"idem_t"' in l]
+    assert len(lines) == 1  # one handler -> one line, not two
+    ev = json.loads(lines[0])
+    assert ev["event"] == "span" and ev["seconds"] >= 0 and "ts" in ev
+
+
+# ------------------------------------------------- dispatch/shape tracking
+def test_dispatch_tracker_detects_novel_signatures():
+    tr = DispatchTracker("test-engine")
+    a = np.zeros((4, 4), dtype=np.float32)
+    assert tr.track("fn", a) is True  # first signature
+    assert tr.track("fn", np.ones((4, 4), dtype=np.float32)) is False
+    assert tr.track("fn", np.zeros((8, 4), dtype=np.float32)) is True
+    assert tr.track("fn", a, static=(True,)) is True  # static args distinguish
+    fam = REGISTRY.dump()["counters"]["kvtpu_jit_recompiles_total"]
+    assert fam["engine=test-engine,fn=fn"] == 3.0
+    assert tr.signatures("fn") == 3
+
+
+def test_abstract_signature_and_tree_nbytes():
+    a = np.zeros((2, 3), dtype=np.int8)
+    b = np.zeros(5, dtype=np.float32)
+    assert abstract_signature([a, b]) == abstract_signature(
+        [np.ones((2, 3), dtype=np.int8), b]
+    )
+    assert abstract_signature(a) != abstract_signature(b)
+    assert tree_nbytes({"x": a, "y": [b, None, 3]}) == a.nbytes + b.nbytes
+
+
+# ----------------------------------------------------------- CLI round trip
+def test_cli_metrics_out_round_trip(tmp_path, capsys, clean_kvtpu_logger):
+    from kubernetes_verification_tpu.cli import main
+
+    d = str(tmp_path / "m")
+    assert main(["generate", d, "--pods", "24", "--policies", "4"]) == 0
+    mx = str(tmp_path / "mx.json")
+    assert main(
+        ["verify", d, "--json", "--metrics-out", mx, "--log-json"]
+    ) == 0
+    out = capsys.readouterr()
+    json.loads(out.out.strip().splitlines()[-1])  # --json stays parseable
+    dump = json.loads(open(mx).read())
+    assert {"encode", "compile", "solve", "verify"} <= set(dump["spans"])
+    assert all(
+        dump["spans"][s]["last_seconds"] >= 0
+        for s in ("encode", "compile", "solve")
+    )
+    assert "kvtpu_closure_iterations_total" in dump["counters"]
+    pps = dump["gauges"]["kvtpu_pairs_per_second"]
+    assert "backend=cpu" in pps and pps["backend=cpu"] > 0
+    # --log-json: one valid JSON event line per span/phase on stderr
+    events = [
+        json.loads(line)
+        for line in out.err.splitlines()
+        if line.startswith("{")
+    ]
+    names = [e.get("name") for e in events]
+    # cpu's verify accumulates "encode" over two blocks -> two phase events
+    for phase in ("encode", "compile", "solve"):
+        assert names.count(phase) >= 1, (phase, names)
+    assert names.count("verify") == 1, names
+    verify_ev = next(e for e in events if e.get("name") == "verify")
+    assert verify_ev["event"] == "span"
+    assert verify_ev["backend"] == "cpu"
+
+
+def test_cli_metrics_subcommand(capsys):
+    from kubernetes_verification_tpu.cli import main
+
+    assert main(["metrics"]) == 0
+    dump = json.loads(capsys.readouterr().out)
+    assert "kvtpu_verify_total" in dump["counters"]
+    assert main(["metrics", "--format", "prom"]) == 0
+    text = capsys.readouterr().out
+    assert "# TYPE kvtpu_span_seconds histogram" in text
+
+
+def test_write_metrics_formats(tmp_path):
+    jp = tmp_path / "m.json"
+    pp = tmp_path / "m.prom"
+    write_metrics(str(jp))
+    write_metrics(str(pp))
+    dump = json.loads(jp.read_text())
+    assert set(dump) == {"counters", "gauges", "histograms", "spans"}
+    text = pp.read_text()
+    assert "# TYPE kvtpu_span_seconds histogram" in text
+    assert "# TYPE kvtpu_verify_total counter" in text
+    # the shared dump helper and the file agree on family names
+    assert set(dump["counters"]) == set(dump_registry()["counters"])
+
+
+def test_default_buckets_are_ascending():
+    assert list(DEFAULT_BUCKETS) == sorted(DEFAULT_BUCKETS)
+    assert DEFAULT_BUCKETS[0] <= 0.001 and DEFAULT_BUCKETS[-1] >= 300.0
