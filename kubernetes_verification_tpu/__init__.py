@@ -38,6 +38,19 @@ from .backends.base import (
 )
 
 from .ingest import dump_cluster, load_cluster, load_kano
+from .resilience import (
+    BackendChainExhausted,
+    BackendError,
+    BackendOOM,
+    BackendTimeout,
+    ConfigError,
+    DeviceLost,
+    EncodeError,
+    IngestError,
+    KvTpuError,
+    PersistError,
+    UnknownBackendError,
+)
 
 _HAVE_INCREMENTAL = True
 try:  # JAX-dependent; optional at import time
@@ -94,8 +107,41 @@ __all__ = [
     "load_cluster",
     "load_kano",
     "dump_cluster",
+    "KvTpuError",
+    "IngestError",
+    "PersistError",
+    "EncodeError",
+    "ConfigError",
+    "BackendError",
+    "BackendOOM",
+    "BackendTimeout",
+    "DeviceLost",
+    "UnknownBackendError",
+    "BackendChainExhausted",
+    "ResilienceConfig",
+    "resilient_verify",
+    "resilient_verify_kano",
+    "register_faulty",
+    "parse_fault_spec",
     "__version__",
 ]
+
+
+def __getattr__(name):
+    """Lazy resilience driver/fault exports: the wrapper and harness import
+    backend modules, which the taxonomy (imported eagerly above) must not."""
+    _lazy = {
+        "ResilienceConfig",
+        "resilient_verify",
+        "resilient_verify_kano",
+        "register_faulty",
+        "parse_fault_spec",
+    }
+    if name in _lazy:
+        from . import resilience
+
+        return getattr(resilience, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 if _HAVE_INCREMENTAL:
     __all__.append("IncrementalVerifier")

@@ -18,6 +18,7 @@ import numpy as np
 from ..models.core import Cluster, Container, KanoPolicy
 from ..observe import trace
 from ..observe.metrics import PAIRS_PER_SECOND, VERIFY_TOTAL
+from ..resilience.errors import ConfigError, UnknownBackendError
 
 __all__ = [
     "VerifyConfig",
@@ -203,7 +204,10 @@ def available_backends() -> List[str]:
 
 def get_backend(name: str) -> VerifierBackend:
     if name not in _REGISTRY:
-        raise KeyError(f"unknown backend {name!r}; have {available_backends()}")
+        raise UnknownBackendError(
+            f"unknown backend {name!r}; have {available_backends()}",
+            backend=name,
+        )
     return _REGISTRY[name]()
 
 
@@ -222,7 +226,7 @@ def verify(cluster: Cluster, config: Optional[VerifyConfig] = None) -> VerifyRes
     """Verify a k8s-level cluster with the configured backend."""
     config = config or VerifyConfig()
     if config.label_relation is not None:
-        raise ValueError(
+        raise ConfigError(
             "label_relation is the kano-mode matcher plugin; k8s-mode "
             "selectors follow the Kubernetes LabelSelector spec (use "
             "verify_kano)"
@@ -245,7 +249,7 @@ def verify_kano(
         config.label_relation is not None
         and not backend.supports_label_relation
     ):
-        raise ValueError(
+        raise ConfigError(
             f"backend {config.backend!r} does not honor label_relation; "
             "use the cpu or tpu backend for a custom kano matcher"
         )

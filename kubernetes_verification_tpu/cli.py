@@ -79,6 +79,30 @@ def _add_verify_flags(p: argparse.ArgumentParser) -> None:
         help="backend option (repeatable), e.g. --opt mesh=4,2 "
         "--opt tile=512 --opt keep_matrix=true for sharded-packed",
     )
+    p.add_argument(
+        "--fallback-chain", metavar="B1,B2,...",
+        help="ordered backends to try (e.g. tpu,sharded,cpu); supersedes "
+        "--backend — exit 3 when the whole chain fails",
+    )
+    p.add_argument(
+        "--max-retries", type=int, default=2, metavar="N",
+        help="transient-failure retries per backend before falling back",
+    )
+    p.add_argument(
+        "--solve-timeout", type=float, default=None, metavar="SECONDS",
+        help="watchdog wall-clock bound per solve attempt",
+    )
+    p.add_argument(
+        "--inject-faults", action="append", default=[],
+        metavar="BACKEND=SPEC",
+        help="register a fault-injecting wrapper backend 'faulty:BACKEND' "
+        "(repeatable); SPEC e.g. oom@0, timeout, device_loss, flaky@0, "
+        "oom>256 — see resilience.faults.parse_fault_spec",
+    )
+    p.add_argument(
+        "--check", action="store_true",
+        help="exit 1 when policy shadow/conflict pairs are found",
+    )
 
 
 #: options whose values must be integers (string fallthrough would surface
@@ -114,14 +138,66 @@ def _parse_opt(kv_str: str):
         return key, raw  # string-valued options (e.g. groups_label=3tier)
 
 
+def _diagnose(args, e: Exception) -> int:
+    """The ``KvTpuError`` → exit-code contract: one line on stderr (the
+    operator path) unless ``--log-json`` asked for the debugging traceback."""
+    from .resilience.errors import exit_code_for
+
+    if getattr(args, "log_json", False):
+        raise e
+    print(f"kv-tpu: {type(e).__name__}: {e}", file=sys.stderr)
+    return exit_code_for(e)
+
+
 def cmd_verify(args) -> int:
-    with _observed(args):
-        return _run_verify(args)
+    from .resilience.errors import KvTpuError
+
+    try:
+        with _observed(args):
+            return _run_verify(args)
+    except KvTpuError as e:
+        return _diagnose(args, e)
+
+
+def _resilience_from_args(args):
+    """``--fallback-chain``/``--max-retries``/``--solve-timeout`` →
+    :class:`~.resilience.ResilienceConfig`, or None when none were given
+    (the plain dispatcher path — identical behaviour to pre-resilience)."""
+    chain = tuple(
+        b.strip()
+        for b in (args.fallback_chain or "").split(",")
+        if b.strip()
+    )
+    if not chain and args.solve_timeout is None and args.max_retries == 2:
+        return None
+    from .resilience import ResilienceConfig
+
+    return ResilienceConfig(
+        fallback_chain=chain,
+        max_retries=args.max_retries,
+        solve_timeout=args.solve_timeout,
+    )
+
+
+def _register_faults(args) -> None:
+    for spec in getattr(args, "inject_faults", []):
+        backend, sep, fault_spec = spec.partition("=")
+        if not sep or not backend or not fault_spec:
+            raise SystemExit(
+                f"--inject-faults expects BACKEND=SPEC, got {spec!r}"
+            )
+        from .resilience.faults import parse_fault_spec, register_faulty
+
+        register_faulty(backend, parse_fault_spec(fault_spec))
 
 
 def _run_verify(args) -> int:
     import kubernetes_verification_tpu as kv
 
+    from .resilience.errors import EXIT_OK, EXIT_VIOLATIONS
+
+    _register_faults(args)
+    resilience = _resilience_from_args(args)
     cfg = kv.VerifyConfig(
         backend=args.backend,
         closure=args.closure,
@@ -132,7 +208,12 @@ def _run_verify(args) -> int:
     )
     if args.kano:
         containers, policies = kv.load_kano(args.path)
-        res = kv.verify_kano(containers, policies, cfg)
+        if resilience is not None:
+            from .resilience import resilient_verify_kano
+
+            res = resilient_verify_kano(containers, policies, cfg, resilience)
+        else:
+            res = kv.verify_kano(containers, policies, cfg)
         pods = containers
         skipped = []
     else:
@@ -150,7 +231,12 @@ def _run_verify(args) -> int:
                 f"({cfg.opt('dense_reach_limit', 20_000)}); raise --opt "
                 "dense_reach_limit=N or drop --output"
             )
-        res = kv.verify(cluster, cfg)
+        if resilience is not None:
+            from .resilience import resilient_verify
+
+            res = resilient_verify(cluster, cfg, resilience)
+        else:
+            res = kv.verify(cluster, cfg)
         pods = cluster.pods
     iso = res.all_isolated()
     hubs = res.all_reachable()
@@ -186,6 +272,9 @@ def _run_verify(args) -> int:
 
         save_result(res, args.output)
         out["saved"] = args.output
+    violations = bool(out["policy_shadow"]) or bool(out["policy_conflict"])
+    if args.check:
+        out["check"] = "failed" if violations else "passed"
     if args.json:
         print(json.dumps(out))
     else:
@@ -202,7 +291,11 @@ def _run_verify(args) -> int:
             print(f"  {k}: {v * 1e3:.1f} ms")
         if skipped:
             print(f"  skipped {len(skipped)} non-verifiable documents")
-    return 0
+        if args.check and violations:
+            print("  check: FAILED (shadowed/conflicting policies present)")
+    if args.check and violations:
+        return EXIT_VIOLATIONS
+    return EXIT_OK
 
 
 def _mesh_from_opts(opts: dict):
@@ -218,14 +311,13 @@ def _load_incremental(directory: str, mesh=None):
     carrying a frozen-universe ``__meta__`` blob."""
     import os
 
-    import numpy as np
-
     from .utils.persist import (
+        _load_npz,
         load_packed_incremental,
         load_ports_incremental,
     )
 
-    with np.load(os.path.join(directory, "state.npz")) as z:
+    with _load_npz(os.path.join(directory, "state.npz")) as z:
         is_ports = "__meta__" in z.files
     if is_ports:
         return load_ports_incremental(directory, mesh=mesh)
@@ -310,8 +402,13 @@ def cmd_snapshot(args) -> int:
 
 
 def cmd_diff(args) -> int:
-    with _observed(args):
-        return _run_diff(args)
+    from .resilience.errors import KvTpuError
+
+    try:
+        with _observed(args):
+            return _run_diff(args)
+    except KvTpuError as e:
+        return _diagnose(args, e)
 
 
 def _run_diff(args) -> int:

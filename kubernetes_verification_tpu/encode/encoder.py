@@ -24,6 +24,7 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 import numpy as np
 
 from ..backends.base import PortAtom
+from ..resilience.errors import EncodeError
 from ..models.core import (
     Cluster,
     Container,
@@ -220,7 +221,7 @@ class EncodedCluster:
     restrict_bank_intern: Optional["_RestrictBank"] = None
 
 
-class FrozenBankMiss(KeyError):
+class FrozenBankMiss(EncodeError, KeyError):
     """A frozen restriction bank was asked for a new (protocol, name,
     atom) row — the incremental caller must rebuild."""
 

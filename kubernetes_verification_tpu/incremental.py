@@ -35,6 +35,7 @@ from .backends.base import VerifyConfig
 from .models.core import Cluster, NetworkPolicy, Pod
 from .observe import DispatchTracker
 from .observe.metrics import INCREMENTAL_OPS
+from .resilience.retry import RetryPolicy, retry_transient
 
 __all__ = ["IncrementalVerifier"]
 
@@ -90,6 +91,9 @@ class IncrementalVerifier:
     #: engine label on kvtpu_incremental_ops_total et al.; methods the
     #: engines share (namespace bookkeeping below) label per-class via this
     metrics_engine = "dense"
+    #: transient-failure budget around the jitted reach derivation;
+    #: assign a tuned RetryPolicy on the instance to change it
+    retry_policy = RetryPolicy()
 
     def _count_op(self, op: str) -> None:
         INCREMENTAL_OPS.labels(engine=self.metrics_engine, op=op).inc()
@@ -414,13 +418,17 @@ class IncrementalVerifier:
                 ),
             )
             self._reach = np.asarray(
-                _derive_reach(
-                    self._ing_count,
-                    self._eg_count,
-                    jnp.asarray(self._ing_iso, dtype=_I32),
-                    jnp.asarray(self._eg_iso, dtype=_I32),
-                    self_traffic=self.config.self_traffic,
-                    default_allow_unselected=self.config.default_allow_unselected,
+                retry_transient(
+                    lambda: _derive_reach(
+                        self._ing_count,
+                        self._eg_count,
+                        jnp.asarray(self._ing_iso, dtype=_I32),
+                        jnp.asarray(self._eg_iso, dtype=_I32),
+                        self_traffic=self.config.self_traffic,
+                        default_allow_unselected=self.config.default_allow_unselected,
+                    ),
+                    policy=self.retry_policy,
+                    backend=self.metrics_engine,
                 )
             )
             self._derive_time = time.perf_counter() - t0

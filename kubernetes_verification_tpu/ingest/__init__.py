@@ -4,6 +4,8 @@ The reference needed a loadable ``~/.kube/config`` just to *parse* YAML
 (``kubesv/kubesv/parser.py:10``); here ingestion is self-contained.
 """
 from .yaml_io import (
+    IngestError,
+    SkipDiagnostic,
     dump_cluster,
     load_cluster,
     load_kano,
@@ -13,6 +15,8 @@ from .yaml_io import (
 )
 
 __all__ = [
+    "IngestError",
+    "SkipDiagnostic",
     "dump_cluster",
     "load_cluster",
     "load_kano",

@@ -55,6 +55,7 @@ from ..models.core import (
     Rule,
     Selector,
 )
+from ..resilience.errors import IngestError
 
 __all__ = [
     "load_cluster",
@@ -64,12 +65,46 @@ __all__ = [
     "parse_namespace",
     "parse_network_policy",
     "IngestError",
+    "SkipDiagnostic",
 ]
 
 
-class IngestError(ValueError):
-    """Raised on malformed manifests (the reference printed and continued,
-    ``kano_py/kano/parser.py:32-33``)."""
+class SkipDiagnostic(str):
+    """One lenient-mode skip, structured: ``path`` / ``doc_index`` /
+    ``kind`` / ``name`` / ``reason`` attributes, with the str value kept as
+    the historical ``"file: kind/name"`` note so existing consumers (JSON
+    dumps, substring asserts) are untouched."""
+
+    path: str
+    doc_index: int
+    kind: Optional[str]
+    name: Optional[str]
+    reason: str
+
+    def __new__(
+        cls,
+        path: str,
+        doc_index: int,
+        kind: Optional[str],
+        name: Optional[str],
+        reason: str,
+    ) -> "SkipDiagnostic":
+        self = super().__new__(cls, f"{path}: {kind}/{name}")
+        self.path = path
+        self.doc_index = doc_index
+        self.kind = kind
+        self.name = name
+        self.reason = reason
+        return self
+
+    def to_dict(self) -> dict:
+        return {
+            "path": self.path,
+            "doc_index": self.doc_index,
+            "kind": self.kind,
+            "name": self.name,
+            "reason": self.reason,
+        }
 
 
 def _meta(obj: dict) -> dict:
@@ -202,20 +237,27 @@ def parse_namespace(obj: dict) -> Namespace:
     return Namespace(name=_name(obj, "Namespace"), labels=_labels(obj))
 
 
-def _iter_docs(path: str) -> Iterable[Tuple[str, dict]]:
-    """Yield (source_file, document) over a file or a directory walk — the
-    reference's traversal shape (``kano_py/kano/parser.py:17-49``)."""
+def _iter_docs(path: str) -> Iterable[Tuple[str, int, dict]]:
+    """Yield (source_file, doc_index, document) over a file or a directory
+    walk — the reference's traversal shape (``kano_py/kano/parser.py:17-49``).
+    ``doc_index`` counts yielded documents per file (``kind: List`` items
+    each get their own index)."""
     if os.path.isdir(path):
         for root, _dirs, files in sorted(os.walk(path)):
             for fname in sorted(files):
                 if fname.endswith((".yml", ".yaml", ".json")):
                     yield from _iter_docs(os.path.join(root, fname))
         return
-    with open(path, "r") as fh:
+    try:
+        fh = open(path, "r")
+    except OSError as e:
+        raise IngestError(f"{path}: cannot read manifests: {e}") from e
+    with fh:
         try:
             docs = list(yaml.load_all(fh, Loader=_Loader))
         except yaml.YAMLError as e:
             raise IngestError(f"{path}: {e}") from e
+    idx = 0
     for doc in docs:
         if doc is None:
             continue
@@ -223,9 +265,11 @@ def _iter_docs(path: str) -> Iterable[Tuple[str, dict]]:
             raise IngestError(f"{path}: top-level document is not a mapping")
         if doc.get("kind") == "List":
             for item in doc.get("items") or ():
-                yield path, item
+                yield path, idx, item
+                idx += 1
         else:
-            yield path, doc
+            yield path, idx, doc
+            idx += 1
 
 
 def load_cluster(
@@ -233,15 +277,17 @@ def load_cluster(
 ) -> Tuple[Cluster, List[str]]:
     """Parse every manifest under ``path`` into a :class:`Cluster`.
 
-    Returns ``(cluster, skipped)`` where ``skipped`` lists
-    ``"file: kind/name"`` for documents of kinds the verifier doesn't consume.
-    ``strict=True`` raises on them instead.
+    Returns ``(cluster, skipped)`` where ``skipped`` lists a
+    :class:`SkipDiagnostic` (str-compatible ``"file: kind/name"``, plus
+    structured ``path``/``doc_index``/``kind``/``name``/``reason``) per
+    document of a kind the verifier doesn't consume. ``strict=True`` raises
+    on them instead.
     """
     pods: List[Pod] = []
     namespaces: List[Namespace] = []
     policies: List[NetworkPolicy] = []
-    skipped: List[str] = []
-    for src, doc in _iter_docs(os.fspath(path)):
+    skipped: List[SkipDiagnostic] = []
+    for src, idx, doc in _iter_docs(os.fspath(path)):
         kind = doc.get("kind")
         if kind == "Pod":
             pods.append(parse_pod(doc))
@@ -250,10 +296,19 @@ def load_cluster(
         elif kind == "NetworkPolicy":
             policies.append(parse_network_policy(doc))
         else:
-            note = f"{src}: {kind}/{_meta(doc).get('name')}"
+            diag = SkipDiagnostic(
+                path=src,
+                doc_index=idx,
+                kind=None if kind is None else str(kind),
+                name=_meta(doc).get("name"),
+                reason=(
+                    "document has no kind" if kind is None
+                    else f"kind {kind} is not verifiable"
+                ),
+            )
             if strict:
-                raise IngestError(f"unsupported kind: {note}")
-            skipped.append(note)
+                raise IngestError(f"unsupported kind: {diag}")
+            skipped.append(diag)
     return Cluster(pods=pods, namespaces=namespaces, policies=policies), skipped
 
 
@@ -269,7 +324,7 @@ def load_kano(
     ingress/egress rule (``kano_py/kano/parser.py:51-89``)."""
     containers: List[Container] = []
     policies: List[KanoPolicy] = []
-    for _src, doc in _iter_docs(os.fspath(path)):
+    for _src, _idx, doc in _iter_docs(os.fspath(path)):
         kind = doc.get("kind")
         if kind == "Pod":
             labels = _labels(doc)

@@ -26,6 +26,10 @@ __all__ = [
     "JIT_RECOMPILES",
     "KERNEL_INVOCATIONS",
     "KERNEL_TILES",
+    "RETRIES_TOTAL",
+    "FALLBACKS_TOTAL",
+    "FAULTS_INJECTED_TOTAL",
+    "DEGRADATIONS_TOTAL",
 ]
 
 SPAN_SECONDS = Histogram(
@@ -105,4 +109,31 @@ KERNEL_TILES = Counter(
     "kvtpu_kernel_tiles_total",
     "Destination tiles/stripes processed by tiled_k8s_reach, by kernel.",
     ("kernel",),
+)
+
+RETRIES_TOTAL = Counter(
+    "kvtpu_retries_total",
+    "Solve attempts retried on a transient BackendError, by backend/engine "
+    "and failure kind (oom, timeout, flaky, ...).",
+    ("backend", "kind"),
+)
+
+FALLBACKS_TOTAL = Counter(
+    "kvtpu_fallbacks_total",
+    "Fallback-chain hops: a backend was abandoned and the next one tried.",
+    ("from_backend", "to_backend"),
+)
+
+FAULTS_INJECTED_TOTAL = Counter(
+    "kvtpu_faults_injected_total",
+    "Faults injected by the resilience.faults harness (faulty:* backends), "
+    "by wrapped backend and fault kind.",
+    ("backend", "kind"),
+)
+
+DEGRADATIONS_TOTAL = Counter(
+    "kvtpu_degradations_total",
+    "Adaptive tile-size halvings applied after RESOURCE_EXHAUSTED before "
+    "falling back to the next backend.",
+    ("backend",),
 )

@@ -80,6 +80,7 @@ from .encode.ports import ALL_ATOM
 from .models.core import Cluster, Namespace, NetworkPolicy, Pod
 from .observe import DispatchTracker
 from .observe.metrics import INCREMENTAL_OPS, STRIPE_WIDTH, STRIPES_SOLVED
+from .resilience.retry import RetryPolicy, retry_transient
 from .ops.tiled import (
     PackedReach,
     _peers_by_slot,
@@ -865,6 +866,9 @@ class PackedIncrementalVerifier:
     #: engine label on kvtpu_incremental_ops_total et al.; the namespace
     #: methods the dense engine borrows from this class label per-class
     metrics_engine = "packed"
+    #: transient-failure budget around jitted dispatches (stripe re-solves);
+    #: assign a tuned RetryPolicy on the instance to change it
+    retry_policy = RetryPolicy()
 
     def _count_op(self, op: str) -> None:
         INCREMENTAL_OPS.labels(engine=self.metrics_engine, op=op).inc()
@@ -1763,13 +1767,17 @@ class PackedIncrementalVerifier:
             "_stripe_step", self._maps,
             static=(width,) + tuple(sorted(self._flags.items())),
         )
-        out = _stripe_step(
-            *self._maps,
-            self._col_mask,
-            self._row_valid,
-            np.int32(d0),
-            width=width,
-            **self._flags,
+        out = retry_transient(
+            lambda: _stripe_step(
+                *self._maps,
+                self._col_mask,
+                self._row_valid,
+                np.int32(d0),
+                width=width,
+                **self._flags,
+            ),
+            policy=self.retry_policy,
+            backend=self.metrics_engine,
         )
         return np.asarray(out[: self.n_pods])
 

@@ -81,6 +81,7 @@ from .ops.tiled import (
 )
 from .observe import DispatchTracker
 from .observe.metrics import INCREMENTAL_OPS
+from .resilience.retry import RetryPolicy, retry_transient
 from .packed_incremental import (
     PackedIncrementalVerifier,
     PolicyVectorizer,
@@ -497,6 +498,9 @@ class PackedPortsIncrementalVerifier:
     #: engine label on kvtpu_incremental_ops_total et al. — also used by
     #: the namespace methods borrowed from the any-port engine
     metrics_engine = "packed-ports"
+    #: transient-failure budget around jitted dispatches (pod-slot updates);
+    #: assign a tuned RetryPolicy on the instance to change it
+    retry_policy = RetryPolicy()
 
     def _count_op(self, op: str) -> None:
         INCREMENTAL_OPS.labels(engine=self.metrics_engine, op=op).inc()
@@ -1322,13 +1326,17 @@ class PackedPortsIncrementalVerifier:
             "_ports_pod_step", self._packed, self._operands,
             static=tuple(sorted(self._flags.items())),
         )
-        out = _ports_pod_step(
-            self._packed, *self._operands, self._ing_cnt, self._eg_cnt,
-            self._col_mask, self._row_valid,
-            np.int32(idx), self._put(ci, "rep"), self._put(ce, "rep"),
-            np.int32(cnt_i), np.int32(cnt_e),
-            np.uint32(1 if active else 0),
-            layout=self._layout, **self._flags,
+        out = retry_transient(
+            lambda: _ports_pod_step(
+                self._packed, *self._operands, self._ing_cnt, self._eg_cnt,
+                self._col_mask, self._row_valid,
+                np.int32(idx), self._put(ci, "rep"), self._put(ce, "rep"),
+                np.int32(cnt_i), np.int32(cnt_e),
+                np.uint32(1 if active else 0),
+                layout=self._layout, **self._flags,
+            ),
+            policy=self.retry_policy,
+            backend=self.metrics_engine,
         )
         (
             self._packed, self._vp_peers_i, self._sel_ing_vp,

@@ -1,0 +1,93 @@
+"""Resilience for kubernetes-verification-tpu: typed errors, retries,
+fallback chains, watchdogs, OOM degradation and fault injection.
+
+* ``errors``  — the :class:`KvTpuError` taxonomy + the CLI exit-code
+  contract (dependency-free; every other layer imports it).
+* ``retry``   — :class:`RetryPolicy` / :func:`retry_transient`, the
+  bounded-backoff primitive the incremental engines wrap their jitted
+  dispatches in.
+* ``wrapper`` — :func:`resilient_verify` / :func:`resilient_verify_kano`:
+  the fallback-chain / watchdog / adaptive-degradation driver.
+* ``faults``  — the deterministic ``faulty:<backend>`` injection harness.
+
+Only ``errors`` is imported eagerly: modules like ``backends.base`` and
+``ingest.yaml_io`` import taxonomy classes from here *while they are
+themselves being imported by* ``wrapper``/``faults`` — the lazy attribute
+hook below keeps that edge acyclic.
+"""
+from __future__ import annotations
+
+from .errors import (  # noqa: F401  (re-exported)
+    EXIT_BACKEND_FAILED,
+    EXIT_INPUT_ERROR,
+    EXIT_OK,
+    EXIT_VIOLATIONS,
+    BackendChainExhausted,
+    BackendError,
+    BackendOOM,
+    BackendTimeout,
+    ConfigError,
+    DeviceLost,
+    EncodeError,
+    IngestError,
+    KvTpuError,
+    PersistError,
+    UnknownBackendError,
+    classify_exception,
+    exit_code_for,
+)
+
+__all__ = [
+    "KvTpuError",
+    "IngestError",
+    "PersistError",
+    "EncodeError",
+    "ConfigError",
+    "BackendError",
+    "BackendOOM",
+    "BackendTimeout",
+    "DeviceLost",
+    "UnknownBackendError",
+    "BackendChainExhausted",
+    "classify_exception",
+    "exit_code_for",
+    "EXIT_OK",
+    "EXIT_VIOLATIONS",
+    "EXIT_INPUT_ERROR",
+    "EXIT_BACKEND_FAILED",
+    # lazy (see __getattr__):
+    "RetryPolicy",
+    "retry_transient",
+    "ResilienceConfig",
+    "resilient_verify",
+    "resilient_verify_kano",
+    "FaultRule",
+    "FaultInjector",
+    "FaultyBackend",
+    "parse_fault_spec",
+    "register_faulty",
+    "FAULT_KINDS",
+]
+
+_LAZY = {
+    "RetryPolicy": "retry",
+    "retry_transient": "retry",
+    "ResilienceConfig": "wrapper",
+    "resilient_verify": "wrapper",
+    "resilient_verify_kano": "wrapper",
+    "FaultRule": "faults",
+    "FaultInjector": "faults",
+    "FaultyBackend": "faults",
+    "parse_fault_spec": "faults",
+    "register_faulty": "faults",
+    "FAULT_KINDS": "faults",
+}
+
+
+def __getattr__(name: str):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(f".{mod}", __name__), name)

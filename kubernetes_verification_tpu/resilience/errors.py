@@ -1,0 +1,226 @@
+"""The typed error taxonomy, rooted at :class:`KvTpuError`.
+
+Every layer of the stack raises these instead of bare ``ValueError`` /
+``RuntimeError`` (linted by ``scripts/check_error_taxonomy.py``), so callers
+— the CLI's exit-code contract, the fallback chain in
+``resilience.wrapper``, a serving loop's error budget — can dispatch on
+*what failed* without string-matching tracebacks:
+
+* :class:`IngestError`   — malformed manifests (parse layer);
+* :class:`PersistError`  — corrupt / truncated / mismatched checkpoints;
+* :class:`EncodeError`   — model objects the tensorizer cannot encode;
+* :class:`ConfigError`   — invalid flag / option combinations;
+* :class:`BackendError`  — a solve attempt failed. Carries ``transient``
+  (retry the same backend may succeed), ``kind`` (``oom`` / ``timeout`` /
+  ``device_loss`` / ``flaky`` / ``error``) and ``backend``.
+
+Each taxonomy class also subclasses the builtin its call sites historically
+raised (``ValueError`` / ``KeyError``), so pre-taxonomy ``except`` clauses
+keep working — the re-parent widens the surface, it never narrows it.
+
+``classify_exception`` maps raw XLA/JAX runtime errors onto the taxonomy by
+their gRPC-style status markers (``RESOURCE_EXHAUSTED``,
+``DEADLINE_EXCEEDED``, ...) — the production-TPU reality that preemption,
+OOM and device loss are routine, not exceptional (PAPERS.md: the
+distributed-linear-algebra and CFD TPU stacks both degrade-and-continue).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+__all__ = [
+    "KvTpuError",
+    "IngestError",
+    "PersistError",
+    "EncodeError",
+    "ConfigError",
+    "BackendError",
+    "BackendOOM",
+    "BackendTimeout",
+    "DeviceLost",
+    "UnknownBackendError",
+    "BackendChainExhausted",
+    "classify_exception",
+    "exit_code_for",
+    "EXIT_OK",
+    "EXIT_VIOLATIONS",
+    "EXIT_INPUT_ERROR",
+    "EXIT_BACKEND_FAILED",
+]
+
+#: The CLI exit-code contract (README "Resilience"): scripts and operators
+#: branch on these, so they are part of the stable interface.
+EXIT_OK = 0  #: verified, no requested invariant violated
+EXIT_VIOLATIONS = 1  #: verified, but --check found violations
+EXIT_INPUT_ERROR = 2  #: bad manifests / checkpoint / flags (IngestError, ...)
+EXIT_BACKEND_FAILED = 3  #: every backend in the fallback chain failed
+
+
+class KvTpuError(Exception):
+    """Root of the kubernetes-verification-tpu error taxonomy."""
+
+
+class IngestError(KvTpuError, ValueError):
+    """Malformed manifests (the reference printed and continued,
+    ``kano_py/kano/parser.py:32-33``; here the parse layer raises typed)."""
+
+
+class PersistError(KvTpuError, ValueError):
+    """A checkpoint/artifact failed to load or verify: truncated file,
+    corrupt array, sha256 mismatch, or semantic-config mismatch. ``path``
+    names the offending artifact."""
+
+    def __init__(self, message: str, *, path: Optional[str] = None) -> None:
+        super().__init__(message)
+        self.path = path
+
+
+class EncodeError(KvTpuError, ValueError):
+    """The tensorizer cannot encode the model objects (e.g. a named-port
+    restriction outside a frozen bank)."""
+
+
+class ConfigError(KvTpuError, ValueError):
+    """Invalid configuration: flag combinations, backend options, mesh
+    shapes — errors the caller fixes by changing inputs, not by retrying."""
+
+
+class BackendError(KvTpuError, RuntimeError):
+    """A solve attempt failed on ``backend``. ``transient=True`` means the
+    same backend may succeed on retry (flaky dispatch, preemption);
+    ``transient=False`` sends the fallback chain to the next backend."""
+
+    kind: str = "error"
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        backend: Optional[str] = None,
+        kind: Optional[str] = None,
+        transient: bool = False,
+    ) -> None:
+        super().__init__(message)
+        self.backend = backend
+        if kind is not None:
+            self.kind = kind
+        self.transient = transient
+
+
+class BackendOOM(BackendError):
+    """Device memory exhausted (XLA ``RESOURCE_EXHAUSTED``). Transient in
+    the adaptive sense: the resilient wrapper halves the tile size and
+    retries before giving up on the backend."""
+
+    kind = "oom"
+
+    def __init__(self, message: str, *, backend: Optional[str] = None) -> None:
+        super().__init__(message, backend=backend, transient=True)
+
+
+class BackendTimeout(BackendError):
+    """The per-attempt watchdog fired (or XLA reported
+    ``DEADLINE_EXCEEDED``): the solve is presumed hung, not wrong."""
+
+    kind = "timeout"
+
+    def __init__(self, message: str, *, backend: Optional[str] = None) -> None:
+        super().__init__(message, backend=backend, transient=True)
+
+
+class DeviceLost(BackendError):
+    """The accelerator went away (preemption, reset, ICI failure).
+    Non-transient for this backend — retrying the same dead device wastes
+    the error budget; the chain falls back instead."""
+
+    kind = "device_loss"
+
+    def __init__(self, message: str, *, backend: Optional[str] = None) -> None:
+        super().__init__(message, backend=backend, transient=False)
+
+
+class UnknownBackendError(BackendError, KeyError):
+    """Requested backend is not registered (also a ``KeyError`` — the
+    registry's historical type)."""
+
+    kind = "unknown_backend"
+
+    def __init__(self, message: str, *, backend: Optional[str] = None) -> None:
+        super().__init__(message, backend=backend, transient=False)
+
+
+class BackendChainExhausted(BackendError):
+    """Every backend in the fallback chain failed. ``failures`` lists
+    ``(backend, BackendError)`` in attempt order — the post-mortem."""
+
+    kind = "chain_exhausted"
+
+    def __init__(
+        self, chain: Tuple[str, ...], failures: List[Tuple[str, "BackendError"]]
+    ) -> None:
+        detail = "; ".join(
+            f"{b}: [{e.kind}] {e}" for b, e in failures
+        )
+        super().__init__(
+            f"all backends in chain {list(chain)} failed: {detail}",
+            transient=False,
+        )
+        self.chain = tuple(chain)
+        self.failures = list(failures)
+
+
+#: substring → taxonomy class, checked in order. XLA surfaces gRPC status
+#: names inside RuntimeError/XlaRuntimeError messages; jax has no stable
+#: exception hierarchy for them, so message markers are the only portable
+#: classification key.
+_MESSAGE_MARKERS = (
+    ("RESOURCE_EXHAUSTED", BackendOOM),
+    ("out of memory", BackendOOM),
+    ("Out of memory", BackendOOM),
+    ("DEADLINE_EXCEEDED", BackendTimeout),
+    ("deadline exceeded", BackendTimeout),
+    ("DATA_LOSS", DeviceLost),
+    ("device is lost", DeviceLost),
+    ("Device lost", DeviceLost),
+    ("device halted", DeviceLost),
+)
+
+#: markers for generically transient conditions (retry same backend)
+_TRANSIENT_MARKERS = ("UNAVAILABLE", "ABORTED", "CANCELLED", "try again")
+
+
+def classify_exception(
+    exc: BaseException, backend: Optional[str] = None
+) -> BackendError:
+    """Map an arbitrary solve-time exception onto the taxonomy.
+
+    Already-typed :class:`BackendError`\\ s pass through (with ``backend``
+    filled in when missing); raw XLA/JAX errors classify by message marker;
+    anything else becomes a non-transient :class:`BackendError` so the
+    fallback chain still gets a chance before the run dies.
+    """
+    if isinstance(exc, BackendError):
+        if exc.backend is None:
+            exc.backend = backend
+        return exc
+    msg = str(exc)
+    for marker, cls in _MESSAGE_MARKERS:
+        if marker in msg:
+            err = cls(msg, backend=backend)
+            err.__cause__ = exc
+            return err
+    transient = any(m in msg for m in _TRANSIENT_MARKERS)
+    err = BackendError(
+        f"{type(exc).__name__}: {msg}", backend=backend, transient=transient
+    )
+    err.__cause__ = exc
+    return err
+
+
+def exit_code_for(exc: BaseException) -> int:
+    """The CLI exit-code contract for an exception that escaped a command."""
+    if isinstance(exc, BackendError):
+        return EXIT_BACKEND_FAILED
+    if isinstance(exc, KvTpuError):
+        return EXIT_INPUT_ERROR
+    raise TypeError(f"not a KvTpuError: {type(exc).__name__}")

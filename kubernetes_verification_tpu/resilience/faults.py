@@ -1,0 +1,211 @@
+"""Deterministic fault injection: ``faulty:<name>`` backends.
+
+``register_faulty("tpu", parse_fault_spec("device_loss"))`` registers a
+``faulty:tpu`` backend that delegates to the real ``tpu`` backend but
+raises (or hangs) on a seeded, reproducible schedule — so the whole
+resilience stack (fallback chain, retries, watchdog, OOM degradation) is
+exercisable in tier-1 under ``JAX_PLATFORMS=cpu``, no broken hardware
+required. The injector object lives in the registry closure, so its call
+counter survives across ``get_backend`` instantiations: ``flaky@0`` means
+"the first verify call through this registration fails", not "every fresh
+instance fails once".
+
+Fault spec grammar (comma list; also the CLI's ``--inject-faults`` value):
+
+* ``KIND``      — inject on every call (``device_loss`` → dead backend);
+* ``KIND@N``    — inject on call index ``N`` only (``flaky@0`` → fails
+  once, the retry succeeds);
+* ``oom>T``     — inject OOM while the attempt's ``tile`` option (default
+  2048) is above ``T`` — exercises adaptive degradation: the wrapper
+  halves the tile until the injector relents;
+* ``KIND%P``    — inject with probability ``P`` per call, drawn from a
+  ``seed``-initialised PRNG (deterministic across runs).
+
+Kinds: ``oom``, ``timeout`` (a simulated hang of ``hang_seconds`` — pair
+with a watchdog), ``device_loss``, ``flaky`` (generic transient).
+
+Every injection increments ``kvtpu_faults_injected_total{backend,kind}``.
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass
+from typing import List, Optional, Sequence
+
+from ..observe.metrics import FAULTS_INJECTED_TOTAL
+from .errors import (
+    BackendError,
+    BackendOOM,
+    ConfigError,
+    DeviceLost,
+)
+
+__all__ = [
+    "FAULT_KINDS",
+    "FaultRule",
+    "FaultInjector",
+    "FaultyBackend",
+    "parse_fault_spec",
+    "register_faulty",
+]
+
+FAULT_KINDS = ("oom", "timeout", "device_loss", "flaky")
+
+#: tile assumed when an ``oom>T`` rule fires against a config carrying no
+#: explicit ``tile`` option — matches ResilienceConfig.initial_tile
+_DEFAULT_TILE = 2048
+
+
+@dataclass(frozen=True)
+class FaultRule:
+    """One injection rule; exactly one trigger dimension is set (or none,
+    meaning "every call")."""
+
+    kind: str
+    at_call: Optional[int] = None
+    while_tile_above: Optional[int] = None
+    prob: Optional[float] = None
+
+    def __post_init__(self) -> None:
+        if self.kind not in FAULT_KINDS:
+            raise ConfigError(
+                f"unknown fault kind {self.kind!r}; have {FAULT_KINDS}"
+            )
+        if self.while_tile_above is not None and self.kind != "oom":
+            raise ConfigError("'>' (tile relief) only applies to oom faults")
+
+
+def parse_fault_spec(spec: str) -> List[FaultRule]:
+    """Parse the ``KIND[@N|>T|%P]`` comma grammar (module docstring)."""
+    rules = []
+    for token in spec.split(","):
+        token = token.strip()
+        if not token:
+            continue
+        for sep, field in (("@", "at_call"), (">", "while_tile_above"), ("%", "prob")):
+            if sep in token:
+                kind, _, raw = token.partition(sep)
+                try:
+                    val = float(raw) if sep == "%" else int(raw)
+                except ValueError:
+                    raise ConfigError(
+                        f"fault spec {token!r}: {raw!r} is not a number"
+                    ) from None
+                rules.append(FaultRule(kind=kind, **{field: val}))
+                break
+        else:
+            rules.append(FaultRule(kind=token))
+    if not rules:
+        raise ConfigError(f"empty fault spec {spec!r}")
+    return rules
+
+
+class FaultInjector:
+    """Seeded, thread-safe fault schedule shared by every instance of one
+    ``faulty:*`` registration."""
+
+    def __init__(self, rules: Sequence[FaultRule], seed: int = 0) -> None:
+        self.rules = list(rules)
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self.calls = 0
+
+    def next_fault(self, config) -> Optional[str]:
+        """Advance the call counter and return the fault kind to inject on
+        this call, or None."""
+        with self._lock:
+            idx = self.calls
+            self.calls += 1
+            for rule in self.rules:
+                if rule.at_call is not None:
+                    if rule.at_call == idx:
+                        return rule.kind
+                elif rule.while_tile_above is not None:
+                    tile = dict(config.backend_options).get(
+                        "tile", _DEFAULT_TILE
+                    )
+                    if isinstance(tile, int) and tile > rule.while_tile_above:
+                        return rule.kind
+                elif rule.prob is not None:
+                    if self._rng.random() < rule.prob:
+                        return rule.kind
+                else:
+                    return rule.kind
+        return None
+
+
+class FaultyBackend:
+    """A :class:`~..backends.base.VerifierBackend` decorator that injects
+    the schedule's fault before delegating to the wrapped backend."""
+
+    def __init__(
+        self,
+        inner,
+        injector: FaultInjector,
+        *,
+        hang_seconds: float = 0.25,
+        sleep=time.sleep,
+    ) -> None:
+        self.inner = inner
+        self.injector = injector
+        self.hang_seconds = hang_seconds
+        self._sleep = sleep
+        self.name = f"faulty:{inner.name}"
+        self.supports_label_relation = inner.supports_label_relation
+
+    def _inject(self, config) -> None:
+        kind = self.injector.next_fault(config)
+        if kind is None:
+            return
+        FAULTS_INJECTED_TOTAL.labels(backend=self.name, kind=kind).inc()
+        if kind == "oom":
+            raise BackendOOM(
+                "injected RESOURCE_EXHAUSTED: out of memory while "
+                "allocating reach tiles",
+                backend=self.name,
+            )
+        if kind == "device_loss":
+            raise DeviceLost("injected device loss", backend=self.name)
+        if kind == "flaky":
+            raise BackendError(
+                "injected flaky dispatch", backend=self.name,
+                kind="flaky", transient=True,
+            )
+        # kind == "timeout": a simulated hang, not an exception — the
+        # caller's watchdog is what should notice. Without a watchdog this
+        # is just added latency.
+        self._sleep(self.hang_seconds)
+
+    def verify(self, cluster, config):
+        self._inject(config)
+        return self.inner.verify(cluster, config)
+
+    def verify_kano(self, containers, policies, config):
+        self._inject(config)
+        return self.inner.verify_kano(containers, policies, config)
+
+
+def register_faulty(
+    inner_name: str,
+    rules: Sequence[FaultRule],
+    *,
+    seed: int = 0,
+    hang_seconds: float = 0.25,
+) -> str:
+    """Register ``faulty:<inner_name>`` wrapping the already-registered
+    ``inner_name`` backend with a fresh :class:`FaultInjector`; returns the
+    new backend name. Re-registering replaces the previous schedule."""
+    from ..backends.base import get_backend, register_backend
+
+    get_backend(inner_name)  # fail fast on unknown inner backends
+    injector = FaultInjector(rules, seed=seed)
+    name = f"faulty:{inner_name}"
+    register_backend(
+        name,
+        lambda: FaultyBackend(
+            get_backend(inner_name), injector, hang_seconds=hang_seconds
+        ),
+    )
+    return name

@@ -1,0 +1,223 @@
+"""``resilient_verify`` — the fault-tolerant front door to the backend
+registry.
+
+Production TPU serving treats OOM, preemption and device loss as routine
+(PAPERS.md: the distributed-linear-algebra pods only scale because chip
+faults are tolerated; the CFD framework degrades tile sizes under memory
+pressure). This wrapper gives the verifier the same posture around
+``backends.base.verify``:
+
+* **fallback chain** — an ordered backend list (``tpu → sharded → cpu``);
+  when one backend fails non-transiently, the next is tried. The chain
+  exhausting raises :class:`~.errors.BackendChainExhausted` (CLI exit 3).
+* **bounded retry** — transient :class:`~.errors.BackendError`\\ s retry the
+  *same* backend with exponential backoff + deterministic jitter
+  (:class:`~.retry.RetryPolicy`).
+* **watchdog** — each solve attempt runs under a wall-clock timeout; a hung
+  attempt is abandoned (the worker thread is orphaned — XLA dispatches are
+  not cancellable) and surfaces as a transient
+  :class:`~.errors.BackendTimeout`.
+* **adaptive OOM degradation** — ``RESOURCE_EXHAUSTED`` halves the ``tile``
+  backend option and re-attempts, down to ``min_tile``, before the chain
+  falls back. Halvings don't consume the retry budget: a smaller tile is
+  progress, not repetition.
+
+Every decision is visible through the PR 1 registry:
+``kvtpu_retries_total``, ``kvtpu_fallbacks_total``,
+``kvtpu_degradations_total`` (and ``kvtpu_faults_injected_total`` from the
+injection harness in ``resilience.faults``).
+"""
+from __future__ import annotations
+
+import time
+from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import TimeoutError as _FuturesTimeout
+from dataclasses import dataclass, replace
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from ..observe import log_event
+from ..observe.metrics import DEGRADATIONS_TOTAL, FALLBACKS_TOTAL, RETRIES_TOTAL
+from .errors import (
+    BackendChainExhausted,
+    BackendError,
+    BackendOOM,
+    BackendTimeout,
+    ConfigError,
+    classify_exception,
+)
+from .retry import RetryPolicy
+
+__all__ = ["ResilienceConfig", "resilient_verify", "resilient_verify_kano"]
+
+
+@dataclass(frozen=True)
+class ResilienceConfig:
+    """The resilient wrapper's knobs (CLI: ``--fallback-chain``,
+    ``--max-retries``, ``--solve-timeout``)."""
+
+    #: ordered backends to try; () means "just the VerifyConfig's backend"
+    fallback_chain: Tuple[str, ...] = ()
+    max_retries: int = 2
+    backoff_base: float = 0.05
+    backoff_max: float = 2.0
+    jitter: float = 0.1
+    seed: int = 0
+    #: wall-clock seconds per solve attempt; None disables the watchdog
+    solve_timeout: Optional[float] = None
+    #: halve the ``tile`` backend option on RESOURCE_EXHAUSTED
+    degrade_on_oom: bool = True
+    #: starting tile when the config carries none and an OOM asks for a halving
+    initial_tile: int = 2048
+    min_tile: int = 128
+
+    def retry_policy(self) -> RetryPolicy:
+        return RetryPolicy(
+            max_retries=self.max_retries,
+            backoff_base=self.backoff_base,
+            backoff_max=self.backoff_max,
+            jitter=self.jitter,
+            seed=self.seed,
+        )
+
+
+def _with_opt(config, key: str, value) -> "object":
+    """A copy of ``config`` with backend option ``key`` set to ``value``."""
+    opts = [(k, v) for k, v in config.backend_options if k != key]
+    opts.append((key, value))
+    return replace(config, backend_options=tuple(opts))
+
+
+def _run_with_watchdog(
+    fn: Callable[[], object], timeout: Optional[float], backend: str
+):
+    """Run one solve attempt, bounded by ``timeout`` seconds.
+
+    The attempt runs on a single-use worker thread; on timeout the thread
+    is abandoned (never joined — a hung XLA dispatch cannot be cancelled
+    from Python) and :class:`BackendTimeout` is raised so the caller can
+    retry or fall back. A fresh executor per attempt keeps an orphaned
+    hang from serializing later attempts behind it.
+    """
+    if timeout is None:
+        return fn()
+    ex = ThreadPoolExecutor(max_workers=1, thread_name_prefix=f"kvtpu-{backend}")
+    try:
+        fut = ex.submit(fn)
+        try:
+            return fut.result(timeout=timeout)
+        except _FuturesTimeout:
+            fut.cancel()
+            raise BackendTimeout(
+                f"watchdog: solve on {backend!r} exceeded {timeout}s",
+                backend=backend,
+            ) from None
+    finally:
+        ex.shutdown(wait=False)
+
+
+def _resilient_call(
+    run_one: Callable[[object], object],
+    config,
+    res: ResilienceConfig,
+    sleep: Callable[[float], None],
+):
+    """The shared chain/retry/degrade driver behind both public wrappers.
+
+    ``run_one(cfg)`` performs a single dispatch with ``cfg.backend`` /
+    ``cfg.backend_options`` already set for the attempt.
+    """
+    chain: Tuple[str, ...] = res.fallback_chain or (config.backend,)
+    if not chain:
+        raise ConfigError("fallback chain is empty")
+    failures: List[Tuple[str, BackendError]] = []
+    for pos, backend in enumerate(chain):
+        cfg = replace(config, backend=backend)
+        delays = res.retry_policy().delays()
+        err: Optional[BackendError] = None
+        while True:
+            try:
+                return _run_with_watchdog(
+                    lambda: run_one(cfg), res.solve_timeout, backend
+                )
+            except Exception as e:  # noqa: BLE001 — the classification point
+                err = classify_exception(e, backend)
+            # -- adaptive OOM degradation: halve the tile, try again -------
+            if (
+                isinstance(err, BackendOOM)
+                and res.degrade_on_oom
+            ):
+                tile = dict(cfg.backend_options).get("tile", res.initial_tile)
+                if isinstance(tile, int) and tile // 2 >= res.min_tile:
+                    cfg = _with_opt(cfg, "tile", tile // 2)
+                    DEGRADATIONS_TOTAL.labels(backend=backend).inc()
+                    log_event(
+                        "degrade", backend=backend, tile=tile // 2,
+                        reason="oom",
+                    )
+                    continue
+            # -- bounded transient retry on the same backend ---------------
+            if err.transient:
+                try:
+                    delay = next(delays)
+                except StopIteration:
+                    delay = None
+                if delay is not None:
+                    RETRIES_TOTAL.labels(backend=backend, kind=err.kind).inc()
+                    log_event(
+                        "retry", backend=backend, kind=err.kind,
+                        delay_seconds=round(delay, 4),
+                    )
+                    sleep(delay)
+                    continue
+            # -- give up on this backend: fall through the chain -----------
+            failures.append((backend, err))
+            if pos + 1 < len(chain):
+                FALLBACKS_TOTAL.labels(
+                    from_backend=backend, to_backend=chain[pos + 1]
+                ).inc()
+                log_event(
+                    "fallback", from_backend=backend,
+                    to_backend=chain[pos + 1], kind=err.kind,
+                )
+            break
+    raise BackendChainExhausted(chain, failures)
+
+
+def resilient_verify(
+    cluster,
+    config=None,
+    resilience: Optional[ResilienceConfig] = None,
+    *,
+    sleep: Callable[[float], None] = time.sleep,
+):
+    """:func:`backends.base.verify` behind the fallback chain / retry /
+    watchdog / degradation driver. ``sleep`` is injectable so tests run the
+    full backoff schedule in zero wall-clock time."""
+    from ..backends import base
+
+    config = config or base.VerifyConfig()
+    res = resilience or ResilienceConfig()
+    return _resilient_call(
+        lambda cfg: base.verify(cluster, cfg), config, res, sleep
+    )
+
+
+def resilient_verify_kano(
+    containers: Sequence,
+    policies: Sequence,
+    config=None,
+    resilience: Optional[ResilienceConfig] = None,
+    *,
+    sleep: Callable[[float], None] = time.sleep,
+):
+    """:func:`backends.base.verify_kano` behind the same driver."""
+    from ..backends import base
+
+    config = config or base.VerifyConfig()
+    res = resilience or ResilienceConfig()
+    return _resilient_call(
+        lambda cfg: base.verify_kano(containers, policies, cfg),
+        config,
+        res,
+        sleep,
+    )

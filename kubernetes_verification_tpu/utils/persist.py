@@ -19,15 +19,20 @@ persistence is first-class:
 """
 from __future__ import annotations
 
+import contextlib
+import hashlib
 import json
 import os
-from typing import Optional
+import zipfile
+from typing import Dict, Iterator, Optional
 
 import numpy as np
 
 from ..backends.base import PortAtom, VerifyConfig, VerifyResult
+from ..resilience.errors import PersistError
 
 __all__ = [
+    "PersistError",
     "save_result",
     "load_result",
     "save_packed",
@@ -56,13 +61,19 @@ def _config_json(cfg: VerifyConfig) -> str:
     )
 
 
-def _check_saved_config(saved: dict, config: Optional[VerifyConfig], where: str) -> VerifyConfig:
+def _check_saved_config(
+    saved: dict,
+    config: Optional[VerifyConfig],
+    where: str,
+    path: Optional[str] = None,
+) -> VerifyConfig:
     missing = [k for k in _SEMANTIC_KEYS if k not in saved]
     if missing:
-        raise ValueError(
+        raise PersistError(
             f"{where}: checkpoint lacks semantic config keys {missing} — "
             "written by an incompatible framework version; re-verify from "
-            "scratch instead of resuming"
+            "scratch instead of resuming",
+            path=path,
         )
     if config is None:
         return VerifyConfig(
@@ -75,12 +86,119 @@ def _check_saved_config(saved: dict, config: Optional[VerifyConfig], where: str)
         if getattr(config, k) != saved[k]
     }
     if mismatched:
-        raise ValueError(
+        raise PersistError(
             f"{where}: config overrides the checkpointed semantic flags "
             f"{mismatched}; resume with matching flags or re-verify from "
-            "scratch"
+            "scratch",
+            path=path,
         )
     return config
+
+
+# ------------------------------------------------------------- checksums
+#: JSON envelope key carrying per-array sha256 digests inside every .npz
+_CHECKSUM_KEY = "__checksums__"
+
+
+def _digest(arr: np.ndarray) -> str:
+    """sha256 over dtype + shape + bytes — a dtype/shape flip with identical
+    raw bytes must not verify."""
+    a = np.ascontiguousarray(arr)
+    h = hashlib.sha256()
+    h.update(f"{a.dtype.str}|{a.shape}|".encode())
+    h.update(a.tobytes())
+    return h.hexdigest()
+
+
+def _savez(path: str, **arrays: np.ndarray) -> None:
+    """``np.savez_compressed`` with a ``__checksums__`` JSON envelope:
+    ``{array name: sha256}`` for every member, so a truncated write or
+    bit-rotted artifact is caught at load instead of surfacing as a shape
+    error three layers later."""
+    sums = {k: _digest(np.asarray(v)) for k, v in arrays.items()}
+    np.savez_compressed(
+        path,
+        **arrays,
+        **{
+            _CHECKSUM_KEY: np.frombuffer(
+                json.dumps(sums).encode(), dtype=np.uint8
+            )
+        },
+    )
+
+
+@contextlib.contextmanager
+def _load_npz(path: str) -> Iterator["np.lib.npyio.NpzFile"]:
+    """``np.load`` that raises :class:`PersistError` (with the offending
+    path) on unreadable/truncated files and on checksum mismatches, instead
+    of leaking raw ``zipfile``/``json``/``KeyError`` tracebacks. Artifacts
+    written before the checksum envelope existed load unverified."""
+    try:
+        z = np.load(path)
+    except FileNotFoundError:
+        raise
+    except (zipfile.BadZipFile, OSError, ValueError, EOFError) as e:
+        raise PersistError(
+            f"{path}: unreadable or truncated checkpoint: {e}", path=path
+        ) from e
+    try:
+        if _CHECKSUM_KEY in z.files:
+            try:
+                sums: Dict[str, str] = json.loads(bytes(z[_CHECKSUM_KEY]).decode())
+            except (json.JSONDecodeError, UnicodeDecodeError) as e:
+                raise PersistError(
+                    f"{path}: corrupt checksum envelope: {e}", path=path
+                ) from e
+            for name, want in sums.items():
+                if name not in z.files:
+                    raise PersistError(
+                        f"{path}: checkpoint is missing array {name!r} "
+                        "named by its checksum envelope (truncated write?)",
+                        path=path,
+                    )
+                try:
+                    got = _digest(z[name])
+                except (zipfile.BadZipFile, OSError, ValueError, EOFError) as e:
+                    raise PersistError(
+                        f"{path}: array {name!r} is unreadable: {e}",
+                        path=path,
+                    ) from e
+                if got != want:
+                    raise PersistError(
+                        f"{path}: sha256 mismatch on array {name!r} "
+                        f"(stored {want[:12]}…, computed {got[:12]}…) — "
+                        "artifact corrupt; rebuild the checkpoint",
+                        path=path,
+                    )
+        yield z
+    finally:
+        z.close()
+
+
+def _member(z, path: str, name: str) -> np.ndarray:
+    """Fetch a required array, raising :class:`PersistError` when absent."""
+    if name not in z.files:
+        raise PersistError(
+            f"{path}: checkpoint lacks required array {name!r}", path=path
+        )
+    return z[name]
+
+
+def _json_member(z, path: str, name: str) -> dict:
+    try:
+        return json.loads(bytes(_member(z, path, name)).decode())
+    except (json.JSONDecodeError, UnicodeDecodeError) as e:
+        raise PersistError(
+            f"{path}: corrupt JSON envelope {name!r}: {e}", path=path
+        ) from e
+
+
+def _member_dict(arrays: dict, path: str, name: str) -> np.ndarray:
+    if name not in arrays:
+        raise PersistError(
+            f"{path}: checkpoint lacks required array {name!r}", path=path
+        )
+    return arrays[name]
 
 _OPT = ("reach_ports", "src_sets", "dst_sets", "selected",
         "ingress_isolated", "egress_isolated", "closure")
@@ -109,34 +227,43 @@ def save_result(result: VerifyResult, path: str) -> None:
         v = getattr(result, name)
         if v is not None:
             arrays[name] = v
-    np.savez_compressed(
+    _savez(
         path, __meta__=np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8),
         **arrays,
     )
 
 
 def load_result(path: str) -> VerifyResult:
-    with np.load(path) as z:
-        meta = json.loads(bytes(z["__meta__"]).decode())
-        arrays = {k: z[k] for k in z.files if k != "__meta__"}
-    return VerifyResult(
-        n_pods=meta["n_pods"],
-        mode=meta["mode"],
-        backend=meta["backend"],
-        config=VerifyConfig(**meta["config"]),
-        reach=arrays["reach"],
-        port_atoms=[
-            PortAtom(protocol=p, lo=lo, hi=hi, name=n)
-            for p, lo, hi, n in meta["port_atoms"]
-        ],
-        timings=meta.get("timings") or {},
-        **{k: arrays.get(k) for k in _OPT},
-    )
+    with _load_npz(path) as z:
+        meta = _json_member(z, path, "__meta__")
+        arrays = {
+            k: z[k]
+            for k in z.files
+            if k not in ("__meta__", _CHECKSUM_KEY)
+        }
+    try:
+        return VerifyResult(
+            n_pods=meta["n_pods"],
+            mode=meta["mode"],
+            backend=meta["backend"],
+            config=VerifyConfig(**meta["config"]),
+            reach=_member_dict(arrays, path, "reach"),
+            port_atoms=[
+                PortAtom(protocol=p, lo=lo, hi=hi, name=n)
+                for p, lo, hi, n in meta["port_atoms"]
+            ],
+            timings=meta.get("timings") or {},
+            **{k: arrays.get(k) for k in _OPT},
+        )
+    except (KeyError, TypeError) as e:
+        raise PersistError(
+            f"{path}: result envelope is missing/invalid: {e!r}", path=path
+        ) from e
 
 
 def save_packed(packed_reach, path: str) -> None:
     """Persist a :class:`~..ops.tiled.PackedReach`."""
-    np.savez_compressed(
+    _savez(
         path,
         packed=np.asarray(packed_reach.packed),
         n_pods=np.int64(packed_reach.n_pods),
@@ -148,12 +275,12 @@ def save_packed(packed_reach, path: str) -> None:
 def load_packed(path: str):
     from ..ops.tiled import PackedReach
 
-    with np.load(path) as z:
+    with _load_npz(path) as z:
         return PackedReach(
-            packed=z["packed"],
-            n_pods=int(z["n_pods"]),
-            ingress_isolated=z["ingress_isolated"],
-            egress_isolated=z["egress_isolated"],
+            packed=_member(z, path, "packed"),
+            n_pods=int(_member(z, path, "n_pods")),
+            ingress_isolated=_member(z, path, "ingress_isolated"),
+            egress_isolated=_member(z, path, "egress_isolated"),
         )
 
 
@@ -169,7 +296,7 @@ def save_incremental(inc, directory: str) -> None:
         f"vec_{i}": np.stack(inc._vectors[k]) for i, k in enumerate(keys)
     }
     config_json = _config_json(inc.config)
-    np.savez_compressed(
+    _savez(
         os.path.join(directory, "state.npz"),
         ing_count=np.asarray(inc._ing_count),
         eg_count=np.asarray(inc._eg_count),
@@ -194,26 +321,38 @@ def load_incremental(directory: str, config: Optional[VerifyConfig] = None,
 
     cluster, _ = load_cluster(os.path.join(directory, "cluster"))
     state_path = os.path.join(directory, "state.npz")
-    with np.load(state_path) as z:
-        saved = json.loads(bytes(z["__config__"]).decode())
+    with _load_npz(state_path) as z:
+        saved = _json_member(z, state_path, "__config__")
         # The checkpointed counts were derived under the saved semantic
         # flags; reinterpreting them under different flags is silent
         # corruption. Only the backend/device choice may differ on resume.
-        config = _check_saved_config(saved, config, "load_incremental")
+        config = _check_saved_config(
+            saved, config, "load_incremental", state_path
+        )
         inc = IncrementalVerifier(
             Cluster(pods=cluster.pods, namespaces=cluster.namespaces, policies=[]),
             config,
             device=device,
         )
-        inc._ing_count = jnp.asarray(z["ing_count"], device=inc.device)
-        inc._eg_count = jnp.asarray(z["eg_count"], device=inc.device)
-        inc._ing_iso = z["ing_iso"].copy()
-        inc._eg_iso = z["eg_iso"].copy()
-        inc.update_count = int(z["update_count"])
-        keys = [str(k) for k in z["keys"]]
+        inc._ing_count = jnp.asarray(
+            _member(z, state_path, "ing_count"), device=inc.device
+        )
+        inc._eg_count = jnp.asarray(
+            _member(z, state_path, "eg_count"), device=inc.device
+        )
+        inc._ing_iso = _member(z, state_path, "ing_iso").copy()
+        inc._eg_iso = _member(z, state_path, "eg_iso").copy()
+        inc.update_count = int(_member(z, state_path, "update_count"))
+        keys = [str(k) for k in _member(z, state_path, "keys")]
         by_key = {f"{p.namespace}/{p.name}": p for p in cluster.policies}
         for i, key in enumerate(keys):
-            v = z[f"vec_{i}"]
+            v = _member(z, state_path, f"vec_{i}")
+            if key not in by_key:
+                raise PersistError(
+                    f"{state_path}: state names policy {key!r} absent from "
+                    "the checkpoint manifest — state/manifest mismatch",
+                    path=state_path,
+                )
             inc.policies[key] = by_key[key]
             inc._vectors[key] = tuple(row.copy() for row in v.astype(bool))
     inc._reach_dirty = True
@@ -236,7 +375,7 @@ def save_packed_incremental(inc, directory: str) -> None:
         inc.as_cluster(include_inactive=True), os.path.join(directory, "cluster")
     )
     state = inc.state_dict()
-    np.savez_compressed(
+    _savez(
         os.path.join(directory, "state.npz"),
         __config__=np.frombuffer(
             _config_json(inc.config).encode(), dtype=np.uint8
@@ -260,10 +399,17 @@ def load_packed_incremental(
     from ..packed_incremental import PackedIncrementalVerifier
 
     cluster, _ = load_cluster(os.path.join(directory, "cluster"))
-    with np.load(os.path.join(directory, "state.npz")) as z:
-        saved = json.loads(bytes(z["__config__"]).decode())
-        config = _check_saved_config(saved, config, "load_packed_incremental")
-        state = {k: z[k] for k in z.files if k != "__config__"}
+    state_path = os.path.join(directory, "state.npz")
+    with _load_npz(state_path) as z:
+        saved = _json_member(z, state_path, "__config__")
+        config = _check_saved_config(
+            saved, config, "load_packed_incremental", state_path
+        )
+        state = {
+            k: z[k]
+            for k in z.files
+            if k not in ("__config__", _CHECKSUM_KEY)
+        }
     return PackedIncrementalVerifier.from_state(
         cluster, state, config, device=device, mesh=mesh,
         keep_matrix=keep_matrix,
@@ -283,7 +429,7 @@ def save_ports_incremental(inc, directory: str) -> None:
         inc.as_cluster(include_inactive=True), os.path.join(directory, "cluster")
     )
     arrays, meta = inc.state_dict()
-    np.savez_compressed(
+    _savez(
         os.path.join(directory, "state.npz"),
         __config__=np.frombuffer(
             _config_json(inc.config).encode(), dtype=np.uint8
@@ -305,12 +451,17 @@ def load_ports_incremental(
     from ..packed_incremental_ports import PackedPortsIncrementalVerifier
 
     cluster, _ = load_cluster(os.path.join(directory, "cluster"))
-    with np.load(os.path.join(directory, "state.npz")) as z:
-        saved = json.loads(bytes(z["__config__"]).decode())
-        config = _check_saved_config(saved, config, "load_ports_incremental")
-        meta = json.loads(bytes(z["__meta__"]).decode())
+    state_path = os.path.join(directory, "state.npz")
+    with _load_npz(state_path) as z:
+        saved = _json_member(z, state_path, "__config__")
+        config = _check_saved_config(
+            saved, config, "load_ports_incremental", state_path
+        )
+        meta = _json_member(z, state_path, "__meta__")
         arrays = {
-            k: z[k] for k in z.files if k not in ("__config__", "__meta__")
+            k: z[k]
+            for k in z.files
+            if k not in ("__config__", "__meta__", _CHECKSUM_KEY)
         }
     return PackedPortsIncrementalVerifier.from_state(
         cluster, arrays, meta, config, device=device, mesh=mesh
@@ -335,7 +486,7 @@ def export_encoding(enc, path_prefix: str) -> str:
             arrays[f"{prefix}_dst_restrict"] = block.dst_restrict
     if enc.restrict_bank is not None:
         arrays["restrict_bank"] = enc.restrict_bank
-    np.savez_compressed(path_prefix + ".npz", **arrays)
+    _savez(path_prefix + ".npz", **arrays)
 
     lines = [
         f"EncodedCluster: {enc.n_pods} pods, {enc.n_namespaces} namespaces, "

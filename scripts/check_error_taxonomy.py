@@ -1,0 +1,143 @@
+#!/usr/bin/env python
+"""Lint the error taxonomy: package code must raise :class:`KvTpuError`
+subclasses (``resilience/errors.py``), not bare builtins — a bare
+``ValueError`` three layers deep cannot be mapped to the CLI exit-code
+contract (0 ok / 1 violations / 2 input error / 3 backend failure) and
+never carries ``transient``/``kind`` for the retry/fallback driver.
+
+Pure AST walk — nothing is imported, so the lint runs without JAX. A raise
+is flagged when it is a call or bare reference to a DISALLOWED builtin name,
+unless
+
+* it is a bare re-raise (``raise`` / ``raise e``-where-e-is-caught is NOT
+  distinguished — only builtin *names* are matched, so re-raising a caught
+  variable is always fine),
+* the builtin is ALWAYS_ALLOWED (control-flow/API-misuse idioms the taxonomy
+  deliberately does not absorb: ``SystemExit`` is argparse/CLI vocabulary,
+  ``NotImplementedError`` is the abstract-method contract, ...), or
+* the file is GRANDFATHERED: the engine/model layers raise ``KeyError``/
+  ``ValueError`` as their documented API contract (tests pin those types).
+  The budget per file is the count at adoption time — a grandfathered file
+  may reduce its count but not grow it, so new code everywhere lands on the
+  taxonomy.
+
+Run directly (exit 1 on a violation) — tier-1 runs it via
+``tests/test_resilience.py``.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import Dict, List, Tuple
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE = os.path.join(ROOT, "kubernetes_verification_tpu")
+
+#: builtins whose raise sites the taxonomy replaces
+DISALLOWED = frozenset({
+    "ValueError",
+    "RuntimeError",
+    "KeyError",
+    "TypeError",
+    "Exception",
+    "BaseException",
+    "OSError",
+    "IOError",
+    "IndexError",
+    "LookupError",
+    "ArithmeticError",
+})
+
+#: idioms the taxonomy does not absorb (always fine to raise)
+ALWAYS_ALLOWED = frozenset({
+    "SystemExit",
+    "NotImplementedError",
+    "AssertionError",
+    "ImportError",
+    "ModuleNotFoundError",
+    "StopIteration",
+    "AttributeError",
+})
+
+#: path (relative to the package) → builtin-raise budget at adoption time.
+#: These layers expose KeyError/ValueError as their API contract (tier-1
+#: tests pin the types); shrink the numbers as files migrate — never grow.
+GRANDFATHERED: Dict[str, int] = {
+    "backends/sharded_packed.py": 7,
+    "datalog/engine.py": 12,
+    "incremental.py": 6,
+    "models/core.py": 10,
+    "observe/registry.py": 7,
+    "ops/closure.py": 3,
+    "ops/pallas_kernels.py": 4,
+    "ops/tiled.py": 7,
+    "packed_incremental.py": 18,
+    "packed_incremental_ports.py": 7,
+    "parallel/mesh.py": 1,
+    "parallel/packed_sharded.py": 16,
+    # exit_code_for's guard against being handed a non-KvTpuError is the
+    # one place TypeError is the honest signal (caller bug, not input)
+    "resilience/errors.py": 1,
+}
+
+
+def _raised_name(node: ast.Raise):
+    exc = node.exc
+    if isinstance(exc, ast.Call) and isinstance(exc.func, ast.Name):
+        return exc.func.id
+    if isinstance(exc, ast.Name):
+        return exc.id
+    return None
+
+
+def scan_file(path: str) -> List[Tuple[int, str]]:
+    with open(path, "r") as fh:
+        tree = ast.parse(fh.read(), filename=path)
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Raise) and node.exc is not None:
+            name = _raised_name(node)
+            if name in DISALLOWED and name not in ALWAYS_ALLOWED:
+                out.append((node.lineno, name))
+    return out
+
+
+def check() -> List[str]:
+    problems: List[str] = []
+    for root, dirs, files in os.walk(PACKAGE):
+        dirs[:] = [d for d in dirs if d != "__pycache__"]
+        for fname in sorted(files):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(root, fname)
+            rel = os.path.relpath(path, PACKAGE).replace(os.sep, "/")
+            sites = scan_file(path)
+            budget = GRANDFATHERED.get(rel)
+            if budget is None:
+                problems += [
+                    f"{rel}:{line}: raise {name}(...) — raise a KvTpuError "
+                    "subclass from resilience/errors.py instead"
+                    for line, name in sites
+                ]
+            elif len(sites) > budget:
+                listing = ", ".join(f"{line}:{name}" for line, name in sites)
+                problems.append(
+                    f"{rel}: {len(sites)} builtin raises exceed the "
+                    f"grandfathered budget of {budget} ({listing}) — new "
+                    "raise sites must use the KvTpuError taxonomy"
+                )
+    return problems
+
+
+def main() -> int:
+    problems = check()
+    if problems:
+        print("\n".join(problems), file=sys.stderr)
+        return 1
+    print("error taxonomy OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

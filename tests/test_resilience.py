@@ -1,0 +1,584 @@
+"""The resilience subsystem: error taxonomy, retry, fallback chain,
+watchdog, adaptive OOM degradation, deterministic fault injection, persist
+checksums, structured skip diagnostics, the CLI exit-code contract, and the
+taxonomy lint — all under ``JAX_PLATFORMS=cpu`` (conftest)."""
+import json
+
+import numpy as np
+import pytest
+
+import kubernetes_verification_tpu as kv
+from kubernetes_verification_tpu.harness.generate import (
+    GeneratorConfig,
+    random_cluster,
+)
+from kubernetes_verification_tpu.observe import REGISTRY
+from kubernetes_verification_tpu.resilience import (
+    EXIT_BACKEND_FAILED,
+    EXIT_INPUT_ERROR,
+    EXIT_OK,
+    EXIT_VIOLATIONS,
+    BackendChainExhausted,
+    BackendError,
+    BackendOOM,
+    BackendTimeout,
+    ConfigError,
+    DeviceLost,
+    EncodeError,
+    FaultInjector,
+    FaultRule,
+    IngestError,
+    KvTpuError,
+    PersistError,
+    ResilienceConfig,
+    RetryPolicy,
+    UnknownBackendError,
+    classify_exception,
+    exit_code_for,
+    parse_fault_spec,
+    register_faulty,
+    resilient_verify,
+    retry_transient,
+)
+from kubernetes_verification_tpu.utils.persist import load_result, save_result
+
+
+def _cluster(seed=5, pods=14, policies=5):
+    return random_cluster(
+        GeneratorConfig(
+            n_pods=pods, n_policies=policies, n_namespaces=2, seed=seed
+        )
+    )
+
+
+def _counter(name, key):
+    return REGISTRY.dump()["counters"].get(name, {}).get(key, 0.0)
+
+
+def _noop_sleep(_seconds):
+    pass
+
+
+# ---------------------------------------------------------------- taxonomy
+def test_taxonomy_keeps_historical_except_clauses_working():
+    # re-parented classes widen the catchable surface, never narrow it
+    assert issubclass(IngestError, ValueError)
+    assert issubclass(PersistError, ValueError)
+    assert issubclass(EncodeError, ValueError)
+    assert issubclass(ConfigError, ValueError)
+    assert issubclass(BackendError, RuntimeError)
+    assert issubclass(UnknownBackendError, KeyError)
+    for cls in (
+        IngestError, PersistError, EncodeError, ConfigError, BackendError,
+    ):
+        assert issubclass(cls, KvTpuError)
+    from kubernetes_verification_tpu.encode.encoder import FrozenBankMiss
+
+    assert issubclass(FrozenBankMiss, EncodeError)
+    assert issubclass(FrozenBankMiss, KeyError)
+
+
+def test_classify_exception_by_message_marker():
+    oom = classify_exception(
+        RuntimeError("RESOURCE_EXHAUSTED: failed to allocate"), "tpu"
+    )
+    assert isinstance(oom, BackendOOM) and oom.transient
+    assert oom.backend == "tpu" and oom.kind == "oom"
+
+    to = classify_exception(RuntimeError("DEADLINE_EXCEEDED while running"))
+    assert isinstance(to, BackendTimeout) and to.transient
+
+    dl = classify_exception(RuntimeError("DATA_LOSS: device halted"), "tpu")
+    assert isinstance(dl, DeviceLost) and not dl.transient
+
+    tr = classify_exception(RuntimeError("UNAVAILABLE: try again"))
+    assert tr.transient and not isinstance(tr, (BackendOOM, BackendTimeout))
+
+    plain = classify_exception(ValueError("bad shape"), "cpu")
+    assert isinstance(plain, BackendError) and not plain.transient
+    assert plain.__cause__ is not None
+
+    # already-typed errors pass through, backend filled in when missing
+    pre = BackendOOM("boom")
+    assert classify_exception(pre, "sharded") is pre
+    assert pre.backend == "sharded"
+
+
+def test_exit_code_contract():
+    assert exit_code_for(BackendOOM("x")) == EXIT_BACKEND_FAILED
+    assert exit_code_for(BackendChainExhausted(("cpu",), [])) == 3
+    assert exit_code_for(IngestError("x")) == EXIT_INPUT_ERROR
+    assert exit_code_for(PersistError("x")) == EXIT_INPUT_ERROR
+    assert exit_code_for(ConfigError("x")) == EXIT_INPUT_ERROR
+    with pytest.raises(TypeError):
+        exit_code_for(ValueError("not ours"))
+    assert (EXIT_OK, EXIT_VIOLATIONS) == (0, 1)
+
+
+def test_unknown_backend_is_typed_and_still_a_keyerror():
+    with pytest.raises(UnknownBackendError) as ei:
+        kv.get_backend("no-such-engine")
+    assert ei.value.backend == "no-such-engine"
+    with pytest.raises(KeyError):  # the registry's historical contract
+        kv.get_backend("no-such-engine")
+
+
+# ------------------------------------------------------------------- retry
+def test_retry_policy_delays_deterministic_and_capped():
+    p = RetryPolicy(max_retries=4, backoff_base=0.5, backoff_max=1.0, seed=7)
+    a, b = list(p.delays()), list(p.delays())
+    assert a == b  # seeded jitter replays identically
+    assert len(a) == 4
+    # capped exponential: base schedule 0.5, 1.0, 1.0, 1.0 (+ jitter < 10%)
+    assert 0.5 <= a[0] <= 0.55
+    assert all(1.0 <= d <= 1.1 for d in a[1:])
+
+
+def test_retry_transient_flaky_once_succeeds():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("ABORTED: speculative dispatch lost")
+        return "ok"
+
+    before = _counter("kvtpu_retries_total", "backend=test,kind=error")
+    out = retry_transient(flaky, backend="test", sleep=_noop_sleep)
+    assert out == "ok" and calls["n"] == 2
+    assert _counter("kvtpu_retries_total", "backend=test,kind=error") == before + 1
+
+
+def test_retry_transient_nontransient_raises_immediately():
+    calls = {"n": 0}
+
+    def dead():
+        calls["n"] += 1
+        raise RuntimeError("DATA_LOSS: device halted")
+
+    with pytest.raises(DeviceLost):
+        retry_transient(dead, backend="test", sleep=_noop_sleep)
+    assert calls["n"] == 1
+
+
+def test_retry_transient_budget_exhausted_raises_classified():
+    def always():
+        raise RuntimeError("UNAVAILABLE: try again")
+
+    with pytest.raises(BackendError) as ei:
+        retry_transient(
+            always,
+            policy=RetryPolicy(max_retries=3),
+            backend="test",
+            sleep=_noop_sleep,
+        )
+    assert ei.value.transient  # classified, budget simply ran out
+    assert isinstance(ei.value.__cause__, RuntimeError)
+
+
+# -------------------------------------------------------------- fault spec
+def test_parse_fault_spec_grammar():
+    rules = parse_fault_spec("flaky@0, oom>256 ,device_loss,timeout%0.5")
+    assert [r.kind for r in rules] == ["flaky", "oom", "device_loss", "timeout"]
+    assert rules[0].at_call == 0
+    assert rules[1].while_tile_above == 256
+    assert rules[2].at_call is None and rules[2].prob is None
+    assert rules[3].prob == 0.5
+
+
+@pytest.mark.parametrize(
+    "bad", ["segfault", "flaky@x", "", "timeout>128", "oom@"]
+)
+def test_parse_fault_spec_rejects(bad):
+    with pytest.raises(ConfigError):
+        parse_fault_spec(bad)
+
+
+def test_fault_injector_is_deterministic_and_shared():
+    cfg = kv.VerifyConfig()
+    seq = lambda: [
+        FaultInjector(parse_fault_spec("flaky%0.4"), seed=11).next_fault(cfg)
+        is not None
+        for _ in range(20)
+    ]
+    # two injectors with the same seed replay the same schedule
+    assert seq() == seq()
+    # flaky@0 fires exactly on the first call THROUGH THE REGISTRATION,
+    # even when get_backend re-instantiates the wrapper per call
+    name = register_faulty("cpu", parse_fault_spec("flaky@0"))
+    first, second = kv.get_backend(name), kv.get_backend(name)
+    assert first is not second  # fresh instances...
+    assert first.injector is second.injector  # ...shared schedule
+    with pytest.raises(BackendError):
+        first.verify(_cluster(pods=4, policies=1), kv.VerifyConfig())
+    # call 1 (on the OTHER instance) passes: the counter survived
+    res = second.verify(_cluster(pods=4, policies=1), kv.VerifyConfig())
+    assert res.n_pods == 4
+
+
+# --------------------------------------------------- the resilient wrapper
+def test_resilient_verify_retries_flaky_once_on_same_backend():
+    cluster = _cluster()
+    name = register_faulty("cpu", parse_fault_spec("flaky@0"))
+    key = f"backend={name},kind=flaky"
+    before = _counter("kvtpu_retries_total", key)
+    res = resilient_verify(
+        cluster,
+        kv.VerifyConfig(backend=name),
+        ResilienceConfig(max_retries=2),
+        sleep=_noop_sleep,
+    )
+    assert _counter("kvtpu_retries_total", key) == before + 1
+    expect = kv.verify(cluster, kv.VerifyConfig(backend="cpu"))
+    np.testing.assert_array_equal(res.reach, expect.reach)
+
+
+def test_resilient_verify_falls_back_on_device_loss():
+    cluster = _cluster(seed=9)
+    name = register_faulty("cpu", parse_fault_spec("device_loss"))
+    key = f"from_backend={name},to_backend=cpu"
+    before = _counter("kvtpu_fallbacks_total", key)
+    res = resilient_verify(
+        cluster,
+        resilience=ResilienceConfig(fallback_chain=(name, "cpu")),
+        sleep=_noop_sleep,
+    )
+    assert res.backend == "cpu"
+    assert _counter("kvtpu_fallbacks_total", key) == before + 1
+
+
+def test_resilient_verify_degrades_tile_on_oom():
+    cluster = _cluster(seed=13)
+    name = register_faulty("cpu", parse_fault_spec("oom>256"))
+    dkey = f"backend={name}"
+    fkey = f"backend={name},kind=oom"
+    d0 = _counter("kvtpu_degradations_total", dkey)
+    f0 = _counter("kvtpu_faults_injected_total", fkey)
+    res = resilient_verify(
+        cluster,
+        kv.VerifyConfig(backend_options=(("tile", 1024),)),
+        ResilienceConfig(fallback_chain=(name,), min_tile=128),
+        sleep=_noop_sleep,
+    )
+    # 1024 → 512 → 256: two halvings, the injector relents at tile ≤ 256
+    assert _counter("kvtpu_degradations_total", dkey) == d0 + 2
+    assert _counter("kvtpu_faults_injected_total", fkey) == f0 + 2
+    assert res.n_pods == cluster.n_pods
+
+
+def test_resilient_verify_oom_respects_min_tile_then_falls_back():
+    cluster = _cluster(seed=13)
+    name = register_faulty("cpu", parse_fault_spec("oom"))  # relentless
+    res = resilient_verify(
+        cluster,
+        kv.VerifyConfig(backend_options=(("tile", 512),)),
+        ResilienceConfig(
+            fallback_chain=(name, "cpu"), min_tile=256, max_retries=0
+        ),
+        sleep=_noop_sleep,
+    )
+    assert res.backend == "cpu"  # degradation floor hit → chain moved on
+
+
+def test_watchdog_times_out_hung_backend_and_falls_back():
+    cluster = _cluster(seed=17, pods=8, policies=2)
+    name = register_faulty(
+        "cpu", parse_fault_spec("timeout"), hang_seconds=1.5
+    )
+    res = resilient_verify(
+        cluster,
+        resilience=ResilienceConfig(
+            fallback_chain=(name, "cpu"), solve_timeout=0.2, max_retries=0
+        ),
+        sleep=_noop_sleep,
+    )
+    assert res.backend == "cpu"
+
+
+def test_chain_exhaustion_raises_with_postmortem():
+    cluster = _cluster(seed=21, pods=8, policies=2)
+    name = register_faulty("cpu", parse_fault_spec("device_loss"))
+    with pytest.raises(BackendChainExhausted) as ei:
+        resilient_verify(
+            cluster,
+            resilience=ResilienceConfig(fallback_chain=(name,)),
+            sleep=_noop_sleep,
+        )
+    exc = ei.value
+    assert exc.chain == (name,)
+    assert [b for b, _ in exc.failures] == [name]
+    assert isinstance(exc.failures[0][1], DeviceLost)
+    assert exit_code_for(exc) == EXIT_BACKEND_FAILED
+
+
+def test_register_faulty_unknown_inner_fails_fast():
+    with pytest.raises(UnknownBackendError):
+        register_faulty("no-such-engine", parse_fault_spec("flaky"))
+
+
+# -------------------------------------------- engine retry-on-transient
+def test_incremental_engine_retries_transient_dispatch(monkeypatch):
+    import kubernetes_verification_tpu.incremental as inc_mod
+
+    iv = inc_mod.IncrementalVerifier(
+        _cluster(seed=3, pods=8, policies=2),
+        kv.VerifyConfig(compute_ports=False),
+    )
+    real = inc_mod._derive_reach
+    calls = {"n": 0}
+
+    def flaky(*args, **kwargs):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("UNAVAILABLE: transient dispatch glitch")
+        return real(*args, **kwargs)
+
+    monkeypatch.setattr(inc_mod, "_derive_reach", flaky)
+    iv.retry_policy = RetryPolicy(max_retries=2, backoff_base=0.0, jitter=0.0)
+    iv._reach_dirty = True
+    before = _counter("kvtpu_retries_total", "backend=dense,kind=error")
+    reach = iv.reach
+    assert calls["n"] == 2 and reach.shape == (8, 8)
+    assert (
+        _counter("kvtpu_retries_total", "backend=dense,kind=error")
+        == before + 1
+    )
+
+
+def test_engines_expose_retry_policy():
+    from kubernetes_verification_tpu.incremental import IncrementalVerifier
+    from kubernetes_verification_tpu.packed_incremental import (
+        PackedIncrementalVerifier,
+    )
+    from kubernetes_verification_tpu.packed_incremental_ports import (
+        PackedPortsIncrementalVerifier,
+    )
+
+    for cls in (
+        IncrementalVerifier,
+        PackedIncrementalVerifier,
+        PackedPortsIncrementalVerifier,
+    ):
+        assert isinstance(cls.retry_policy, RetryPolicy)
+
+
+# -------------------------------------------------------- persist checksums
+def test_save_result_embeds_checksums_and_roundtrips(tmp_path):
+    res = kv.verify(_cluster(seed=31), kv.VerifyConfig(backend="cpu"))
+    p = str(tmp_path / "res.npz")
+    save_result(res, p)
+    with np.load(p) as z:
+        assert "__checksums__" in z.files
+        sums = json.loads(bytes(z["__checksums__"]).decode())
+        assert "reach" in sums and len(sums["reach"]) == 64  # sha256 hex
+    back = load_result(p)
+    np.testing.assert_array_equal(back.reach, res.reach)
+
+
+def test_corrupt_array_raises_persist_error_with_path(tmp_path):
+    res = kv.verify(_cluster(seed=31), kv.VerifyConfig(backend="cpu"))
+    p = str(tmp_path / "res.npz")
+    save_result(res, p)
+    with np.load(p) as z:
+        members = {name: z[name] for name in z.files}
+    flipped = members["reach"].copy()
+    flipped.flat[0] = not flipped.flat[0]
+    members["reach"] = flipped  # bit-rot one array, keep the old envelope
+    np.savez_compressed(p, **members)
+    with pytest.raises(PersistError) as ei:
+        load_result(p)
+    assert "sha256 mismatch" in str(ei.value) and "reach" in str(ei.value)
+    assert ei.value.path == p
+
+
+def test_truncated_file_raises_persist_error(tmp_path):
+    p = str(tmp_path / "res.npz")
+    with open(p, "wb") as fh:
+        fh.write(b"PK\x03\x04 definitely not a whole zip")
+    with pytest.raises(PersistError) as ei:
+        load_result(p)
+    assert ei.value.path == p
+    with pytest.raises(ValueError):  # PersistError is still a ValueError
+        load_result(p)
+
+
+def test_missing_array_named_by_envelope_is_truncation(tmp_path):
+    res = kv.verify(_cluster(seed=31), kv.VerifyConfig(backend="cpu"))
+    p = str(tmp_path / "res.npz")
+    save_result(res, p)
+    with np.load(p) as z:
+        members = {n: z[n] for n in z.files if n != "reach"}
+    np.savez_compressed(p, **members)  # envelope still names "reach"
+    with pytest.raises(PersistError) as ei:
+        load_result(p)
+    assert "truncated write?" in str(ei.value)
+
+
+def test_legacy_artifact_without_envelope_still_loads(tmp_path):
+    res = kv.verify(_cluster(seed=31), kv.VerifyConfig(backend="cpu"))
+    p = str(tmp_path / "res.npz")
+    save_result(res, p)
+    with np.load(p) as z:
+        members = {n: z[n] for n in z.files if n != "__checksums__"}
+    np.savez_compressed(p, **members)  # a pre-checksum-era artifact
+    back = load_result(p)
+    np.testing.assert_array_equal(back.reach, res.reach)
+
+
+# ------------------------------------------------- structured skip reports
+def test_skip_diagnostic_is_structured_and_str_compatible(tmp_path):
+    manifest = tmp_path / "mixed.yaml"
+    manifest.write_text(
+        "apiVersion: v1\nkind: Pod\nmetadata:\n  name: a\n"
+        "  namespace: default\nspec: {}\n"
+        "---\n"
+        "apiVersion: v1\nkind: ConfigMap\nmetadata:\n  name: cm\n"
+    )
+    cluster, skipped = kv.load_cluster(str(manifest))
+    assert cluster.n_pods == 1 and len(skipped) == 1
+    diag = skipped[0]
+    assert isinstance(diag, str)  # historical "file: kind/name" surface
+    assert "ConfigMap" in diag and "cm" in diag
+    assert diag.path == str(manifest)
+    assert diag.doc_index == 1
+    assert diag.kind == "ConfigMap" and diag.name == "cm"
+    assert "not verifiable" in diag.reason
+    d = diag.to_dict()
+    assert d["doc_index"] == 1 and d["kind"] == "ConfigMap"
+    json.dumps({"skipped": skipped})  # str subclass stays serialisable
+    with pytest.raises(IngestError):
+        kv.load_cluster(str(manifest), strict=True)
+
+
+def test_missing_manifest_path_is_ingest_error(tmp_path):
+    with pytest.raises(IngestError):
+        kv.load_cluster(str(tmp_path / "nowhere"))
+
+
+# ----------------------------------------------------- CLI exit-code contract
+def _write_manifests(tmp_path, n=10):
+    from kubernetes_verification_tpu.cli import main
+
+    d = str(tmp_path / "m")
+    assert main(
+        ["generate", d, "--pods", str(n), "--policies", "3", "--seed", "3"]
+    ) == 0
+    return d
+
+
+def test_cli_exit_2_on_bad_input(tmp_path, capsys):
+    from kubernetes_verification_tpu.cli import main
+
+    rc = main(["verify", str(tmp_path / "missing"), "--json"])
+    err = capsys.readouterr().err
+    assert rc == EXIT_INPUT_ERROR
+    # a one-line operator diagnostic, not a traceback
+    assert "kv-tpu: IngestError:" in err and "Traceback" not in err
+
+
+def test_cli_exit_3_on_chain_exhaustion(tmp_path, capsys):
+    from kubernetes_verification_tpu.cli import main
+
+    d = _write_manifests(tmp_path)
+    capsys.readouterr()
+    rc = main([
+        "verify", d, "--json",
+        "--inject-faults", "cpu=device_loss",
+        "--fallback-chain", "faulty:cpu",
+        "--max-retries", "0",
+    ])
+    assert rc == EXIT_BACKEND_FAILED
+    assert "BackendChainExhausted" in capsys.readouterr().err
+
+
+def test_cli_fallback_chain_recovers_and_counts(tmp_path, capsys):
+    from kubernetes_verification_tpu.cli import main
+
+    d = _write_manifests(tmp_path)
+    capsys.readouterr()
+    rc = main([
+        "verify", d, "--json",
+        "--inject-faults", "cpu=device_loss",
+        "--fallback-chain", "faulty:cpu,cpu",
+    ])
+    assert rc == EXIT_OK
+    out = json.loads(capsys.readouterr().out)
+    assert out["backend"] == "cpu"
+
+
+def test_cli_check_flag_gives_violations_exit(tmp_path, capsys):
+    from kubernetes_verification_tpu.cli import main
+
+    # two identical policies shadow each other → --check exits 1
+    d = tmp_path / "shadow"
+    d.mkdir()
+    pol = (
+        "apiVersion: networking.k8s.io/v1\nkind: NetworkPolicy\n"
+        "metadata:\n  name: {name}\n  namespace: default\n"
+        "spec:\n  podSelector: {{}}\n  policyTypes: [Ingress]\n"
+        "  ingress:\n  - from:\n    - podSelector: {{}}\n"
+    )
+    (d / "cluster.yaml").write_text(
+        "apiVersion: v1\nkind: Namespace\nmetadata:\n  name: default\n"
+        "---\n"
+        "apiVersion: v1\nkind: Pod\nmetadata:\n  name: a\n"
+        "  namespace: default\n  labels: {app: a}\nspec: {}\n"
+        "---\n"
+        "apiVersion: v1\nkind: Pod\nmetadata:\n  name: b\n"
+        "  namespace: default\n  labels: {app: b}\nspec: {}\n"
+        "---\n" + pol.format(name="allow-all-one")
+        + "---\n" + pol.format(name="allow-all-two")
+    )
+    assert main(["verify", str(d), "--json"]) == EXIT_OK
+    out = json.loads(capsys.readouterr().out)
+    assert out["policy_shadow"]  # the duplicate pair is visible
+    rc = main(["verify", str(d), "--json", "--check"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == EXIT_VIOLATIONS and out["check"] == "failed"
+
+
+def test_cli_metrics_shows_resilience_families(capsys):
+    from kubernetes_verification_tpu.cli import main
+
+    assert main(["metrics"]) == 0
+    dump = json.loads(capsys.readouterr().out)
+    for family in (
+        "kvtpu_retries_total",
+        "kvtpu_fallbacks_total",
+        "kvtpu_faults_injected_total",
+        "kvtpu_degradations_total",
+    ):
+        assert family in dump["counters"], family
+
+
+def test_cli_diff_corrupt_checkpoint_exits_2(tmp_path, capsys):
+    from kubernetes_verification_tpu.cli import main
+
+    d = _write_manifests(tmp_path, n=8)
+    ckpt = str(tmp_path / "ckpt")
+    assert main(["snapshot", d, ckpt, "--no-ports"]) == 0
+    state = tmp_path / "ckpt" / "state.npz"
+    state.write_bytes(state.read_bytes()[: state.stat().st_size // 2])
+    capsys.readouterr()
+    rc = main(["diff", ckpt])
+    err = capsys.readouterr().err
+    assert rc == EXIT_INPUT_ERROR
+    assert "PersistError" in err
+
+
+# ---------------------------------------------------------------- the lint
+def test_error_taxonomy_lint_passes():
+    import importlib.util
+    from pathlib import Path
+
+    script = (
+        Path(__file__).resolve().parent.parent
+        / "scripts"
+        / "check_error_taxonomy.py"
+    )
+    spec = importlib.util.spec_from_file_location(
+        "check_error_taxonomy", script
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.check() == []
