@@ -85,8 +85,9 @@ def _add_verify_flags(p: argparse.ArgumentParser) -> None:
         "--backend — exit 3 when the whole chain fails",
     )
     p.add_argument(
-        "--max-retries", type=int, default=2, metavar="N",
-        help="transient-failure retries per backend before falling back",
+        "--max-retries", type=int, default=None, metavar="N",
+        help="transient-failure retries per backend before falling back "
+        "(default 2 when the resilient path is active)",
     )
     p.add_argument(
         "--solve-timeout", type=float, default=None, metavar="SECONDS",
@@ -168,13 +169,13 @@ def _resilience_from_args(args):
         for b in (args.fallback_chain or "").split(",")
         if b.strip()
     )
-    if not chain and args.solve_timeout is None and args.max_retries == 2:
+    if not chain and args.solve_timeout is None and args.max_retries is None:
         return None
     from .resilience import ResilienceConfig
 
     return ResilienceConfig(
         fallback_chain=chain,
-        max_retries=args.max_retries,
+        max_retries=2 if args.max_retries is None else args.max_retries,
         solve_timeout=args.solve_timeout,
     )
 

@@ -144,6 +144,9 @@ class UnknownBackendError(BackendError, KeyError):
     registry's historical type)."""
 
     kind = "unknown_backend"
+    # KeyError.__str__ reprs its argument, which would quote every CLI
+    # diagnostic and BackendChainExhausted detail ('"unknown backend ..."')
+    __str__ = Exception.__str__
 
     def __init__(self, message: str, *, backend: Optional[str] = None) -> None:
         super().__init__(message, backend=backend, transient=False)

@@ -29,9 +29,8 @@ injection harness in ``resilience.faults``).
 """
 from __future__ import annotations
 
+import threading
 import time
-from concurrent.futures import ThreadPoolExecutor
-from concurrent.futures import TimeoutError as _FuturesTimeout
 from dataclasses import dataclass, replace
 from typing import Callable, List, Optional, Sequence, Tuple
 
@@ -43,6 +42,7 @@ from .errors import (
     BackendOOM,
     BackendTimeout,
     ConfigError,
+    KvTpuError,
     classify_exception,
 )
 from .retry import RetryPolicy
@@ -92,27 +92,40 @@ def _run_with_watchdog(
 ):
     """Run one solve attempt, bounded by ``timeout`` seconds.
 
-    The attempt runs on a single-use worker thread; on timeout the thread
-    is abandoned (never joined — a hung XLA dispatch cannot be cancelled
-    from Python) and :class:`BackendTimeout` is raised so the caller can
-    retry or fall back. A fresh executor per attempt keeps an orphaned
-    hang from serializing later attempts behind it.
+    The attempt runs on a single-use **daemon** thread; on timeout it is
+    abandoned (never joined — a hung XLA dispatch cannot be cancelled from
+    Python) and :class:`BackendTimeout` is raised so the caller can retry
+    or fall back. Daemon status is what keeps the contract honest: a
+    non-daemon worker (e.g. ``ThreadPoolExecutor``'s) would be joined at
+    interpreter exit, so the very hang the watchdog detected would block
+    the CLI from ever delivering its exit code.
     """
     if timeout is None:
         return fn()
-    ex = ThreadPoolExecutor(max_workers=1, thread_name_prefix=f"kvtpu-{backend}")
-    try:
-        fut = ex.submit(fn)
+    outcome: List[Tuple[bool, object]] = []
+    done = threading.Event()
+
+    def _attempt() -> None:
         try:
-            return fut.result(timeout=timeout)
-        except _FuturesTimeout:
-            fut.cancel()
-            raise BackendTimeout(
-                f"watchdog: solve on {backend!r} exceeded {timeout}s",
-                backend=backend,
-            ) from None
-    finally:
-        ex.shutdown(wait=False)
+            outcome.append((True, fn()))
+        except BaseException as e:  # noqa: BLE001 — relayed to the caller
+            outcome.append((False, e))
+        finally:
+            done.set()
+
+    t = threading.Thread(
+        target=_attempt, name=f"kvtpu-{backend}-watchdog", daemon=True
+    )
+    t.start()
+    if not done.wait(timeout):
+        raise BackendTimeout(
+            f"watchdog: solve on {backend!r} exceeded {timeout}s",
+            backend=backend,
+        ) from None
+    ok, payload = outcome[0]
+    if ok:
+        return payload
+    raise payload  # type: ignore[misc]
 
 
 def _resilient_call(
@@ -139,6 +152,14 @@ def _resilient_call(
                 return _run_with_watchdog(
                     lambda: run_one(cfg), res.solve_timeout, backend
                 )
+            except BackendError as e:
+                err = classify_exception(e, backend)
+            except KvTpuError:
+                # IngestError / ConfigError / EncodeError ... are the
+                # caller's input bug, not infrastructure: retrying or
+                # falling back cannot fix them, and wrapping them would
+                # misreport exit 2 (input error) as exit 3 (backend failed).
+                raise
             except Exception as e:  # noqa: BLE001 — the classification point
                 err = classify_exception(e, backend)
             # -- adaptive OOM degradation: halve the tile, try again -------

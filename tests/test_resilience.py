@@ -582,3 +582,82 @@ def test_error_taxonomy_lint_passes():
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     assert mod.check() == []
+
+
+# -------------------------------------------------------- review regressions
+def test_watchdog_worker_is_daemon_and_never_blocks_exit():
+    # a hung solve must not be joined at interpreter exit: the orphaned
+    # worker has to be a daemon thread, or exit 3 is never delivered
+    import threading
+
+    cluster = _cluster(seed=23, pods=8, policies=2)
+    name = register_faulty(
+        "cpu", parse_fault_spec("timeout"), hang_seconds=5.0
+    )
+    with pytest.raises(BackendChainExhausted):
+        resilient_verify(
+            cluster,
+            resilience=ResilienceConfig(
+                fallback_chain=(name,), solve_timeout=0.1, max_retries=0
+            ),
+            sleep=_noop_sleep,
+        )
+    orphans = [
+        t for t in threading.enumerate() if "-watchdog" in t.name
+    ]
+    assert orphans  # the hung worker is still alive (5s sleep)...
+    assert all(t.daemon for t in orphans)  # ...but cannot block exit
+
+
+def test_non_backend_kvtpu_error_escapes_chain(monkeypatch):
+    # a ConfigError raised inside a solve attempt is the caller's input
+    # bug: it must not be wrapped into BackendError (exit 3), it must
+    # surface unchanged (exit 2) without burning the fallback chain
+    from kubernetes_verification_tpu.backends import base
+
+    class Boom(base.VerifierBackend):
+        name = "boom"
+
+        def verify(self, cluster, config):
+            raise ConfigError("bad label_relation")
+
+    monkeypatch.setitem(base._REGISTRY, "boom", Boom)
+    with pytest.raises(ConfigError) as ei:
+        resilient_verify(
+            _cluster(seed=27, pods=6, policies=2),
+            resilience=ResilienceConfig(fallback_chain=("boom", "cpu")),
+            sleep=_noop_sleep,
+        )
+    assert exit_code_for(ei.value) == EXIT_INPUT_ERROR
+
+
+def test_cli_explicit_default_max_retries_activates_resilience(
+    tmp_path, capsys
+):
+    # --max-retries 2 (the documented default) must behave like any other
+    # value: it activates the resilient path, so a flaky-once backend
+    # recovers on retry instead of dying on the plain dispatcher
+    from kubernetes_verification_tpu.cli import main
+
+    d = _write_manifests(tmp_path)
+    capsys.readouterr()
+    key = "backend=faulty:cpu,kind=flaky"
+    before = _counter("kvtpu_retries_total", key)
+    rc = main([
+        "verify", d, "--json",
+        "--inject-faults", "cpu=flaky@0",
+        "--backend", "faulty:cpu",
+        "--max-retries", "2",
+    ])
+    capsys.readouterr()
+    assert rc == EXIT_OK
+    assert _counter("kvtpu_retries_total", key) == before + 1
+
+
+def test_unknown_backend_error_str_is_unquoted():
+    # KeyError.__str__ reprs its argument; the taxonomy overrides it so
+    # CLI one-liners and chain post-mortems aren't wrapped in quotes
+    e = UnknownBackendError("unknown backend 'nope'", backend="nope")
+    assert str(e) == "unknown backend 'nope'"
+    post = BackendChainExhausted(("nope",), [("nope", e)])
+    assert '"' not in str(post)
