@@ -30,18 +30,48 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def _dumps_with_metrics(obj: dict) -> str:
-    """Serialise a benchmark result line, attaching the observability
-    registry dump under ``metrics`` (span timings, kernel/closure counters,
-    recompiles) so BENCH_*.json carries a breakdown alongside the headline
-    ``metric``/``value`` — which stay exactly as before."""
+def _emit(obj: dict) -> None:
+    """Print ONE benchmark result line and append the run to the history.
+
+    The printed line attaches the observability registry dump under
+    ``metrics`` (span timings, kernel/closure counters, recompiles) and,
+    when introspection is on (``--introspect``), the per-kernel cost
+    reports under ``cost`` — the headline ``metric``/``value`` stay
+    exactly as before. A copy WITHOUT the bulky ``metrics`` dump is
+    appended to ``bench_history.jsonl`` next to this script (override
+    with ``KVTPU_BENCH_HISTORY``; empty disables) so
+    ``scripts/check_bench_regression.py`` can gate the trajectory."""
+    line = dict(obj)
+    try:
+        from kubernetes_verification_tpu.observe.introspect import (
+            reports_dict,
+        )
+
+        cost = reports_dict()
+        if cost:
+            line["cost"] = cost
+            obj = {**obj, "cost": cost}
+    except Exception:
+        pass  # introspection must never cost a benchmark result line
     try:
         from kubernetes_verification_tpu.observe import dump_registry
 
-        obj = {**obj, "metrics": dump_registry(include_buckets=False)}
+        line["metrics"] = dump_registry(include_buckets=False)
     except Exception:
         pass  # a broken registry must never cost a benchmark result line
-    return json.dumps(obj)
+    hist = os.environ.get(
+        "KVTPU_BENCH_HISTORY",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "bench_history.jsonl"),
+    )
+    if hist:
+        try:
+            from kubernetes_verification_tpu.observe.history import append_run
+
+            append_run(obj, hist)
+        except Exception as exc:
+            log(f"bench history append failed ({exc!r}) — result printed anyway")
+    print(json.dumps(line))
 
 
 def _band(times) -> dict:
@@ -121,19 +151,19 @@ def bench_tiled(args) -> None:
         f"{r.timings['reachable_pairs']} reachable pairs"
     )
     ports_tag = "port bitmaps" if compute_ports else "any-port"
-    print(
-        _dumps_with_metrics(
-            {
-                "metric": (
-                    f"all-pairs reachability, {n} pods / {args.policies} "
-                    f"policies, {ports_tag} (north-star config), 1 chip"
-                ),
-                "value": round(value, 1),
-                "unit": "pairs/s",
-                "vs_baseline": round(value / BASELINE_PAIRS_PER_SEC, 4),
-                "band": band,
-            }
-        )
+    _emit(
+        {
+            "metric": (
+                f"all-pairs reachability, {n} pods / {args.policies} "
+                f"policies, {ports_tag} (north-star config), 1 chip"
+            ),
+            "value": round(value, 1),
+            "unit": "pairs/s",
+            "vs_baseline": round(value / BASELINE_PAIRS_PER_SEC, 4),
+            "band": band,
+            "compile_s": round(t3 - t2, 2),
+            "steady_s": round(solve, 4),
+        }
     )
 
 
@@ -289,26 +319,28 @@ def bench_incremental(args) -> None:
         + "  ".join(f"{kk} {v * 1e3:.1f}ms" for kk, v in piped.items())
     )
     sync_band = _band([t for v in samples.values() for t in v])
-    print(
-        _dumps_with_metrics(
-            {
-                "metric": (
-                    f"incremental diff (policy add/update/remove + pod "
-                    f"add/relabel/remove, pipelined), "
-                    f"{n} pods / {args.policies} policies, "
-                    f"{'port bitmaps' if with_ports else 'any-port'}, "
-                    "packed state, 1 chip"
-                ),
-                "value": round(overall_piped * 1e3, 2),
-                "unit": "ms",
-                # target: ≤100 ms per diff → >1.0 means better than target
-                "vs_baseline": round(0.1 / overall_piped, 4),
-                "sync_band": sync_band,
-                "piped_ms": {
-                    k: round(v * 1e3, 2) for k, v in piped.items()
-                },
-            }
-        )
+    _emit(
+        {
+            "metric": (
+                f"incremental diff (policy add/update/remove + pod "
+                f"add/relabel/remove, pipelined), "
+                f"{n} pods / {args.policies} policies, "
+                f"{'port bitmaps' if with_ports else 'any-port'}, "
+                "packed state, 1 chip"
+            ),
+            "value": round(overall_piped * 1e3, 2),
+            "unit": "ms",
+            # target: ≤100 ms per diff → >1.0 means better than target
+            "vs_baseline": round(0.1 / overall_piped, 4),
+            "sync_band": sync_band,
+            "piped_ms": {
+                k: round(v * 1e3, 2) for k, v in piped.items()
+            },
+            # init = encode+maps+first solve (compiles); the warm diffs
+            # above take per-kind compiles out of the steady figure
+            "compile_s": round(t2 - t1, 2),
+            "steady_s": round(overall_piped, 4),
+        }
     )
 
 
@@ -436,23 +468,24 @@ def bench_closure(args) -> None:
     mixed_s = time.perf_counter() - s
     log(f"closure after a mixed policy diff: {mixed_s:.2f}s "
         f"({full_s / mixed_s:.1f}x faster than full)")
-    print(
-        _dumps_with_metrics(
-            {
-                "metric": (
-                    f"packed closure after an adds-only policy diff, "
-                    f"{n} pods / {args.policies} policies (full and "
-                    "mixed-diff numbers ride along), 1 chip"
-                ),
-                "value": round(adds_s, 3),
-                "unit": "s",
-                "vs_baseline": round(full_s / adds_s, 2),
-                "full_s": round(full_s, 2),
-                "full_band": full_band,
-                "mixed_diff_s": round(mixed_s, 2),
-                "adds_diff_real": adds_real,
-            }
-        )
+    _emit(
+        {
+            "metric": (
+                f"packed closure after an adds-only policy diff, "
+                f"{n} pods / {args.policies} policies (full and "
+                "mixed-diff numbers ride along), 1 chip"
+            ),
+            "value": round(adds_s, 3),
+            "unit": "s",
+            "vs_baseline": round(full_s / adds_s, 2),
+            "full_s": round(full_s, 2),
+            "full_band": full_band,
+            "mixed_diff_s": round(mixed_s, 2),
+            "adds_diff_real": adds_real,
+            # first full closure includes compile; full_s is its steady median
+            "compile_s": round(full_first, 2),
+            "steady_s": round(full_s, 4),
+        }
     )
 
 
@@ -623,24 +656,24 @@ def bench_stripe(args) -> None:
     restripe_s = time.perf_counter() - s
     log(f"matrix-free diff {diff_s * 1e3:.1f}ms; "
         f"stripe re-verify ({tile} dsts) {restripe_s:.2f}s")
-    print(
-        _dumps_with_metrics(
-            {
-                "metric": (
-                    f"config-5 single-chip share: {n_big}-pod packed stripe "
-                    f"({width} dsts) + 250k matrix-free diff, "
-                    f"{args.policies} policies, 1 chip"
-                ),
-                "value": round(stripe_rate, 1),
-                "unit": "pairs/s",
-                "vs_baseline": round(stripe_rate / BASELINE_PAIRS_PER_SEC, 4),
-                "stripe_s": round(stripe_s, 3),
-                "stripe_band": stripe_band,
-                "mf_diff_ms": round(diff_s * 1e3, 2),
-                "mf_restripe_s": round(restripe_s, 3),
-                **sweep_extra,
-            }
-        )
+    _emit(
+        {
+            "metric": (
+                f"config-5 single-chip share: {n_big}-pod packed stripe "
+                f"({width} dsts) + 250k matrix-free diff, "
+                f"{args.policies} policies, 1 chip"
+            ),
+            "value": round(stripe_rate, 1),
+            "unit": "pairs/s",
+            "vs_baseline": round(stripe_rate / BASELINE_PAIRS_PER_SEC, 4),
+            "stripe_s": round(stripe_s, 3),
+            "stripe_band": stripe_band,
+            "mf_diff_ms": round(diff_s * 1e3, 2),
+            "mf_restripe_s": round(restripe_s, 3),
+            "compile_s": round(t2 - t1, 2),
+            "steady_s": round(stripe_s, 4),
+            **sweep_extra,
+        }
     )
 
 
@@ -681,10 +714,14 @@ def bench_headtohead(args) -> None:
         ),
     }
     kernels = {}
+    compile_s = {}
     for name, fn in variants.items():
+        s = time.perf_counter()
         r = fn()  # compile
+        compile_s[name] = round(time.perf_counter() - s, 2)
         kernels[name] = (r.meta or {}).get("kernel", "?")
-        log(f"{name}: compiled (kernel={kernels[name]})")
+        log(f"{name}: compiled in {compile_s[name]}s "
+            f"(kernel={kernels[name]})")
     reps = max(3, min(args.repeats, 7))
     times = {k: [] for k in variants}
     for i in range(reps):
@@ -701,26 +738,26 @@ def bench_headtohead(args) -> None:
     )
     log(f"pallas vs xla: {delta_pct:+.1f}% median "
         f"({'pallas slower' if delta_pct > 0 else 'pallas faster'})")
-    print(
-        _dumps_with_metrics(
-            {
-                "metric": (
-                    f"interleaved kernel A/B (xla vs pallas), {n} pods / "
-                    f"{args.policies} policies, "
-                    f"{'any-port' if args.no_ports else 'port bitmaps'}, "
-                    "1 chip"
-                ),
-                "value": round(delta_pct, 1),
-                "unit": "pallas_vs_xla_median_pct",
-                "vs_baseline": round(
-                    (float(n) * n / bands["xla"]["median_s"])
-                    / BASELINE_PAIRS_PER_SEC,
-                    4,
-                ),
-                "bands": bands,
-                "kernels": kernels,
-            }
-        )
+    _emit(
+        {
+            "metric": (
+                f"interleaved kernel A/B (xla vs pallas), {n} pods / "
+                f"{args.policies} policies, "
+                f"{'any-port' if args.no_ports else 'port bitmaps'}, "
+                "1 chip"
+            ),
+            "value": round(delta_pct, 1),
+            "unit": "pallas_vs_xla_median_pct",
+            "vs_baseline": round(
+                (float(n) * n / bands["xla"]["median_s"])
+                / BASELINE_PAIRS_PER_SEC,
+                4,
+            ),
+            "bands": bands,
+            "kernels": kernels,
+            "compile_s": compile_s,
+            "steady_s": round(bands["xla"]["median_s"], 4),
+        }
     )
 
 
@@ -776,7 +813,20 @@ def main() -> None:
         action="store_true",
         help="tiled mode: drop port bitmaps (any-port semantics)",
     )
+    ap.add_argument(
+        "--introspect",
+        action="store_true",
+        help="lower+compile each dispatched kernel once per signature and "
+        "attach per-kernel FLOP/byte/HBM cost reports to the emitted JSON "
+        "line (``cost``; see kv-tpu explain for the interactive view)",
+    )
     args = ap.parse_args()
+    if args.introspect:
+        from kubernetes_verification_tpu.observe.introspect import (
+            set_introspection,
+        )
+
+        set_introspection(True)
     if args.pods is None:
         args.pods = {
             "tiled": 100_000, "incremental": 100_000, "closure": 100_000,
@@ -882,6 +932,17 @@ def main() -> None:
     drain(out)
     t4 = time.perf_counter()
     log(f"compile+first run {t4 - t3:.2f}s")
+    # --introspect: this mode dispatches the raw jits (no DispatchTracker),
+    # so publish the cost report for the step directly
+    from kubernetes_verification_tpu.observe.introspect import maybe_publish
+
+    if args.mode == "k8s":
+        maybe_publish("bench", "k8s_step", _k8s_step, dev_args, kwargs)
+    else:
+        maybe_publish(
+            "bench", "kano_step", _kano_step, dev_args,
+            dict(with_closure=False),
+        )
 
     # Amortized steady-state throughput: pipeline K solves (async dispatch,
     # in-order device queue), one drain at the end. This is the
@@ -897,18 +958,18 @@ def main() -> None:
     log(f"solve amortized {solve * 1e3:.1f}ms over {k} pipelined runs; "
         f"{value / 1e9:.2f}e9 pairs/s")
 
-    print(
-        _dumps_with_metrics(
-            {
-                "metric": (
-                    f"all-pairs reachability throughput "
-                    f"({args.mode}, {n} pods, {args.policies} policies)"
-                ),
-                "value": round(value, 1),
-                "unit": "pairs/s",
-                "vs_baseline": round(value / BASELINE_PAIRS_PER_SEC, 4),
-            }
-        )
+    _emit(
+        {
+            "metric": (
+                f"all-pairs reachability throughput "
+                f"({args.mode}, {n} pods, {args.policies} policies)"
+            ),
+            "value": round(value, 1),
+            "unit": "pairs/s",
+            "vs_baseline": round(value / BASELINE_PAIRS_PER_SEC, 4),
+            "compile_s": round(t4 - t3, 2),
+            "steady_s": round(solve, 4),
+        }
     )
 
 

@@ -32,6 +32,7 @@ from ..models.core import (
     Rule,
 )
 from ..observe import Phases
+from ..observe.introspect import publish_host_estimate
 from ..observe.metrics import BYTES_TRANSFERRED, CLOSURE_ITERATIONS
 from .base import (
     VerifierBackend,
@@ -116,6 +117,16 @@ class CpuBackend(VerifierBackend):
                         containers[i].allow_policies.append(pi)
 
         BYTES_TRANSFERRED.labels(backend=self.name).set(0)  # pure host
+        # analytic host estimate (no XLA program to analyse): P selector
+        # sweeps over n containers plus P rank-1 outer products into [n,n]
+        publish_host_estimate(
+            self.name,
+            "verify_kano",
+            flops=len(policies) * n * (2 + n),
+            bytes_accessed=len(policies) * n * n + 2 * len(policies) * n,
+            output_bytes=reach.nbytes + src_sets.nbytes + dst_sets.nbytes,
+            signature=(n, len(policies)),
+        )
         return VerifyResult(
             n_pods=n,
             mode="kano",
@@ -276,6 +287,32 @@ class CpuBackend(VerifierBackend):
             reach = reach_pq.any(axis=2)
 
         BYTES_TRANSFERRED.labels(backend=self.name).set(0)  # pure host
+        # analytic host estimates, one per phase: selector/peer matching is
+        # the "encode" side, rule ORs into the [n,n,Q] allow tensors (then
+        # the 3-tensor combine) dominate the "solve" side
+        n_rules = sum(
+            (len(pol.ingress or ()) if affects_in[pi] else 0)
+            + (len(pol.egress or ()) if affects_eg[pi] else 0)
+            for pi, pol in enumerate(policies)
+        )
+        publish_host_estimate(
+            self.name,
+            "encode_selectors",
+            flops=(P + n_rules) * n,
+            bytes_accessed=2 * (P + n_rules) * n,
+            output_bytes=selected.nbytes,
+            signature=(n, P, Q),
+        )
+        publish_host_estimate(
+            self.name,
+            "solve_reach",
+            flops=(n_rules + 3) * n * n * Q,
+            bytes_accessed=2 * (n_rules + 3) * n * n * Q,
+            argument_bytes=selected.nbytes,
+            output_bytes=reach.nbytes + reach_pq.nbytes,
+            temp_bytes=ingress_allow.nbytes + egress_allow.nbytes,
+            signature=(n, P, Q),
+        )
         return VerifyResult(
             n_pods=n,
             mode="k8s",

@@ -32,6 +32,7 @@ from ..encode.encoder import (
 from ..models.core import Cluster, Container, KanoPolicy
 from ..native.binding import BitMatrix, pack, words
 from ..observe import Phases
+from ..observe.introspect import publish_host_estimate
 from ..observe.metrics import BYTES_TRANSFERRED
 from .base import (
     VerifierBackend,
@@ -125,6 +126,16 @@ class NativeBackend(VerifierBackend):
                 closure = cbm.to_bool()
             reach = reach_bm.to_bool()
         BYTES_TRANSFERRED.labels(backend=self.name).set(0)  # host C++ engine
+        # analytic host estimate: subset-match over packed words plus the
+        # rank-1 OR-scatter into the packed n x n matrix (64 pods per word)
+        publish_host_estimate(
+            self.name,
+            "verify_kano",
+            flops=2 * len(policies) * n * words(n) + n * words(n),
+            bytes_accessed=8 * (2 * len(policies) + n) * words(n),
+            output_bytes=reach.nbytes,
+            signature=(n, len(policies)),
+        )
         for i, c in enumerate(containers):
             c.select_policies.clear()
             c.allow_policies.clear()
@@ -254,6 +265,19 @@ class NativeBackend(VerifierBackend):
             dst_sets = eg_dst | (sel_ing & has_ing[:, None])
 
         BYTES_TRANSFERRED.labels(backend=self.name).set(0)  # host C++ engine
+        # analytic host estimate: grant evaluation + packed [n, n, Q]
+        # combine, word-parallel over 64-pod lanes
+        n_grants = len(enc.ingress.pol) + len(enc.egress.pol)
+        n_q = len(enc.atoms) if config.compute_ports else 1
+        publish_host_estimate(
+            self.name,
+            "verify_k8s",
+            flops=(n_grants + 3 * n) * n_q * words(n),
+            bytes_accessed=8 * (n_grants + 3 * n) * n_q * words(n),
+            output_bytes=reach.nbytes
+            + (reach_pq.nbytes if reach_pq is not None else 0),
+            signature=(n, P, n_q),
+        )
 
         return VerifyResult(
             n_pods=n,

@@ -120,28 +120,36 @@ class TpuBackend(VerifierBackend):
             config.direction_aware_isolation,
             config.closure,
         )
-        _TRACKER.track("_k8s_step", enc, static=flags)
+        step_args = (
+            enc.pod_kv,
+            enc.pod_key,
+            enc.pod_ns,
+            enc.ns_kv,
+            enc.ns_key,
+            enc.pol_sel,
+            enc.pol_ns,
+            enc.pol_affects_ingress,
+            enc.pol_affects_egress,
+            enc.ingress,
+            enc.egress,
+            enc.restrict_bank,
+        )
+        step_kwargs = dict(
+            self_traffic=config.self_traffic,
+            default_allow_unselected=config.default_allow_unselected,
+            direction_aware_isolation=config.direction_aware_isolation,
+            with_closure=config.closure,
+        )
+        _TRACKER.track(
+            "_k8s_step",
+            enc,
+            static=flags,
+            lower=lambda: _k8s_step.lower(*step_args, **step_kwargs),
+        )
         # "compile" covers the jitted dispatch: trace+compile on a novel
         # signature, cache-hit dispatch otherwise (execution is async)
         with ph("compile", backend=self.name):
-            out, closure = _k8s_step(
-                enc.pod_kv,
-                enc.pod_key,
-                enc.pod_ns,
-                enc.ns_kv,
-                enc.ns_key,
-                enc.pol_sel,
-                enc.pol_ns,
-                enc.pol_affects_ingress,
-                enc.pol_affects_egress,
-                enc.ingress,
-                enc.egress,
-                enc.restrict_bank,
-                self_traffic=config.self_traffic,
-                default_allow_unselected=config.default_allow_unselected,
-                direction_aware_isolation=config.direction_aware_isolation,
-                with_closure=config.closure,
-            )
+            out, closure = _k8s_step(*step_args, **step_kwargs)
         with ph("solve", backend=self.name):
             jax.block_until_ready(out.reach)
         BYTES_TRANSFERRED.labels(backend=self.name).set(
@@ -178,30 +186,46 @@ class TpuBackend(VerifierBackend):
                 enc_r = encode_kano_relation(
                     containers, policies, config.label_relation
                 )
+            step_args = (
+                enc_r.pod_kv,
+                enc_r.pod_key,
+                enc_r.src_sel,
+                enc_r.dst_sel,
+            )
             _TRACKER.track(
-                "_kano_relation_step", enc_r, static=(config.closure,)
+                "_kano_relation_step",
+                enc_r,
+                static=(config.closure,),
+                lower=lambda: _kano_relation_step.lower(
+                    *step_args, with_closure=config.closure
+                ),
             )
             with ph("compile", backend=self.name):
                 out, closure = _kano_relation_step(
-                    enc_r.pod_kv,
-                    enc_r.pod_key,
-                    enc_r.src_sel,
-                    enc_r.dst_sel,
-                    with_closure=config.closure,
+                    *step_args, with_closure=config.closure
                 )
             enc_bytes = tree_nbytes(enc_r)
         else:
             with ph("encode"):
                 enc = encode_kano(containers, policies)
-            _TRACKER.track("_kano_step", enc, static=(config.closure,))
+            step_args = (
+                enc.pod_kv,
+                enc.src_req,
+                enc.src_impossible,
+                enc.dst_req,
+                enc.dst_impossible,
+            )
+            _TRACKER.track(
+                "_kano_step",
+                enc,
+                static=(config.closure,),
+                lower=lambda: _kano_step.lower(
+                    *step_args, with_closure=config.closure
+                ),
+            )
             with ph("compile", backend=self.name):
                 out, closure = _kano_step(
-                    enc.pod_kv,
-                    enc.src_req,
-                    enc.src_impossible,
-                    enc.dst_req,
-                    enc.dst_impossible,
-                    with_closure=config.closure,
+                    *step_args, with_closure=config.closure
                 )
             enc_bytes = tree_nbytes(enc)
         with ph("solve", backend=self.name):

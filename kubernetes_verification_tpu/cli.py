@@ -596,6 +596,16 @@ def _apply_diffs(args, inc, ops, skipped_docs) -> None:
 
 
 def cmd_explain(args) -> int:
+    # two modes share the verb: per-kernel cost/memory introspection when a
+    # cluster size or backend is given, the legacy encoding+Datalog export
+    # when only a manifest PATH is
+    if args.pods is not None or args.backend is not None:
+        return _explain_cost(args)
+    if not args.path:
+        raise SystemExit(
+            "explain: give a manifest PATH (tensor/Datalog export) or "
+            "--pods N [--backend B] (per-kernel cost/memory table)"
+        )
     import kubernetes_verification_tpu as kv
     from .datalog import build_k8s_program
     from .encode.encoder import encode_cluster
@@ -612,6 +622,111 @@ def cmd_explain(args) -> int:
     print(open(txt).read().rstrip())
     print(f"wrote {args.out}.npz, {txt}, {dl}")
     return 0
+
+
+def _explain_cost(args) -> int:
+    """``kv-tpu explain --pods N --backend B``: run one verification with
+    introspection enabled and print the per-kernel cost/memory table plus a
+    device-memory snapshot. Designed to run under ``JAX_PLATFORMS=cpu`` —
+    XLA's cost analysis of the lowered program is platform-independent
+    enough to answer "which kernel dominates and is it memory-bound"."""
+    import kubernetes_verification_tpu as kv
+    from .observe import introspect, telemetry
+
+    backend = args.backend or "cpu"
+    introspect.set_introspection(True)
+    telemetry.install_span_memory_hook()
+    if args.path:
+        cluster, _ = kv.load_cluster(args.path)
+    else:
+        from .harness.generate import GeneratorConfig, random_cluster
+
+        cluster = random_cluster(
+            GeneratorConfig(
+                n_pods=args.pods or 64,
+                n_policies=args.policies,
+                n_namespaces=args.namespaces,
+                seed=args.seed,
+            )
+        )
+    config = kv.VerifyConfig(backend=backend, compute_ports=args.ports)
+    result = kv.verify(cluster, config)
+    mem = telemetry.sample_once()
+    reports = introspect.reports()
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "backend": backend,
+                    "n_pods": result.n_pods,
+                    "n_policies": len(cluster.policies),
+                    "timings": {
+                        k: round(v, 6) for k, v in result.timings.items()
+                    },
+                    "reports": [r.to_dict() for r in reports],
+                    "memory": mem,
+                },
+                sort_keys=True,
+            )
+        )
+        return 0
+    print(
+        f"# {backend} backend · {result.n_pods} pods / "
+        f"{len(cluster.policies)} policies"
+    )
+    table = introspect.format_cost_table(reports)
+    print(table if table else "(no kernels published cost reports)")
+    print()
+    print(telemetry.format_memory_table(mem))
+    print()
+    print(
+        "timings: "
+        + "  ".join(f"{k}={v:.4f}s" for k, v in sorted(result.timings.items()))
+    )
+    return 0
+
+
+def cmd_history(args) -> int:
+    """``kv-tpu history``: show the bench-history trajectory and the
+    regression gate's verdict over it."""
+    from .observe.history import (
+        check_regression,
+        default_paths,
+        format_findings,
+        load_runs,
+    )
+
+    paths = args.paths or default_paths()
+    runs = load_runs(paths)
+    if args.json:
+        ok, findings = check_regression(
+            runs, tolerance=args.tolerance, window=args.window
+        )
+        print(
+            json.dumps(
+                {"ok": ok, "runs": runs, "findings": findings}, sort_keys=True
+            )
+        )
+        return 0 if ok else 1
+    if not runs:
+        print(
+            "no bench history found (run bench.py to append to "
+            "bench_history.jsonl)"
+        )
+        return 0
+    for r in runs:
+        extras = "".join(
+            f"  {k}={r[k]}"
+            for k in ("compile_s", "steady_s", "round")
+            if r.get(k) is not None
+        )
+        print(f"{r['metric']}: {r['value']:.6g} {r.get('unit', '')}{extras}")
+    ok, findings = check_regression(
+        runs, tolerance=args.tolerance, window=args.window
+    )
+    print()
+    print(format_findings(findings))
+    return 0 if ok else 1
 
 
 def cmd_generate(args) -> int:
@@ -734,11 +849,51 @@ def main(argv: Optional[list] = None) -> int:
     _add_obs_flags(p)
     p.set_defaults(fn=cmd_diff)
 
-    p = sub.add_parser("explain", help="export encoded model + Datalog program")
-    p.add_argument("path")
+    p = sub.add_parser(
+        "explain",
+        help="export encoded model + Datalog program (PATH), or print a "
+        "per-kernel cost/memory table (--pods/--backend)",
+    )
+    p.add_argument("path", nargs="?")
     p.add_argument("--out", default="model")
     p.add_argument("--no-ports", dest="ports", action="store_false")
+    p.add_argument(
+        "--pods", type=int, default=None,
+        help="cost mode: synthesize a cluster of this many pods and report "
+        "per-kernel FLOPs/bytes/peak memory (runs fine under "
+        "JAX_PLATFORMS=cpu)",
+    )
+    p.add_argument("--policies", type=int, default=8)
+    p.add_argument("--namespaces", type=int, default=3)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument(
+        "--backend", default=None,
+        help="cost mode: backend to introspect (default cpu)",
+    )
+    p.add_argument("--json", action="store_true")
     p.set_defaults(fn=cmd_explain)
+
+    p = sub.add_parser(
+        "history",
+        help="show the bench-history trajectory and the regression gate "
+        "verdict (exit 1 on a regression)",
+    )
+    p.add_argument(
+        "paths", nargs="*",
+        help="history files (default: bench_history.jsonl, else the "
+        "committed BENCH_r*.json snapshots)",
+    )
+    p.add_argument(
+        "--tolerance", type=float, default=0.25,
+        help="relative slip vs. the trailing median before flagging "
+        "(default 0.25)",
+    )
+    p.add_argument(
+        "--window", type=int, default=5,
+        help="trailing runs the median is taken over (default 5)",
+    )
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_history)
 
     p = sub.add_parser("generate", help="write a synthetic cluster as YAML")
     p.add_argument("dir")

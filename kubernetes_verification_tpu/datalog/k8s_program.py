@@ -48,6 +48,7 @@ from ..backends.base import (
 from ..encode.vocab import Vocab
 from ..models.core import Cluster, Container, KanoPolicy, Selector
 from ..observe import Phases
+from ..observe.introspect import publish_host_estimate as _publish_host_estimate
 from ..observe.metrics import BYTES_TRANSFERRED
 from .engine import Atom, Program, Solution, solve
 
@@ -441,6 +442,20 @@ class DatalogBackend(VerifierBackend):
         )
         src_sets = ing_allow | (sel_eg & has_eg[:, None])
         dst_sets = eg_allow | (sel_ing & has_ing[:, None])
+        # analytic host estimate: semi-naive evaluation touches each dense
+        # relation tensor once per stratum; the [N, N, Q] allow/edge
+        # relations dominate
+        n_q = (
+            sol["edge_q"].shape[2] if "edge_q" in sol.relations else 1
+        )
+        _publish_host_estimate(
+            self.name,
+            "solve_datalog",
+            flops=3 * N * N * n_q + 2 * P * N,
+            bytes_accessed=2 * (3 * N * N * n_q + 2 * P * N),
+            output_bytes=sol["edge"].nbytes,
+            signature=(N, P, n_q),
+        )
         return VerifyResult(
             n_pods=N,
             mode="k8s",
@@ -479,6 +494,15 @@ class DatalogBackend(VerifierBackend):
             c.select_policies.extend(np.nonzero(src_sets[:, i])[0].tolist())
             c.allow_policies.extend(np.nonzero(dst_sets[:, i])[0].tolist())
         reach = sol["reach"]
+        n = len(containers)
+        _publish_host_estimate(
+            self.name,
+            "solve_datalog_kano",
+            flops=P * n * (2 + n),
+            bytes_accessed=2 * P * n * n,
+            output_bytes=reach.nbytes,
+            signature=(n, P),
+        )
         closure = None
         if config.closure:
             from ..backends.cpu import _transitive_closure

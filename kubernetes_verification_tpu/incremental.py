@@ -251,7 +251,15 @@ class IncrementalVerifier:
 
     def _apply(self, vecs, sign: int) -> None:
         sel_ing, sel_eg, ing_peers, eg_peers = (jnp.asarray(v) for v in vecs)
-        _TRACKER.track("_rank1_add", self._ing_count, ing_peers, sel_ing)
+        _TRACKER.track(
+            "_rank1_add",
+            self._ing_count,
+            ing_peers,
+            sel_ing,
+            lower=lambda: _rank1_add.lower(
+                self._ing_count, ing_peers, sel_ing, sign
+            ),
+        )
         self._ing_count = _rank1_add(self._ing_count, ing_peers, sel_ing, sign)
         self._eg_count = _rank1_add(self._eg_count, sel_eg, eg_peers, sign)
         self._ing_iso += sign * np.asarray(vecs[0], dtype=np.int64)
@@ -332,12 +340,16 @@ class IncrementalVerifier:
             for vec, f in zip(self._vectors[key], flags):
                 vec[idx] = f
         new = row_col_sums()
-        _TRACKER.track("_row_col_patch", self._ing_count)
-        self._ing_count = _row_col_patch(
-            self._ing_count, idx,
-            jnp.asarray(new[0] - old[0], dtype=_I32),
-            jnp.asarray(new[1] - old[1], dtype=_I32),
+        d_row = jnp.asarray(new[0] - old[0], dtype=_I32)
+        d_col = jnp.asarray(new[1] - old[1], dtype=_I32)
+        _TRACKER.track(
+            "_row_col_patch",
+            self._ing_count,
+            lower=lambda: _row_col_patch.lower(
+                self._ing_count, idx, d_row, d_col
+            ),
         )
+        self._ing_count = _row_col_patch(self._ing_count, idx, d_row, d_col)
         self._eg_count = _row_col_patch(
             self._eg_count, idx,
             jnp.asarray(new[2] - old[2], dtype=_I32),
@@ -415,6 +427,14 @@ class IncrementalVerifier:
                 static=(
                     self.config.self_traffic,
                     self.config.default_allow_unselected,
+                ),
+                lower=lambda: _derive_reach.lower(
+                    self._ing_count,
+                    self._eg_count,
+                    jnp.asarray(self._ing_iso, dtype=_I32),
+                    jnp.asarray(self._eg_iso, dtype=_I32),
+                    self_traffic=self.config.self_traffic,
+                    default_allow_unselected=self.config.default_allow_unselected,
                 ),
             )
             self._reach = np.asarray(

@@ -14,15 +14,29 @@ One import surface for the whole stack:
   (``jit``).
 * ``dump_registry`` / ``write_metrics`` / ``to_prometheus`` — exporters
   (``export``).
+* ``KernelCostReport`` / ``set_introspection`` / ``maybe_publish`` — AOT
+  cost/memory analysis of compiled dispatch sites (``introspect``).
+* ``memory_snapshot`` / ``start_sampler`` — live device-memory telemetry
+  with host-RSS fallback (``telemetry``).
+* ``append_run`` / ``check_regression`` — the bench-history store and
+  regression gate (``history``).
 
 ``utils.observe`` re-exports the seed-era names from here for backward
 compatibility.
 """
 from __future__ import annotations
 
-from . import metrics
+from . import history, introspect, metrics, telemetry
 from .events import configure_logging, log_event, logger
 from .export import dump_registry, to_prometheus, write_metrics
+from .history import append_run, check_regression, load_runs
+from .introspect import (
+    KernelCostReport,
+    format_cost_table,
+    maybe_publish,
+    publish_host_estimate,
+    set_introspection,
+)
 from .jit import DispatchTracker, abstract_signature, tree_nbytes
 from .registry import (
     DEFAULT_BUCKETS,
@@ -33,10 +47,47 @@ from .registry import (
     Histogram,
     MetricsRegistry,
 )
-from .spans import Phases, Span, current_span, profile_to, trace
+from .spans import (
+    Phases,
+    Span,
+    current_span,
+    profile_to,
+    set_memory_hook,
+    trace,
+    trace_to_dir,
+)
+from .telemetry import (
+    TelemetrySampler,
+    format_memory_table,
+    install_span_memory_hook,
+    memory_snapshot,
+    sample_once,
+    start_sampler,
+    stop_sampler,
+)
 
 __all__ = [
     "metrics",
+    "introspect",
+    "telemetry",
+    "history",
+    "KernelCostReport",
+    "format_cost_table",
+    "maybe_publish",
+    "publish_host_estimate",
+    "set_introspection",
+    "TelemetrySampler",
+    "format_memory_table",
+    "install_span_memory_hook",
+    "memory_snapshot",
+    "sample_once",
+    "start_sampler",
+    "stop_sampler",
+    "append_run",
+    "check_regression",
+    "load_runs",
+    "set_memory_hook",
+    "trace_to_dir",
     "configure_logging",
     "log_event",
     "logger",

@@ -1,0 +1,179 @@
+"""Benchmark history: an append-only JSONL store plus a regression gate.
+
+``bench.py`` appends every result line (headline metric + compile/steady
+split + cost reports) to ``bench_history.jsonl``; the gate compares the
+newest value per metric series against the trailing median of the previous
+runs and flags a configurable relative slip. Two on-disk shapes are
+understood, so the gate also runs directly over the repo's recorded
+``BENCH_r0*.json`` trajectory:
+
+* one JSON object per line with ``metric``/``value``/``unit`` keys (what
+  ``append_run`` writes);
+* a whole-file JSON wrapper with a ``parsed`` sub-object carrying those
+  keys (the driver snapshots in ``BENCH_r0*.json``).
+
+Regression direction comes from the unit: throughput units are
+higher-is-better, latency units lower-is-better, anything unrecognised is
+reported but never gated (a delta-percent series has no universal "worse"
+direction).
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import time
+from typing import Dict, Iterable, List, Optional, Tuple
+
+__all__ = [
+    "DEFAULT_HISTORY",
+    "append_run",
+    "load_runs",
+    "check_regression",
+    "format_findings",
+]
+
+DEFAULT_HISTORY = "bench_history.jsonl"
+
+#: unit -> gate direction; anything else is "unknown" and not gated
+_HIGHER_IS_BETTER = frozenset({"pairs/s", "pairs_per_second", "ops/s", "qps"})
+_LOWER_IS_BETTER = frozenset({"s", "ms", "us", "seconds", "bytes"})
+
+
+def append_run(record: dict, path: str = DEFAULT_HISTORY) -> dict:
+    """Append one result record (must carry ``metric`` and ``value``) to the
+    history file, stamping ``ts`` when absent. Returns the stored record."""
+    rec = dict(record)
+    rec.setdefault("ts", round(time.time(), 3))
+    with open(path, "a") as fh:
+        fh.write(json.dumps(rec, sort_keys=True) + "\n")
+    return rec
+
+
+def _entry(obj, origin: str) -> Optional[dict]:
+    """Normalise one decoded JSON object to a gate entry, unwrapping the
+    driver's ``{"n": .., "parsed": {...}}`` snapshot shape."""
+    if not isinstance(obj, dict):
+        return None
+    if "metric" not in obj and isinstance(obj.get("parsed"), dict):
+        inner = dict(obj["parsed"])
+        inner.setdefault("round", obj.get("n"))
+        obj = inner
+    if "metric" not in obj or "value" not in obj:
+        return None
+    try:
+        value = float(obj["value"])
+    except (TypeError, ValueError):
+        return None
+    out = dict(obj)
+    out["value"] = value
+    out["origin"] = origin
+    return out
+
+
+def load_runs(paths: Iterable[str]) -> List[dict]:
+    """Parse history entries from JSONL and/or whole-file JSON paths, in
+    the given order (order defines "newest" within a series). Unreadable
+    files and unparseable lines are skipped — the gate reports on whatever
+    survives."""
+    runs: List[dict] = []
+    for path in paths:
+        try:
+            with open(path) as fh:
+                text = fh.read().strip()
+        except OSError:
+            continue
+        if not text:
+            continue
+        objs = []
+        try:
+            objs = [json.loads(text)]  # whole-file JSON (BENCH_r0*.json)
+        except ValueError:
+            for line in text.splitlines():
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    objs.append(json.loads(line))
+                except ValueError:
+                    continue
+        for obj in objs:
+            e = _entry(obj, path)
+            if e is not None:
+                runs.append(e)
+    return runs
+
+
+def default_paths(root: str = ".") -> List[str]:
+    """The history file when present, else the committed BENCH_r*.json
+    trajectory snapshots."""
+    hist = os.path.join(root, DEFAULT_HISTORY)
+    if os.path.exists(hist):
+        return [hist]
+    return sorted(glob.glob(os.path.join(root, "BENCH_r*.json")))
+
+
+def _direction(unit: Optional[str]) -> str:
+    if unit in _HIGHER_IS_BETTER:
+        return "higher"
+    if unit in _LOWER_IS_BETTER:
+        return "lower"
+    return "unknown"
+
+
+def check_regression(
+    runs: List[dict], tolerance: float = 0.25, window: int = 5
+) -> Tuple[bool, List[dict]]:
+    """Group runs by (metric, unit) series; within each series with ≥ 2
+    entries, compare the newest value against the median of up to
+    ``window`` preceding runs. A drop (throughput) or rise (latency) beyond
+    ``tolerance`` (relative) regresses. Returns (ok, findings)."""
+    series: Dict[Tuple[str, Optional[str]], List[dict]] = {}
+    for r in runs:
+        series.setdefault((r["metric"], r.get("unit")), []).append(r)
+    findings: List[dict] = []
+    for (metric, unit), rs in sorted(series.items()):
+        if len(rs) < 2:
+            continue
+        newest = rs[-1]
+        prev = rs[:-1][-window:]
+        vals = sorted(r["value"] for r in prev)
+        median = vals[len(vals) // 2]
+        direction = _direction(unit)
+        finding = {
+            "metric": metric,
+            "unit": unit,
+            "direction": direction,
+            "newest": newest["value"],
+            "trailing_median": median,
+            "n_previous": len(prev),
+            "regressed": False,
+        }
+        if median > 0 and direction != "unknown":
+            ratio = newest["value"] / median
+            finding["ratio"] = round(ratio, 4)
+            if direction == "higher":
+                finding["regressed"] = ratio < 1.0 - tolerance
+            else:
+                finding["regressed"] = ratio > 1.0 + tolerance
+        findings.append(finding)
+    ok = not any(f["regressed"] for f in findings)
+    return ok, findings
+
+
+def format_findings(findings: List[dict]) -> str:
+    if not findings:
+        return "no metric series with >= 2 runs; nothing to gate"
+    lines = []
+    for f in findings:
+        ratio = f.get("ratio")
+        verdict = "REGRESSED" if f["regressed"] else (
+            "ok" if f["direction"] != "unknown" else "ungated"
+        )
+        lines.append(
+            f"[{verdict:>9}] {f['metric']} ({f['unit']}, {f['direction']}"
+            f"-is-better): newest={f['newest']:.6g} vs median({f['n_previous']}"
+            f" prev)={f['trailing_median']:.6g}"
+            + (f" ratio={ratio:.3f}" if ratio is not None else "")
+        )
+    return "\n".join(lines)

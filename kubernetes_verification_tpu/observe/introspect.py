@@ -1,0 +1,362 @@
+"""Per-kernel cost/memory introspection via the JAX AOT API.
+
+The observe layer so far records *wall-clock* facts (spans, counters); this
+module records what the compiled XLA programs actually *cost*: FLOPs and
+bytes accessed from ``compiled.cost_analysis()``, and argument/output/temp
+bytes from ``compiled.memory_analysis()``, folded into a structured
+``KernelCostReport`` with an arithmetic-intensity figure positioned against
+a per-platform roofline ridge (TPU-KNN, arXiv:2206.14286, argues per-kernel
+cost models are what make peak-FLOP/s reasoning possible at all).
+
+Publishing is **off by default** and explicitly enabled (``kv-tpu
+explain``, ``bench.py --introspect``, or ``KVTPU_INTROSPECT=1``): the AOT
+path re-lowers and re-compiles the dispatch — ``jitted.lower(*args)
+.compile()`` does not share jit's executable cache — so an always-on pass
+would double every compile cliff. Dispatch sites therefore hand the tracker
+a zero-arg ``lower=`` closure that is only evaluated when introspection is
+on AND the abstract signature is new (``DispatchTracker.track``), mirroring
+the recompile cache in ``observe/jit.py``.
+
+Pure-host backends (cpu, datalog, native) have no XLA program to analyse;
+they publish analytic order-of-magnitude estimates through
+``publish_host_estimate`` so ``kv-tpu explain --backend cpu`` still renders
+a cost/memory table (``source=host-estimate`` marks those rows).
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .events import log_event
+from .metrics import (
+    COST_REPORTS_TOTAL,
+    KERNEL_BYTES_ACCESSED,
+    KERNEL_FLOPS,
+    KERNEL_PEAK_BYTES,
+)
+
+__all__ = [
+    "KernelCostReport",
+    "introspection_enabled",
+    "set_introspection",
+    "publish_compiled",
+    "publish_host_estimate",
+    "maybe_publish",
+    "reports",
+    "reports_dict",
+    "clear_reports",
+    "format_cost_table",
+    "roofline_ridge",
+]
+
+#: Machine-balance ridge points (FLOP/byte at which a kernel flips from
+#: memory- to compute-bound), per platform. TPU: v5e-class bf16 peak
+#: (~197 TFLOP/s) over HBM bandwidth (~819 GB/s) ≈ 240. CPU: order of a
+#: server core's FMA throughput over DRAM bandwidth. Coarse by design —
+#: the table labels a kernel "memory"- or "compute"-bound, not a percent.
+_RIDGE_FLOPS_PER_BYTE = {"tpu": 240.0, "gpu": 80.0, "cpu": 10.0, "host": 10.0}
+
+_ENV_FLAG = "KVTPU_INTROSPECT"
+
+_lock = threading.RLock()
+_enabled: Optional[bool] = None  # None = defer to the env var
+_reports: Dict[Tuple[str, str, object], "KernelCostReport"] = {}
+
+
+@dataclasses.dataclass(frozen=True)
+class KernelCostReport:
+    """Structured cost/memory summary of one compiled dispatch site."""
+
+    engine: str
+    fn: str
+    platform: str
+    source: str  # "xla" (AOT cost/memory analysis) | "host-estimate"
+    flops: int
+    bytes_accessed: int
+    argument_bytes: int
+    output_bytes: int
+    temp_bytes: int
+    peak_bytes: int
+    generated_code_bytes: int = 0
+
+    @property
+    def arithmetic_intensity(self) -> float:
+        """FLOPs per byte of memory traffic — the roofline x-axis."""
+        return self.flops / self.bytes_accessed if self.bytes_accessed else 0.0
+
+    @property
+    def ridge_flops_per_byte(self) -> float:
+        return roofline_ridge(self.platform)
+
+    @property
+    def roofline_bound(self) -> str:
+        """Which roofline the kernel sits under on its platform."""
+        ridge = self.ridge_flops_per_byte
+        if not self.flops or not self.bytes_accessed:
+            return "n/a"
+        return "compute" if self.arithmetic_intensity >= ridge else "memory"
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["arithmetic_intensity"] = round(self.arithmetic_intensity, 4)
+        d["ridge_flops_per_byte"] = self.ridge_flops_per_byte
+        d["roofline_bound"] = self.roofline_bound
+        return d
+
+
+def roofline_ridge(platform: str) -> float:
+    return _RIDGE_FLOPS_PER_BYTE.get(platform, _RIDGE_FLOPS_PER_BYTE["host"])
+
+
+# ------------------------------------------------------------------ gating
+def introspection_enabled() -> bool:
+    if _enabled is not None:
+        return _enabled
+    return os.environ.get(_ENV_FLAG, "").lower() not in ("", "0", "false")
+
+
+def set_introspection(on: bool) -> None:
+    """Force introspection on/off for this process (overrides the
+    KVTPU_INTROSPECT env var)."""
+    global _enabled
+    _enabled = bool(on)
+
+
+# ------------------------------------------------------------- publishing
+def _store(key: Tuple[str, str, object], rep: KernelCostReport) -> None:
+    with _lock:
+        _reports[key] = rep
+    KERNEL_FLOPS.labels(engine=rep.engine, fn=rep.fn).set(rep.flops)
+    KERNEL_BYTES_ACCESSED.labels(engine=rep.engine, fn=rep.fn).set(
+        rep.bytes_accessed
+    )
+    KERNEL_PEAK_BYTES.labels(engine=rep.engine, fn=rep.fn).set(rep.peak_bytes)
+    COST_REPORTS_TOTAL.labels(
+        engine=rep.engine, fn=rep.fn, source=rep.source
+    ).inc()
+    log_event(
+        "kernel_cost_report",
+        engine=rep.engine,
+        fn=rep.fn,
+        source=rep.source,
+        flops=rep.flops,
+        bytes_accessed=rep.bytes_accessed,
+        peak_bytes=rep.peak_bytes,
+        bound=rep.roofline_bound,
+    )
+
+
+def _first_cost_dict(cost) -> dict:
+    """``compiled.cost_analysis()`` is a dict on new jax, a list of dicts on
+    older versions, or None when the backend doesn't implement it."""
+    if isinstance(cost, dict):
+        return cost
+    if isinstance(cost, (list, tuple)) and cost and isinstance(cost[0], dict):
+        return cost[0]
+    return {}
+
+
+def publish_compiled(
+    engine: str,
+    fn: str,
+    lower: Callable[[], object],
+    signature: object = None,
+) -> Optional[KernelCostReport]:
+    """Evaluate a zero-arg ``lower`` closure (returning ``jitted.lower(...)``
+    or an already-``.compile()``d executable), extract cost/memory analysis,
+    and cache the report per (engine, fn, signature). No-op when
+    introspection is disabled; never raises — an unanalysable kernel logs
+    an event and returns None."""
+    if not introspection_enabled():
+        return None
+    key = (engine, fn, signature)
+    with _lock:
+        if key in _reports:
+            return _reports[key]
+    try:
+        obj = lower()
+        compiled = obj.compile() if hasattr(obj, "compile") else obj
+        cost = _first_cost_dict(compiled.cost_analysis())
+        mem = None
+        try:
+            mem = compiled.memory_analysis()
+        except Exception:  # some backends lower but don't expose memory
+            mem = None
+        platform = "cpu"
+        try:
+            platform = compiled.devices()[0].platform
+        except Exception:
+            pass
+    except Exception as e:  # AOT analysis must never break the solve path
+        log_event(
+            "introspect_error", engine=engine, fn=fn, error=f"{type(e).__name__}: {e}"
+        )
+        return None
+    arg_b = int(getattr(mem, "argument_size_in_bytes", 0) or 0)
+    out_b = int(getattr(mem, "output_size_in_bytes", 0) or 0)
+    tmp_b = int(getattr(mem, "temp_size_in_bytes", 0) or 0)
+    alias_b = int(getattr(mem, "alias_size_in_bytes", 0) or 0)
+    rep = KernelCostReport(
+        engine=engine,
+        fn=fn,
+        platform=platform,
+        source="xla",
+        flops=int(cost.get("flops", 0) or 0),
+        bytes_accessed=int(cost.get("bytes accessed", 0) or 0),
+        argument_bytes=arg_b,
+        output_bytes=out_b,
+        temp_bytes=tmp_b,
+        # live high-water mark: everything resident at once, minus buffers
+        # the executable aliases input->output
+        peak_bytes=max(0, arg_b + out_b + tmp_b - alias_b),
+        generated_code_bytes=int(
+            getattr(mem, "generated_code_size_in_bytes", 0) or 0
+        ),
+    )
+    _store(key, rep)
+    return rep
+
+
+def maybe_publish(
+    engine: str, fn: str, jitted, args: Tuple = (), kwargs: Optional[dict] = None
+) -> Optional[KernelCostReport]:
+    """Publish a cost report for ``jitted(*args, **kwargs)`` keyed by the
+    operands' abstract signature. For dispatch sites without a
+    ``DispatchTracker`` (the sharded ops build their shard_map jits
+    per-call); cheap no-op when introspection is off."""
+    if not introspection_enabled():
+        return None
+    from .jit import abstract_signature
+
+    kwargs = kwargs or {}
+    sig = (
+        abstract_signature(args),
+        tuple(sorted((k, abstract_signature(v)) for k, v in kwargs.items())),
+    )
+    return publish_compiled(
+        engine, fn, lambda: jitted.lower(*args, **kwargs), signature=sig
+    )
+
+
+def _host_peak_bytes() -> int:
+    """Peak RSS of this process — the host analogue of peak HBM."""
+    try:
+        import resource
+        import sys
+
+        ru = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        return int(ru) * (1 if sys.platform == "darwin" else 1024)
+    except Exception:  # pragma: no cover - resource is POSIX-only
+        return 0
+
+
+def publish_host_estimate(
+    engine: str,
+    fn: str,
+    *,
+    flops: int,
+    bytes_accessed: int,
+    argument_bytes: int = 0,
+    output_bytes: int = 0,
+    temp_bytes: int = 0,
+    signature: object = None,
+) -> Optional[KernelCostReport]:
+    """Analytic cost report for a pure-host kernel (no XLA program to
+    lower): the caller supplies order-of-magnitude FLOP/byte counts from
+    its problem shape; peak memory falls back to process peak RSS."""
+    if not introspection_enabled():
+        return None
+    key = (engine, fn, signature)
+    with _lock:
+        if key in _reports:
+            return _reports[key]
+    rep = KernelCostReport(
+        engine=engine,
+        fn=fn,
+        platform="host",
+        source="host-estimate",
+        flops=int(flops),
+        bytes_accessed=int(bytes_accessed),
+        argument_bytes=int(argument_bytes),
+        output_bytes=int(output_bytes),
+        temp_bytes=int(temp_bytes),
+        peak_bytes=_host_peak_bytes(),
+    )
+    _store(key, rep)
+    return rep
+
+
+# -------------------------------------------------------------- reporting
+def reports() -> List[KernelCostReport]:
+    """All published reports, in publication order."""
+    with _lock:
+        return list(_reports.values())
+
+
+def reports_dict() -> List[dict]:
+    """JSON-ready report list (what bench.py attaches to its result line)."""
+    return [r.to_dict() for r in reports()]
+
+
+def clear_reports() -> None:
+    with _lock:
+        _reports.clear()
+
+
+def _fmt_count(v: float) -> str:
+    """Engineering-style count: 0, 999, 1.2e6."""
+    v = float(v)
+    if v == 0:
+        return "0"
+    if abs(v) < 1e4:
+        return str(int(v)) if v == int(v) else f"{v:.1f}"
+    return f"{v:.2e}"
+
+
+def _fmt_bytes(v: float) -> str:
+    v = float(v)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(v) < 1024 or unit == "TiB":
+            return f"{v:.0f}{unit}" if unit == "B" else f"{v:.1f}{unit}"
+        v /= 1024
+    return f"{v:.1f}TiB"  # pragma: no cover - unreachable
+
+
+def format_cost_table(reps: Optional[List[KernelCostReport]] = None) -> str:
+    """Fixed-width per-kernel cost/memory table (the ``kv-tpu explain``
+    body). Empty string when nothing was published."""
+    reps = reports() if reps is None else list(reps)
+    if not reps:
+        return ""
+    header = (
+        "engine", "kernel", "src", "flops", "bytes", "flops/B",
+        "bound", "peak", "args", "out", "temp",
+    )
+    rows = [header]
+    for r in reps:
+        rows.append(
+            (
+                r.engine,
+                r.fn,
+                r.source if r.source == "xla" else "host",
+                _fmt_count(r.flops),
+                _fmt_bytes(r.bytes_accessed),
+                _fmt_count(round(r.arithmetic_intensity, 2)),
+                r.roofline_bound,
+                _fmt_bytes(r.peak_bytes),
+                _fmt_bytes(r.argument_bytes),
+                _fmt_bytes(r.output_bytes),
+                _fmt_bytes(r.temp_bytes),
+            )
+        )
+    widths = [max(len(row[i]) for row in rows) for i in range(len(header))]
+    lines = []
+    for ri, row in enumerate(rows):
+        lines.append(
+            "  ".join(cell.ljust(widths[i]) for i, cell in enumerate(row)).rstrip()
+        )
+        if ri == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    return "\n".join(lines)

@@ -69,9 +69,15 @@ class DispatchTracker:
         self.engine = engine
         self._seen: Dict[str, Set[Tuple]] = {}
 
-    def track(self, fn: str, *operands, static: Tuple = ()) -> bool:
+    def track(self, fn: str, *operands, static: Tuple = (), lower=None) -> bool:
         """Record one dispatch of ``fn``; returns True (and bumps the
-        recompile counter) when this abstract signature is new."""
+        recompile counter) when this abstract signature is new.
+
+        ``lower`` is an optional zero-arg closure returning
+        ``jitted.lower(<the real dispatch args>)`` — evaluated only when the
+        signature is new AND introspection is enabled, publishing a
+        ``KernelCostReport`` for the fresh compile (the AOT analysis pass
+        does not share jit's executable cache, so it must stay opt-in)."""
         sig = (tuple(static), abstract_signature(operands))
         seen = self._seen.setdefault(fn, set())
         if sig in seen:
@@ -84,6 +90,10 @@ class DispatchTracker:
             fn=fn,
             signatures=len(seen),
         )
+        if lower is not None:
+            from .introspect import publish_compiled
+
+            publish_compiled(self.engine, fn, lower, signature=sig)
         return True
 
     def signatures(self, fn: str) -> int:

@@ -30,6 +30,12 @@ __all__ = [
     "FALLBACKS_TOTAL",
     "FAULTS_INJECTED_TOTAL",
     "DEGRADATIONS_TOTAL",
+    "HBM_BYTES_IN_USE",
+    "HBM_PEAK_BYTES",
+    "KERNEL_FLOPS",
+    "KERNEL_BYTES_ACCESSED",
+    "KERNEL_PEAK_BYTES",
+    "COST_REPORTS_TOTAL",
 ]
 
 SPAN_SECONDS = Histogram(
@@ -136,4 +142,50 @@ DEGRADATIONS_TOTAL = Counter(
     "Adaptive tile-size halvings applied after RESOURCE_EXHAUSTED before "
     "falling back to the next backend.",
     ("backend",),
+)
+
+HBM_BYTES_IN_USE = Gauge(
+    "kvtpu_hbm_bytes_in_use",
+    "Device memory in use at the most recent telemetry sample, per device "
+    "(host RSS under device=host when the platform exposes no "
+    "memory_stats(), e.g. the CPU backend).",
+    ("device",),
+)
+
+HBM_PEAK_BYTES = Gauge(
+    "kvtpu_hbm_peak_bytes",
+    "Peak device memory since process start, per device (peak host RSS "
+    "under device=host on platforms without memory_stats()).",
+    ("device",),
+)
+
+KERNEL_FLOPS = Gauge(
+    "kvtpu_kernel_flops",
+    "XLA cost_analysis() FLOP estimate for the most recent compile of a "
+    "jitted dispatch site (host-side analytic estimate for pure-NumPy "
+    "backends), by engine and function.",
+    ("engine", "fn"),
+)
+
+KERNEL_BYTES_ACCESSED = Gauge(
+    "kvtpu_kernel_bytes_accessed",
+    "XLA cost_analysis() bytes-accessed estimate for the most recent "
+    "compile of a jitted dispatch site — the memory-traffic side of the "
+    "roofline.",
+    ("engine", "fn"),
+)
+
+KERNEL_PEAK_BYTES = Gauge(
+    "kvtpu_kernel_peak_bytes",
+    "Peak live bytes (arguments + outputs + temporaries) from "
+    "memory_analysis() for the most recent compile of a jitted dispatch "
+    "site — the HBM high-water mark the executable needs.",
+    ("engine", "fn"),
+)
+
+COST_REPORTS_TOTAL = Counter(
+    "kvtpu_cost_reports_total",
+    "KernelCostReports published by the introspection layer, by engine/"
+    "function and source (xla AOT lowering vs. host analytic estimate).",
+    ("engine", "fn", "source"),
 )

@@ -28,9 +28,37 @@ from typing import Dict, Iterator, Optional
 from .events import log_event
 from .metrics import SPAN_SECONDS
 
-__all__ = ["Span", "trace", "current_span", "Phases", "profile_to"]
+__all__ = [
+    "Span",
+    "trace",
+    "current_span",
+    "Phases",
+    "profile_to",
+    "trace_to_dir",
+    "set_memory_hook",
+]
 
 _state = threading.local()
+
+#: optional () -> int callable returning live memory bytes; when installed
+#: (``telemetry.install_span_memory_hook``) every span records
+#: ``mem_enter_bytes``/``mem_exit_bytes`` in its event line
+_memory_hook = None
+
+
+def set_memory_hook(hook) -> None:
+    """Install (or clear, with None) the span memory snapshot hook."""
+    global _memory_hook
+    _memory_hook = hook
+
+
+def _memory_bytes():
+    if _memory_hook is None:
+        return None
+    try:
+        return int(_memory_hook())
+    except Exception:  # telemetry must never fail a traced region
+        return None
 
 
 def _stack() -> list:
@@ -76,6 +104,9 @@ def trace(name: str, _event: str = "span", **attrs) -> Iterator[Span]:
     """Open a nested span; yields the live ``Span`` so callers can attach
     attrs mid-flight (``span.attrs["rounds"] = r``)."""
     span = Span(name=name, attrs=dict(attrs), parent=current_span())
+    mem0 = _memory_bytes()
+    if mem0 is not None:
+        span.attrs["mem_enter_bytes"] = mem0
     _stack().append(span)
     t0 = time.perf_counter()
     try:
@@ -88,6 +119,9 @@ def trace(name: str, _event: str = "span", **attrs) -> Iterator[Span]:
         span.seconds = time.perf_counter() - t0
         _stack().pop()
         SPAN_SECONDS.labels(name=name).observe(span.seconds)
+        mem1 = _memory_bytes()
+        if mem1 is not None:
+            span.attrs["mem_exit_bytes"] = mem1
         fields = dict(span.attrs)
         fields.update(name=name, seconds=span.seconds)
         if span.parent is not None:
@@ -124,14 +158,58 @@ class Phases:
 @contextlib.contextmanager
 def profile_to(log_dir: str) -> Iterator[None]:
     """Capture a jax profiler trace into ``log_dir`` (TensorBoard format).
-    No-op (with a warning event) when jax is unavailable."""
+
+    Degrades to a no-op — one hint line on stderr plus a
+    ``profile_skipped`` event — when jax is unavailable or the platform has
+    no profiler support, instead of failing the whole command. Creates
+    ``log_dir`` (the jax profiler assumes it exists)."""
     try:
         import jax
     except Exception:  # pragma: no cover - exercised only without jax
         log_event("profile_skipped", reason="jax unavailable", log_dir=log_dir)
         yield
         return
-    with jax.profiler.trace(log_dir):
-        log_event("profile_start", log_dir=log_dir)
+    import os
+
+    os.makedirs(log_dir, exist_ok=True)
+    try:
+        ctx = jax.profiler.trace(log_dir)
+        ctx.__enter__()
+    except Exception as e:
+        print(
+            f"kv-tpu: --profile unsupported on this platform "
+            f"({type(e).__name__}: {e}); continuing without a device trace",
+            file=sys.stderr,
+        )
+        log_event(
+            "profile_skipped",
+            reason=f"{type(e).__name__}: {e}",
+            log_dir=log_dir,
+        )
         yield
-    log_event("profile_done", log_dir=log_dir)
+        return
+    log_event("profile_start", log_dir=log_dir)
+    ok = True
+    try:
+        yield
+    finally:
+        try:
+            ctx.__exit__(None, None, None)
+        except Exception as e:
+            ok = False
+            print(
+                f"kv-tpu: --profile capture failed "
+                f"({type(e).__name__}: {e}); no trace written to {log_dir}",
+                file=sys.stderr,
+            )
+            log_event(
+                "profile_skipped",
+                reason=f"{type(e).__name__}: {e}",
+                log_dir=log_dir,
+            )
+        if ok:
+            log_event("profile_done", log_dir=log_dir)
+
+
+#: the name ISSUE/older docs use for the same facility
+trace_to_dir = profile_to

@@ -1306,10 +1306,15 @@ class PackedIncrementalVerifier:
         if self._packed is None:
             # matrix-free: update the maps + counts; record what a later
             # solve_stripe must re-verify
-            _TRACKER.track("_slot_write", self._maps)
-            out = _slot_write(
-                *self._maps, np.int32(slot), self._put(new4_padded, "new4")
+            step_args = (
+                *self._maps, np.int32(slot), self._put(new4_padded, "new4"),
             )
+            _TRACKER.track(
+                "_slot_write",
+                self._maps,
+                lower=lambda: _slot_write.lower(*step_args),
+            )
+            out = _slot_write(*step_args)
             (
                 self._sel_ing8, self._sel_eg8, self._ing_by_pol,
                 self._eg_by_pol, self._ing_cnt, self._eg_cnt,
@@ -1330,22 +1335,26 @@ class PackedIncrementalVerifier:
         else:
             c0 = np.zeros(_COL_GROUP, dtype=np.int32)
             meta0 = self._col_meta(c0, 0)
-        _TRACKER.track(
-            "_diff_step", self._packed, self._maps,
-            static=(bool(row_groups), bool(col_groups))
-            + tuple(sorted(self._flags.items())),
-        )
-        out = _diff_step(
+        step_args = (
             self._packed, *self._maps, self._col_mask, self._row_valid,
             np.int32(slot),
             self._put(new4_padded, "new4"),
             self._put(r0, "rep"),
             self._put(c0, "rep"),
             *(self._put(m, "rep") for m in meta0),
+        )
+        step_kwargs = dict(
             has_rows=bool(row_groups),
             has_cols=bool(col_groups),
             **self._flags,
         )
+        _TRACKER.track(
+            "_diff_step", self._packed, self._maps,
+            static=(bool(row_groups), bool(col_groups))
+            + tuple(sorted(self._flags.items())),
+            lower=lambda: _diff_step.lower(*step_args, **step_kwargs),
+        )
+        out = _diff_step(*step_args, **step_kwargs)
         (
             self._packed, self._sel_ing8, self._sel_eg8, self._ing_by_pol,
             self._eg_by_pol, self._ing_cnt, self._eg_cnt,
@@ -1488,12 +1497,17 @@ class PackedIncrementalVerifier:
         if bookkeep:
             self._mark_closure_dirty([idx], [idx])
         if self._packed is None:
-            _TRACKER.track("_pod_step_mf", self._maps)
-            out = _pod_step_mf(
+            step_args = (
                 *self._maps, self._col_mask, self._row_valid,
                 np.int32(idx), self._put(cols4, "rep"),
                 np.uint32(1 if active else 0),
             )
+            _TRACKER.track(
+                "_pod_step_mf",
+                self._maps,
+                lower=lambda: _pod_step_mf.lower(*step_args),
+            )
+            out = _pod_step_mf(*step_args)
             (
                 self._sel_ing8, self._sel_eg8, self._ing_by_pol,
                 self._eg_by_pol, self._ing_cnt, self._eg_cnt,
@@ -1503,15 +1517,17 @@ class PackedIncrementalVerifier:
                 self.dirty_rows[idx] = True
                 self.dirty_cols[idx] = True
         else:
+            step_args = (
+                self._packed, *self._maps, self._col_mask, self._row_valid,
+                np.int32(idx), self._put(cols4, "rep"),
+                np.uint32(1 if active else 0),
+            )
             _TRACKER.track(
                 "_pod_step", self._packed, self._maps,
                 static=tuple(sorted(self._flags.items())),
+                lower=lambda: _pod_step.lower(*step_args, **self._flags),
             )
-            out = _pod_step(
-                self._packed, *self._maps, self._col_mask, self._row_valid,
-                np.int32(idx), self._put(cols4, "rep"),
-                np.uint32(1 if active else 0), **self._flags,
-            )
+            out = _pod_step(*step_args, **self._flags)
             (
                 self._packed, self._sel_ing8, self._sel_eg8,
                 self._ing_by_pol, self._eg_by_pol, self._ing_cnt,
@@ -1763,19 +1779,17 @@ class PackedIncrementalVerifier:
             )
         STRIPE_WIDTH.labels(engine=self.metrics_engine).set(width)
         STRIPES_SOLVED.labels(engine=self.metrics_engine).inc()
+        stripe_args = (
+            *self._maps, self._col_mask, self._row_valid, np.int32(d0),
+        )
+        stripe_kwargs = dict(width=width, **self._flags)
         _TRACKER.track(
             "_stripe_step", self._maps,
             static=(width,) + tuple(sorted(self._flags.items())),
+            lower=lambda: _stripe_step.lower(*stripe_args, **stripe_kwargs),
         )
         out = retry_transient(
-            lambda: _stripe_step(
-                *self._maps,
-                self._col_mask,
-                self._row_valid,
-                np.int32(d0),
-                width=width,
-                **self._flags,
-            ),
+            lambda: _stripe_step(*stripe_args, **stripe_kwargs),
             policy=self.retry_policy,
             backend=self.metrics_engine,
         )

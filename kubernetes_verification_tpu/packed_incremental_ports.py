@@ -1113,8 +1113,7 @@ class PackedPortsIncrementalVerifier:
 
         rows_i, vals_i = safe_pack(assigned_i, freed_i, new_si, True, "i")
         rows_e, vals_e = safe_pack(assigned_e, freed_e, new_se, False, "e")
-        _TRACKER.track("_vp_write", self._operands, vals_i, vals_e)
-        out = _vp_write(
+        step_args = (
             *self._operands, self._ing_cnt, self._eg_cnt,
             self._put(rows_i, "rep"),
             self._put(vals_i, "rep"),
@@ -1123,6 +1122,14 @@ class PackedPortsIncrementalVerifier:
             self._put(d_ing, "vec"),
             self._put(d_eg, "vec"),
         )
+        _TRACKER.track(
+            "_vp_write",
+            self._operands,
+            vals_i,
+            vals_e,
+            lower=lambda: _vp_write.lower(*step_args),
+        )
+        out = _vp_write(*step_args)
         (
             self._vp_peers_i, self._sel_ing_vp, self._sel_eg_vp,
             self._vp_peers_e, self._ing_cnt, self._eg_cnt,
@@ -1322,19 +1329,21 @@ class PackedPortsIncrementalVerifier:
         ``bookkeep`` is False only for the prewarm no-op."""
         if bookkeep:
             self._mark_closure_dirty([idx], [idx])
+        step_args = (
+            self._packed, *self._operands, self._ing_cnt, self._eg_cnt,
+            self._col_mask, self._row_valid,
+            np.int32(idx), self._put(ci, "rep"), self._put(ce, "rep"),
+            np.int32(cnt_i), np.int32(cnt_e),
+            np.uint32(1 if active else 0),
+        )
+        step_kwargs = dict(layout=self._layout, **self._flags)
         _TRACKER.track(
             "_ports_pod_step", self._packed, self._operands,
             static=tuple(sorted(self._flags.items())),
+            lower=lambda: _ports_pod_step.lower(*step_args, **step_kwargs),
         )
         out = retry_transient(
-            lambda: _ports_pod_step(
-                self._packed, *self._operands, self._ing_cnt, self._eg_cnt,
-                self._col_mask, self._row_valid,
-                np.int32(idx), self._put(ci, "rep"), self._put(ce, "rep"),
-                np.int32(cnt_i), np.int32(cnt_e),
-                np.uint32(1 if active else 0),
-                layout=self._layout, **self._flags,
-            ),
+            lambda: _ports_pod_step(*step_args, **step_kwargs),
             policy=self.retry_policy,
             backend=self.metrics_engine,
         )

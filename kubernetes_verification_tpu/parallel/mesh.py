@@ -26,6 +26,7 @@ import numpy as np
 __all__ = [
     "POD_AXIS",
     "GRANT_AXIS",
+    "shard_map",
     "mesh_for",
     "distributed_mesh",
     "init_distributed",
@@ -35,6 +36,23 @@ __all__ = [
 
 POD_AXIS = "pods"
 GRANT_AXIS = "grants"
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = False):
+    """``jax.shard_map`` across jax versions: the top-level API (jax >= 0.6,
+    ``check_vma``) when present, else ``jax.experimental.shard_map`` (same
+    semantics; the replication-check kwarg is spelled ``check_rep``)."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma,
+    )
 
 
 def mesh_for(

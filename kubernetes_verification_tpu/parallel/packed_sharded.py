@@ -51,10 +51,11 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from ..encode.encoder import EncodedCluster, GrantBlock
+from ..observe.introspect import maybe_publish
 from ..ops.match import match_selectors
 from ..ops.reach import _grant_peers
 from ..ops.tiled import PortLayout, pack_bool_cols, unpack_cols
-from .mesh import GRANT_AXIS, POD_AXIS, pad_amount
+from .mesh import GRANT_AXIS, POD_AXIS, pad_amount, shard_map
 from .sharded_ops import _grant_pspecs, _specs_like, pad_grants, pad_pods
 
 __all__ = ["PackedShardedResult", "sharded_packed_reach"]
@@ -717,7 +718,7 @@ def sharded_packed_reach(
             layout=layout,
         )
         return jax.jit(
-            jax.shard_map(
+            shard_map(
                 b, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
                 check_vma=False,
             )
@@ -755,6 +756,12 @@ def sharded_packed_reach(
                 f"sweep_chunk_tiles must be a multiple of mp={mp}"
             )
         fn_main = make_fn(sweep_chunk_tiles // mp)
+        maybe_publish(
+            "sharded-packed",
+            "packed_sweep",
+            fn_main,
+            call_args + (np.int32(0),),
+        )
         rem = n_tiles_total % sweep_chunk_tiles
         fn_rem = make_fn(rem // mp) if rem else None
         acc_row = np.zeros(Np, dtype=np.int64)
@@ -802,6 +809,9 @@ def sharded_packed_reach(
             },
         )
     fn = make_fn((t1 - t0) // mp)
+    maybe_publish(
+        "sharded-packed", "packed_stripe", fn, call_args + (np.int32(t0),)
+    )
     t_start = time.perf_counter()
     packed, row_deg, col_deg, grp_deg, ing_iso, eg_iso = fn(
         *call_args, np.int32(t0)

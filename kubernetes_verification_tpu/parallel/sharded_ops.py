@@ -35,9 +35,10 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from ..encode.encoder import EncodedCluster, EncodedKano, GrantBlock, SelectorEnc
+from ..observe.introspect import maybe_publish
 from ..ops.match import match_selectors, subset_match
 from ..ops.reach import K8sOut, KanoOut, _grant_peers
-from .mesh import GRANT_AXIS, POD_AXIS, pad_amount, pad_rows
+from .mesh import GRANT_AXIS, POD_AXIS, pad_amount, pad_rows, shard_map
 
 __all__ = [
     "pad_pods",
@@ -347,11 +348,11 @@ def sharded_k8s_reach(
         dst_sets=P(None, POD_AXIS),
     )
     fn = jax.jit(
-        jax.shard_map(
+        shard_map(
             body, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
         )
     )
-    out = fn(
+    call_args = (
         pod_kv,
         pod_key,
         pod_ns,
@@ -366,11 +367,13 @@ def sharded_k8s_reach(
         egress,
         bank_full,
     )
+    maybe_publish("sharded", "k8s_reach", fn, call_args)
+    out = fn(*call_args)
     closure = None
     if with_closure:
         steps = max(1, math.ceil(math.log2(max(n + n_pad, 2))))
         cfn = jax.jit(
-            jax.shard_map(
+            shard_map(
                 partial(_closure_local, steps=steps),
                 mesh=mesh,
                 in_specs=P(POD_AXIS, None),
@@ -378,6 +381,7 @@ def sharded_k8s_reach(
                 check_vma=False,
             )
         )
+        maybe_publish("sharded", "closure", cfn, (out.reach,))
         closure = np.asarray(cfn(out.reach))[:n, :n]
 
     trim = lambda a, *ax: np.asarray(a)[
@@ -431,7 +435,7 @@ def sharded_kano_reach(
     dst_imp = pad_rows(enc.dst_impossible, p_pad, fill=True)
 
     fn = jax.jit(
-        jax.shard_map(
+        shard_map(
             _kano_local,
             mesh=mesh,
             in_specs=(
@@ -450,12 +454,14 @@ def sharded_kano_reach(
             check_vma=False,
         )
     )
-    out = fn(pod_kv, valid, src_req, src_imp, dst_req, dst_imp)
+    call_args = (pod_kv, valid, src_req, src_imp, dst_req, dst_imp)
+    maybe_publish("sharded", "kano_reach", fn, call_args)
+    out = fn(*call_args)
     closure = None
     if with_closure:
         steps = max(1, math.ceil(math.log2(max(n + n_pad, 2))))
         cfn = jax.jit(
-            jax.shard_map(
+            shard_map(
                 partial(_closure_local, steps=steps),
                 mesh=mesh,
                 in_specs=P(POD_AXIS, None),
@@ -463,6 +469,7 @@ def sharded_kano_reach(
                 check_vma=False,
             )
         )
+        maybe_publish("sharded", "closure", cfn, (out.reach,))
         closure = np.asarray(cfn(out.reach))[:n, :n]
     out_np = KanoOut(
         reach=np.asarray(out.reach)[:n, :n],
@@ -480,7 +487,7 @@ def sharded_closure(mesh: jax.sharding.Mesh, reach: np.ndarray) -> np.ndarray:
     rows = np.pad(reach, ((0, n_pad), (0, n_pad)), constant_values=False)
     steps = max(1, math.ceil(math.log2(max(n + n_pad, 2))))
     cfn = jax.jit(
-        jax.shard_map(
+        shard_map(
             partial(_closure_local, steps=steps),
             mesh=mesh,
             in_specs=P(POD_AXIS, None),
@@ -488,4 +495,5 @@ def sharded_closure(mesh: jax.sharding.Mesh, reach: np.ndarray) -> np.ndarray:
             check_vma=False,
         )
     )
+    maybe_publish("sharded", "closure", cfn, (rows,))
     return np.asarray(cfn(rows))[:n, :n]

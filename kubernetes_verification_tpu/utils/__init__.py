@@ -1,6 +1,8 @@
 """Cross-cutting utilities: persistence (checkpoint/resume, exports) and
 observability (structured logs, phase timing, device profiling)."""
-from .observe import Phases, configure_logging, log_event, profile_to
+# straight from the observe package — importing the deprecated
+# ``utils.observe`` shim here would warn on every ``utils`` import
+from ..observe import Phases, configure_logging, log_event, profile_to
 from .persist import (
     export_encoding,
     load_incremental,

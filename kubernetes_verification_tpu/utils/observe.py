@@ -1,10 +1,22 @@
-"""Backward-compatible shim: the observability layer grew into the
+"""DEPRECATED shim: the observability layer grew into the
 ``kubernetes_verification_tpu.observe`` package (metrics registry, spans,
-exporters). The seed-era names keep importing from here.
+exporters, introspection). Import from there instead; this module only
+re-exports the seed-era names and will be removed once no external
+callers remain (the last in-repo one, ``tests/test_persist.py``, has
+migrated).
 """
 from __future__ import annotations
 
-from ..observe import (  # noqa: F401
+import warnings
+
+warnings.warn(
+    "kubernetes_verification_tpu.utils.observe is deprecated; import from "
+    "kubernetes_verification_tpu.observe instead",
+    DeprecationWarning,
+    stacklevel=2,
+)
+
+from ..observe import (  # noqa: F401,E402
     Phases,
     configure_logging,
     log_event,

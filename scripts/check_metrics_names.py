@@ -1,15 +1,26 @@
 #!/usr/bin/env python
-"""Lint the metric namespace: every family registered at import time must
-match ``^kvtpu_[a-z0-9_]+$`` so the Prometheus/JSON exporter output stays
-stable (dashboards and scrape configs key on these names).
+"""Lint the metric namespace and maintain the METRICS.md reference.
+
+Three duties (the first two run in tier-1 via ``tests/test_observe.py``):
+
+* every family registered at import time must match ``^kvtpu_[a-z0-9_]+$``
+  so the Prometheus/JSON exporter output stays stable (dashboards and
+  scrape configs key on these names);
+* every family in ``REQUIRED_FAMILIES`` must exist — this is the frozen
+  dashboard contract; renaming or dropping one must show up as a failing
+  lint, not a silently-empty panel;
+* ``--write METRICS.md`` regenerates the one-row-per-family reference
+  table from the live registry (name, kind, labels, help);
+  ``--check-docs METRICS.md`` fails when the file drifted from the code.
 
 Importing the modules below covers every registration site: the shared
 families live in ``observe/metrics.py``, and any module that registered a
-private family would do so at its own import. Run directly (exit 1 on a bad
-name) — tier-1 runs it via ``tests/test_observe.py``.
+private family would do so at its own import. Run directly (exit 1 on a
+bad/missing name).
 """
 from __future__ import annotations
 
+import argparse
 import importlib
 import os
 import sys
@@ -23,16 +34,90 @@ MODULES = (
     "kubernetes_verification_tpu.observe.metrics",
 )
 
+#: the dashboard contract: families that must exist in every build. New
+#: families are appended here by the PR that introduces them.
+REQUIRED_FAMILIES = frozenset(
+    {
+        "kvtpu_span_seconds",
+        "kvtpu_verify_total",
+        "kvtpu_pairs_per_second",
+        "kvtpu_bytes_transferred",
+        "kvtpu_closure_iterations_total",
+        "kvtpu_delta_closure_rounds_total",
+        "kvtpu_incremental_ops_total",
+        "kvtpu_stripe_width",
+        "kvtpu_stripes_solved_total",
+        "kvtpu_jit_recompiles_total",
+        "kvtpu_kernel_invocations_total",
+        "kvtpu_kernel_tiles_total",
+        "kvtpu_retries_total",
+        "kvtpu_fallbacks_total",
+        "kvtpu_faults_injected_total",
+        "kvtpu_degradations_total",
+        # introspection layer
+        "kvtpu_hbm_bytes_in_use",
+        "kvtpu_hbm_peak_bytes",
+        "kvtpu_kernel_flops",
+        "kvtpu_kernel_bytes_accessed",
+        "kvtpu_kernel_peak_bytes",
+        "kvtpu_cost_reports_total",
+    }
+)
 
-def check() -> list:
-    from kubernetes_verification_tpu.observe import METRIC_NAME_RE, REGISTRY
+DOCS_HEADER = """# Metrics reference
+
+One row per `kvtpu_*` metric family. Auto-generated from the live registry
+by `python scripts/check_metrics_names.py --write METRICS.md` — edit the
+help strings in `kubernetes_verification_tpu/observe/metrics.py`, not this
+file (`--check-docs` fails CI when the two drift).
+"""
+
+
+def _registry():
+    from kubernetes_verification_tpu.observe import REGISTRY
 
     for mod in MODULES:
         importlib.import_module(mod)
-    return [n for n in REGISTRY.names() if not METRIC_NAME_RE.match(n)]
+    return REGISTRY
 
 
-def main() -> int:
+def check() -> list:
+    """Bad names (pattern violations). Kept as the historical entry point —
+    ``tests/test_observe.py`` asserts it returns []."""
+    from kubernetes_verification_tpu.observe import METRIC_NAME_RE
+
+    reg = _registry()
+    return [n for n in reg.names() if not METRIC_NAME_RE.match(n)]
+
+
+def check_required() -> list:
+    """Required families missing from the registry."""
+    return sorted(REQUIRED_FAMILIES - set(_registry().names()))
+
+
+def docs_markdown() -> str:
+    """The METRICS.md body: a table with one row per family."""
+    reg = _registry()
+    lines = [DOCS_HEADER, "| name | kind | labels | help |", "|---|---|---|---|"]
+    for m in reg.collect():
+        labels = ", ".join(f"`{ln}`" for ln in m.labelnames) or "—"
+        help_text = " ".join(m.help.split()).replace("|", "\\|")
+        lines.append(f"| `{m.name}` | {m.kind} | {labels} | {help_text} |")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--write", metavar="PATH",
+        help="write the auto-generated metrics reference table to PATH",
+    )
+    ap.add_argument(
+        "--check-docs", metavar="PATH",
+        help="exit 1 when PATH differs from the generated reference",
+    )
+    args = ap.parse_args(argv)
+
     bad = check()
     if bad:
         print(
@@ -41,9 +126,34 @@ def main() -> int:
             file=sys.stderr,
         )
         return 1
-    from kubernetes_verification_tpu.observe import REGISTRY
-
-    print(f"{len(REGISTRY.names())} metric families OK")
+    missing = check_required()
+    if missing:
+        print(
+            "required metric families missing from the registry: "
+            + ", ".join(missing),
+            file=sys.stderr,
+        )
+        return 1
+    if args.write:
+        with open(args.write, "w") as fh:
+            fh.write(docs_markdown())
+        print(f"wrote {args.write}")
+    if args.check_docs:
+        try:
+            with open(args.check_docs) as fh:
+                on_disk = fh.read()
+        except OSError:
+            on_disk = ""
+        if on_disk != docs_markdown():
+            print(
+                f"{args.check_docs} is stale — regenerate with "
+                f"`python scripts/check_metrics_names.py --write "
+                f"{args.check_docs}`",
+                file=sys.stderr,
+            )
+            return 1
+    reg = _registry()
+    print(f"{len(reg.names())} metric families OK")
     return 0
 
 

@@ -1,0 +1,366 @@
+"""The introspection layer: HLO cost analysis (``observe/introspect.py``),
+device/host memory telemetry (``observe/telemetry.py``), the bench-history
+regression gate (``observe/history.py`` + ``scripts/check_bench_regression``),
+and the ``kv-tpu explain --pods``/``kv-tpu history`` CLI verbs."""
+import importlib.util
+import json
+import os
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from kubernetes_verification_tpu.observe import REGISTRY, introspect, telemetry
+from kubernetes_verification_tpu.observe.history import (
+    append_run,
+    check_regression,
+    default_paths,
+    format_findings,
+    load_runs,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(name, REPO / "scripts" / f"{name}.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture()
+def intro():
+    """Introspection ON with a clean report store; restored afterwards so
+    the default-off contract holds for every other test."""
+    introspect.clear_reports()
+    introspect.set_introspection(True)
+    yield introspect
+    introspect.set_introspection(False)
+    introspect.clear_reports()
+
+
+# ------------------------------------------------------------ cost analysis
+def test_cost_report_from_jitted_dispatch(intro):
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda a, b: a @ b)
+    x = jnp.ones((64, 64), jnp.float32)
+    rep = intro.maybe_publish("test", "matmul", f, (x, x))
+    assert rep is not None and rep.source == "xla"
+    assert rep.flops > 0 and rep.bytes_accessed > 0
+    assert rep.arithmetic_intensity > 0
+    assert rep.roofline_bound in ("compute", "memory")
+    # same abstract signature -> cached, no second report
+    intro.maybe_publish("test", "matmul", f, (x + 1, x))
+    assert len(intro.reports()) == 1
+    # a new shape is a new signature -> second report
+    y = jnp.ones((32, 32), jnp.float32)
+    intro.maybe_publish("test", "matmul", f, (y, y))
+    assert len(intro.reports()) == 2
+    # the gauges carry the numbers for the exporter
+    d = REGISTRY.dump()
+    assert d["gauges"]["kvtpu_kernel_flops"]["engine=test,fn=matmul"] > 0
+    assert d["counters"]["kvtpu_cost_reports_total"][
+        "engine=test,fn=matmul,source=xla"
+    ] >= 2
+
+
+def test_introspection_off_is_a_noop():
+    import jax
+    import jax.numpy as jnp
+
+    introspect.clear_reports()
+    assert not introspect.introspection_enabled()
+    f = jax.jit(lambda a: a * 2)
+    out = introspect.maybe_publish("test", "noop", f, (jnp.ones(8),))
+    assert out is None and introspect.reports() == []
+
+
+def test_host_estimate_and_roofline(intro):
+    rep = intro.publish_host_estimate(
+        "native", "sweep", flops=1000.0, bytes_accessed=50.0,
+        argument_bytes=40, output_bytes=10,
+    )
+    assert rep.source == "host-estimate" and rep.platform == "host"
+    assert rep.arithmetic_intensity == pytest.approx(20.0)
+    assert rep.roofline_bound == "compute"  # 20 >= the host ridge (10)
+    low = intro.publish_host_estimate(
+        "native", "copy", flops=1.0, bytes_accessed=100.0, signature=(1,)
+    )
+    assert low.roofline_bound == "memory"
+    assert low.peak_bytes >= 0  # host RSS peak rides along
+
+
+def test_format_cost_table(intro):
+    intro.publish_host_estimate(
+        "e", "k", flops=2e9, bytes_accessed=1e6, signature=("s",)
+    )
+    table = intro.format_cost_table()
+    lines = table.splitlines()
+    assert len(lines) >= 3  # header, rule, one row
+    assert "flops/B" in lines[0] and "bound" in lines[0]
+    assert any("host" in ln and "2.00e+09" in ln for ln in lines[2:])
+    assert intro.format_cost_table([]) == ""
+
+
+def test_backend_verify_publishes_reports(intro):
+    import kubernetes_verification_tpu as kv
+    from kubernetes_verification_tpu.harness.generate import (
+        GeneratorConfig,
+        random_cluster,
+    )
+
+    cluster = random_cluster(
+        GeneratorConfig(n_pods=16, n_policies=4, n_namespaces=2, seed=0)
+    )
+    kv.verify(cluster, kv.VerifyConfig(backend="cpu"))
+    fns = {r.fn for r in intro.reports()}
+    assert {"encode_selectors", "solve_reach"} <= fns
+
+
+# ---------------------------------------------------------------- telemetry
+def test_memory_snapshot_never_empty():
+    snap = telemetry.memory_snapshot()
+    assert snap, "snapshot must fall back to host RSS when devices hide stats"
+    for e in snap:
+        assert {"device", "platform", "bytes_in_use", "source"} <= set(e)
+        assert e["bytes_in_use"] > 0
+    assert telemetry.total_bytes_in_use() > 0
+
+
+def test_sample_once_feeds_hbm_gauges():
+    telemetry.sample_once()
+    g = REGISTRY.dump()["gauges"]
+    assert any(v > 0 for v in g["kvtpu_hbm_bytes_in_use"].values())
+    assert any(v > 0 for v in g["kvtpu_hbm_peak_bytes"].values())
+
+
+def test_sampler_thread_starts_and_stops():
+    s = telemetry.start_sampler(interval_s=0.01)
+    assert s.is_alive()
+    assert telemetry.start_sampler() is s  # singleton while running
+    telemetry.stop_sampler()
+    s.join(timeout=5)
+    assert not s.is_alive()
+
+
+def test_span_memory_hook_annotates_spans():
+    from kubernetes_verification_tpu.observe import spans, trace
+
+    spans.set_memory_hook(lambda: 12345)
+    try:
+        with trace("mem_probe_t") as sp:
+            pass
+        assert sp.attrs["mem_enter_bytes"] == 12345
+        assert sp.attrs["mem_exit_bytes"] == 12345
+    finally:
+        spans.set_memory_hook(None)
+    with trace("mem_probe_off_t") as sp:
+        pass
+    assert "mem_enter_bytes" not in sp.attrs
+
+
+def test_install_span_memory_hook_uses_live_snapshot():
+    from kubernetes_verification_tpu.observe import spans, trace
+
+    telemetry.install_span_memory_hook()
+    try:
+        with trace("mem_live_t") as sp:
+            pass
+        assert sp.attrs["mem_enter_bytes"] > 0
+    finally:
+        spans.set_memory_hook(None)
+
+
+def test_format_memory_table():
+    table = telemetry.format_memory_table()
+    lines = table.splitlines()
+    assert "in_use" in lines[0] and len(lines) >= 3
+
+
+def test_new_families_render_in_prometheus_exposition():
+    """The satellite exporter contract: sampled HBM + cost gauges come out
+    as valid text exposition (HELP/TYPE headers, escaped label values)."""
+    from kubernetes_verification_tpu.observe import to_prometheus
+
+    telemetry.sample_once()
+    introspect.set_introspection(True)
+    try:
+        introspect.publish_host_estimate(
+            "exp", "probe", flops=10.0, bytes_accessed=5.0, signature=("x",)
+        )
+    finally:
+        introspect.set_introspection(False)
+        introspect.clear_reports()
+    text = to_prometheus()
+    for fam, kind in (
+        ("kvtpu_hbm_bytes_in_use", "gauge"),
+        ("kvtpu_hbm_peak_bytes", "gauge"),
+        ("kvtpu_kernel_flops", "gauge"),
+        ("kvtpu_cost_reports_total", "counter"),
+    ):
+        assert f"# TYPE {fam} {kind}" in text
+        assert f"# HELP {fam} " in text
+    assert 'kvtpu_kernel_flops{engine="exp",fn="probe"} 10' in text
+
+
+# -------------------------------------------------------- history + gate
+def _runs(values, unit="pairs/s", metric="m"):
+    return [{"metric": metric, "value": v, "unit": unit} for v in values]
+
+
+def test_history_append_load_round_trip(tmp_path):
+    p = str(tmp_path / "h.jsonl")
+    append_run({"metric": "m", "value": 1.5, "unit": "s"}, p)
+    append_run({"metric": "m", "value": 1.6, "unit": "s"}, p)
+    runs = load_runs([p])
+    assert [r["value"] for r in runs] == [1.5, 1.6]
+    assert all("ts" in r for r in runs)
+
+
+def test_history_loads_whole_file_bench_snapshots(tmp_path):
+    # the BENCH_r0*.json driver format: one JSON object wrapping `parsed`
+    p = tmp_path / "BENCH_r01.json"
+    p.write_text(json.dumps(
+        {"n": 1, "parsed": {"metric": "m", "value": 2.0, "unit": "pairs/s"}}
+    ))
+    runs = load_runs([str(p)])
+    assert len(runs) == 1 and runs[0]["value"] == 2.0
+
+
+def test_regression_gate_flags_2x_slowdown():
+    ok, f = check_regression(_runs([10.0, 10.5, 9.8, 10.2, 10.1, 5.0]))
+    assert not ok
+    (finding,) = [x for x in f if x["regressed"]]
+    assert finding["ratio"] == pytest.approx(0.5, abs=0.02)
+    assert finding["direction"] == "higher"
+    assert "REGRESSED" in format_findings(f)
+
+
+def test_regression_gate_passes_steady_series():
+    ok, f = check_regression(_runs([10.0, 10.5, 9.8, 10.2, 9.9]))
+    assert ok and not any(x["regressed"] for x in f)
+
+
+def test_regression_gate_lower_is_better_units():
+    ok, f = check_regression(_runs([1.0, 1.1, 0.9, 1.0, 2.2], unit="s"))
+    assert not ok and f[0]["direction"] == "lower"
+    ok, _ = check_regression(_runs([2.2, 1.1, 0.9, 1.0, 1.0], unit="s"))
+    assert ok  # getting faster never trips the gate
+
+
+def test_regression_gate_ignores_unknown_units_and_short_series():
+    # an unknown unit is reported but never gated
+    ok, f = check_regression(_runs([10.0, 1.0], unit="weird_pct"))
+    assert ok and not f[0]["regressed"]
+    # a single run has no trailing median to compare against
+    ok, f = check_regression(_runs([10.0]))
+    assert ok
+
+
+def test_regression_gate_passes_the_committed_trajectory():
+    paths = default_paths(str(REPO))
+    if not paths:
+        pytest.skip("no committed BENCH_r*.json trajectory")
+    runs = load_runs(paths)
+    assert runs, "committed snapshots must parse"
+    ok, findings = check_regression(runs)
+    assert ok, format_findings(findings)
+
+
+def test_check_bench_regression_script_dry_run(capsys):
+    mod = _load_script("check_bench_regression")
+    assert mod.main(["--dry-run"]) == 0
+    assert "tolerance" in capsys.readouterr().out
+
+
+def test_check_bench_regression_script_flags_synthetic(tmp_path, capsys):
+    p = str(tmp_path / "h.jsonl")
+    for v in [10.0, 10.5, 9.8, 10.2, 10.1, 5.0]:
+        append_run({"metric": "m", "value": v, "unit": "pairs/s"}, p)
+    mod = _load_script("check_bench_regression")
+    assert mod.main([p]) == 1
+    assert mod.main([p, "--dry-run"]) == 0
+    capsys.readouterr()
+
+
+# ------------------------------------------------------------ docs contract
+def test_metrics_docs_in_sync():
+    mod = _load_script("check_metrics_names")
+    assert mod.check() == []
+    assert mod.check_required() == []
+    on_disk = (REPO / "METRICS.md").read_text()
+    assert on_disk == mod.docs_markdown(), (
+        "METRICS.md is stale — regenerate with "
+        "`python scripts/check_metrics_names.py --write METRICS.md`"
+    )
+    assert mod.main(["--check-docs", str(REPO / "METRICS.md")]) == 0
+
+
+# ------------------------------------------------------------------ CLI
+def test_cli_explain_cost_mode(capsys):
+    from kubernetes_verification_tpu.cli import main
+
+    try:
+        assert main(
+            ["explain", "--pods", "24", "--policies", "4", "--backend", "cpu"]
+        ) == 0
+    finally:
+        introspect.set_introspection(False)
+        introspect.clear_reports()
+    out = capsys.readouterr().out
+    assert "encode_selectors" in out and "solve_reach" in out
+    assert "in_use" in out  # the memory table rode along
+
+
+def test_cli_explain_cost_mode_json(capsys):
+    from kubernetes_verification_tpu.cli import main
+
+    try:
+        assert main(
+            ["explain", "--pods", "24", "--policies", "4",
+             "--backend", "cpu", "--json"]
+        ) == 0
+    finally:
+        introspect.set_introspection(False)
+        introspect.clear_reports()
+    d = json.loads(capsys.readouterr().out)
+    assert d["reports"] and {"flops", "roofline_bound"} <= set(d["reports"][0])
+    assert d["memory"] and d["memory"][0]["bytes_in_use"] > 0
+
+
+def test_cli_explain_without_args_errors(capsys):
+    from kubernetes_verification_tpu.cli import main
+
+    with pytest.raises(SystemExit):
+        main(["explain"])
+
+
+def test_cli_history_verb(tmp_path, capsys):
+    from kubernetes_verification_tpu.cli import main
+
+    p = str(tmp_path / "h.jsonl")
+    for v in [10.0, 10.5, 9.8, 10.2, 10.1]:
+        append_run({"metric": "m", "value": v, "unit": "pairs/s"}, p)
+    assert main(["history", p]) == 0
+    assert "ok" in capsys.readouterr().out
+    append_run({"metric": "m", "value": 5.0, "unit": "pairs/s"}, p)
+    assert main(["history", p]) == 1
+    assert "REGRESSED" in capsys.readouterr().out
+    assert main(["history", p, "--json"]) == 1
+    assert json.loads(capsys.readouterr().out)["ok"] is False
+
+
+def test_legacy_utils_observe_shim_warns():
+    import importlib
+    import warnings
+
+    import kubernetes_verification_tpu.utils.observe as shim
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        shim = importlib.reload(shim)
+    assert any(issubclass(x.category, DeprecationWarning) for x in w)
+    assert shim.logger is not None and shim.Phases is not None

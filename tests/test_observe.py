@@ -123,6 +123,31 @@ def test_prometheus_golden_text(reg):
     )
 
 
+def test_prometheus_escapes_labels_and_help(reg):
+    c = Counter(
+        "kvtpu_esc_total", "Help with\nnewline and back\\slash.", ("path",),
+        registry=reg,
+    )
+    c.labels(path='a\\b"c\nd').inc()
+    text = to_prometheus(reg)
+    # HELP: newline and backslash must be escaped or the scrape breaks
+    assert "# HELP kvtpu_esc_total Help with\\nnewline and back\\\\slash." in text
+    # label values: backslash, quote, newline — all escaped per exposition 0.0.4
+    assert 'kvtpu_esc_total{path="a\\\\b\\"c\\nd"} 1' in text
+    # every emitted line is either a comment or a well-formed sample
+    for line in text.strip().split("\n"):
+        assert line.startswith("# ") or " " in line
+
+
+def test_prometheus_every_family_has_help_and_type():
+    """Each registered family (incl. the introspection layer's HBM/cost
+    additions) renders a HELP + TYPE header pair in the global exposition."""
+    text = to_prometheus()
+    for m in REGISTRY.collect():
+        assert f"# HELP {m.name} " in text
+        assert f"# TYPE {m.name} {m.kind}" in text
+
+
 def test_all_registered_names_pass_the_lint():
     # the tier-1 hook for scripts/check_metrics_names.py: every family the
     # package registered at import time obeys the naming contract

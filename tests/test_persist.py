@@ -11,7 +11,7 @@ from kubernetes_verification_tpu.harness.generate import (
 )
 from kubernetes_verification_tpu.incremental import IncrementalVerifier
 from kubernetes_verification_tpu.ops.tiled import tiled_k8s_reach
-from kubernetes_verification_tpu.utils.observe import Phases, log_event, logger
+from kubernetes_verification_tpu.observe import Phases, log_event, logger
 from kubernetes_verification_tpu.utils.persist import (
     export_encoding,
     load_incremental,
