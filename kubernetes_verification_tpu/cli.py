@@ -13,7 +13,13 @@ only, SURVEY.md §1); this exposes the full pipeline:
 * ``kv-tpu explain PATH``  — export the encoded tensors + the Datalog
   program text (the ``get_datalog`` facility, ``kubesv/kubesv/
   constraint.py:127-128``, for both representations);
-* ``kv-tpu generate DIR``  — write a synthetic cluster as YAML manifests;
+* ``kv-tpu generate DIR``  — write a synthetic cluster as YAML manifests
+  (``--events-out`` adds a churn event stream);
+* ``kv-tpu serve``         — continuous verification: apply a mutation-event
+  stream through the coalescing service loop, check declarative
+  assertions (violations exit 1 with pod-pair witnesses);
+* ``kv-tpu query``         — can-reach / who-can-reach / blast-radius /
+  what-if admission checks against manifests or a serve snapshot;
 * ``kv-tpu backends``      — list available execution backends.
 """
 from __future__ import annotations
@@ -744,7 +750,237 @@ def cmd_generate(args) -> int:
     paths = dump_cluster(cluster, args.dir)
     print(f"wrote {len(cluster.pods)} pods / {len(cluster.policies)} policies "
           f"to {', '.join(paths)}")
+    if args.events_out:
+        from .harness.generate import random_event_stream
+        from .serve.events import write_events
+
+        events = random_event_stream(
+            cluster,
+            n_events=args.n_events,
+            seed=args.seed,
+            p_resync=args.resync_rate,
+        )
+        write_events(events, args.events_out)
+        print(
+            f"wrote a {len(events)}-event churn stream to {args.events_out} "
+            f"(replay with: kv-tpu serve {args.dir} "
+            f"--events {args.events_out})"
+        )
     return 0
+
+
+def cmd_serve(args) -> int:
+    from .resilience.errors import KvTpuError
+
+    try:
+        with _observed(args):
+            return _run_serve(args)
+    except KvTpuError as e:
+        return _diagnose(args, e)
+
+
+def _load_serve_service(args, serve_config):
+    """Build the service from manifests (``path``) or a warm-restart
+    snapshot (``--from-snapshot``)."""
+    from .serve import VerificationService
+
+    if getattr(args, "from_snapshot", None):
+        return VerificationService.from_snapshot(
+            args.from_snapshot, serve_config=serve_config
+        ), []
+    if not args.path:
+        raise SystemExit("serve: give a manifest PATH or --from-snapshot DIR")
+    import kubernetes_verification_tpu as kv
+
+    cluster, skipped = kv.load_cluster(args.path)
+    cfg = kv.VerifyConfig(
+        backend="cpu",
+        compute_ports=False,
+        self_traffic=args.self_traffic,
+        default_allow_unselected=args.default_allow,
+    )
+    return VerificationService(cluster, cfg, serve_config), skipped
+
+
+def _run_serve(args) -> int:
+    from .resilience.errors import (
+        EXIT_OK,
+        EXIT_VIOLATIONS,
+        EXIT_INPUT_ERROR,
+    )
+    from .serve import EventSource, ServeConfig, load_assertions
+
+    serve_config = ServeConfig(
+        staleness_bound=args.staleness,
+        batch_size=args.batch_size,
+        snapshot_dir=args.snapshot_out,
+        snapshot_every=args.snapshot_every,
+    )
+    svc, skipped = _load_serve_service(args, serve_config)
+    if getattr(args, "assert_file", None):
+        svc.assertions.extend(load_assertions(args.assert_file))
+    svc.start()
+    try:
+        if args.events:
+            source = EventSource(args.events)
+            if args.tail:
+                for batch in source.tail(
+                    idle_timeout=args.idle_timeout,
+                    batch_size=args.batch_size,
+                ):
+                    svc.submit(batch)
+            else:
+                for batch in source.batches(args.batch_size):
+                    svc.submit(batch)
+        svc.flush()
+        # force a final solve so assertion-free runs still verify the
+        # stream end-state, and print the answer-bearing summary
+        reach = svc.reach(trigger="query" if not svc.assertions else "assertions")
+        pairs = int(reach.sum())
+    finally:
+        svc.close(snapshot=bool(args.snapshot_out))
+    out = {
+        "pods": svc.n_pods,
+        "policies": len(svc.engine.policies),
+        "reachable_pairs": pairs,
+        "assertions": len(svc.assertions),
+        "violations": [v.describe() for v in svc.violations],
+        **svc.stats.to_dict(),
+    }
+    if skipped:
+        out["skipped_documents"] = skipped
+    if args.snapshot_out:
+        out["snapshot"] = args.snapshot_out
+    if args.json:
+        print(json.dumps(out, sort_keys=True))
+    else:
+        print(
+            f"{out['pods']} pods / {out['policies']} policies after "
+            f"{out['events_seen']} events ({out['events_applied']} applied, "
+            f"{out['events_coalesced']} coalesced away) in "
+            f"{out['batches']} batches / {out['total_solves']} solves: "
+            f"{pairs} reachable pairs"
+        )
+        for v in svc.violations:
+            print(f"  VIOLATION: {v.describe()}")
+        if args.snapshot_out:
+            print(f"  snapshot: {args.snapshot_out}")
+    return EXIT_VIOLATIONS if svc.violations else EXIT_OK
+
+
+def cmd_query(args) -> int:
+    from .resilience.errors import KvTpuError
+
+    try:
+        with _observed(args):
+            return _run_query(args)
+    except KvTpuError as e:
+        return _diagnose(args, e)
+
+
+def _run_query(args) -> int:
+    from .resilience.errors import EXIT_OK, EXIT_VIOLATIONS
+    from .serve import (
+        AddPolicy,
+        QueryEngine,
+        ServeConfig,
+        load_assertions,
+    )
+
+    svc, _skipped = _load_serve_service(args, ServeConfig())
+    assertions = (
+        load_assertions(args.assert_file)
+        if getattr(args, "assert_file", None)
+        else []
+    )
+    q = QueryEngine(svc)
+    out = {}
+    exit_code = EXIT_OK
+    if args.can_reach:
+        src, dst = args.can_reach
+        ok = q.can_reach(src, dst, port=args.port, protocol=args.protocol)
+        out["can_reach"] = {
+            "src": src, "dst": dst, "port": args.port,
+            "protocol": args.protocol if args.port is not None else None,
+            "allowed": ok,
+        }
+    if args.who_can_reach:
+        out["who_can_reach"] = {
+            "dst": args.who_can_reach,
+            "sources": q.who_can_reach(args.who_can_reach),
+        }
+    if args.blast_radius:
+        out["blast_radius"] = {
+            "src": args.blast_radius,
+            "targets": q.blast_radius(args.blast_radius),
+        }
+    if args.what_if:
+        import kubernetes_verification_tpu as kv
+
+        delta, _ = kv.load_cluster(args.what_if)
+        if not delta.policies:
+            raise SystemExit(
+                f"--what-if {args.what_if}: no NetworkPolicy documents found"
+            )
+        res = q.what_if(
+            [AddPolicy(policy=p) for p in delta.policies],
+            assertions=assertions or None,
+        )
+        out["what_if"] = res.to_dict()
+        if not res.ok:
+            exit_code = EXIT_VIOLATIONS
+    elif assertions:
+        svc.assertions.extend(assertions)
+        found = svc.check_assertions()
+        out["assertions"] = {
+            "checked": len(assertions),
+            "violations": [v.describe() for v in found],
+        }
+        if found:
+            exit_code = EXIT_VIOLATIONS
+    if not out:
+        raise SystemExit(
+            "query: nothing to answer — give --can-reach SRC DST, "
+            "--who-can-reach DST, --blast-radius SRC, --what-if MANIFESTS "
+            "and/or --assert FILE"
+        )
+    if args.json:
+        print(json.dumps(out, sort_keys=True))
+    else:
+        if "can_reach" in out:
+            c = out["can_reach"]
+            via = (
+                f" on {c['protocol']}/{c['port']}"
+                if c["port"] is not None
+                else ""
+            )
+            print(
+                f"{c['src']} -> {c['dst']}{via}: "
+                f"{'ALLOWED' if c['allowed'] else 'DENIED'}"
+            )
+        if "who_can_reach" in out:
+            w = out["who_can_reach"]
+            print(f"{len(w['sources'])} pods can reach {w['dst']}: "
+                  f"{w['sources']}")
+        if "blast_radius" in out:
+            b = out["blast_radius"]
+            print(f"{b['src']} can reach {len(b['targets'])} pods: "
+                  f"{b['targets']}")
+        if "what_if" in out:
+            w = out["what_if"]
+            print(
+                f"what-if: {'OK' if w['ok'] else 'REJECTED'} "
+                f"(+{w['pairs_added']} / -{w['pairs_removed']} pairs)"
+            )
+            for line in w["violations"]:
+                print(f"  VIOLATION: {line}")
+        if "assertions" in out:
+            a = out["assertions"]
+            print(f"{a['checked']} assertions checked, "
+                  f"{len(a['violations'])} violated")
+            for line in a["violations"]:
+                print(f"  VIOLATION: {line}")
+    return exit_code
 
 
 def cmd_backends(_args) -> int:
@@ -901,7 +1137,113 @@ def main(argv: Optional[list] = None) -> int:
     p.add_argument("--policies", type=int, default=50)
     p.add_argument("--namespaces", type=int, default=5)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument(
+        "--events-out", metavar="FILE",
+        help="also write a churn event stream (JSONL) valid against the "
+        "generated cluster, for kv-tpu serve / bench.py --mode serve",
+    )
+    p.add_argument(
+        "--n-events", type=int, default=500,
+        help="events in the churn stream (with --events-out)",
+    )
+    p.add_argument(
+        "--resync-rate", type=float, default=0.0,
+        help="per-event probability of a full_resync relist in the stream",
+    )
     p.set_defaults(fn=cmd_generate)
+
+    p = sub.add_parser(
+        "serve",
+        help="continuous verification: apply a mutation-event stream to an "
+        "incremental engine, check assertions, answer with exit codes",
+    )
+    p.add_argument("path", nargs="?", help="manifest file/dir (cold start)")
+    p.add_argument(
+        "--from-snapshot", metavar="DIR",
+        help="warm restart from a serve snapshot instead of manifests",
+    )
+    p.add_argument(
+        "--events", metavar="FILE",
+        help="JSONL mutation-event stream to apply (see kv-tpu generate "
+        "--events-out for the schema)",
+    )
+    p.add_argument(
+        "--tail", action="store_true",
+        help="keep polling --events for appended lines instead of one "
+        "replay pass",
+    )
+    p.add_argument(
+        "--idle-timeout", type=float, default=1.0, metavar="SECONDS",
+        help="with --tail: stop after this long with no stream growth",
+    )
+    p.add_argument(
+        "--assert", dest="assert_file", metavar="FILE",
+        help="declarative allow/deny assertion file (JSON), re-checked "
+        "after every applied batch; violations exit 1 with a pod-pair "
+        "witness",
+    )
+    p.add_argument(
+        "--staleness", type=float, default=None, metavar="SECONDS",
+        help="solve when applied-but-unsolved mutations age past this "
+        "bound (default: fully lazy — solve on query/assertions only)",
+    )
+    p.add_argument(
+        "--batch-size", type=int, default=256,
+        help="max events coalesced into one engine batch",
+    )
+    p.add_argument(
+        "--snapshot-out", metavar="DIR",
+        help="snapshot the warm engine state here on exit (and every "
+        "--snapshot-every batches)",
+    )
+    p.add_argument(
+        "--snapshot-every", type=int, default=0, metavar="N",
+        help="with --snapshot-out: also snapshot every N applied batches",
+    )
+    p.add_argument("--no-self-traffic", dest="self_traffic", action="store_false")
+    p.add_argument("--no-default-allow", dest="default_allow", action="store_false")
+    p.add_argument("--json", action="store_true")
+    _add_obs_flags(p)
+    p.set_defaults(fn=cmd_serve)
+
+    p = sub.add_parser(
+        "query",
+        help="one-shot queries against a cluster or serve snapshot: "
+        "can-reach / who-can-reach / blast-radius / what-if admission",
+    )
+    p.add_argument("path", nargs="?", help="manifest file/dir")
+    p.add_argument(
+        "--from-snapshot", metavar="DIR",
+        help="query a serve snapshot instead of manifests",
+    )
+    p.add_argument(
+        "--can-reach", nargs=2, metavar=("SRC", "DST"),
+        help="pod pair as NAMESPACE/NAME NAMESPACE/NAME",
+    )
+    p.add_argument(
+        "--port", type=int, default=None,
+        help="with --can-reach: refine to a concrete port (CPU-oracle "
+        "exact answer)",
+    )
+    p.add_argument("--protocol", default="TCP", help="with --port")
+    p.add_argument("--who-can-reach", metavar="DST")
+    p.add_argument("--blast-radius", metavar="SRC")
+    p.add_argument(
+        "--what-if", metavar="MANIFESTS",
+        help="admission dry run: would adding these NetworkPolicy "
+        "manifests violate the --assert file? (exit 1 if so; nothing "
+        "is committed)",
+    )
+    p.add_argument(
+        "--assert", dest="assert_file", metavar="FILE",
+        help="assertion file checked against the current state (or the "
+        "what-if overlay)",
+    )
+    p.add_argument("--no-self-traffic", dest="self_traffic", action="store_false")
+    p.add_argument("--no-default-allow", dest="default_allow", action="store_false")
+    p.add_argument("--json", action="store_true")
+    _add_obs_flags(p)
+    p.set_defaults(fn=cmd_query)
 
     p = sub.add_parser("backends", help="list available backends")
     p.set_defaults(fn=cmd_backends)

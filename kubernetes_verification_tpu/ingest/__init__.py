@@ -9,9 +9,12 @@ from .yaml_io import (
     dump_cluster,
     load_cluster,
     load_kano,
+    namespace_to_dict,
+    network_policy_to_dict,
     parse_network_policy,
     parse_namespace,
     parse_pod,
+    pod_to_dict,
 )
 
 __all__ = [
@@ -20,7 +23,10 @@ __all__ = [
     "dump_cluster",
     "load_cluster",
     "load_kano",
+    "namespace_to_dict",
+    "network_policy_to_dict",
     "parse_network_policy",
     "parse_namespace",
     "parse_pod",
+    "pod_to_dict",
 ]

@@ -64,6 +64,9 @@ __all__ = [
     "parse_pod",
     "parse_namespace",
     "parse_network_policy",
+    "pod_to_dict",
+    "namespace_to_dict",
+    "network_policy_to_dict",
     "IngestError",
     "SkipDiagnostic",
 ]
@@ -416,6 +419,74 @@ def _rules_to_yaml(rules: Optional[Tuple[Rule, ...]], peer_key: str) -> Optional
     return out
 
 
+def namespace_to_dict(ns: Namespace) -> dict:
+    """Manifest-shaped doc for one namespace; ``parse_namespace`` inverts."""
+    return {
+        "apiVersion": "v1",
+        "kind": "Namespace",
+        "metadata": {"name": ns.name, **({"labels": dict(ns.labels)} if ns.labels else {})},
+    }
+
+
+def pod_to_dict(p: Pod) -> dict:
+    """Manifest-shaped doc for one pod; ``parse_pod`` inverts."""
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": p.name,
+            "namespace": p.namespace,
+            **({"labels": dict(p.labels)} if p.labels else {}),
+        },
+        "spec": {
+            "containers": [
+                {
+                    "name": p.name,
+                    **(
+                        {
+                            "ports": [
+                                {"name": n, "protocol": proto, "containerPort": port}
+                                for n, (proto, port) in p.container_ports.items()
+                            ]
+                        }
+                        if p.container_ports
+                        else {}
+                    ),
+                }
+            ]
+        },
+        **({"status": {"podIP": p.ip}} if p.ip else {}),
+    }
+
+
+def network_policy_to_dict(pol: NetworkPolicy) -> dict:
+    """Manifest-shaped doc for one policy; ``parse_network_policy`` inverts
+    (null-vs-empty preserved: absent sections stay absent)."""
+    return {
+        "apiVersion": "networking.k8s.io/v1",
+        "kind": "NetworkPolicy",
+        "metadata": {"name": pol.name, "namespace": pol.namespace},
+        "spec": {
+            "podSelector": _selector_to_yaml(pol.pod_selector),
+            **(
+                {"policyTypes": list(pol.policy_types)}
+                if pol.policy_types is not None
+                else {}
+            ),
+            **(
+                {"ingress": _rules_to_yaml(pol.ingress, "from")}
+                if pol.ingress is not None
+                else {}
+            ),
+            **(
+                {"egress": _rules_to_yaml(pol.egress, "to")}
+                if pol.egress is not None
+                else {}
+            ),
+        },
+    }
+
+
 def dump_cluster(cluster: Cluster, directory: Union[str, os.PathLike]) -> List[str]:
     """Write the cluster as one multi-doc manifest per object kind under
     ``directory``; returns the written paths. ``load_cluster`` of the
@@ -432,77 +503,10 @@ def dump_cluster(cluster: Cluster, directory: Union[str, os.PathLike]) -> List[s
             yaml.safe_dump_all(list(docs), fh, sort_keys=False)
         written.append(p)
 
-    emit(
-        "namespaces.yaml",
-        [
-            {
-                "apiVersion": "v1",
-                "kind": "Namespace",
-                "metadata": {"name": ns.name, **({"labels": dict(ns.labels)} if ns.labels else {})},
-            }
-            for ns in cluster.namespaces
-        ],
-    )
-    emit(
-        "pods.yaml",
-        [
-            {
-                "apiVersion": "v1",
-                "kind": "Pod",
-                "metadata": {
-                    "name": p.name,
-                    "namespace": p.namespace,
-                    **({"labels": dict(p.labels)} if p.labels else {}),
-                },
-                "spec": {
-                    "containers": [
-                        {
-                            "name": p.name,
-                            **(
-                                {
-                                    "ports": [
-                                        {"name": n, "protocol": proto, "containerPort": port}
-                                        for n, (proto, port) in p.container_ports.items()
-                                    ]
-                                }
-                                if p.container_ports
-                                else {}
-                            ),
-                        }
-                    ]
-                },
-                **({"status": {"podIP": p.ip}} if p.ip else {}),
-            }
-            for p in cluster.pods
-        ],
-    )
+    emit("namespaces.yaml", [namespace_to_dict(ns) for ns in cluster.namespaces])
+    emit("pods.yaml", [pod_to_dict(p) for p in cluster.pods])
     emit(
         "networkpolicies.yaml",
-        [
-            {
-                "apiVersion": "networking.k8s.io/v1",
-                "kind": "NetworkPolicy",
-                "metadata": {"name": pol.name, "namespace": pol.namespace},
-                "spec": {
-                    "podSelector": _selector_to_yaml(pol.pod_selector),
-                    **(
-                        {"policyTypes": list(pol.policy_types)}
-                        if pol.policy_types is not None
-                        else {}
-                    ),
-                    **(
-                        {"ingress": _rules_to_yaml(pol.ingress, "from")}
-                        if pol.ingress is not None
-                        else {}
-                    ),
-                    **(
-                        {"egress": _rules_to_yaml(pol.egress, "to")}
-                        if pol.egress is not None
-                        else {}
-                    ),
-                },
-            }
-            for pol in cluster.policies
-        ],
+        [network_policy_to_dict(pol) for pol in cluster.policies],
     )
     return written

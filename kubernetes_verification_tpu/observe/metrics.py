@@ -36,6 +36,14 @@ __all__ = [
     "KERNEL_BYTES_ACCESSED",
     "KERNEL_PEAK_BYTES",
     "COST_REPORTS_TOTAL",
+    "SERVE_EVENTS_TOTAL",
+    "SERVE_COALESCED_TOTAL",
+    "SERVE_BATCHES_TOTAL",
+    "SERVE_SOLVES_TOTAL",
+    "SERVE_QUERIES_TOTAL",
+    "SERVE_ASSERTION_FAILURES_TOTAL",
+    "SERVE_QUEUE_DEPTH",
+    "SERVE_STALENESS_SECONDS",
 ]
 
 SPAN_SECONDS = Histogram(
@@ -188,4 +196,59 @@ COST_REPORTS_TOTAL = Counter(
     "KernelCostReports published by the introspection layer, by engine/"
     "function and source (xla AOT lowering vs. host analytic estimate).",
     ("engine", "fn", "source"),
+)
+
+SERVE_EVENTS_TOTAL = Counter(
+    "kvtpu_serve_events_total",
+    "Mutation events APPLIED to the serving engine after coalescing, by "
+    "event kind (add_policy, update_pod_labels, full_resync, ...).",
+    ("kind",),
+)
+
+SERVE_COALESCED_TOTAL = Counter(
+    "kvtpu_serve_coalesced_total",
+    "Events absorbed by write-coalescing before reaching the engine "
+    "(duplicate relabels folded, add+remove pairs cancelled, deltas "
+    "discarded by a full_resync), by event kind.",
+    ("kind",),
+)
+
+SERVE_BATCHES_TOTAL = Counter(
+    "kvtpu_serve_batches_total",
+    "Event batches applied by the verification service (one span and at "
+    "most one solve per batch).",
+)
+
+SERVE_SOLVES_TOTAL = Counter(
+    "kvtpu_serve_solves_total",
+    "Reachability re-derivations run by the serving loop, by trigger "
+    "(query arrived, staleness bound expired, assertions checked after a "
+    "batch, incremental-solve fallback to a from-scratch verify).",
+    ("trigger",),
+)
+
+SERVE_QUERIES_TOTAL = Counter(
+    "kvtpu_serve_queries_total",
+    "Queries answered by the serving query engine, by query kind "
+    "(can_reach, who_can_reach, blast_radius, what_if).",
+    ("kind",),
+)
+
+SERVE_ASSERTION_FAILURES_TOTAL = Counter(
+    "kvtpu_serve_assertion_failures_total",
+    "Declarative allow/deny assertions found violated after an applied "
+    "batch, by assertion name.",
+    ("assertion",),
+)
+
+SERVE_QUEUE_DEPTH = Gauge(
+    "kvtpu_serve_queue_depth",
+    "Events buffered in the serving queue but not yet applied to the "
+    "engine, sampled when the worker drains a batch.",
+)
+
+SERVE_STALENESS_SECONDS = Gauge(
+    "kvtpu_serve_staleness_seconds",
+    "Age of the oldest applied-but-unsolved mutation at the most recent "
+    "solve — how stale answers were allowed to get before re-deriving.",
 )

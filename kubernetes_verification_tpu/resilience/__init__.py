@@ -32,6 +32,7 @@ from .errors import (  # noqa: F401  (re-exported)
     IngestError,
     KvTpuError,
     PersistError,
+    ServeError,
     UnknownBackendError,
     classify_exception,
     exit_code_for,
@@ -43,6 +44,7 @@ __all__ = [
     "PersistError",
     "EncodeError",
     "ConfigError",
+    "ServeError",
     "BackendError",
     "BackendOOM",
     "BackendTimeout",

@@ -34,6 +34,7 @@ __all__ = [
     "PersistError",
     "EncodeError",
     "ConfigError",
+    "ServeError",
     "BackendError",
     "BackendOOM",
     "BackendTimeout",
@@ -83,6 +84,21 @@ class EncodeError(KvTpuError, ValueError):
 class ConfigError(KvTpuError, ValueError):
     """Invalid configuration: flag combinations, backend options, mesh
     shapes — errors the caller fixes by changing inputs, not by retrying."""
+
+
+class ServeError(KvTpuError, ValueError):
+    """The continuous-verification service rejected an input: an event that
+    references an unknown pod/policy/namespace, a query naming a pod the
+    engine does not hold, or misuse of the service lifecycle. Exit-code
+    contract: input error (2) — the *stream*, not the solver, is wrong.
+    ``event_index`` (when set) names the offending event's position in its
+    stream."""
+
+    def __init__(
+        self, message: str, *, event_index: Optional[int] = None
+    ) -> None:
+        super().__init__(message)
+        self.event_index = event_index
 
 
 class BackendError(KvTpuError, RuntimeError):

@@ -1,0 +1,72 @@
+"""Continuous verification: event streams in, always-current answers out.
+
+The batch verifiers answer "is this cluster snapshot safe?"; this package
+keeps the answer *standing* while the cluster churns (the serving story the
+incremental engines were built for — BASELINE config 5):
+
+* ``events``  — the typed mutation-event model, its JSONL codec, the
+  tail-able :class:`EventSource`, and the write-coalescing reduction;
+* ``service`` — :class:`VerificationService`: one incremental engine
+  behind one worker thread, lazy solve scheduling, staleness bounds and
+  warm-restart snapshots;
+* ``queries`` — :class:`QueryEngine` (``can_reach`` / ``who_can_reach`` /
+  ``blast_radius``), declarative allow/deny assertions with violating-pair
+  witnesses, and admission-style ``what_if`` dry runs on a copy-on-write
+  overlay.
+
+CLI: ``kv-tpu serve`` / ``kv-tpu query``; benchmark: ``bench.py --mode
+serve``; metric families: ``kvtpu_serve_*``.
+"""
+from .events import (
+    AddPolicy,
+    Event,
+    EventSource,
+    FullResync,
+    RemoveNamespace,
+    RemovePolicy,
+    UpdateNamespaceLabels,
+    UpdatePodLabels,
+    UpdatePolicy,
+    coalesce,
+    decode_event,
+    encode_event,
+    read_events,
+    write_events,
+)
+from .queries import (
+    Assertion,
+    PodSelector,
+    QueryEngine,
+    Violation,
+    WhatIfResult,
+    check_assertions,
+    load_assertions,
+)
+from .service import ServeConfig, ServeStats, VerificationService
+
+__all__ = [
+    "Event",
+    "AddPolicy",
+    "RemovePolicy",
+    "UpdatePolicy",
+    "UpdatePodLabels",
+    "UpdateNamespaceLabels",
+    "RemoveNamespace",
+    "FullResync",
+    "EventSource",
+    "encode_event",
+    "decode_event",
+    "read_events",
+    "write_events",
+    "coalesce",
+    "ServeConfig",
+    "ServeStats",
+    "VerificationService",
+    "QueryEngine",
+    "PodSelector",
+    "Assertion",
+    "Violation",
+    "WhatIfResult",
+    "load_assertions",
+    "check_assertions",
+]

@@ -1,0 +1,406 @@
+"""Typed cluster-mutation events + the JSONL wire codec + ``EventSource``.
+
+The paper's verifiers are one-shot batch checkers; a serving loop instead
+absorbs a *stream* of cluster mutations (the watch-API shape: one typed
+delta per object change, Kano/HOTI'20 frames the same re-verification
+problem as policy churn). This module is the ingest half of ``serve/``:
+
+* one frozen dataclass per mutation kind, mirroring exactly the delta ops
+  the incremental engines expose (``add_policy`` … ``remove_namespace``)
+  plus :class:`FullResync` (the watch-API "relist" — drop all pending
+  deltas and rebuild);
+* a JSONL codec: one JSON object per line, ``{"event": <kind>, ...}``,
+  with model objects carried as the same manifest-shaped dicts the YAML
+  ingest layer parses (``parse_network_policy`` etc.), so a stream is
+  greppable and hand-editable;
+* :class:`EventSource` — replay a file in batches, or *tail* it while a
+  producer appends (the file-backed stand-in for a watch connection).
+
+Malformed lines raise :class:`~..resilience.errors.IngestError` with the
+line number — a stream problem is an input error (exit 2), not a solver
+failure.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterator, List, Optional, Sequence, Tuple
+
+from ..ingest.yaml_io import (
+    namespace_to_dict,
+    network_policy_to_dict,
+    parse_namespace,
+    parse_network_policy,
+    parse_pod,
+    pod_to_dict,
+)
+from ..models.core import Cluster, NetworkPolicy
+from ..resilience.errors import IngestError
+
+__all__ = [
+    "Event",
+    "AddPolicy",
+    "RemovePolicy",
+    "UpdatePolicy",
+    "UpdatePodLabels",
+    "UpdateNamespaceLabels",
+    "RemoveNamespace",
+    "FullResync",
+    "EVENT_KINDS",
+    "encode_event",
+    "decode_event",
+    "write_events",
+    "read_events",
+    "EventSource",
+    "coalesce",
+]
+
+
+@dataclass(frozen=True)
+class Event:
+    """Base of the mutation-event model. ``kind`` is the wire tag and the
+    label value on the ``kvtpu_serve_*`` metric families."""
+
+    kind = "event"
+
+    @property
+    def key(self) -> Optional[str]:
+        """Coalescing identity: events with equal non-None keys mutate the
+        same object, so the service may fold them. None = never coalesced."""
+        return None
+
+
+@dataclass(frozen=True)
+class AddPolicy(Event):
+    kind = "add_policy"
+    policy: NetworkPolicy = None  # type: ignore[assignment]
+
+    @property
+    def key(self) -> str:
+        return f"policy/{self.policy.namespace}/{self.policy.name}"
+
+
+@dataclass(frozen=True)
+class RemovePolicy(Event):
+    kind = "remove_policy"
+    namespace: str = "default"
+    name: str = ""
+
+    @property
+    def key(self) -> str:
+        return f"policy/{self.namespace}/{self.name}"
+
+
+@dataclass(frozen=True)
+class UpdatePolicy(Event):
+    kind = "update_policy"
+    policy: NetworkPolicy = None  # type: ignore[assignment]
+
+    @property
+    def key(self) -> str:
+        return f"policy/{self.policy.namespace}/{self.policy.name}"
+
+
+@dataclass(frozen=True)
+class UpdatePodLabels(Event):
+    kind = "update_pod_labels"
+    namespace: str = "default"
+    pod: str = ""
+    labels: Dict[str, str] = field(default_factory=dict)
+
+    @property
+    def key(self) -> str:
+        return f"pod/{self.namespace}/{self.pod}"
+
+
+@dataclass(frozen=True)
+class UpdateNamespaceLabels(Event):
+    kind = "update_namespace_labels"
+    namespace: str = ""
+    labels: Dict[str, str] = field(default_factory=dict)
+
+    @property
+    def key(self) -> str:
+        return f"namespace/{self.namespace}"
+
+
+@dataclass(frozen=True)
+class RemoveNamespace(Event):
+    """Never coalesced (``key`` stays None): a preceding relabel may be
+    what *registers* the namespace, so folding the pair to a bare removal
+    would make a valid stream invalid. Both ops are cheap host
+    bookkeeping anyway — there is nothing to save."""
+
+    kind = "remove_namespace"
+    namespace: str = ""
+
+
+@dataclass(frozen=True)
+class FullResync(Event):
+    """The relist: replace the engine's entire state with ``cluster``.
+    Pending (uncommitted) deltas before a resync are dead weight — the
+    coalescer discards them, exactly like a watch client dropping its
+    buffered deltas on a relist."""
+
+    kind = "full_resync"
+    cluster: Cluster = None  # type: ignore[assignment]
+
+
+#: kind tag → event class (the codec's dispatch table)
+EVENT_KINDS: Dict[str, type] = {
+    cls.kind: cls
+    for cls in (
+        AddPolicy,
+        RemovePolicy,
+        UpdatePolicy,
+        UpdatePodLabels,
+        UpdateNamespaceLabels,
+        RemoveNamespace,
+        FullResync,
+    )
+}
+
+
+# ----------------------------------------------------------------- codec
+def _cluster_to_dict(cluster: Cluster) -> dict:
+    return {
+        "namespaces": [namespace_to_dict(ns) for ns in cluster.namespaces],
+        "pods": [pod_to_dict(p) for p in cluster.pods],
+        "policies": [network_policy_to_dict(p) for p in cluster.policies],
+    }
+
+
+def _cluster_from_dict(obj: dict) -> Cluster:
+    return Cluster(
+        pods=[parse_pod(d) for d in obj.get("pods", [])],
+        namespaces=[parse_namespace(d) for d in obj.get("namespaces", [])],
+        policies=[parse_network_policy(d) for d in obj.get("policies", [])],
+    )
+
+
+def encode_event(ev: Event) -> str:
+    """One JSON line (no trailing newline) for one event."""
+    if isinstance(ev, (AddPolicy, UpdatePolicy)):
+        body = {"policy": network_policy_to_dict(ev.policy)}
+    elif isinstance(ev, RemovePolicy):
+        body = {"namespace": ev.namespace, "name": ev.name}
+    elif isinstance(ev, UpdatePodLabels):
+        body = {
+            "namespace": ev.namespace, "pod": ev.pod,
+            "labels": dict(ev.labels),
+        }
+    elif isinstance(ev, UpdateNamespaceLabels):
+        body = {"namespace": ev.namespace, "labels": dict(ev.labels)}
+    elif isinstance(ev, RemoveNamespace):
+        body = {"namespace": ev.namespace}
+    elif isinstance(ev, FullResync):
+        body = {"cluster": _cluster_to_dict(ev.cluster)}
+    else:
+        raise IngestError(f"cannot encode event of type {type(ev).__name__}")
+    return json.dumps({"event": ev.kind, **body}, sort_keys=True)
+
+
+def decode_event(line: str, *, where: str = "<event>") -> Event:
+    """Parse one JSONL line into an :class:`Event`; ``where`` names the
+    source (file:lineno) in diagnostics."""
+    try:
+        obj = json.loads(line)
+    except json.JSONDecodeError as e:
+        raise IngestError(f"{where}: not valid JSON: {e}") from e
+    if not isinstance(obj, dict) or "event" not in obj:
+        raise IngestError(f"{where}: event line lacks an 'event' tag")
+    kind = obj["event"]
+    cls = EVENT_KINDS.get(kind)
+    if cls is None:
+        raise IngestError(
+            f"{where}: unknown event kind {kind!r} (known: "
+            f"{sorted(EVENT_KINDS)})"
+        )
+    try:
+        if cls in (AddPolicy, UpdatePolicy):
+            return cls(policy=parse_network_policy(obj["policy"]))
+        if cls is RemovePolicy:
+            return RemovePolicy(namespace=obj["namespace"], name=obj["name"])
+        if cls is UpdatePodLabels:
+            return UpdatePodLabels(
+                namespace=obj["namespace"], pod=obj["pod"],
+                labels=dict(obj.get("labels") or {}),
+            )
+        if cls is UpdateNamespaceLabels:
+            return UpdateNamespaceLabels(
+                namespace=obj["namespace"],
+                labels=dict(obj.get("labels") or {}),
+            )
+        if cls is RemoveNamespace:
+            return RemoveNamespace(namespace=obj["namespace"])
+        return FullResync(cluster=_cluster_from_dict(obj["cluster"]))
+    except IngestError:
+        raise
+    except (KeyError, TypeError, ValueError) as e:
+        raise IngestError(
+            f"{where}: malformed {kind!r} event: {e!r}"
+        ) from e
+
+
+def write_events(events: Sequence[Event], path: str) -> int:
+    """Append ``events`` to ``path`` as JSONL; returns the count written."""
+    with open(path, "a") as fh:
+        for ev in events:
+            fh.write(encode_event(ev) + "\n")
+    return len(events)
+
+
+def read_events(path: str) -> List[Event]:
+    """Decode a whole JSONL stream (blank lines skipped)."""
+    return list(EventSource(path).replay())
+
+
+class EventSource:
+    """A replayable, tail-able JSONL event stream.
+
+    * :meth:`replay` — decode from the current offset to EOF (one pass);
+    * :meth:`batches` — the same, grouped into ≤``batch_size`` chunks;
+    * :meth:`tail` — keep polling the file for appended lines, yielding a
+      batch per drain, until ``idle_timeout`` seconds pass with no growth
+      (None = forever). A partial final line (a writer mid-append) is left
+      unconsumed until its newline arrives.
+
+    The byte ``offset`` is resumable state: a service checkpoint can store
+    it and a restart continues the stream where the crash left it.
+    """
+
+    def __init__(self, path: str, offset: int = 0) -> None:
+        self.path = path
+        self.offset = offset
+        self.lineno = 0
+
+    def _drain(self) -> List[Event]:
+        with open(self.path, "rb") as fh:
+            fh.seek(self.offset)
+            chunk = fh.read()
+        out: List[Event] = []
+        consumed = 0
+        for raw in chunk.splitlines(keepends=True):
+            if not raw.endswith(b"\n"):
+                break  # partial trailing line: a writer is mid-append
+            consumed += len(raw)
+            self.lineno += 1
+            line = raw.decode("utf-8", errors="replace").strip()
+            if not line:
+                continue
+            out.append(
+                decode_event(line, where=f"{self.path}:{self.lineno}")
+            )
+        self.offset += consumed
+        return out
+
+    def replay(self) -> Iterator[Event]:
+        yield from self._drain()
+
+    def batches(self, batch_size: int = 64) -> Iterator[List[Event]]:
+        buf: List[Event] = []
+        for ev in self._drain():
+            buf.append(ev)
+            if len(buf) >= batch_size:
+                yield buf
+                buf = []
+        if buf:
+            yield buf
+
+    def tail(
+        self,
+        poll_interval: float = 0.05,
+        idle_timeout: Optional[float] = 1.0,
+        batch_size: int = 256,
+        sleep: Callable[[float], None] = time.sleep,
+    ) -> Iterator[List[Event]]:
+        """Yield batches of newly appended events until the stream goes
+        quiet for ``idle_timeout`` seconds (None = tail forever)."""
+        last_growth = time.monotonic()
+        while True:
+            got = self._drain() if os.path.exists(self.path) else []
+            while got:
+                yield got[:batch_size]
+                got = got[batch_size:]
+                last_growth = time.monotonic()
+            if (
+                idle_timeout is not None
+                and time.monotonic() - last_growth >= idle_timeout
+            ):
+                return
+            sleep(poll_interval)
+
+
+def coalesce(
+    events: Sequence[Event],
+) -> Tuple[List[Event], List[Event]]:
+    """Collapse a batch to its net effect: ``(kept, dropped)``.
+
+    Rules (per coalescing ``key``, order of survivors is the order of each
+    key's *last* contributing event, so valid streams stay valid):
+
+    * repeated relabels of one pod/namespace keep only the last;
+    * ``AddPolicy`` then ``RemovePolicy`` in one batch cancel entirely;
+    * ``AddPolicy`` then ``UpdatePolicy`` fold into one ``AddPolicy`` with
+      the final spec; ``UpdatePolicy`` chains keep the last;
+    * ``RemovePolicy`` then ``AddPolicy`` fold into one ``UpdatePolicy``
+      (the engine's update *is* remove+add — one op instead of two);
+    * ``FullResync`` discards every pending event before it.
+    """
+    kept: List[Optional[Event]] = []
+    dropped: List[Event] = []
+    slot: Dict[str, int] = {}  # key → index in kept
+
+    def _replace(key: str, ev: Optional[Event], old: Event) -> None:
+        kept[slot[key]] = None
+        dropped.append(old)
+        if ev is None:
+            del slot[key]
+        else:
+            slot[key] = len(kept)
+            kept.append(ev)
+
+    for ev in events:
+        if isinstance(ev, FullResync):
+            dropped += [e for e in kept if e is not None]
+            kept = [ev]
+            slot = {}
+            continue
+        if isinstance(ev, RemoveNamespace):
+            # barrier: a later relabel of this namespace may re-CREATE it,
+            # so it must not fold into (and reorder past) this removal
+            slot.pop(f"namespace/{ev.namespace}", None)
+            kept.append(ev)
+            continue
+        key = ev.key
+        if key is None or key not in slot:
+            if key is not None:
+                slot[key] = len(kept)
+            kept.append(ev)
+            continue
+        prev = kept[slot[key]]
+        if isinstance(ev, (UpdatePodLabels, UpdateNamespaceLabels)):
+            _replace(key, ev, prev)
+        elif isinstance(ev, RemovePolicy):
+            if isinstance(prev, AddPolicy):
+                # net no-op: the policy both appears and disappears inside
+                # this batch
+                kept[slot[key]] = None
+                del slot[key]
+                dropped += [prev, ev]
+            else:  # Update/Remove before: net effect is the removal
+                _replace(key, ev, prev)
+        elif isinstance(ev, (AddPolicy, UpdatePolicy)):
+            if isinstance(prev, AddPolicy):
+                _replace(key, AddPolicy(policy=ev.policy), prev)
+            elif isinstance(prev, RemovePolicy):
+                # remove+add of one key = one in-place update
+                _replace(key, UpdatePolicy(policy=ev.policy), prev)
+            else:
+                _replace(key, UpdatePolicy(policy=ev.policy), prev)
+        else:  # a future keyed kind with no fold rule: keep both
+            slot[key] = len(kept)
+            kept.append(ev)
+    return [e for e in kept if e is not None], dropped

@@ -1,0 +1,445 @@
+"""Queries, declarative assertions and what-if admission checks.
+
+The serving counterpart of the one-shot result API
+(:class:`~..backends.base.VerifyResult`): a :class:`QueryEngine` answers
+against a live :class:`~.service.VerificationService`, solving lazily —
+
+* :meth:`QueryEngine.can_reach` — one pod pair, optionally refined to a
+  concrete ``(protocol, port)``. The dense serving engine is any-port, so
+  the port-precise form re-runs the CPU oracle on a 2-pod sub-cluster
+  (pair reachability depends only on the policies plus the two pods'
+  labels/namespaces, so the sub-problem is exact and tiny);
+* :meth:`QueryEngine.who_can_reach` / :meth:`QueryEngine.blast_radius` —
+  one column / one row of the reach matrix, as pod names;
+* :meth:`QueryEngine.what_if` — admission-style dry run: candidate policy
+  events are applied to a copy-on-write overlay of the engine's count
+  matrices (fresh non-donated buffers; the engine's own ``_rank1_add``
+  donates and would invalidate live state), the overlay's reach is derived
+  with the same jitted kernel, and the diff plus assertion verdicts come
+  back WITHOUT committing anything.
+
+Assertions are declarative allow/deny invariants over pod selectors,
+re-checked after every applied batch; a violated assertion carries a
+concrete witnessing pod pair (the serving form of the reference's
+``assert_reachable`` test idiom).
+"""
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..backends.base import VerifyConfig
+from ..incremental import _derive_reach
+from ..models.core import Cluster, Pod
+from ..observe.metrics import (
+    SERVE_ASSERTION_FAILURES_TOTAL,
+    SERVE_QUERIES_TOTAL,
+)
+from ..resilience.errors import IngestError, ServeError
+from .events import AddPolicy, Event, RemovePolicy, UpdatePolicy
+
+__all__ = [
+    "PodSelector",
+    "Assertion",
+    "Violation",
+    "WhatIfResult",
+    "QueryEngine",
+    "load_assertions",
+    "check_assertions",
+]
+
+_I32 = jnp.int32
+
+
+@jax.jit
+def _overlay_rank1(count, src, dst, sign):
+    """count + sign · src ⊗ dst — the overlay's NON-donating twin of the
+    engine's ``_rank1_add`` (which donates its first argument and must
+    never see a live engine buffer from this module)."""
+    return count + sign * (
+        src.astype(_I32)[:, None] * dst.astype(_I32)[None, :]
+    )
+
+
+# ------------------------------------------------------------ pod selection
+@dataclass(frozen=True)
+class PodSelector:
+    """Selects pods by exact namespace, exact name and/or a label subset
+    (all given fields must match; an empty selector matches every pod)."""
+
+    namespace: Optional[str] = None
+    name: Optional[str] = None
+    labels: Tuple[Tuple[str, str], ...] = ()
+
+    @classmethod
+    def from_dict(cls, obj: dict, *, where: str = "<selector>") -> "PodSelector":
+        if not isinstance(obj, dict):
+            raise IngestError(f"{where}: selector must be an object")
+        unknown = set(obj) - {"namespace", "name", "labels", "pod"}
+        if unknown:
+            raise IngestError(
+                f"{where}: unknown selector field(s) {sorted(unknown)}"
+            )
+        name = obj.get("name", obj.get("pod"))
+        labels = obj.get("labels") or {}
+        if not isinstance(labels, dict):
+            raise IngestError(f"{where}: labels must be an object")
+        return cls(
+            namespace=obj.get("namespace"),
+            name=name,
+            labels=tuple(sorted((str(k), str(v)) for k, v in labels.items())),
+        )
+
+    def matches(self, pod: Pod) -> bool:
+        if self.namespace is not None and pod.namespace != self.namespace:
+            return False
+        if self.name is not None and pod.name != self.name:
+            return False
+        return all(pod.labels.get(k) == v for k, v in self.labels)
+
+    def indices(self, pods: Sequence[Pod]) -> np.ndarray:
+        return np.asarray(
+            [i for i, p in enumerate(pods) if self.matches(p)], dtype=np.int64
+        )
+
+    def describe(self) -> str:
+        parts = []
+        if self.namespace is not None:
+            parts.append(f"namespace={self.namespace}")
+        if self.name is not None:
+            parts.append(f"name={self.name}")
+        parts += [f"{k}={v}" for k, v in self.labels]
+        return "{" + ", ".join(parts) + "}" if parts else "{*}"
+
+
+@dataclass(frozen=True)
+class Assertion:
+    """``allow``: every (src, dst) pair matched by the selectors must be
+    reachable. ``deny``: none may be. Checked after every applied batch."""
+
+    name: str
+    kind: str  # "allow" | "deny"
+    src: PodSelector
+    dst: PodSelector
+    #: skip src==dst pairs (self-traffic is usually policy-independent)
+    ignore_self: bool = True
+
+
+@dataclass(frozen=True)
+class Violation:
+    """One violated assertion with a concrete witnessing pod pair."""
+
+    assertion: str
+    kind: str
+    witness_src: str  # "namespace/name"
+    witness_dst: str
+    pairs: int  # total violating pairs, not just the witness
+
+    def describe(self) -> str:
+        verb = "cannot reach" if self.kind == "allow" else "can reach"
+        extra = f" (+{self.pairs - 1} more pairs)" if self.pairs > 1 else ""
+        return (
+            f"assertion {self.assertion!r} violated: {self.witness_src} "
+            f"{verb} {self.witness_dst}{extra}"
+        )
+
+
+def load_assertions(path: str) -> List[Assertion]:
+    """Parse an assertion file: a JSON list (or ``{"assertions": [...]}``)
+    of ``{"name", "kind": "allow"|"deny", "from": SEL, "to": SEL}``."""
+    try:
+        with open(path) as fh:
+            doc = json.load(fh)
+    except OSError as e:
+        raise IngestError(f"cannot read assertion file {path}: {e}") from e
+    except json.JSONDecodeError as e:
+        raise IngestError(f"{path}: not valid JSON: {e}") from e
+    if isinstance(doc, dict):
+        doc = doc.get("assertions")
+    if not isinstance(doc, list):
+        raise IngestError(
+            f"{path}: expected a JSON list of assertions (or an object "
+            "with an 'assertions' list)"
+        )
+    out: List[Assertion] = []
+    for i, obj in enumerate(doc):
+        where = f"{path}[{i}]"
+        if not isinstance(obj, dict):
+            raise IngestError(f"{where}: assertion must be an object")
+        kind = obj.get("kind")
+        if kind not in ("allow", "deny"):
+            raise IngestError(
+                f"{where}: kind must be 'allow' or 'deny', got {kind!r}"
+            )
+        if "from" not in obj or "to" not in obj:
+            raise IngestError(f"{where}: assertion needs 'from' and 'to'")
+        out.append(
+            Assertion(
+                name=str(obj.get("name", f"assertion-{i}")),
+                kind=kind,
+                src=PodSelector.from_dict(obj["from"], where=f"{where}.from"),
+                dst=PodSelector.from_dict(obj["to"], where=f"{where}.to"),
+                ignore_self=bool(obj.get("ignore_self", True)),
+            )
+        )
+    return out
+
+
+def _pod_name(pod: Pod) -> str:
+    return f"{pod.namespace}/{pod.name}"
+
+
+def _violations_on(
+    assertions: Sequence[Assertion],
+    reach: np.ndarray,
+    pods: Sequence[Pod],
+) -> List[Violation]:
+    found: List[Violation] = []
+    for a in assertions:
+        src_idx = a.src.indices(pods)
+        dst_idx = a.dst.indices(pods)
+        if src_idx.size == 0 or dst_idx.size == 0:
+            continue
+        sub = reach[np.ix_(src_idx, dst_idx)]
+        bad = ~sub if a.kind == "allow" else sub.copy()
+        if a.ignore_self:
+            bad &= src_idx[:, None] != dst_idx[None, :]
+        si, di = np.nonzero(bad)
+        if si.size == 0:
+            continue
+        found.append(
+            Violation(
+                assertion=a.name,
+                kind=a.kind,
+                witness_src=_pod_name(pods[int(src_idx[si[0]])]),
+                witness_dst=_pod_name(pods[int(dst_idx[di[0]])]),
+                pairs=int(si.size),
+            )
+        )
+    return found
+
+
+def check_assertions(service, assertions: Sequence[Assertion]) -> List[Violation]:
+    """Check ``assertions`` against the service's current state (solving
+    if stale, trigger=``assertions``); counts each violated assertion on
+    ``kvtpu_serve_assertion_failures_total``."""
+    if not assertions:
+        return []
+    reach = service._solve("assertions")
+    found = _violations_on(assertions, reach, service.engine.pods)
+    for v in found:
+        SERVE_ASSERTION_FAILURES_TOTAL.labels(assertion=v.assertion).inc()
+    return found
+
+
+# ----------------------------------------------------------------- what-if
+@dataclass
+class WhatIfResult:
+    """Admission verdict for a candidate policy change (nothing committed).
+    ``ok`` means no configured assertion would be violated."""
+
+    ok: bool
+    n_added: int
+    n_removed: int
+    added: List[Tuple[str, str]] = field(default_factory=list)
+    removed: List[Tuple[str, str]] = field(default_factory=list)
+    violations: List[Violation] = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return {
+            "ok": self.ok,
+            "pairs_added": self.n_added,
+            "pairs_removed": self.n_removed,
+            "added": [list(p) for p in self.added],
+            "removed": [list(p) for p in self.removed],
+            "violations": [v.describe() for v in self.violations],
+        }
+
+
+class QueryEngine:
+    """Query front end over a :class:`~.service.VerificationService`."""
+
+    def __init__(self, service) -> None:
+        self.service = service
+
+    # ------------------------------------------------------------- helpers
+    def _count(self, kind: str) -> None:
+        SERVE_QUERIES_TOTAL.labels(kind=kind).inc()
+        st = self.service.stats
+        st.queries[kind] = st.queries.get(kind, 0) + 1
+
+    def _ref(self, ref: str) -> Tuple[str, str]:
+        ns, sep, name = ref.partition("/")
+        if not sep or not ns or not name:
+            raise ServeError(
+                f"pod reference must be NAMESPACE/NAME, got {ref!r}"
+            )
+        return ns, name
+
+    def _idx(self, ref: str) -> int:
+        ns, name = self._ref(ref)
+        return self.service.pod_index(ns, name)
+
+    # ------------------------------------------------------------- queries
+    def can_reach(
+        self,
+        src: str,
+        dst: str,
+        port: Optional[int] = None,
+        protocol: str = "TCP",
+    ) -> bool:
+        """Is ``src`` → ``dst`` allowed — on any port (``port=None``) or on
+        a concrete ``(protocol, port)`` via the 2-pod oracle refinement."""
+        self._count("can_reach")
+        si, di = self._idx(src), self._idx(dst)
+        if port is None:
+            return bool(self.service.reach()[si, di])
+        return self._can_reach_port(si, di, port, protocol)
+
+    def _can_reach_port(
+        self, si: int, di: int, port: int, protocol: str
+    ) -> bool:
+        self.service.flush()
+        eng = self.service.engine
+        cluster = eng.as_cluster()
+        pair = [cluster.pods[si]] + (
+            [cluster.pods[di]] if di != si else []
+        )
+        cfg = eng.config
+        import kubernetes_verification_tpu as kv
+
+        res = kv.verify(
+            Cluster(
+                pods=pair,
+                namespaces=list(cluster.namespaces),
+                policies=list(cluster.policies),
+            ),
+            VerifyConfig(
+                backend="cpu",
+                compute_ports=True,
+                self_traffic=cfg.self_traffic,
+                default_allow_unselected=cfg.default_allow_unselected,
+                direction_aware_isolation=cfg.direction_aware_isolation,
+            ),
+        )
+        s, d = (0, 0) if di == si else (0, 1)
+        if res.reach_ports is not None:
+            for q, atom in enumerate(res.port_atoms):
+                if (
+                    atom.name is None
+                    and atom.protocol == protocol
+                    and atom.lo <= port <= atom.hi
+                ):
+                    return bool(res.reach_ports[s, d, q])
+        # no numbered atom covers the port (degenerate universe): the
+        # any-port answer is the best available refinement
+        return bool(res.reach[s, d])
+
+    def who_can_reach(self, dst: str) -> List[str]:
+        """Every pod that can reach ``dst`` (one column of the matrix)."""
+        self._count("who_can_reach")
+        di = self._idx(dst)
+        reach = self.service.reach()
+        pods = self.service.engine.pods
+        return [
+            _pod_name(pods[i]) for i in np.nonzero(reach[:, di])[0] if i != di
+        ]
+
+    def blast_radius(self, src: str) -> List[str]:
+        """Every pod that ``src`` can reach (one row of the matrix) — the
+        exposure set if ``src`` is compromised."""
+        self._count("blast_radius")
+        si = self._idx(src)
+        reach = self.service.reach()
+        pods = self.service.engine.pods
+        return [
+            _pod_name(pods[i]) for i in np.nonzero(reach[si, :])[0] if i != si
+        ]
+
+    # ------------------------------------------------------------- what-if
+    def what_if(
+        self,
+        events: Sequence[Event],
+        assertions: Optional[Sequence[Assertion]] = None,
+        max_witnesses: int = 20,
+    ) -> WhatIfResult:
+        """Dry-run candidate policy events against a copy-on-write overlay
+        of the engine's count matrices; the engine itself is untouched.
+
+        Only policy-shaped events admit (``AddPolicy`` / ``UpdatePolicy`` /
+        ``RemovePolicy``) — label churn is not an admission decision."""
+        self._count("what_if")
+        svc = self.service
+        svc.flush()
+        with svc._lock:
+            before = svc._solve("query")
+            eng = svc.engine
+            ing, egc = eng._ing_count, eng._eg_count
+            ing_iso = eng._ing_iso.copy()
+            eg_iso = eng._eg_iso.copy()
+            resident: Dict[str, tuple] = dict(eng._vectors)
+
+            def shift(vecs, sign: int) -> None:
+                nonlocal ing, egc, ing_iso, eg_iso
+                sel_ing, sel_eg, ing_peers, eg_peers = (
+                    jnp.asarray(v) for v in vecs
+                )
+                ing = _overlay_rank1(ing, ing_peers, sel_ing, sign)
+                egc = _overlay_rank1(egc, sel_eg, eg_peers, sign)
+                ing_iso += sign * np.asarray(vecs[0], dtype=np.int64)
+                eg_iso += sign * np.asarray(vecs[1], dtype=np.int64)
+
+            for ev in events:
+                if isinstance(ev, (AddPolicy, UpdatePolicy)):
+                    key = f"{ev.policy.namespace}/{ev.policy.name}"
+                    if key in resident:
+                        shift(resident.pop(key), -1)
+                    vecs = eng._policy_vectors(ev.policy)
+                    resident[key] = vecs
+                    shift(vecs, +1)
+                elif isinstance(ev, RemovePolicy):
+                    key = f"{ev.namespace}/{ev.name}"
+                    if key not in resident:
+                        raise ServeError(
+                            f"what-if removes unknown policy {key}"
+                        )
+                    shift(resident.pop(key), -1)
+                else:
+                    raise ServeError(
+                        f"what-if admits policy events only, got {ev.kind}"
+                    )
+            cfg = eng.config
+            after = np.asarray(
+                _derive_reach(
+                    ing,
+                    egc,
+                    jnp.asarray(ing_iso, dtype=_I32),
+                    jnp.asarray(eg_iso, dtype=_I32),
+                    self_traffic=cfg.self_traffic,
+                    default_allow_unselected=cfg.default_allow_unselected,
+                )
+            )
+            pods = eng.pods
+        added = np.nonzero(after & ~before)
+        removed = np.nonzero(before & ~after)
+        name_pairs = lambda idx: [
+            (_pod_name(pods[int(s)]), _pod_name(pods[int(d)]))
+            for s, d in zip(idx[0][:max_witnesses], idx[1][:max_witnesses])
+        ]
+        checks = list(
+            assertions if assertions is not None else svc.assertions
+        )
+        violations = _violations_on(checks, after, pods)
+        return WhatIfResult(
+            ok=not violations,
+            n_added=int(added[0].size),
+            n_removed=int(removed[0].size),
+            added=name_pairs(added),
+            removed=name_pairs(removed),
+            violations=violations,
+        )

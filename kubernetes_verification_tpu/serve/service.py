@@ -1,0 +1,470 @@
+"""The continuous-verification service core.
+
+One :class:`VerificationService` owns one dense
+:class:`~..incremental.IncrementalVerifier` and feeds it mutation batches
+from a stream, with three serving-loop behaviours the one-shot CLI verbs
+don't have:
+
+* **write-coalescing** — each drained batch is reduced to its net effect
+  (:func:`~.events.coalesce`) before touching the engine, so a relabel
+  storm on one pod costs one row/col patch and an add+remove pair costs
+  nothing;
+* **lazy solving** — applying a batch only marks the engine's reach
+  derivation dirty; the actual solve runs when a query arrives, when
+  declarative assertions must be re-checked, or when the configured
+  staleness bound expires. Solves are therefore counted per *batch* (at
+  most), not per event — the serving analogue of the paper's
+  incremental-vs-rebuild argument;
+* **warm restart** — the engine state snapshots through
+  ``utils/persist.save_incremental`` so a crashed service resumes without
+  re-solving from manifests.
+
+Ingestion can be synchronous (:meth:`VerificationService.apply`) or run
+behind the single worker thread (:meth:`start` / :meth:`submit` /
+:meth:`flush`): the worker is the only thread that touches the engine once
+started, and queries synchronise with it by draining the queue first.
+
+Resilience: the engine's ``reach`` already retries transients
+(``retry_transient``); when the incremental derivation still fails with a
+:class:`~..resilience.errors.BackendError`, the service falls back to a
+from-scratch CPU verify of ``as_cluster()`` — degraded throughput, same
+answers — and counts the hop on ``kvtpu_fallbacks_total``.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..backends.base import VerifyConfig
+from ..incremental import IncrementalVerifier
+from ..models.core import Cluster, Namespace
+from ..observe import trace
+from ..observe.metrics import (
+    FALLBACKS_TOTAL,
+    SERVE_BATCHES_TOTAL,
+    SERVE_COALESCED_TOTAL,
+    SERVE_EVENTS_TOTAL,
+    SERVE_QUEUE_DEPTH,
+    SERVE_SOLVES_TOTAL,
+    SERVE_STALENESS_SECONDS,
+)
+from ..resilience.errors import BackendError, KvTpuError, ServeError
+from .events import (
+    AddPolicy,
+    Event,
+    FullResync,
+    RemoveNamespace,
+    RemovePolicy,
+    UpdateNamespaceLabels,
+    UpdatePodLabels,
+    UpdatePolicy,
+    coalesce,
+)
+
+__all__ = ["ServeConfig", "ServeStats", "VerificationService"]
+
+
+@dataclass
+class ServeConfig:
+    """Serving-loop knobs (the verification semantics live in
+    :class:`~..backends.base.VerifyConfig`)."""
+
+    #: seconds an applied-but-unsolved mutation may age before the worker
+    #: re-derives on its own; None = fully lazy (solve only on query /
+    #: assertion check)
+    staleness_bound: Optional[float] = None
+    #: max events the worker drains into one coalesced batch
+    batch_size: int = 256
+    #: directory to snapshot the warm engine into (None = no snapshots)
+    snapshot_dir: Optional[str] = None
+    #: snapshot every N applied batches (0 = only on close())
+    snapshot_every: int = 0
+
+
+@dataclass
+class ServeStats:
+    """Serving counters, mirrored onto the ``kvtpu_serve_*`` metric
+    families; the CLI prints ``to_dict()`` as its summary line."""
+
+    events_seen: int = 0
+    events_applied: int = 0
+    events_coalesced: int = 0
+    batches: int = 0
+    solves: Dict[str, int] = field(default_factory=dict)
+    queries: Dict[str, int] = field(default_factory=dict)
+    assertion_checks: int = 0
+    assertion_failures: int = 0
+    snapshots: int = 0
+
+    @property
+    def total_solves(self) -> int:
+        return sum(self.solves.values())
+
+    def to_dict(self) -> dict:
+        return {
+            "events_seen": self.events_seen,
+            "events_applied": self.events_applied,
+            "events_coalesced": self.events_coalesced,
+            "batches": self.batches,
+            "solves": dict(self.solves),
+            "total_solves": self.total_solves,
+            "queries": dict(self.queries),
+            "assertion_checks": self.assertion_checks,
+            "assertion_failures": self.assertion_failures,
+            "snapshots": self.snapshots,
+        }
+
+
+class VerificationService:
+    """A long-lived verifier: event batches in, always-current answers out.
+
+    Construct from a :class:`Cluster` (cold start) or
+    :meth:`from_snapshot` (warm restart). Synchronous use::
+
+        svc = VerificationService(cluster)
+        svc.apply(events)          # coalesce + incremental engine ops
+        svc.reach()                # solves lazily, here
+
+    Threaded use: :meth:`start` spawns the single worker; :meth:`submit`
+    enqueues; :meth:`flush` blocks until the queue is drained (queries do
+    this implicitly so answers reflect every submitted event).
+    """
+
+    def __init__(
+        self,
+        cluster: Optional[Cluster] = None,
+        config: Optional[VerifyConfig] = None,
+        serve_config: Optional[ServeConfig] = None,
+        *,
+        engine: Optional[IncrementalVerifier] = None,
+        device=None,
+    ) -> None:
+        if (cluster is None) == (engine is None):
+            raise ServeError(
+                "VerificationService needs exactly one of cluster= or "
+                "engine="
+            )
+        if engine is None:
+            cfg = config or VerifyConfig(compute_ports=False)
+            engine = IncrementalVerifier(cluster, cfg, device=device)
+        self._engine = engine
+        self.config = engine.config
+        self.serve_config = serve_config or ServeConfig()
+        self._pod_idx: Dict[Tuple[str, str], int] = {
+            (p.namespace, p.name): i for i, p in enumerate(engine.pods)
+        }
+        self.stats = ServeStats()
+        #: declarative allow/deny assertions (see ``serve.queries``),
+        #: re-checked after every applied batch; violations accumulate here
+        self.assertions: list = []
+        self.violations: list = []
+        self._lock = threading.RLock()
+        self._queue: "queue.Queue[Event]" = queue.Queue()
+        self._worker: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._worker_error: Optional[KvTpuError] = None
+        self._dirty_since: Optional[float] = None
+        #: reach matrix from a from-scratch fallback solve; valid until the
+        #: next mutation (the incremental counts may be what broke)
+        self._fallback_reach: Optional[np.ndarray] = None
+
+    # ------------------------------------------------------------ snapshots
+    @classmethod
+    def from_snapshot(
+        cls,
+        directory: str,
+        serve_config: Optional[ServeConfig] = None,
+        *,
+        config: Optional[VerifyConfig] = None,
+        device=None,
+    ) -> "VerificationService":
+        """Warm restart: rebuild the engine from a
+        ``save_incremental`` checkpoint (crash recovery — no re-solve)."""
+        from ..utils.persist import load_incremental
+
+        engine = load_incremental(directory, config=config, device=device)
+        return cls(engine=engine, serve_config=serve_config)
+
+    def snapshot(self, directory: Optional[str] = None) -> str:
+        """Checkpoint the warm engine state for crash-recovery restart."""
+        target = directory or self.serve_config.snapshot_dir
+        if not target:
+            raise ServeError(
+                "no snapshot directory configured (ServeConfig.snapshot_dir)"
+            )
+        from ..utils.persist import save_incremental
+
+        with self._lock:
+            save_incremental(self._engine, target)
+            self.stats.snapshots += 1
+        return target
+
+    # -------------------------------------------------------------- applying
+    @property
+    def engine(self) -> IncrementalVerifier:
+        return self._engine
+
+    @property
+    def n_pods(self) -> int:
+        return len(self._engine.pods)
+
+    def pod_index(self, namespace: str, name: str) -> int:
+        """Engine row index for pod ``namespace/name`` (ServeError when the
+        service holds no such pod)."""
+        idx = self._pod_idx.get((namespace, name))
+        if idx is None:
+            raise ServeError(
+                f"unknown pod {namespace}/{name} (service holds "
+                f"{len(self._pod_idx)} pods)"
+            )
+        return idx
+
+    def apply(self, events: Sequence[Event]) -> int:
+        """Coalesce ``events`` into their net effect and apply them to the
+        engine as one batch; returns the number of engine mutations.
+
+        The solve stays lazy: this only dirties the derivation (unless
+        assertions are configured, which force a post-batch check)."""
+        events = list(events)
+        if not events:
+            return 0
+        with self._lock:
+            kept, dropped = coalesce(events)
+            with trace(
+                "serve_batch", events=len(events), applied=len(kept)
+            ):
+                for ev in dropped:
+                    SERVE_COALESCED_TOTAL.labels(kind=ev.kind).inc()
+                self.stats.events_seen += len(events)
+                self.stats.events_coalesced += len(dropped)
+                for i, ev in enumerate(kept):
+                    try:
+                        self._apply_one(ev)
+                    except (KeyError, ValueError) as e:
+                        if isinstance(e, KvTpuError):
+                            raise
+                        raise ServeError(
+                            f"event {i} ({ev.kind}) rejected by the "
+                            f"engine: {e}",
+                            event_index=i,
+                        ) from e
+                    SERVE_EVENTS_TOTAL.labels(kind=ev.kind).inc()
+                    self.stats.events_applied += 1
+                self.stats.batches += 1
+                SERVE_BATCHES_TOTAL.inc()
+                if kept:
+                    self._fallback_reach = None
+                    if self._dirty_since is None:
+                        self._dirty_since = time.monotonic()
+            if self.assertions:
+                self.check_assertions()
+            sc = self.serve_config
+            if sc.snapshot_dir and sc.snapshot_every and (
+                self.stats.batches % sc.snapshot_every == 0
+            ):
+                self.snapshot()
+        return len(kept)
+
+    def _apply_one(self, ev: Event) -> None:
+        eng = self._engine
+        if isinstance(ev, AddPolicy):
+            # idempotent, kubectl-apply style: adding a resident key is an
+            # update (watch replays re-deliver adds after reconnects)
+            key = f"{ev.policy.namespace}/{ev.policy.name}"
+            if key in eng.policies:
+                eng.update_policy(ev.policy)
+            else:
+                eng.add_policy(ev.policy)
+        elif isinstance(ev, UpdatePolicy):
+            key = f"{ev.policy.namespace}/{ev.policy.name}"
+            if key in eng.policies:
+                eng.update_policy(ev.policy)
+            else:  # update of an unseen key (e.g. coalesced remove+add)
+                eng.add_policy(ev.policy)
+        elif isinstance(ev, RemovePolicy):
+            eng.remove_policy(ev.namespace, ev.name)
+        elif isinstance(ev, UpdatePodLabels):
+            eng.update_pod_labels(
+                self.pod_index(ev.namespace, ev.pod), dict(ev.labels)
+            )
+        elif isinstance(ev, UpdateNamespaceLabels):
+            # add_namespace registers unknown namespaces and delegates
+            # label changes on known ones to update_namespace_labels
+            eng.add_namespace(Namespace(ev.namespace, dict(ev.labels)))
+        elif isinstance(ev, RemoveNamespace):
+            eng.remove_namespace(ev.namespace)
+        elif isinstance(ev, FullResync):
+            self._engine = IncrementalVerifier(
+                ev.cluster, self.config, device=eng.device
+            )
+            self._pod_idx = {
+                (p.namespace, p.name): i
+                for i, p in enumerate(self._engine.pods)
+            }
+        else:
+            raise ServeError(f"unhandled event kind {ev.kind!r}")
+
+    # --------------------------------------------------------------- solving
+    def reach(self, trigger: str = "query") -> np.ndarray:
+        """The current reachability matrix, solving first if stale. With a
+        worker running, submitted-but-unapplied events are drained first so
+        the answer reflects the whole stream."""
+        self.flush()
+        return self._solve(trigger)
+
+    def _solve(self, trigger: str) -> np.ndarray:
+        with self._lock:
+            eng = self._engine
+            if self._fallback_reach is not None:
+                return self._fallback_reach
+            if not eng._reach_dirty and eng._reach is not None:
+                return np.asarray(eng.reach)
+            staleness = (
+                time.monotonic() - self._dirty_since
+                if self._dirty_since is not None
+                else 0.0
+            )
+            try:
+                reach = np.asarray(eng.reach)
+            except BackendError:
+                reach = self._solve_fallback()
+                trigger = "fallback"
+            SERVE_SOLVES_TOTAL.labels(trigger=trigger).inc()
+            self.stats.solves[trigger] = (
+                self.stats.solves.get(trigger, 0) + 1
+            )
+            SERVE_STALENESS_SECONDS.set(staleness)
+            self._dirty_since = None
+            return reach
+
+    def _solve_fallback(self) -> np.ndarray:
+        """Incremental derivation failed hard: answer from a from-scratch
+        CPU verify of the engine's current cluster snapshot."""
+        import kubernetes_verification_tpu as kv
+
+        cfg = self.config
+        res = kv.verify(
+            self._engine.as_cluster(),
+            VerifyConfig(
+                backend="cpu",
+                compute_ports=False,
+                self_traffic=cfg.self_traffic,
+                default_allow_unselected=cfg.default_allow_unselected,
+                direction_aware_isolation=cfg.direction_aware_isolation,
+            ),
+        )
+        FALLBACKS_TOTAL.labels(
+            from_backend="serve-dense", to_backend="cpu"
+        ).inc()
+        self._fallback_reach = np.asarray(res.reach)
+        return self._fallback_reach
+
+    def check_assertions(self) -> list:
+        """Re-check the configured declarative assertions against the
+        current state; new violations append to ``self.violations``."""
+        from .queries import check_assertions
+
+        with self._lock:
+            found = check_assertions(self, self.assertions)
+            self.stats.assertion_checks += 1
+            self.stats.assertion_failures += len(found)
+            self.violations.extend(found)
+            return found
+
+    # ------------------------------------------------------------- threading
+    def start(self) -> None:
+        """Spawn the single worker thread that owns engine writes."""
+        with self._lock:
+            if self._worker is not None and self._worker.is_alive():
+                raise ServeError("service worker already running")
+            self._stop.clear()
+            self._worker = threading.Thread(
+                target=self._run, name="kvtpu-serve-worker", daemon=True
+            )
+            self._worker.start()
+
+    def submit(self, events: Sequence[Event]) -> None:
+        """Enqueue events for the worker (start() must have been called)."""
+        if self._worker is None:
+            raise ServeError("submit() before start(); use apply() instead")
+        for ev in events:
+            self._queue.put(ev)
+
+    def flush(self, timeout: Optional[float] = None) -> None:
+        """Block until every submitted event has been applied; re-raise a
+        worker-side error into the caller."""
+        if self._worker is not None:
+            deadline = (
+                time.monotonic() + timeout if timeout is not None else None
+            )
+            while not self._queue.empty() or self._queue.unfinished_tasks:
+                if self._worker_error is not None:
+                    break
+                if not self._worker.is_alive():
+                    break
+                if deadline is not None and time.monotonic() > deadline:
+                    raise ServeError(
+                        f"flush timed out after {timeout}s with "
+                        f"{self._queue.qsize()} events pending"
+                    )
+                time.sleep(0.002)
+        if self._worker_error is not None:
+            err, self._worker_error = self._worker_error, None
+            raise err
+
+    def close(self, snapshot: bool = False) -> None:
+        """Stop the worker (draining first) and optionally snapshot."""
+        if self._worker is not None:
+            try:
+                self.flush()
+            finally:
+                self._stop.set()
+                self._worker.join(timeout=5.0)
+                self._worker = None
+        if snapshot and self.serve_config.snapshot_dir:
+            self.snapshot()
+
+    def _run(self) -> None:
+        sc = self.serve_config
+        poll = 0.02 if sc.staleness_bound is None else min(
+            0.02, sc.staleness_bound / 4
+        )
+        while not self._stop.is_set():
+            batch: List[Event] = []
+            try:
+                batch.append(self._queue.get(timeout=poll))
+            except queue.Empty:
+                self._maybe_staleness_solve()
+                continue
+            while len(batch) < sc.batch_size:
+                try:
+                    batch.append(self._queue.get_nowait())
+                except queue.Empty:
+                    break
+            SERVE_QUEUE_DEPTH.set(float(self._queue.qsize()))
+            try:
+                self.apply(batch)
+            except KvTpuError as e:
+                # surface on the next flush()/reach(); keep draining so the
+                # stream after a poison event still applies
+                self._worker_error = e
+            finally:
+                for _ in batch:
+                    self._queue.task_done()
+        self._maybe_staleness_solve()
+
+    def _maybe_staleness_solve(self) -> None:
+        bound = self.serve_config.staleness_bound
+        if bound is None:
+            return
+        with self._lock:
+            if (
+                self._dirty_since is not None
+                and time.monotonic() - self._dirty_since >= bound
+            ):
+                self._solve("staleness")

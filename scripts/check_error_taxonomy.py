@@ -21,6 +21,15 @@ unless
   may reduce its count but not grow it, so new code everywhere lands on the
   taxonomy.
 
+A second pass flags bare ``except:`` handlers anywhere in the package —
+they swallow ``KeyboardInterrupt``/``SystemExit`` and hide taxonomy errors
+from the exit-code contract; catch a named type (``Exception`` at the
+broadest) instead. No budget: the package has none and must stay at none.
+
+Newer layers (``serve/`` and everything after it) are NOT grandfathered —
+they were written on the taxonomy from day one and get a zero budget like
+any other non-listed file.
+
 Run directly (exit 1 on a violation) — tier-1 runs it via
 ``tests/test_resilience.py``.
 """
@@ -103,6 +112,17 @@ def scan_file(path: str) -> List[Tuple[int, str]]:
     return out
 
 
+def scan_bare_except(path: str) -> List[int]:
+    """Line numbers of ``except:`` handlers with no exception type."""
+    with open(path, "r") as fh:
+        tree = ast.parse(fh.read(), filename=path)
+    return [
+        node.lineno
+        for node in ast.walk(tree)
+        if isinstance(node, ast.ExceptHandler) and node.type is None
+    ]
+
+
 def check() -> List[str]:
     problems: List[str] = []
     for root, dirs, files in os.walk(PACKAGE):
@@ -113,6 +133,12 @@ def check() -> List[str]:
             path = os.path.join(root, fname)
             rel = os.path.relpath(path, PACKAGE).replace(os.sep, "/")
             sites = scan_file(path)
+            problems += [
+                f"{rel}:{line}: bare `except:` — catch a named type "
+                "(Exception at the broadest) so KeyboardInterrupt and "
+                "taxonomy errors are not swallowed"
+                for line in scan_bare_except(path)
+            ]
             budget = GRANDFATHERED.get(rel)
             if budget is None:
                 problems += [

@@ -61,6 +61,15 @@ REQUIRED_FAMILIES = frozenset(
         "kvtpu_kernel_bytes_accessed",
         "kvtpu_kernel_peak_bytes",
         "kvtpu_cost_reports_total",
+        # serving layer (serve/)
+        "kvtpu_serve_events_total",
+        "kvtpu_serve_coalesced_total",
+        "kvtpu_serve_batches_total",
+        "kvtpu_serve_solves_total",
+        "kvtpu_serve_queries_total",
+        "kvtpu_serve_assertion_failures_total",
+        "kvtpu_serve_queue_depth",
+        "kvtpu_serve_staleness_seconds",
     }
 )
 

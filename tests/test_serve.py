@@ -1,0 +1,392 @@
+"""Serving layer (``serve/``): event codec round-trips, the coalescing
+algebra's edge cases, the continuous service against from-scratch batch
+verify at checkpoints, assertions with pod-pair witnesses, what-if
+admission (nothing committed), snapshot/restore, and the CLI serve/query
+exit-code contract."""
+import json
+
+import numpy as np
+import pytest
+
+import kubernetes_verification_tpu as kv
+from kubernetes_verification_tpu.cli import main
+from kubernetes_verification_tpu.harness.generate import (
+    GeneratorConfig,
+    random_cluster,
+    random_event_stream,
+)
+from kubernetes_verification_tpu.resilience import (
+    EXIT_INPUT_ERROR,
+    EXIT_OK,
+    EXIT_VIOLATIONS,
+    ServeError,
+)
+from kubernetes_verification_tpu.serve import (
+    AddPolicy,
+    Assertion,
+    FullResync,
+    PodSelector,
+    QueryEngine,
+    RemoveNamespace,
+    RemovePolicy,
+    UpdateNamespaceLabels,
+    UpdatePodLabels,
+    UpdatePolicy,
+    VerificationService,
+    check_assertions,
+    coalesce,
+    decode_event,
+    encode_event,
+    read_events,
+    write_events,
+)
+
+
+def _full(cluster, config):
+    return kv.verify(
+        cluster,
+        kv.VerifyConfig(
+            backend="cpu",
+            compute_ports=False,
+            self_traffic=config.self_traffic,
+            default_allow_unselected=config.default_allow_unselected,
+            direction_aware_isolation=config.direction_aware_isolation,
+        ),
+    ).reach
+
+
+@pytest.fixture(scope="module")
+def stream_setup():
+    """A 64-pod cluster plus a 500-event churn stream (the acceptance
+    floor for the serving path)."""
+    cluster = random_cluster(
+        GeneratorConfig(
+            n_pods=64, n_policies=24, n_namespaces=6, seed=7,
+            p_ipblock_peer=0.0, min_selector_labels=1,
+        )
+    )
+    events = random_event_stream(cluster, n_events=500, seed=3)
+    return cluster, kv.VerifyConfig(compute_ports=False), events
+
+
+@pytest.fixture()
+def small():
+    cluster = random_cluster(
+        GeneratorConfig(
+            n_pods=18, n_policies=6, n_namespaces=3, seed=11,
+            p_ipblock_peer=0.0, min_selector_labels=1,
+        )
+    )
+    return cluster, VerificationService(cluster)
+
+
+# ------------------------------------------------------------------ codec
+def test_codec_round_trips_every_kind(stream_setup, tmp_path):
+    cluster, _, _ = stream_setup
+    pol = cluster.policies[0]
+    events = [
+        AddPolicy(policy=pol),
+        UpdatePolicy(policy=cluster.policies[1]),
+        RemovePolicy(namespace=pol.namespace, name=pol.name),
+        UpdatePodLabels(
+            namespace=cluster.pods[0].namespace,
+            pod=cluster.pods[0].name,
+            labels={"tier": "web"},
+        ),
+        UpdateNamespaceLabels(namespace="extra", labels={"env": "prod"}),
+        RemoveNamespace(namespace="extra"),
+        FullResync(cluster=cluster),
+    ]
+    for ev in events:
+        line = encode_event(ev)
+        back = decode_event(line)
+        assert type(back) is type(ev)
+        # canonical-form fixpoint: re-encoding the decoded event is stable
+        assert encode_event(back) == line
+    path = str(tmp_path / "events.jsonl")
+    write_events(events, path)
+    again = read_events(path)
+    assert [e.kind for e in again] == [e.kind for e in events]
+
+
+def test_decode_rejects_garbage():
+    from kubernetes_verification_tpu.resilience import IngestError
+
+    with pytest.raises(IngestError):
+        decode_event("not json")
+    with pytest.raises(IngestError):
+        decode_event(json.dumps({"event": "no_such_kind"}))
+
+
+# ------------------------------------------------------------- coalescing
+def test_coalesce_duplicate_pod_relabels_last_wins(small):
+    cluster, svc = small
+    pod = cluster.pods[0]
+    first = UpdatePodLabels(
+        namespace=pod.namespace, pod=pod.name, labels={"v": "1"}
+    )
+    second = UpdatePodLabels(
+        namespace=pod.namespace, pod=pod.name, labels={"v": "2"}
+    )
+    kept, dropped = coalesce([first, second])
+    assert kept == [second] and dropped == [first]
+    svc.apply([first, second])
+    assert svc.stats.events_applied == 1
+    assert svc.stats.events_coalesced == 1
+    i = svc.pod_index(pod.namespace, pod.name)
+    assert svc.engine.pods[i].labels == {"v": "2"}
+
+
+def test_coalesce_add_then_remove_cancels(small):
+    cluster, svc = small
+    before = svc.engine.update_count
+    pol = kv.NetworkPolicy(
+        name="transient", namespace=cluster.pods[0].namespace,
+        pod_selector=kv.Selector(),
+    )
+    kept, dropped = coalesce(
+        [AddPolicy(policy=pol), RemovePolicy(namespace=pol.namespace, name=pol.name)]
+    )
+    assert kept == [] and len(dropped) == 2
+    svc.apply([AddPolicy(policy=pol),
+               RemovePolicy(namespace=pol.namespace, name=pol.name)])
+    # net no-op: nothing reached the engine, nothing went stale
+    assert svc.engine.update_count == before
+    assert f"{pol.namespace}/transient" not in svc.engine.policies
+
+
+def test_coalesce_resync_discards_pending_deltas(small, stream_setup):
+    cluster, svc = small
+    other, cfg, _ = stream_setup
+    pod = cluster.pods[0]
+    evs = [
+        UpdatePodLabels(namespace=pod.namespace, pod=pod.name, labels={}),
+        AddPolicy(policy=kv.NetworkPolicy(
+            name="doomed", namespace=pod.namespace, pod_selector=kv.Selector(),
+        )),
+        FullResync(cluster=other),
+    ]
+    kept, dropped = coalesce(evs)
+    assert [e.kind for e in kept] == ["full_resync"] and len(dropped) == 2
+    svc.apply(evs)
+    assert svc.n_pods == len(other.pods)
+    np.testing.assert_array_equal(svc.reach(), _full(other, cfg))
+
+
+def test_coalesce_namespace_remove_is_a_barrier():
+    """Regression: a relabel may be what *registers* a namespace, so it
+    must never fold forward past an intervening RemoveNamespace — the
+    create/remove/create/remove order has to survive coalescing."""
+    evs = [
+        UpdateNamespaceLabels(namespace="extra", labels={"a": "1"}),
+        RemoveNamespace(namespace="extra"),
+        UpdateNamespaceLabels(namespace="extra", labels={"a": "2"}),
+        RemoveNamespace(namespace="extra"),
+    ]
+    kept, dropped = coalesce(evs)
+    assert kept == evs and dropped == []
+    cluster = random_cluster(
+        GeneratorConfig(n_pods=8, n_policies=2, n_namespaces=2, seed=1,
+                        p_ipblock_peer=0.0)
+    )
+    svc = VerificationService(cluster)
+    svc.apply(evs)  # must not raise "not registered"
+    assert svc.stats.events_applied == 4
+
+
+# ----------------------------------------------- stream vs batch verify
+def test_stream_matches_batch_verify_at_checkpoints(stream_setup):
+    cluster, cfg, events = stream_setup
+    svc = VerificationService(cluster)
+    np.testing.assert_array_equal(svc.reach(), _full(cluster, cfg))
+    for i in range(0, len(events), 100):
+        svc.apply(events[i:i + 100])
+        np.testing.assert_array_equal(
+            svc.reach(), _full(svc.engine.as_cluster(), cfg)
+        )
+    assert svc.stats.events_seen == len(events)
+    # the lazy-solve + coalescing claims the bench mode also asserts
+    assert svc.stats.events_coalesced > 0
+    assert svc.stats.total_solves < svc.stats.events_seen
+
+
+def test_worker_thread_path_matches(stream_setup):
+    cluster, cfg, events = stream_setup
+    svc = VerificationService(cluster)
+    svc.start()
+    try:
+        for i in range(0, len(events), 50):
+            svc.submit(events[i:i + 50])
+        svc.flush(timeout=120.0)
+        np.testing.assert_array_equal(
+            svc.reach(), _full(svc.engine.as_cluster(), cfg)
+        )
+    finally:
+        svc.close()
+
+
+def test_snapshot_restore_bit_for_bit(stream_setup, tmp_path):
+    cluster, cfg, events = stream_setup
+    svc = VerificationService(cluster)
+    svc.apply(events)
+    want = svc.reach()
+    snap = str(tmp_path / "snap")
+    svc.snapshot(snap)
+    restored = VerificationService.from_snapshot(snap)
+    np.testing.assert_array_equal(restored.reach(), want)
+    # …and the restored engine's as_cluster() re-verifies identically
+    np.testing.assert_array_equal(
+        _full(restored.engine.as_cluster(), cfg), want
+    )
+
+
+# -------------------------------------------------- assertions / queries
+def test_assertion_violation_carries_witness(small):
+    cluster, svc = small
+    ns_a = cluster.pods[0].namespace
+    # default-allow cluster reaches across namespaces → a deny must trip
+    deny = Assertion(
+        name="locked-down", kind="deny",
+        src=PodSelector(namespace=ns_a), dst=PodSelector(),
+    )
+    found = check_assertions(svc, [deny])
+    assert found and found[0].assertion == "locked-down"
+    assert "can reach" in found[0].describe()
+    src_ns, _ = found[0].witness_src.split("/", 1)
+    assert src_ns == ns_a
+    # auto-check after every applied batch accumulates on the service
+    svc.assertions = [deny]
+    pod = cluster.pods[0]
+    svc.apply([UpdatePodLabels(namespace=pod.namespace, pod=pod.name,
+                               labels=dict(pod.labels))])
+    assert svc.violations
+
+
+def test_queries_match_reach_matrix(small):
+    cluster, svc = small
+    q = QueryEngine(svc)
+    reach = svc.reach()
+    pods = svc.engine.pods
+    name = lambda p: f"{p.namespace}/{p.name}"
+    s, d = 0, len(pods) - 1
+    assert q.can_reach(name(pods[s]), name(pods[d])) == bool(reach[s, d])
+    who = q.who_can_reach(name(pods[d]))
+    want = [name(pods[i]) for i in np.nonzero(reach[:, d])[0] if i != d]
+    assert who == want
+    blast = q.blast_radius(name(pods[s]))
+    want = [name(pods[j]) for j in np.nonzero(reach[s, :])[0] if j != s]
+    assert blast == want
+    with pytest.raises(ServeError):
+        q.can_reach("nowhere/ghost", name(pods[0]))
+
+
+def test_can_reach_port_refinement():
+    ns = kv.Namespace("default", {})
+    pods = (
+        kv.Pod("web", "default", {"app": "web"}),
+        kv.Pod("db", "default", {"app": "db"}),
+    )
+    lock = kv.NetworkPolicy(
+        name="db-ingress", namespace="default",
+        pod_selector=kv.Selector({"app": "db"}),
+        ingress=(kv.Rule(
+            peers=(kv.Peer(pod_selector=kv.Selector({"app": "web"})),),
+            ports=(kv.PortSpec("TCP", 5432),),
+        ),),
+    )
+    cluster = kv.Cluster(pods=pods, policies=(lock,), namespaces=(ns,))
+    svc = VerificationService(cluster)
+    q = QueryEngine(svc)
+    assert q.can_reach("default/web", "default/db", port=5432)
+    assert not q.can_reach("default/web", "default/db", port=80)
+
+
+def test_what_if_commits_nothing(small):
+    cluster, svc = small
+    q = QueryEngine(svc)
+    before = svc.reach().copy()
+    count = svc.engine.update_count
+    ns = cluster.pods[0].namespace
+    isolate = kv.NetworkPolicy(
+        name="what-if-isolate", namespace=ns, pod_selector=kv.Selector(),
+    )
+    deny = Assertion(
+        name="still-open", kind="allow",
+        src=PodSelector(), dst=PodSelector(namespace=ns),
+    )
+    res = q.what_if([AddPolicy(policy=isolate)], assertions=[deny],
+                    max_witnesses=10_000)
+    assert res.n_removed > 0  # isolating a namespace cuts pairs
+    assert not res.ok and res.violations
+    # overlay only: live state is untouched
+    assert svc.engine.update_count == count
+    assert f"{ns}/what-if-isolate" not in svc.engine.policies
+    np.testing.assert_array_equal(svc.reach(), before)
+    # ground truth: committing the same event reproduces the overlay diff
+    svc.apply([AddPolicy(policy=isolate)])
+    after = svc.reach()
+    np.testing.assert_array_equal(
+        np.argwhere(before & ~after),
+        np.array([[q._idx(s), q._idx(d)] for s, d in res.removed]
+                 if res.removed else np.empty((0, 2), dtype=int)),
+    )
+
+
+# -------------------------------------------------------------------- CLI
+def test_cli_serve_query_exit_contract(tmp_path, capsys):
+    d = str(tmp_path / "cluster")
+    ev = str(tmp_path / "events.jsonl")
+    assert main(["generate", d, "--pods", "24", "--policies", "6",
+                 "--events-out", ev, "--n-events", "80"]) == EXIT_OK
+    out = capsys.readouterr()
+
+    # clean serve: exit 0, coalescing visible in the JSON summary
+    snap = str(tmp_path / "snap")
+    assert main(["serve", d, "--events", ev, "--snapshot-out", snap,
+                 "--json"]) == EXIT_OK
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert summary["events_seen"] == 80
+    assert summary["events_applied"] <= 80
+
+    # deny assertion seeded to fail on a default-allow cluster: exit 1 + witness
+    af = str(tmp_path / "assert.json")
+    with open(af, "w") as fh:
+        json.dump([{"name": "nothing-talks", "kind": "deny",
+                    "from": {}, "to": {}}], fh)
+    assert main(["serve", d, "--events", ev, "--assert", af]) == EXIT_VIOLATIONS
+    out = capsys.readouterr().out
+    assert "nothing-talks" in out and "can reach" in out
+
+    # queries against the snapshot; unknown pod is an input error (exit 2)
+    base, _ = kv.load_cluster(d)
+    ref = f"{base.pods[0].namespace}/{base.pods[0].name}"
+    assert main(["query", "--from-snapshot", snap, "--who-can-reach",
+                 ref, "--json"]) == EXIT_OK
+    capsys.readouterr()
+    assert main(["query", "--from-snapshot", snap, "--can-reach",
+                 "nowhere/ghost", ref]) == EXIT_INPUT_ERROR
+
+
+def test_cli_what_if_admission(tmp_path, capsys):
+    d = str(tmp_path / "cluster")
+    assert main(["generate", d, "--pods", "16", "--policies", "4"]) == EXIT_OK
+    capsys.readouterr()
+    af = str(tmp_path / "assert.json")
+    with open(af, "w") as fh:
+        json.dump([{"name": "ns0-open", "kind": "allow",
+                    "from": {"namespace": "ns0"},
+                    "to": {"namespace": "ns0"}}], fh)
+    pol = str(tmp_path / "isolate.yaml")
+    with open(pol, "w") as fh:
+        fh.write(
+            "apiVersion: networking.k8s.io/v1\n"
+            "kind: NetworkPolicy\n"
+            "metadata:\n  name: isolate-all\n  namespace: ns0\n"
+            "spec:\n  podSelector: {}\n  policyTypes: [Ingress]\n"
+        )
+    # isolating ns0 violates the allow assertion — admission says no
+    assert main(["query", d, "--what-if", pol, "--assert", af,
+                 "--json"]) == EXIT_VIOLATIONS
+    verdict = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert verdict["what_if"]["ok"] is False
+    assert verdict["what_if"]["violations"]
