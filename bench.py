@@ -768,7 +768,9 @@ def bench_serve(args) -> None:
     band (each timed query pays its lazy solve) and the coalescing/solve
     amplification ride along. Lazy scheduling means solves are bounded by
     batches + queries, not events — the emitted line records both so the
-    regression gate can watch the ratio."""
+    regression gate can watch the ratio. A durability rider times three
+    atomic checkpoints of the warm engine and reports the overhead an
+    every-8-batches cadence would add to the loop."""
     import jax
 
     from kubernetes_verification_tpu.harness.generate import (
@@ -833,11 +835,30 @@ def bench_serve(args) -> None:
     assert n_solves < n_timed, (
         f"lazy scheduling broken: {n_solves} solves for {n_timed} events"
     )
+    # durability rider: what one atomic checkpoint costs, and what share
+    # of the serving loop it would claim at an every-8-batches cadence
+    import tempfile
+
+    from kubernetes_verification_tpu.serve import CheckpointManager
+
+    ck_times = []
+    with tempfile.TemporaryDirectory() as ckdir:
+        cm = CheckpointManager(ckdir, retain=2)
+        for _ in range(3):
+            s = time.perf_counter()
+            cm.checkpoint(svc.engine, log_offset=0, last_seq=-1)
+            ck_times.append(time.perf_counter() - s)
+    ck_band = _band(ck_times)
+    ck_pct = 100.0 * ck_band["median_s"] / (
+        8 * apply_band["median_s"] + ck_band["median_s"]
+    )
     log(
         f"{n_timed} events in {wall:.2f}s = {value:.0f} events/s; "
         f"{n_solves} solves ({n_timed / max(1, n_solves):.1f} events/solve); "
         f"{svc.stats.events_coalesced} coalesced away; query median "
-        f"{query_band['median_s'] * 1e3:.1f}ms"
+        f"{query_band['median_s'] * 1e3:.1f}ms; checkpoint median "
+        f"{ck_band['median_s'] * 1e3:.1f}ms "
+        f"({ck_pct:.1f}% overhead at every-8-batches)"
     )
     _emit(
         {
@@ -856,6 +877,8 @@ def bench_serve(args) -> None:
             "events_coalesced": svc.stats.events_coalesced,
             "solves": svc.stats.solves,
             "events_per_solve": round(n_timed / max(1, n_solves), 2),
+            "checkpoint_band": ck_band,
+            "checkpoint_overhead_pct": round(ck_pct, 2),
             "compile_s": round(t2 - t1, 2),
             "steady_s": round(apply_band["median_s"], 4),
         }

@@ -20,6 +20,8 @@ only, SURVEY.md §1); this exposes the full pipeline:
   assertions (violations exit 1 with pod-pair witnesses);
 * ``kv-tpu query``         — can-reach / who-can-reach / blast-radius /
   what-if admission checks against manifests or a serve snapshot;
+* ``kv-tpu recover``       — read-only triage of a serve checkpoint
+  directory (generation health, WAL valid prefix);
 * ``kv-tpu backends``      — list available execution backends.
 """
 from __future__ import annotations
@@ -802,6 +804,34 @@ def _load_serve_service(args, serve_config):
     return VerificationService(cluster, cfg, serve_config), skipped
 
 
+def _resume_serve_service(args, serve_config):
+    """Crash recovery: rebuild the service from the checkpoint ladder in
+    ``--checkpoint-dir`` (replaying the event log past the recorded
+    offset), degrading to a from-scratch build of ``path`` when every
+    generation is damaged."""
+    from .serve import RecoveryManager
+
+    initial_cluster, cfg, skipped = None, None, []
+    if args.path:
+        import kubernetes_verification_tpu as kv
+
+        initial_cluster, skipped = kv.load_cluster(args.path)
+        cfg = kv.VerifyConfig(
+            backend="cpu",
+            compute_ports=False,
+            self_traffic=args.self_traffic,
+            default_allow_unselected=args.default_allow,
+        )
+    result = RecoveryManager(args.checkpoint_dir).recover(
+        log_path=args.events,
+        initial_cluster=initial_cluster,
+        config=cfg,
+        serve_config=serve_config,
+        batch_size=args.batch_size,
+    )
+    return result.service, skipped, result.source, result
+
+
 def _run_serve(args) -> int:
     from .resilience.errors import (
         EXIT_OK,
@@ -816,29 +846,88 @@ def _run_serve(args) -> int:
         snapshot_dir=args.snapshot_out,
         snapshot_every=args.snapshot_every,
     )
-    svc, skipped = _load_serve_service(args, serve_config)
+    recovery = None
+    source = None
+    if getattr(args, "resume", False):
+        if not args.checkpoint_dir:
+            raise SystemExit("serve: --resume requires --checkpoint-dir")
+        svc, skipped, source, recovery = _resume_serve_service(
+            args, serve_config
+        )
+    else:
+        svc, skipped = _load_serve_service(args, serve_config)
+    if source is None and args.events:
+        source = EventSource(args.events)
+    cm = None
+    if getattr(args, "checkpoint_dir", None):
+        from .serve import CheckpointManager
+
+        cm = CheckpointManager(args.checkpoint_dir)
     if getattr(args, "assert_file", None):
         svc.assertions.extend(load_assertions(args.assert_file))
-    svc.start()
-    try:
-        if args.events:
-            source = EventSource(args.events)
-            if args.tail:
-                for batch in source.tail(
-                    idle_timeout=args.idle_timeout,
-                    batch_size=args.batch_size,
-                ):
-                    svc.submit(batch)
-            else:
-                for batch in source.batches(args.batch_size):
-                    svc.submit(batch)
-        svc.flush()
-        # force a final solve so assertion-free runs still verify the
-        # stream end-state, and print the answer-bearing summary
-        reach = svc.reach(trigger="query" if not svc.assertions else "assertions")
-        pairs = int(reach.sum())
-    finally:
-        svc.close(snapshot=bool(args.snapshot_out))
+    checkpoints = 0
+
+    def _checkpoint() -> None:
+        nonlocal checkpoints
+        cm.checkpoint(
+            svc.engine,
+            log_path=args.events,
+            log_offset=source.offset if source else 0,
+            last_seq=source.last_seq if source else -1,
+        )
+        checkpoints += 1
+
+    if cm is not None:
+        # checkpointing drives the loop synchronously: the recorded
+        # log offset must describe a quiesced engine, so the worker
+        # thread (which applies at its own pace) stays off
+        try:
+            if source is not None and args.events:
+                batch_iter = (
+                    source.tail(
+                        idle_timeout=args.idle_timeout,
+                        batch_size=args.batch_size,
+                    )
+                    if args.tail
+                    else source.batches(args.batch_size)
+                )
+                batches_since = 0
+                for batch in batch_iter:
+                    svc.apply(batch)
+                    batches_since += 1
+                    if (
+                        args.checkpoint_every
+                        and batches_since >= args.checkpoint_every
+                    ):
+                        _checkpoint()
+                        batches_since = 0
+            reach = svc.reach(
+                trigger="query" if not svc.assertions else "assertions"
+            )
+            pairs = int(reach.sum())
+            _checkpoint()  # the exit checkpoint: resume loses nothing
+        finally:
+            svc.close(snapshot=bool(args.snapshot_out))
+    else:
+        svc.start()
+        try:
+            if source is not None and args.events:
+                if args.tail:
+                    for batch in source.tail(
+                        idle_timeout=args.idle_timeout,
+                        batch_size=args.batch_size,
+                    ):
+                        svc.submit(batch)
+                else:
+                    for batch in source.batches(args.batch_size):
+                        svc.submit(batch)
+            svc.flush()
+            # force a final solve so assertion-free runs still verify the
+            # stream end-state, and print the answer-bearing summary
+            reach = svc.reach(trigger="query" if not svc.assertions else "assertions")
+            pairs = int(reach.sum())
+        finally:
+            svc.close(snapshot=bool(args.snapshot_out))
     out = {
         "pods": svc.n_pods,
         "policies": len(svc.engine.policies),
@@ -851,6 +940,17 @@ def _run_serve(args) -> int:
         out["skipped_documents"] = skipped
     if args.snapshot_out:
         out["snapshot"] = args.snapshot_out
+    if cm is not None:
+        out["checkpoints"] = checkpoints
+        out["checkpoint_dir"] = args.checkpoint_dir
+    if recovery is not None:
+        out["recovery"] = {
+            "outcome": recovery.outcome,
+            "generation": recovery.generation,
+            "replayed": recovery.replayed,
+            "duplicates_skipped": recovery.duplicates_skipped,
+            "rejected_generations": len(recovery.errors),
+        }
     if args.json:
         print(json.dumps(out, sort_keys=True))
     else:
@@ -865,7 +965,79 @@ def _run_serve(args) -> int:
             print(f"  VIOLATION: {v.describe()}")
         if args.snapshot_out:
             print(f"  snapshot: {args.snapshot_out}")
+        if recovery is not None:
+            print(
+                f"  recovered: {recovery.outcome} (gen "
+                f"{recovery.generation}, {recovery.replayed} events "
+                f"replayed, {recovery.duplicates_skipped} duplicates "
+                "skipped)"
+            )
+        if cm is not None:
+            print(
+                f"  checkpoints: {checkpoints} -> {args.checkpoint_dir}"
+            )
     return EXIT_VIOLATIONS if svc.violations else EXIT_OK
+
+
+def cmd_recover(args) -> int:
+    from .resilience.errors import KvTpuError
+
+    try:
+        with _observed(args):
+            return _run_recover(args)
+    except KvTpuError as e:
+        return _diagnose(args, e)
+
+
+def _run_recover(args) -> int:
+    """Read-only durability triage: report every checkpoint generation's
+    health and (with ``--events``) the WAL's valid prefix; nothing is
+    loaded, repaired or truncated. Exit 2 when the directory is missing
+    or every generation is damaged."""
+    import os
+
+    from .resilience.errors import EXIT_INPUT_ERROR, EXIT_OK
+    from .serve import RecoveryManager
+
+    if not os.path.isdir(args.dir):
+        print(f"recover: {args.dir} is not a directory", file=sys.stderr)
+        return EXIT_INPUT_ERROR
+    report = RecoveryManager(args.dir).inspect(log_path=args.events)
+    if args.json:
+        print(json.dumps(report, sort_keys=True))
+    else:
+        gens = report["generations"]
+        if not gens:
+            print(f"{args.dir}: no checkpoint generations")
+        for g in gens:
+            if g["valid"]:
+                print(
+                    f"gen {g['generation']:>3}  OK   "
+                    f"offset={g['log_offset']} last_seq={g['last_seq']} "
+                    f"log={g['event_log']}"
+                )
+            else:
+                print(f"gen {g['generation']:>3}  BAD  {g['error']}")
+        wal = report.get("wal")
+        if wal:
+            if "error" in wal:
+                print(f"wal {wal['path']}: ERROR {wal['error']}")
+            else:
+                tail = (
+                    f"  TORN tail: {wal['torn_bytes']} bytes after "
+                    f"offset {wal['valid_bytes']} (serve --resume "
+                    "truncates)"
+                    if wal["torn"]
+                    else ""
+                )
+                print(
+                    f"wal {wal['path']}: {wal['records']} records "
+                    f"({wal['sequenced']} sequenced, "
+                    f"last_seq={wal['last_seq']}){tail}"
+                )
+    if report["generations"] and not report["usable"]:
+        return EXIT_INPUT_ERROR
+    return EXIT_OK
 
 
 def cmd_query(args) -> int:
@@ -1200,11 +1372,45 @@ def main(argv: Optional[list] = None) -> int:
         "--snapshot-every", type=int, default=0, metavar="N",
         help="with --snapshot-out: also snapshot every N applied batches",
     )
+    p.add_argument(
+        "--checkpoint-dir", metavar="DIR",
+        help="write atomic crash-safe checkpoints (engine snapshot + "
+        "manifest binding the event-log offset) here; one is always "
+        "taken on exit",
+    )
+    p.add_argument(
+        "--checkpoint-every", type=int, default=0, metavar="N",
+        help="with --checkpoint-dir: also checkpoint every N applied "
+        "batches (0 = exit only)",
+    )
+    p.add_argument(
+        "--resume", action="store_true",
+        help="recover from the newest valid checkpoint in "
+        "--checkpoint-dir (falling back to older generations on "
+        "corruption) and replay --events past the recorded offset; "
+        "PATH, if given, enables a from-scratch rebuild when every "
+        "generation is damaged",
+    )
     p.add_argument("--no-self-traffic", dest="self_traffic", action="store_false")
     p.add_argument("--no-default-allow", dest="default_allow", action="store_false")
     p.add_argument("--json", action="store_true")
     _add_obs_flags(p)
     p.set_defaults(fn=cmd_serve)
+
+    p = sub.add_parser(
+        "recover",
+        help="inspect a serve checkpoint directory: per-generation "
+        "manifest/snapshot health and the event log's valid prefix "
+        "(read-only; exit 2 when nothing is recoverable)",
+    )
+    p.add_argument("dir", help="a kv-tpu serve --checkpoint-dir directory")
+    p.add_argument(
+        "--events", metavar="FILE",
+        help="also scan this event log (WAL) without repairing it",
+    )
+    p.add_argument("--json", action="store_true")
+    _add_obs_flags(p)
+    p.set_defaults(fn=cmd_recover)
 
     p = sub.add_parser(
         "query",

@@ -44,6 +44,10 @@ __all__ = [
     "SERVE_ASSERTION_FAILURES_TOTAL",
     "SERVE_QUEUE_DEPTH",
     "SERVE_STALENESS_SECONDS",
+    "CHECKPOINTS_TOTAL",
+    "RECOVERIES_TOTAL",
+    "WAL_TRUNCATIONS_TOTAL",
+    "BREAKER_TRANSITIONS_TOTAL",
 ]
 
 SPAN_SECONDS = Histogram(
@@ -251,4 +255,36 @@ SERVE_STALENESS_SECONDS = Gauge(
     "kvtpu_serve_staleness_seconds",
     "Age of the oldest applied-but-unsolved mutation at the most recent "
     "solve — how stale answers were allowed to get before re-deriving.",
+)
+
+CHECKPOINTS_TOTAL = Counter(
+    "kvtpu_checkpoints_total",
+    "Atomic serving checkpoints committed (engine snapshot + manifest "
+    "binding snapshot digest, event-log offset and last-applied sequence "
+    "number, promoted via tmp-file + fsync + os.replace).",
+)
+
+RECOVERIES_TOTAL = Counter(
+    "kvtpu_recoveries_total",
+    "Serving-state recoveries, by outcome: 'newest' (latest checkpoint "
+    "generation loaded clean), 'fallback' (a newer generation was corrupt "
+    "and an older one was used), 'rebuild' (every checkpoint was unusable "
+    "— replayed the whole event log from scratch).",
+    ("outcome",),
+)
+
+WAL_TRUNCATIONS_TOTAL = Counter(
+    "kvtpu_wal_truncations_total",
+    "Torn event-log tails truncated on WAL open — a crash mid-append left "
+    "a partial or checksum-failing final record, which was dropped so the "
+    "surviving prefix stays replayable (strict mode raises instead).",
+)
+
+BREAKER_TRANSITIONS_TOTAL = Counter(
+    "kvtpu_breaker_transitions_total",
+    "Circuit-breaker state transitions, by backend and destination state "
+    "(closed / open / half_open) — a flapping backend shows up as "
+    "open/half_open churn instead of burning the fallback chain and "
+    "watchdog budget on every solve.",
+    ("backend", "to"),
 )

@@ -8,7 +8,10 @@ fallback chains, watchdogs, OOM degradation and fault injection.
   dispatches in.
 * ``wrapper`` — :func:`resilient_verify` / :func:`resilient_verify_kano`:
   the fallback-chain / watchdog / adaptive-degradation driver.
-* ``faults``  — the deterministic ``faulty:<backend>`` injection harness.
+* ``breaker`` — per-backend circuit breaker (closed/open/half-open with
+  cooldown) consulted by the chain driver and the serving loop.
+* ``faults``  — the deterministic ``faulty:<backend>`` injection harness
+  plus the named durability kill-points for the crash-fault harness.
 
 Only ``errors`` is imported eagerly: modules like ``backends.base`` and
 ``ingest.yaml_io`` import taxonomy classes from here *while they are
@@ -69,6 +72,15 @@ __all__ = [
     "parse_fault_spec",
     "register_faulty",
     "FAULT_KINDS",
+    "KILL_POINTS",
+    "KillPointInjector",
+    "install_kill_points",
+    "clear_kill_points",
+    "kill_point",
+    "CircuitBreaker",
+    "breaker_for",
+    "reset_breakers",
+    "breaker_states",
 ]
 
 _LAZY = {
@@ -83,6 +95,15 @@ _LAZY = {
     "parse_fault_spec": "faults",
     "register_faulty": "faults",
     "FAULT_KINDS": "faults",
+    "KILL_POINTS": "faults",
+    "KillPointInjector": "faults",
+    "install_kill_points": "faults",
+    "clear_kill_points": "faults",
+    "kill_point": "faults",
+    "CircuitBreaker": "breaker",
+    "breaker_for": "breaker",
+    "reset_breakers": "breaker",
+    "breaker_states": "breaker",
 }
 
 

@@ -25,14 +25,24 @@ Kinds: ``oom``, ``timeout`` (a simulated hang of ``hang_seconds`` — pair
 with a watchdog), ``device_loss``, ``flaky`` (generic transient).
 
 Every injection increments ``kvtpu_faults_injected_total{backend,kind}``.
+
+Crash kill-points: the spec grammar also accepts the named points in the
+durability write path (``after-tmp-write``, ``before-rename``,
+``mid-log-append``, ``after-manifest``). These are not backend faults —
+:func:`install_kill_points` arms them process-wide and the durability code
+calls :func:`kill_point` at each site; a firing point hard-kills the
+process with ``os._exit`` (no cleanup, no atexit — the closest userspace
+stand-in for SIGKILL), which is what the recovery fuzz harness drives
+through a subprocess.
 """
 from __future__ import annotations
 
+import os
 import random
 import threading
 import time
 from dataclasses import dataclass
-from typing import List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence
 
 from ..observe.metrics import FAULTS_INJECTED_TOTAL
 from .errors import (
@@ -44,14 +54,28 @@ from .errors import (
 
 __all__ = [
     "FAULT_KINDS",
+    "KILL_POINTS",
     "FaultRule",
     "FaultInjector",
     "FaultyBackend",
+    "KillPointInjector",
     "parse_fault_spec",
     "register_faulty",
+    "install_kill_points",
+    "clear_kill_points",
+    "kill_point",
 ]
 
-FAULT_KINDS = ("oom", "timeout", "device_loss", "flaky")
+#: named crash points in the durability write path (serve/durability.py
+#: and the WAL append path) — process-killing, not backend faults
+KILL_POINTS = (
+    "after-tmp-write",
+    "before-rename",
+    "mid-log-append",
+    "after-manifest",
+)
+
+FAULT_KINDS = ("oom", "timeout", "device_loss", "flaky") + KILL_POINTS
 
 #: tile assumed when an ``oom>T`` rule fires against a config carrying no
 #: explicit ``tile`` option — matches ResilienceConfig.initial_tile
@@ -200,6 +224,12 @@ def register_faulty(
     from ..backends.base import get_backend, register_backend
 
     get_backend(inner_name)  # fail fast on unknown inner backends
+    for rule in rules:
+        if rule.kind in KILL_POINTS:
+            raise ConfigError(
+                f"kill-point {rule.kind!r} is a process crash, not a "
+                "backend fault — arm it with install_kill_points()"
+            )
     injector = FaultInjector(rules, seed=seed)
     name = f"faulty:{inner_name}"
     register_backend(
@@ -209,3 +239,84 @@ def register_faulty(
         ),
     )
     return name
+
+
+# ------------------------------------------------------------ kill points
+class KillPointInjector:
+    """Seeded, per-point-counting crash schedule: ``should_kill(name)``
+    advances that point's hit counter and answers whether this hit is the
+    one that dies (``KIND@N`` = hit index N, ``KIND%P`` = probability P
+    per hit, bare ``KIND`` = every hit)."""
+
+    def __init__(
+        self,
+        rules: Sequence[FaultRule],
+        *,
+        seed: int = 0,
+        exit_code: int = 137,  # what a shell reports for SIGKILL
+    ) -> None:
+        self.rules = [r for r in rules if r.kind in KILL_POINTS]
+        if not self.rules:
+            raise ConfigError(
+                f"no kill-point rules in {list(rules)!r}; known points: "
+                f"{KILL_POINTS}"
+            )
+        self.exit_code = exit_code
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self.hits: Dict[str, int] = {}
+
+    def should_kill(self, point: str) -> bool:
+        with self._lock:
+            idx = self.hits.get(point, 0)
+            self.hits[point] = idx + 1
+            for rule in self.rules:
+                if rule.kind != point:
+                    continue
+                if rule.at_call is not None:
+                    if rule.at_call == idx:
+                        return True
+                elif rule.prob is not None:
+                    if self._rng.random() < rule.prob:
+                        return True
+                else:
+                    return True
+        return False
+
+
+#: the process-wide armed schedule (None = every kill_point() is a no-op)
+_KILL_INJECTOR: Optional[KillPointInjector] = None
+
+
+def install_kill_points(
+    rules: Sequence[FaultRule], *, seed: int = 0, exit_code: int = 137
+) -> KillPointInjector:
+    """Arm the durability kill-points process-wide (rules typically come
+    from ``parse_fault_spec("mid-log-append@7")``); returns the injector
+    so a harness can inspect hit counters before the crash."""
+    global _KILL_INJECTOR
+    _KILL_INJECTOR = KillPointInjector(rules, seed=seed, exit_code=exit_code)
+    return _KILL_INJECTOR
+
+
+def clear_kill_points() -> None:
+    """Disarm every kill-point (tests; the child process never needs to)."""
+    global _KILL_INJECTOR
+    _KILL_INJECTOR = None
+
+
+def kill_point(name: str, flush=None) -> None:
+    """A named crash site. No-op unless armed via
+    :func:`install_kill_points`; when the armed schedule fires, ``flush``
+    (a file object, if given) is flushed so partially written bytes reach
+    the OS — a torn tail, not an empty one — and the process dies with
+    ``os._exit`` (bypassing ``finally``/``atexit``, like SIGKILL would).
+    """
+    inj = _KILL_INJECTOR
+    if inj is None:
+        return
+    if inj.should_kill(name):
+        FAULTS_INJECTED_TOTAL.labels(backend="durability", kind=name).inc()
+        if flush is not None:
+            flush.flush()
+        os._exit(inj.exit_code)

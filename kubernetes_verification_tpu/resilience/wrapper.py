@@ -21,6 +21,11 @@ pressure). This wrapper gives the verifier the same posture around
   backend option and re-attempts, down to ``min_tile``, before the chain
   falls back. Halvings don't consume the retry budget: a smaller tile is
   progress, not repetition.
+* **circuit breaker** (opt-in: ``breaker_threshold`` > 0) — a backend
+  whose attempts keep exhausting their retries trips its per-backend
+  breaker (:mod:`~.breaker`) and is skipped outright until the cooldown
+  admits a half-open probe, so a flapping backend stops charging every
+  request the full retry + watchdog toll.
 
 Every decision is visible through the PR 1 registry:
 ``kvtpu_retries_total``, ``kvtpu_fallbacks_total``,
@@ -69,6 +74,11 @@ class ResilienceConfig:
     #: starting tile when the config carries none and an OOM asks for a halving
     initial_tile: int = 2048
     min_tile: int = 128
+    #: consecutive exhausted attempts before a backend's circuit breaker
+    #: opens and the chain skips it outright; 0 disables the breaker
+    breaker_threshold: int = 0
+    #: seconds an open circuit waits before admitting a half-open probe
+    breaker_cooldown: float = 30.0
 
     def retry_policy(self) -> RetryPolicy:
         return RetryPolicy(
@@ -144,14 +154,44 @@ def _resilient_call(
         raise ConfigError("fallback chain is empty")
     failures: List[Tuple[str, BackendError]] = []
     for pos, backend in enumerate(chain):
+        breaker = None
+        if res.breaker_threshold > 0:
+            from .breaker import breaker_for
+
+            breaker = breaker_for(
+                backend,
+                failure_threshold=res.breaker_threshold,
+                cooldown=res.breaker_cooldown,
+            )
+            if not breaker.allow():
+                # circuit open: skip the doomed backend without burning
+                # its retry schedule or watchdog budget
+                err = BackendError(
+                    f"circuit breaker open for {backend!r} "
+                    f"(cooldown {res.breaker_cooldown}s)",
+                    backend=backend, kind="breaker_open", transient=True,
+                )
+                failures.append((backend, err))
+                if pos + 1 < len(chain):
+                    FALLBACKS_TOTAL.labels(
+                        from_backend=backend, to_backend=chain[pos + 1]
+                    ).inc()
+                    log_event(
+                        "fallback", from_backend=backend,
+                        to_backend=chain[pos + 1], kind="breaker_open",
+                    )
+                continue
         cfg = replace(config, backend=backend)
         delays = res.retry_policy().delays()
         err: Optional[BackendError] = None
         while True:
             try:
-                return _run_with_watchdog(
+                result = _run_with_watchdog(
                     lambda: run_one(cfg), res.solve_timeout, backend
                 )
+                if breaker is not None:
+                    breaker.record_success()
+                return result
             except BackendError as e:
                 err = classify_exception(e, backend)
             except KvTpuError:
@@ -191,6 +231,8 @@ def _resilient_call(
                     sleep(delay)
                     continue
             # -- give up on this backend: fall through the chain -----------
+            if breaker is not None:
+                breaker.record_failure()
             failures.append((backend, err))
             if pos + 1 < len(chain):
                 FALLBACKS_TOTAL.labels(

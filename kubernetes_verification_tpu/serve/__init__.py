@@ -12,11 +12,24 @@ incremental engines were built for — BASELINE config 5):
 * ``queries`` — :class:`QueryEngine` (``can_reach`` / ``who_can_reach`` /
   ``blast_radius``), declarative allow/deny assertions with violating-pair
   witnesses, and admission-style ``what_if`` dry runs on a copy-on-write
-  overlay.
+  overlay;
+* ``durability`` — crash-safe checkpoints: :class:`CheckpointManager`
+  (atomic snapshot + manifest generations) and :class:`RecoveryManager`
+  (ladder recovery + WAL replay with duplicate-application skipping),
+  over the sequenced WAL layer in ``events`` (:class:`WalWriter` /
+  :func:`scan_wal`).
 
-CLI: ``kv-tpu serve`` / ``kv-tpu query``; benchmark: ``bench.py --mode
-serve``; metric families: ``kvtpu_serve_*``.
+CLI: ``kv-tpu serve`` / ``kv-tpu query`` / ``kv-tpu recover``; benchmark:
+``bench.py --mode serve``; metric families: ``kvtpu_serve_*``,
+``kvtpu_checkpoints_total``, ``kvtpu_recoveries_total``,
+``kvtpu_wal_truncations_total``.
 """
+from .durability import (
+    CheckpointInfo,
+    CheckpointManager,
+    RecoveryManager,
+    RecoveryResult,
+)
 from .events import (
     AddPolicy,
     Event,
@@ -27,10 +40,14 @@ from .events import (
     UpdateNamespaceLabels,
     UpdatePodLabels,
     UpdatePolicy,
+    WalInfo,
+    WalWriter,
     coalesce,
     decode_event,
+    decode_record,
     encode_event,
     read_events,
+    scan_wal,
     write_events,
 )
 from .queries import (
@@ -56,9 +73,17 @@ __all__ = [
     "EventSource",
     "encode_event",
     "decode_event",
+    "decode_record",
     "read_events",
     "write_events",
     "coalesce",
+    "WalInfo",
+    "WalWriter",
+    "scan_wal",
+    "CheckpointInfo",
+    "CheckpointManager",
+    "RecoveryManager",
+    "RecoveryResult",
     "ServeConfig",
     "ServeStats",
     "VerificationService",

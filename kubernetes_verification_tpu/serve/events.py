@@ -19,12 +19,26 @@ problem as policy churn). This module is the ingest half of ``serve/``:
 Malformed lines raise :class:`~..resilience.errors.IngestError` with the
 line number — a stream problem is an input error (exit 2), not a solver
 failure.
+
+WAL semantics (crash-safe durability, optional and backward-compatible):
+a *sequenced* record additionally carries a monotonic ``seq`` number and a
+``crc`` checksum over its canonical JSON body. :func:`scan_wal` validates a
+log on open — a torn tail (a crash mid-append) is truncated-and-warned by
+default (``kvtpu_wal_truncations_total``) or raises
+:class:`~..resilience.errors.ServeError` in ``strict`` mode, while
+corruption *followed by* valid records always raises (that is bit rot, not
+a tear). :class:`WalWriter` appends sequenced records, resuming the
+sequence from the existing log, and hosts the ``mid-log-append`` kill
+point for the crash-fault harness. Unsequenced (legacy) logs keep working
+everywhere: records without ``seq``/``crc`` decode as before and simply
+don't participate in duplicate-application skipping.
 """
 from __future__ import annotations
 
 import json
 import os
 import time
+import zlib
 from dataclasses import dataclass, field
 from typing import Callable, Dict, Iterator, List, Optional, Sequence, Tuple
 
@@ -37,7 +51,7 @@ from ..ingest.yaml_io import (
     pod_to_dict,
 )
 from ..models.core import Cluster, NetworkPolicy
-from ..resilience.errors import IngestError
+from ..resilience.errors import IngestError, ServeError
 
 __all__ = [
     "Event",
@@ -51,11 +65,19 @@ __all__ = [
     "EVENT_KINDS",
     "encode_event",
     "decode_event",
+    "decode_record",
     "write_events",
     "read_events",
     "EventSource",
     "coalesce",
+    "WalInfo",
+    "WalWriter",
+    "scan_wal",
 ]
+
+#: reserved record keys for WAL framing; no event body uses either
+WAL_SEQ_KEY = "seq"
+WAL_CRC_KEY = "crc"
 
 
 @dataclass(frozen=True)
@@ -180,8 +202,16 @@ def _cluster_from_dict(obj: dict) -> Cluster:
     )
 
 
-def encode_event(ev: Event) -> str:
-    """One JSON line (no trailing newline) for one event."""
+def _wal_crc(canonical: str) -> str:
+    """crc32 (hex) over a record's canonical JSON — cheap per-record
+    integrity for torn-tail detection; sha256 guards the snapshots."""
+    return format(zlib.crc32(canonical.encode("utf-8")) & 0xFFFFFFFF, "08x")
+
+
+def encode_event(ev: Event, seq: Optional[int] = None) -> str:
+    """One JSON line (no trailing newline) for one event. With ``seq`` the
+    record is WAL-framed: it carries the sequence number plus a crc over
+    the canonical body, so a torn or bit-rotted tail is detectable."""
     if isinstance(ev, (AddPolicy, UpdatePolicy)):
         body = {"policy": network_policy_to_dict(ev.policy)}
     elif isinstance(ev, RemovePolicy):
@@ -199,18 +229,46 @@ def encode_event(ev: Event) -> str:
         body = {"cluster": _cluster_to_dict(ev.cluster)}
     else:
         raise IngestError(f"cannot encode event of type {type(ev).__name__}")
-    return json.dumps({"event": ev.kind, **body}, sort_keys=True)
+    obj = {"event": ev.kind, **body}
+    if seq is None:
+        return json.dumps(obj, sort_keys=True)
+    obj[WAL_SEQ_KEY] = int(seq)
+    obj[WAL_CRC_KEY] = _wal_crc(json.dumps(obj, sort_keys=True))
+    return json.dumps(obj, sort_keys=True)
 
 
 def decode_event(line: str, *, where: str = "<event>") -> Event:
     """Parse one JSONL line into an :class:`Event`; ``where`` names the
     source (file:lineno) in diagnostics."""
+    return decode_record(line, where=where)[0]
+
+
+def decode_record(
+    line: str, *, where: str = "<event>"
+) -> Tuple[Event, Optional[int]]:
+    """Parse one JSONL line into ``(event, seq)``; ``seq`` is None on
+    unsequenced (legacy) records. A present ``crc`` is verified against
+    the canonical body and a mismatch raises :class:`IngestError`."""
     try:
         obj = json.loads(line)
     except json.JSONDecodeError as e:
         raise IngestError(f"{where}: not valid JSON: {e}") from e
     if not isinstance(obj, dict) or "event" not in obj:
         raise IngestError(f"{where}: event line lacks an 'event' tag")
+    seq = obj.pop(WAL_SEQ_KEY, None)
+    crc = obj.pop(WAL_CRC_KEY, None)
+    if seq is not None and not isinstance(seq, int):
+        raise IngestError(f"{where}: WAL seq {seq!r} is not an integer")
+    if crc is not None:
+        body = dict(obj)
+        if seq is not None:
+            body[WAL_SEQ_KEY] = seq
+        want = _wal_crc(json.dumps(body, sort_keys=True))
+        if crc != want:
+            raise IngestError(
+                f"{where}: WAL record checksum mismatch (stored {crc!r}, "
+                f"computed {want!r}) — torn or corrupted record"
+            )
     kind = obj["event"]
     cls = EVENT_KINDS.get(kind)
     if cls is None:
@@ -220,22 +278,24 @@ def decode_event(line: str, *, where: str = "<event>") -> Event:
         )
     try:
         if cls in (AddPolicy, UpdatePolicy):
-            return cls(policy=parse_network_policy(obj["policy"]))
+            return cls(policy=parse_network_policy(obj["policy"])), seq
         if cls is RemovePolicy:
-            return RemovePolicy(namespace=obj["namespace"], name=obj["name"])
+            return RemovePolicy(
+                namespace=obj["namespace"], name=obj["name"]
+            ), seq
         if cls is UpdatePodLabels:
             return UpdatePodLabels(
                 namespace=obj["namespace"], pod=obj["pod"],
                 labels=dict(obj.get("labels") or {}),
-            )
+            ), seq
         if cls is UpdateNamespaceLabels:
             return UpdateNamespaceLabels(
                 namespace=obj["namespace"],
                 labels=dict(obj.get("labels") or {}),
-            )
+            ), seq
         if cls is RemoveNamespace:
-            return RemoveNamespace(namespace=obj["namespace"])
-        return FullResync(cluster=_cluster_from_dict(obj["cluster"]))
+            return RemoveNamespace(namespace=obj["namespace"]), seq
+        return FullResync(cluster=_cluster_from_dict(obj["cluster"])), seq
     except IngestError:
         raise
     except (KeyError, TypeError, ValueError) as e:
@@ -244,11 +304,24 @@ def decode_event(line: str, *, where: str = "<event>") -> Event:
         ) from e
 
 
-def write_events(events: Sequence[Event], path: str) -> int:
-    """Append ``events`` to ``path`` as JSONL; returns the count written."""
+def write_events(
+    events: Sequence[Event],
+    path: str,
+    *,
+    start_seq: Optional[int] = None,
+    fsync: bool = False,
+) -> int:
+    """Append ``events`` to ``path`` as JSONL; returns the count written.
+    With ``start_seq`` the records are WAL-framed (``seq``/``crc``),
+    numbered consecutively from it; ``fsync`` makes the append durable
+    before returning."""
     with open(path, "a") as fh:
-        for ev in events:
-            fh.write(encode_event(ev) + "\n")
+        for i, ev in enumerate(events):
+            seq = None if start_seq is None else start_seq + i
+            fh.write(encode_event(ev, seq=seq) + "\n")
+        if fsync:
+            fh.flush()
+            os.fsync(fh.fileno())
     return len(events)
 
 
@@ -267,33 +340,69 @@ class EventSource:
       (None = forever). A partial final line (a writer mid-append) is left
       unconsumed until its newline arrives.
 
+    Racing a live writer: the *final* line of a drain may be mid-flush
+    even when its newline already landed (a torn buffered write), so a
+    decode failure there leaves the line unconsumed (offset not advanced)
+    to be retried on the next drain instead of raising; ``strict=True``
+    restores the raise. A bad line *followed by* complete lines is real
+    corruption and always raises.
+
     The byte ``offset`` is resumable state: a service checkpoint can store
-    it and a restart continues the stream where the crash left it.
+    it and a restart continues the stream where the crash left it. On WAL
+    (sequenced) streams, ``start_after_seq`` skips records whose ``seq``
+    is already applied — the zero-duplicate-application half of recovery —
+    counting them in ``skipped``; ``last_seq`` tracks the highest applied
+    sequence number (-1 until one is seen).
     """
 
-    def __init__(self, path: str, offset: int = 0) -> None:
+    def __init__(
+        self,
+        path: str,
+        offset: int = 0,
+        *,
+        start_after_seq: Optional[int] = None,
+        strict: bool = False,
+    ) -> None:
         self.path = path
         self.offset = offset
         self.lineno = 0
+        self.strict = strict
+        self.last_seq = -1 if start_after_seq is None else int(start_after_seq)
+        self.skipped = 0
 
     def _drain(self) -> List[Event]:
         with open(self.path, "rb") as fh:
             fh.seek(self.offset)
             chunk = fh.read()
         out: List[Event] = []
-        consumed = 0
-        for raw in chunk.splitlines(keepends=True):
+        lines = chunk.splitlines(keepends=True)
+        for n, raw in enumerate(lines):
             if not raw.endswith(b"\n"):
                 break  # partial trailing line: a writer is mid-append
-            consumed += len(raw)
-            self.lineno += 1
             line = raw.decode("utf-8", errors="replace").strip()
             if not line:
+                self.offset += len(raw)
+                self.lineno += 1
                 continue
-            out.append(
-                decode_event(line, where=f"{self.path}:{self.lineno}")
-            )
-        self.offset += consumed
+            try:
+                ev, seq = decode_record(
+                    line, where=f"{self.path}:{self.lineno + 1}"
+                )
+            except IngestError:
+                if n == len(lines) - 1 and not self.strict:
+                    # the writer's final append may have landed its newline
+                    # before the rest of the record (torn buffered write):
+                    # leave it unconsumed and retry on the next drain
+                    break
+                raise
+            self.offset += len(raw)
+            self.lineno += 1
+            if seq is not None:
+                if seq <= self.last_seq:
+                    self.skipped += 1
+                    continue
+                self.last_seq = seq
+            out.append(ev)
         return out
 
     def replay(self) -> Iterator[Event]:
@@ -331,6 +440,172 @@ class EventSource:
             ):
                 return
             sleep(poll_interval)
+
+
+# ------------------------------------------------------------------- WAL
+@dataclass
+class WalInfo:
+    """What :func:`scan_wal` found: the valid prefix and the torn tail."""
+
+    path: str
+    #: complete, decodable records in the valid prefix
+    records: int = 0
+    #: how many of those were WAL-framed (carried seq/crc)
+    sequenced: int = 0
+    #: highest sequence number in the valid prefix (-1 = none)
+    last_seq: int = -1
+    #: byte offset one past the last valid record — the replay ceiling
+    valid_bytes: int = 0
+    #: torn-tail bytes truncated (``repair=True``) or still on disk
+    truncated_bytes: int = 0
+    #: True when the scan found a torn tail (regardless of repair)
+    torn: bool = False
+
+
+def scan_wal(
+    path: str, *, strict: bool = False, repair: bool = True
+) -> WalInfo:
+    """Validate an event log on open: per-record decode + crc check + seq
+    monotonicity over the whole file.
+
+    A *torn tail* — an invalid suffix with no valid record after it, the
+    signature of a crash mid-append — is truncated in place when
+    ``repair`` is set (counted on ``kvtpu_wal_truncations_total``) or left
+    on disk when not; ``strict`` raises :class:`ServeError` instead. An
+    invalid record *followed by* a valid one is not a tear but corruption
+    (or interleaved writers) and always raises.
+    """
+    from ..observe import log_event
+    from ..observe.metrics import WAL_TRUNCATIONS_TOTAL
+
+    info = WalInfo(path=path)
+    if not os.path.exists(path):
+        return info
+    with open(path, "rb") as fh:
+        data = fh.read()
+    lines = data.splitlines(keepends=True)
+    bad_at: Optional[int] = None  # byte offset of the first invalid record
+    bad_why = ""
+    offset = 0
+    lineno = 0
+    for raw in lines:
+        lineno += 1
+        if not raw.endswith(b"\n"):
+            bad_at, bad_why = offset, "record has no trailing newline"
+            break
+        line = raw.decode("utf-8", errors="replace").strip()
+        if not line:
+            offset += len(raw)
+            info.valid_bytes = offset
+            continue
+        try:
+            _, seq = decode_record(line, where=f"{path}:{lineno}")
+        except IngestError as e:
+            bad_at, bad_why = offset, str(e)
+            break
+        if seq is not None:
+            if seq <= info.last_seq:
+                raise ServeError(
+                    f"{path}:{lineno}: WAL sequence regressed "
+                    f"({seq} after {info.last_seq}) — the log was "
+                    "corrupted or written by interleaved writers"
+                )
+            info.last_seq = seq
+            info.sequenced += 1
+        info.records += 1
+        offset += len(raw)
+        info.valid_bytes = offset
+    if bad_at is None:
+        return info
+    # anything decodable after the bad record means mid-stream corruption
+    rest = data[bad_at:]
+    for raw in rest.splitlines(keepends=True)[1:]:
+        if not raw.endswith(b"\n"):
+            continue
+        line = raw.decode("utf-8", errors="replace").strip()
+        if not line:
+            continue
+        try:
+            decode_record(line)
+        except IngestError:
+            continue
+        raise ServeError(
+            f"{path}: invalid record at byte {bad_at} is followed by "
+            f"valid records — mid-stream corruption, not a torn tail "
+            f"({bad_why})"
+        )
+    info.torn = True
+    info.truncated_bytes = len(data) - info.valid_bytes
+    if strict:
+        raise ServeError(
+            f"{path}: torn WAL tail — {info.truncated_bytes} bytes after "
+            f"offset {info.valid_bytes} do not form a valid record "
+            f"({bad_why}); re-open without strict to truncate and resume"
+        )
+    if repair:
+        with open(path, "rb+") as fh:
+            fh.truncate(info.valid_bytes)
+        WAL_TRUNCATIONS_TOTAL.inc()
+        log_event(
+            "wal_truncate", path=path, valid_bytes=info.valid_bytes,
+            dropped_bytes=info.truncated_bytes, reason=bad_why,
+        )
+    return info
+
+
+class WalWriter:
+    """Append-only sequenced event-log writer.
+
+    Opening scans the existing log (torn tails repaired unless ``strict``)
+    and resumes the sequence after its highest number, so every record
+    ever written to one path has a unique, monotonically increasing
+    ``seq``. ``fsync`` (default) makes each :meth:`append` durable before
+    returning — the write-ahead half of the checkpoint protocol.
+    """
+
+    def __init__(
+        self, path: str, *, fsync: bool = True, strict: bool = False
+    ) -> None:
+        self.path = path
+        self.fsync = fsync
+        info = scan_wal(path, strict=strict)
+        self.next_seq = info.last_seq + 1
+        self._fh = open(path, "a")
+
+    def append(self, events: Sequence[Event]) -> int:
+        """Append ``events`` as WAL-framed records; returns the last
+        sequence number written (``next_seq - 1`` when empty)."""
+        from ..resilience.faults import kill_point
+
+        for ev in events:
+            line = encode_event(ev, seq=self.next_seq) + "\n"
+            half = max(1, len(line) // 2)
+            self._fh.write(line[:half])
+            # crash-fault hook: fires (if armed) with only the first half
+            # of this record flushed — the canonical torn-tail producer
+            kill_point("mid-log-append", flush=self._fh)
+            self._fh.write(line[half:])
+            self.next_seq += 1
+        self._fh.flush()
+        if self.fsync:
+            os.fsync(self._fh.fileno())
+        return self.next_seq - 1
+
+    @property
+    def offset(self) -> int:
+        """Current end-of-log byte offset (valid after :meth:`append`)."""
+        return self._fh.tell()
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None  # type: ignore[assignment]
+
+    def __enter__(self) -> "WalWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
 
 def coalesce(

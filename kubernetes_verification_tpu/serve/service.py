@@ -28,7 +28,10 @@ Resilience: the engine's ``reach`` already retries transients
 (``retry_transient``); when the incremental derivation still fails with a
 :class:`~..resilience.errors.BackendError`, the service falls back to a
 from-scratch CPU verify of ``as_cluster()`` — degraded throughput, same
-answers — and counts the hop on ``kvtpu_fallbacks_total``.
+answers — and counts the hop on ``kvtpu_fallbacks_total``. A private
+circuit breaker (``ServeConfig.breaker_threshold``) remembers repeated
+engine failures: while open, queries skip the doomed incremental solve
+entirely until the cooldown admits a half-open probe.
 """
 from __future__ import annotations
 
@@ -53,6 +56,7 @@ from ..observe.metrics import (
     SERVE_SOLVES_TOTAL,
     SERVE_STALENESS_SECONDS,
 )
+from ..resilience.breaker import CircuitBreaker
 from ..resilience.errors import BackendError, KvTpuError, ServeError
 from .events import (
     AddPolicy,
@@ -84,6 +88,13 @@ class ServeConfig:
     snapshot_dir: Optional[str] = None
     #: snapshot every N applied batches (0 = only on close())
     snapshot_every: int = 0
+    #: consecutive incremental-solve failures before the service's circuit
+    #: breaker opens and queries go straight to the from-scratch CPU
+    #: fallback for the cooldown; 0 disables the breaker
+    breaker_threshold: int = 3
+    #: seconds an open serving breaker waits before probing the
+    #: incremental engine again
+    breaker_cooldown: float = 30.0
 
 
 @dataclass
@@ -172,6 +183,19 @@ class VerificationService:
         #: reach matrix from a from-scratch fallback solve; valid until the
         #: next mutation (the incremental counts may be what broke)
         self._fallback_reach: Optional[np.ndarray] = None
+        #: private breaker guarding the incremental derivation: while open,
+        #: queries skip the doomed engine solve and go straight to the
+        #: from-scratch CPU fallback until the cooldown admits a probe
+        sc = self.serve_config
+        self._breaker: Optional[CircuitBreaker] = (
+            CircuitBreaker(
+                "serve-dense",
+                failure_threshold=sc.breaker_threshold,
+                cooldown=sc.breaker_cooldown,
+            )
+            if sc.breaker_threshold > 0
+            else None
+        )
 
     # ------------------------------------------------------------ snapshots
     @classmethod
@@ -329,11 +353,22 @@ class VerificationService:
                 if self._dirty_since is not None
                 else 0.0
             )
-            try:
-                reach = np.asarray(eng.reach)
-            except BackendError:
+            br = self._breaker
+            if br is not None and not br.allow():
+                # circuit open: the engine has failed repeatedly and the
+                # cooldown hasn't elapsed — don't pay a doomed solve
                 reach = self._solve_fallback()
                 trigger = "fallback"
+            else:
+                try:
+                    reach = np.asarray(eng.reach)
+                    if br is not None:
+                        br.record_success()
+                except BackendError:
+                    if br is not None:
+                        br.record_failure()
+                    reach = self._solve_fallback()
+                    trigger = "fallback"
             SERVE_SOLVES_TOTAL.labels(trigger=trigger).inc()
             self.stats.solves[trigger] = (
                 self.stats.solves.get(trigger, 0) + 1

@@ -26,6 +26,12 @@ they swallow ``KeyboardInterrupt``/``SystemExit`` and hide taxonomy errors
 from the exit-code contract; catch a named type (``Exception`` at the
 broadest) instead. No budget: the package has none and must stay at none.
 
+A third pass enforces the crash-safety discipline in
+``serve/durability.py``: any function that opens a file for writing must
+also call ``os.replace`` (the tmp-file + fsync + rename promotion) —
+a bare ``open(..., "w")`` there is a torn-state bug waiting for a kill
+point, which is exactly what the recovery fuzz harness injects.
+
 Newer layers (``serve/`` and everything after it) are NOT grandfathered —
 they were written on the taxonomy from day one and get a zero budget like
 any other non-listed file.
@@ -91,6 +97,13 @@ GRANDFATHERED: Dict[str, int] = {
 }
 
 
+#: the one file under the atomic-write discipline (package-relative)
+ATOMIC_WRITE_FILES = frozenset({"serve/durability.py"})
+
+#: open() modes that create or mutate bytes on disk
+_WRITE_MODE_CHARS = frozenset("wax+")
+
+
 def _raised_name(node: ast.Raise):
     exc = node.exc
     if isinstance(exc, ast.Call) and isinstance(exc.func, ast.Name):
@@ -123,6 +136,45 @@ def scan_bare_except(path: str) -> List[int]:
     ]
 
 
+def scan_nonatomic_writes(path: str) -> List[Tuple[int, str]]:
+    """(line, mode) for every ``open()`` with a write mode inside a
+    function that never calls ``os.replace`` — in a crash-safe module
+    every durable write must be promoted atomically, so a bare write is
+    a torn-state bug."""
+    with open(path, "r") as fh:
+        tree = ast.parse(fh.read(), filename=path)
+    out: List[Tuple[int, str]] = []
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        opens: List[Tuple[int, str]] = []
+        has_replace = False
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            if isinstance(node.func, ast.Name) and node.func.id == "open":
+                mode = "r"
+                if len(node.args) >= 2 and isinstance(
+                    node.args[1], ast.Constant
+                ):
+                    mode = node.args[1].value
+                for kw in node.keywords:
+                    if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+                        mode = kw.value.value
+                if isinstance(mode, str) and set(mode) & _WRITE_MODE_CHARS:
+                    opens.append((node.lineno, mode))
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "replace"
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "os"
+            ):
+                has_replace = True
+        if not has_replace:
+            out += opens
+    return out
+
+
 def check() -> List[str]:
     problems: List[str] = []
     for root, dirs, files in os.walk(PACKAGE):
@@ -139,6 +191,13 @@ def check() -> List[str]:
                 "taxonomy errors are not swallowed"
                 for line in scan_bare_except(path)
             ]
+            if rel in ATOMIC_WRITE_FILES:
+                problems += [
+                    f"{rel}:{line}: open(..., {mode!r}) in a function "
+                    "without os.replace — durable writes here must use "
+                    "the tmp-file + fsync + os.replace promotion"
+                    for line, mode in scan_nonatomic_writes(path)
+                ]
             budget = GRANDFATHERED.get(rel)
             if budget is None:
                 problems += [

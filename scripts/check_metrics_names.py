@@ -70,6 +70,11 @@ REQUIRED_FAMILIES = frozenset(
         "kvtpu_serve_assertion_failures_total",
         "kvtpu_serve_queue_depth",
         "kvtpu_serve_staleness_seconds",
+        # durability layer (WAL / checkpoints / recovery / breaker)
+        "kvtpu_checkpoints_total",
+        "kvtpu_recoveries_total",
+        "kvtpu_wal_truncations_total",
+        "kvtpu_breaker_transitions_total",
     }
 )
 

@@ -1,0 +1,95 @@
+"""Subprocess body for the kill-point recovery fuzz (tests/test_durability.py).
+
+Runs the durable serving write path — WAL appends, engine applies, periodic
+atomic checkpoints — over a deterministic churn stream, with one armed
+kill-point from ``--kill``. The armed point hard-kills the process with
+``os._exit(137)`` mid-write; the parent test then recovers from whatever
+survived on disk and compares bit-for-bit against a from-scratch
+verification of the surviving log prefix.
+
+Deliberately *never* solves reach: the child's job is to die while writing,
+not to spend seconds deriving answers nobody will read.
+"""
+import argparse
+import os
+import sys
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--workdir", required=True)
+    ap.add_argument(
+        "--kill", default="",
+        help="fault spec armed via install_kill_points, e.g. "
+        "'mid-log-append@137' (empty = run to completion)",
+    )
+    ap.add_argument("--seed", type=int, default=3)
+    ap.add_argument("--n-events", type=int, default=500)
+    ap.add_argument("--pods", type=int, default=64)
+    ap.add_argument("--batch", type=int, default=25)
+    ap.add_argument("--checkpoint-every", type=int, default=3)
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+    import kubernetes_verification_tpu as kv
+    from kubernetes_verification_tpu.harness.generate import (
+        GeneratorConfig,
+        random_cluster,
+        random_event_stream,
+    )
+    from kubernetes_verification_tpu.resilience.faults import (
+        install_kill_points,
+        parse_fault_spec,
+    )
+    from kubernetes_verification_tpu.serve import (
+        CheckpointManager,
+        EventSource,
+        VerificationService,
+        WalWriter,
+    )
+
+    # MUST mirror the parent test's generator knobs exactly: the parent
+    # rebuilds this cluster for the from-scratch oracle
+    cluster = random_cluster(
+        GeneratorConfig(
+            n_pods=args.pods, n_policies=24, n_namespaces=6, seed=7,
+            p_ipblock_peer=0.0, min_selector_labels=1,
+        )
+    )
+    events = random_event_stream(
+        cluster, n_events=args.n_events, seed=args.seed
+    )
+    if args.kill:
+        install_kill_points(parse_fault_spec(args.kill), seed=args.seed)
+
+    log = os.path.join(args.workdir, "events.jsonl")
+    svc = VerificationService(
+        cluster, kv.VerifyConfig(backend="cpu", compute_ports=False)
+    )
+    cm = CheckpointManager(os.path.join(args.workdir, "ck"), retain=3)
+    writer = WalWriter(log)
+    source = EventSource(log)
+    batches_since = 0
+    for i in range(0, len(events), args.batch):
+        writer.append(events[i:i + args.batch])
+        for batch in source.batches(args.batch):
+            svc.apply(batch)
+        batches_since += 1
+        if batches_since >= args.checkpoint_every:
+            cm.checkpoint(
+                svc.engine, log_path=log,
+                log_offset=source.offset, last_seq=source.last_seq,
+            )
+            batches_since = 0
+    cm.checkpoint(
+        svc.engine, log_path=log,
+        log_offset=source.offset, last_seq=source.last_seq,
+    )
+    writer.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -139,6 +139,7 @@ def test_cli_diff_round_trip_ports(tmp_path, capsys):
     _cli_diff_round_trip(tmp_path, capsys, [], "ports")
 
 
+@pytest.mark.slow
 def test_cli_diff_round_trip_any_port(tmp_path, capsys):
     _cli_diff_round_trip(tmp_path, capsys, ["--no-ports"], "anyport")
 
@@ -156,6 +157,7 @@ def test_cli_diff_no_save_and_bad_remove(tmp_path, capsys):
         main(["diff", ck, "--remove", "garbage"])
 
 
+@pytest.mark.slow
 def test_cli_diff_out_of_universe_aborts_cleanly(tmp_path, capsys):
     """A ports-engine diff outside the frozen universe exits with rebuild
     guidance instead of a traceback, and the checkpoint on disk is intact."""

@@ -1,0 +1,687 @@
+"""Crash-safe durability: WAL sequencing/checksums and torn-tail repair,
+the live-writer race in ``EventSource``, atomic checkpoint generations and
+rotation, the ladder recovery manager (newest → fallback → rebuild), the
+per-backend circuit breaker, the named kill-points, and the subprocess
+kill-fuzz that proves recovery is bit-for-bit against a from-scratch
+verification of the surviving log prefix."""
+import json
+import os
+import random
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import kubernetes_verification_tpu as kv
+from kubernetes_verification_tpu.cli import main
+from kubernetes_verification_tpu.harness.generate import (
+    GeneratorConfig,
+    random_cluster,
+    random_event_stream,
+)
+from kubernetes_verification_tpu.incremental import IncrementalVerifier
+from kubernetes_verification_tpu.observe import REGISTRY
+from kubernetes_verification_tpu.resilience import (
+    EXIT_INPUT_ERROR,
+    EXIT_OK,
+    BackendError,
+    ConfigError,
+    IngestError,
+    PersistError,
+    ServeError,
+)
+from kubernetes_verification_tpu.resilience.breaker import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    CircuitBreaker,
+    breaker_for,
+    breaker_states,
+    reset_breakers,
+)
+from kubernetes_verification_tpu.resilience.faults import (
+    KILL_POINTS,
+    KillPointInjector,
+    clear_kill_points,
+    install_kill_points,
+    kill_point,
+    parse_fault_spec,
+    register_faulty,
+)
+from kubernetes_verification_tpu.serve import (
+    CheckpointManager,
+    EventSource,
+    RecoveryManager,
+    ServeConfig,
+    VerificationService,
+    WalWriter,
+    decode_event,
+    decode_record,
+    encode_event,
+    scan_wal,
+    write_events,
+)
+from kubernetes_verification_tpu.serve.durability import load_manifest
+
+CHILD = os.path.join(os.path.dirname(os.path.abspath(__file__)), "durability_child.py")
+
+
+def _counter(name, key):
+    return REGISTRY.dump()["counters"].get(name, {}).get(key, 0.0)
+
+
+def _gauge_or_counter_total(name):
+    return sum(REGISTRY.dump()["counters"].get(name, {}).values())
+
+
+@pytest.fixture(scope="module")
+def churn():
+    """One small cluster + churn stream shared by the WAL/checkpoint
+    tests (each test writes its own log/checkpoint files)."""
+    cluster = random_cluster(
+        GeneratorConfig(
+            n_pods=24, n_policies=10, n_namespaces=3, seed=7,
+            p_ipblock_peer=0.0, min_selector_labels=1,
+        )
+    )
+    events = random_event_stream(cluster, n_events=120, seed=3)
+    cfg = kv.VerifyConfig(backend="cpu", compute_ports=False)
+    return cluster, events, cfg
+
+
+# --------------------------------------------------------------- WAL codec
+def test_wal_codec_round_trips_with_seq_and_crc(churn):
+    _, events, _ = churn
+    for i, ev in enumerate(events[:40]):
+        line = encode_event(ev, seq=i)
+        obj = json.loads(line)
+        assert obj["seq"] == i and "crc" in obj
+        back, seq = decode_record(line)
+        assert seq == i
+        # the WAL frame is transparent: re-encoding the decoded event
+        # unsequenced must give the legacy (frameless) line
+        legacy = encode_event(ev)
+        assert "seq" not in json.loads(legacy)
+        assert encode_event(back) == legacy
+        # and decode_event keeps working on sequenced records
+        assert decode_event(line) == back
+
+
+def test_wal_crc_mismatch_raises(churn):
+    _, events, _ = churn
+    line = encode_event(events[0], seq=0)
+    obj = json.loads(line)
+    obj["seq"] = 7  # body changed, crc stale
+    with pytest.raises(IngestError, match="checksum mismatch"):
+        decode_record(json.dumps(obj, sort_keys=True))
+    with pytest.raises(IngestError, match="not an integer"):
+        decode_record(line.replace('"seq": 0', '"seq": "zero"'))
+
+
+def test_scan_wal_truncates_torn_tail(tmp_path, churn):
+    _, events, _ = churn
+    log = str(tmp_path / "wal.jsonl")
+    write_events(events[:10], log, start_seq=0)
+    good_size = os.path.getsize(log)
+    with open(log, "a") as fh:
+        fh.write(encode_event(events[10], seq=10)[: 25])  # torn mid-record
+    before = _gauge_or_counter_total("kvtpu_wal_truncations_total")
+    info = scan_wal(log)
+    assert info.torn and info.records == 10 and info.last_seq == 9
+    assert info.valid_bytes == good_size
+    assert os.path.getsize(log) == good_size  # repaired in place
+    assert _gauge_or_counter_total("kvtpu_wal_truncations_total") == before + 1
+    clean = scan_wal(log)
+    assert not clean.torn and clean.records == 10
+
+
+def test_scan_wal_strict_raises_and_leaves_file(tmp_path, churn):
+    _, events, _ = churn
+    log = str(tmp_path / "wal.jsonl")
+    write_events(events[:5], log, start_seq=0)
+    with open(log, "a") as fh:
+        fh.write("{torn")
+    size = os.path.getsize(log)
+    with pytest.raises(ServeError, match="torn WAL tail"):
+        scan_wal(log, strict=True)
+    assert os.path.getsize(log) == size  # strict never repairs
+
+
+def test_scan_wal_midstream_corruption_always_raises(tmp_path, churn):
+    _, events, _ = churn
+    log = str(tmp_path / "wal.jsonl")
+    lines = [encode_event(ev, seq=i) for i, ev in enumerate(events[:6])]
+    lines[2] = lines[2][:20] + "#corrupt#" + lines[2][20:]
+    with open(log, "w") as fh:
+        fh.write("\n".join(lines) + "\n")
+    with pytest.raises(ServeError, match="mid-stream corruption"):
+        scan_wal(log)
+
+
+def test_scan_wal_seq_regression_raises(tmp_path, churn):
+    _, events, _ = churn
+    log = str(tmp_path / "wal.jsonl")
+    with open(log, "w") as fh:
+        fh.write(encode_event(events[0], seq=5) + "\n")
+        fh.write(encode_event(events[1], seq=3) + "\n")
+    with pytest.raises(ServeError, match="sequence regressed"):
+        scan_wal(log)
+
+
+def test_wal_writer_resumes_sequence_across_reopen(tmp_path, churn):
+    _, events, _ = churn
+    log = str(tmp_path / "wal.jsonl")
+    with WalWriter(log) as w:
+        assert w.append(events[:4]) == 3
+    with WalWriter(log) as w:
+        assert w.next_seq == 4
+        assert w.append(events[4:7]) == 6
+    info = scan_wal(log)
+    assert (info.records, info.sequenced, info.last_seq) == (7, 7, 6)
+    src = EventSource(log)
+    assert len(list(src.replay())) == 7 and src.last_seq == 6
+
+
+def test_event_source_skips_already_applied_seqs(tmp_path, churn):
+    _, events, _ = churn
+    log = str(tmp_path / "wal.jsonl")
+    write_events(events[:10], log, start_seq=0)
+    src = EventSource(log, start_after_seq=5)
+    got = list(src.replay())
+    assert len(got) == 4 and src.skipped == 6 and src.last_seq == 9
+
+
+# -------------------------------------------- live-writer race (satellite)
+def test_event_source_tail_survives_byte_by_byte_writer(tmp_path, churn):
+    """Regression: a reader draining mid-append must never raise on the
+    partially flushed last record — it stays unconsumed (offset parked)
+    until the writer finishes it."""
+    _, events, _ = churn
+    log = str(tmp_path / "wal.jsonl")
+    payload = b""
+    for i, ev in enumerate(events[:6]):
+        payload += (encode_event(ev, seq=i) + "\n").encode()
+    open(log, "w").close()
+    src = EventSource(log)
+    got = []
+    with open(log, "ab") as fh:
+        step = 7  # a stride that lands mid-record on every drain
+        for i in range(0, len(payload), step):
+            fh.write(payload[i:i + step])
+            fh.flush()
+            got += src._drain()  # must not raise mid-record
+    got += src._drain()
+    assert len(got) == 6 and src.last_seq == 5
+    assert src.offset == len(payload)
+
+
+def test_event_source_defers_newline_terminated_torn_tail(tmp_path, churn):
+    """A torn buffered write can land a newline before the record is
+    complete: a decode failure on the *final* line defers (offset not
+    advanced) instead of raising, and the record is consumed once the
+    writer rewrites it whole; strict=True restores the raise."""
+    _, events, _ = churn
+    log = str(tmp_path / "wal.jsonl")
+    good = encode_event(events[0], seq=0) + "\n"
+    torn = encode_event(events[1], seq=1)[:30] + "\n"
+    with open(log, "w") as fh:
+        fh.write(good + torn)
+    src = EventSource(log)
+    assert len(src._drain()) == 1  # no raise; torn tail deferred
+    assert src.offset == len(good)  # parked before the bad line
+    strict = EventSource(log, strict=True)
+    with pytest.raises(IngestError):
+        strict._drain()
+    # the writer completes the record: the reader resumes cleanly
+    with open(log, "rb+") as fh:
+        fh.truncate(len(good))
+    write_events([events[1]], log, start_seq=1)
+    assert len(src._drain()) == 1 and src.last_seq == 1
+
+
+def test_event_source_raises_on_mid_chunk_corruption(tmp_path, churn):
+    _, events, _ = churn
+    log = str(tmp_path / "wal.jsonl")
+    with open(log, "w") as fh:
+        fh.write(encode_event(events[0], seq=0) + "\n")
+        fh.write("{broken\n")
+        fh.write(encode_event(events[1], seq=1) + "\n")
+    with pytest.raises(IngestError):
+        list(EventSource(log).replay())
+
+
+# ------------------------------------------------------------- checkpoints
+def test_checkpoint_rotation_keeps_newest_generations(tmp_path, churn):
+    cluster, events, cfg = churn
+    svc = VerificationService(cluster, cfg)
+    cm = CheckpointManager(str(tmp_path), retain=2)
+    before = _gauge_or_counter_total("kvtpu_checkpoints_total")
+    for i in range(4):
+        svc.apply(events[i * 10:(i + 1) * 10])
+        cm.checkpoint(svc.engine, log_offset=i, last_seq=i)
+    assert cm.generations() == [4, 3]
+    names = sorted(os.listdir(str(tmp_path)))
+    assert names == [
+        "gen-00000003", "gen-00000004",
+        "manifest-00000003.json", "manifest-00000004.json",
+    ]
+    assert _gauge_or_counter_total("kvtpu_checkpoints_total") == before + 4
+
+
+def test_manifest_checksum_detects_tampering(tmp_path, churn):
+    cluster, _, cfg = churn
+    svc = VerificationService(cluster, cfg)
+    cm = CheckpointManager(str(tmp_path))
+    info = cm.checkpoint(svc.engine, log_offset=123, last_seq=45)
+    m = load_manifest(info.manifest_path)
+    assert m["log_offset"] == 123 and m["last_seq"] == 45
+    with open(info.manifest_path) as fh:
+        raw = fh.read()
+    with open(info.manifest_path, "w") as fh:
+        fh.write(raw.replace('"log_offset": 123', '"log_offset": 999'))
+    with pytest.raises(PersistError, match="checksum mismatch"):
+        load_manifest(info.manifest_path)
+
+
+def test_orphan_generation_number_is_burnt(tmp_path, churn):
+    """A crash after the snapshot rename but before the manifest leaves an
+    orphan gen dir; the next checkpoint must not reuse its number."""
+    cluster, _, cfg = churn
+    svc = VerificationService(cluster, cfg)
+    cm = CheckpointManager(str(tmp_path))
+    cm.checkpoint(svc.engine)
+    os.makedirs(str(tmp_path / "gen-00000005"))  # orphan, no manifest
+    info = cm.checkpoint(svc.engine)
+    assert info.generation == 6
+
+
+# ---------------------------------------------------------------- recovery
+def _reach(svc):
+    return np.asarray(svc.reach())
+
+
+def test_recovery_newest_is_bit_for_bit(tmp_path, churn):
+    cluster, events, cfg = churn
+    log = str(tmp_path / "events.jsonl")
+    ckdir = str(tmp_path / "ck")
+    svc = VerificationService(cluster, cfg)
+    cm = CheckpointManager(ckdir)
+    writer = WalWriter(log)
+    src = EventSource(log)
+    writer.append(events[:60])
+    for b in src.batches(64):
+        svc.apply(b)
+    cm.checkpoint(
+        svc.engine, log_path=log, log_offset=src.offset, last_seq=src.last_seq
+    )
+    # more events land after the checkpoint: recovery must replay them
+    writer.append(events[60:90])
+    for b in src.batches(64):
+        svc.apply(b)
+    writer.close()
+    before = _counter("kvtpu_recoveries_total", "outcome=newest")
+    res = RecoveryManager(ckdir).recover(log_path=log, config=cfg)
+    assert res.outcome == "newest" and res.generation == 1
+    assert res.replayed == 30 and res.duplicates_skipped == 0
+    assert _counter("kvtpu_recoveries_total", "outcome=newest") == before + 1
+    np.testing.assert_array_equal(_reach(res.service), _reach(svc))
+
+
+@pytest.mark.parametrize("damage", ["manifest", "snapshot"])
+def test_recovery_falls_back_to_previous_generation(tmp_path, churn, damage):
+    """Corrupting the newest manifest (or its snapshot payload) must land
+    recovery on the previous generation and count
+    kvtpu_recoveries_total{outcome=fallback}."""
+    cluster, events, cfg = churn
+    log = str(tmp_path / "events.jsonl")
+    ckdir = str(tmp_path / "ck")
+    svc = VerificationService(cluster, cfg)
+    cm = CheckpointManager(ckdir)
+    writer = WalWriter(log)
+    src = EventSource(log)
+    for lo, hi in ((0, 40), (40, 80)):
+        writer.append(events[lo:hi])
+        for b in src.batches(64):
+            svc.apply(b)
+        cm.checkpoint(
+            svc.engine, log_path=log,
+            log_offset=src.offset, last_seq=src.last_seq,
+        )
+    writer.close()
+    if damage == "manifest":
+        with open(os.path.join(ckdir, "manifest-00000002.json"), "a") as fh:
+            fh.write("}}garbage")
+    else:
+        state = os.path.join(ckdir, "gen-00000002", "state.npz")
+        with open(state, "rb+") as fh:
+            fh.seek(-16, os.SEEK_END)
+            fh.write(b"\x00" * 16)
+    before = _counter("kvtpu_recoveries_total", "outcome=fallback")
+    res = RecoveryManager(ckdir).recover(log_path=log, config=cfg)
+    assert res.outcome == "fallback" and res.generation == 1
+    assert res.replayed == 40 and res.duplicates_skipped == 0
+    assert [g for g, _ in res.errors] == [2]
+    assert (
+        _counter("kvtpu_recoveries_total", "outcome=fallback") == before + 1
+    )
+    np.testing.assert_array_equal(_reach(res.service), _reach(svc))
+
+
+def test_recovery_rebuilds_when_every_generation_is_corrupt(tmp_path, churn):
+    cluster, events, cfg = churn
+    log = str(tmp_path / "events.jsonl")
+    ckdir = str(tmp_path / "ck")
+    svc = VerificationService(cluster, cfg)
+    cm = CheckpointManager(ckdir)
+    writer = WalWriter(log)
+    src = EventSource(log)
+    writer.append(events[:50])
+    for b in src.batches(64):
+        svc.apply(b)
+    cm.checkpoint(
+        svc.engine, log_path=log, log_offset=src.offset, last_seq=src.last_seq
+    )
+    writer.close()
+    for name in os.listdir(ckdir):
+        if name.startswith("manifest"):
+            with open(os.path.join(ckdir, name), "w") as fh:
+                fh.write("not json")
+    rm = RecoveryManager(ckdir)
+    with pytest.raises(PersistError, match="no usable checkpoint"):
+        rm.recover(log_path=log, config=cfg)
+    before = _counter("kvtpu_recoveries_total", "outcome=rebuild")
+    res = rm.recover(log_path=log, initial_cluster=cluster, config=cfg)
+    assert res.outcome == "rebuild" and res.generation == -1
+    assert res.replayed == 50 and res.duplicates_skipped == 0
+    assert _counter("kvtpu_recoveries_total", "outcome=rebuild") == before + 1
+    np.testing.assert_array_equal(_reach(res.service), _reach(svc))
+
+
+# ---------------------------------------------------------- circuit breaker
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_breaker_opens_half_opens_and_closes():
+    clock = _Clock()
+    key_open = "backend=unit-test,to=open"
+    before_open = _counter("kvtpu_breaker_transitions_total", key_open)
+    br = CircuitBreaker(
+        "unit-test", failure_threshold=2, cooldown=10.0, clock=clock
+    )
+    assert br.state == CLOSED and br.allow()
+    br.record_failure()
+    assert br.state == CLOSED and br.allow()
+    br.record_failure()
+    assert br.state == OPEN and not br.allow()
+    assert (
+        _counter("kvtpu_breaker_transitions_total", key_open)
+        == before_open + 1
+    )
+    clock.t = 10.0  # cooldown elapsed: exactly one probe admitted
+    assert br.allow() and br.state == HALF_OPEN
+    assert not br.allow()  # second concurrent probe refused
+    br.record_success()
+    assert br.state == CLOSED and br.allow()
+    # a failing probe re-opens for a fresh cooldown
+    br.record_failure()
+    br.record_failure()
+    clock.t = 20.0
+    assert br.allow() and br.state == HALF_OPEN
+    br.record_failure()
+    assert br.state == OPEN and not br.allow()
+    clock.t = 25.0
+    assert not br.allow()  # fresh cooldown, not the stale one
+    assert br.transitions == [
+        OPEN, HALF_OPEN, CLOSED, OPEN, HALF_OPEN, OPEN
+    ]
+
+
+def test_breaker_registry_is_process_wide():
+    reset_breakers()
+    try:
+        a = breaker_for("reg-test", failure_threshold=1, cooldown=99.0)
+        b = breaker_for("reg-test", failure_threshold=5)  # first knobs win
+        assert a is b and b.failure_threshold == 1
+        a.record_failure()
+        assert breaker_states() == [("reg-test", OPEN)]
+    finally:
+        reset_breakers()
+
+
+def test_resilient_verify_skips_open_backend(churn):
+    """With breaker_threshold set, a backend that exhausted its retries
+    trips its breaker and later calls skip it without re-paying the
+    attempt (visible as a breaker_open hop in the chain)."""
+    from kubernetes_verification_tpu.resilience import (
+        ResilienceConfig,
+        resilient_verify,
+    )
+
+    cluster, _, cfg = churn
+    name = register_faulty("cpu", parse_fault_spec("device_loss"))
+    reset_breakers()
+    try:
+        res = ResilienceConfig(
+            fallback_chain=(name, "cpu"), max_retries=0,
+            breaker_threshold=1, breaker_cooldown=1000.0,
+        )
+        key = f"backend={name},to=open"
+        before = _counter("kvtpu_breaker_transitions_total", key)
+        r1 = resilient_verify(cluster, cfg, res, sleep=lambda _: None)
+        assert (
+            _counter("kvtpu_breaker_transitions_total", key) == before + 1
+        )
+        assert dict(breaker_states())[name] == OPEN
+        # second call: the faulty backend is skipped outright, yet the
+        # chain still answers (and identically) from the healthy tail
+        r2 = resilient_verify(cluster, cfg, res, sleep=lambda _: None)
+        np.testing.assert_array_equal(
+            np.asarray(r1.reach), np.asarray(r2.reach)
+        )
+    finally:
+        reset_breakers()
+
+
+def test_service_breaker_short_circuits_to_fallback(churn, monkeypatch):
+    """After threshold engine failures the service's breaker opens and
+    queries stop touching the doomed incremental solve entirely."""
+    cluster, events, cfg = churn
+    svc = VerificationService(
+        cluster, cfg,
+        ServeConfig(breaker_threshold=1, breaker_cooldown=1000.0),
+    )
+    calls = {"n": 0}
+
+    def _boom(self):
+        calls["n"] += 1
+        raise BackendError("injected engine failure", backend="serve-dense")
+
+    monkeypatch.setattr(IncrementalVerifier, "reach", property(_boom))
+    r1 = svc.reach()
+    assert calls["n"] == 1 and svc._breaker.state == OPEN
+    svc.apply(events[:5])  # dirty the derivation again
+    r2 = svc.reach()
+    assert calls["n"] == 1  # breaker open: the engine was never consulted
+    assert svc.stats.solves.get("fallback") == 2
+    assert r1.shape == r2.shape
+
+
+# -------------------------------------------------------------- kill points
+def test_kill_point_disarmed_is_noop():
+    clear_kill_points()
+    kill_point("after-manifest")  # must simply return
+
+
+def test_kill_point_spec_validation():
+    with pytest.raises(ConfigError):
+        install_kill_points(parse_fault_spec("oom"))  # not a kill point
+    with pytest.raises(ConfigError, match="process crash"):
+        register_faulty("cpu", parse_fault_spec("before-rename"))
+    inj = KillPointInjector(parse_fault_spec("mid-log-append@2"))
+    assert not inj.should_kill("mid-log-append")
+    assert not inj.should_kill("after-manifest")  # separate hit counter
+    assert not inj.should_kill("mid-log-append")
+    assert inj.should_kill("mid-log-append")
+    clear_kill_points()
+
+
+def _run_child(workdir, kill, seed=3, n_events=40, pods=12, batch=10,
+               checkpoint_every=2):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [
+            sys.executable, CHILD, "--workdir", str(workdir),
+            "--kill", kill, "--seed", str(seed),
+            "--n-events", str(n_events), "--pods", str(pods),
+            "--batch", str(batch), "--checkpoint-every",
+            str(checkpoint_every),
+        ],
+        env=env, capture_output=True, text=True, timeout=300,
+    )
+
+
+def test_kill_point_harness_kills_and_recovery_repairs(tmp_path):
+    """One fast end-to-end crash: the child dies mid-append with half a
+    record flushed; scan_wal repairs the tear and recovery answers
+    bit-for-bit with a from-scratch verify of the surviving prefix."""
+    proc = _run_child(tmp_path, "mid-log-append@11")
+    assert proc.returncode == 137, proc.stderr
+    log = str(tmp_path / "events.jsonl")
+    info = scan_wal(log)
+    assert info.torn and info.records == 11 and info.last_seq == 10
+    cluster = random_cluster(
+        GeneratorConfig(
+            n_pods=12, n_policies=24, n_namespaces=6, seed=7,
+            p_ipblock_peer=0.0, min_selector_labels=1,
+        )
+    )
+    cfg = kv.VerifyConfig(backend="cpu", compute_ports=False)
+    res = RecoveryManager(str(tmp_path / "ck")).recover(
+        log_path=log, initial_cluster=cluster, config=cfg
+    )
+    assert res.duplicates_skipped == 0
+    oracle = VerificationService(cluster, cfg)
+    for b in EventSource(log).batches(64):
+        oracle.apply(b)
+    np.testing.assert_array_equal(_reach(res.service), _reach(oracle))
+
+
+@pytest.mark.slow
+def test_recovery_fuzz_kill_points_bit_for_bit(tmp_path):
+    """The acceptance fuzz: a 500-event churn stream on 64 pods, killed at
+    ≥20 random points (including inside checkpoint writes via all four
+    named kill-points); every recovery must equal a from-scratch
+    verification of the surviving log prefix bit-for-bit, with zero
+    duplicate event application (sequence-number audit)."""
+    n_events, pods, batch, ck_every = 500, 64, 25, 3
+    # 20 append rounds → 6 periodic + 1 final checkpoints when unkilled
+    n_checkpoints = (n_events // batch) // ck_every + 1
+    cluster = random_cluster(
+        GeneratorConfig(
+            n_pods=pods, n_policies=24, n_namespaces=6, seed=7,
+            p_ipblock_peer=0.0, min_selector_labels=1,
+        )
+    )
+    cfg = kv.VerifyConfig(backend="cpu", compute_ports=False)
+    rng = random.Random(20260804)
+    kills = 0
+    for i in range(20):
+        point = KILL_POINTS[i % len(KILL_POINTS)]
+        at = rng.randrange(
+            n_events if point == "mid-log-append" else n_checkpoints
+        )
+        spec = f"{point}@{at}"
+        workdir = tmp_path / f"run-{i:02d}"
+        workdir.mkdir()
+        proc = _run_child(
+            workdir, spec, seed=3, n_events=n_events, pods=pods,
+            batch=batch, checkpoint_every=ck_every,
+        )
+        assert proc.returncode in (137, 0), (spec, proc.stderr)
+        if proc.returncode == 137:
+            kills += 1
+        log = str(workdir / "events.jsonl")
+        res = RecoveryManager(str(workdir / "ck")).recover(
+            log_path=log, initial_cluster=cluster, config=cfg
+        )
+        assert res.duplicates_skipped == 0, spec  # no double application
+        oracle = VerificationService(cluster, cfg)
+        survived = 0
+        for b in EventSource(log).batches(256):
+            oracle.apply(b)
+            survived += len(b)
+        assert res.last_seq == survived - 1 or survived == 0, spec
+        np.testing.assert_array_equal(
+            _reach(res.service), _reach(oracle), err_msg=spec
+        )
+    assert kills >= 20, f"only {kills}/20 runs actually died"
+
+
+# ---------------------------------------------------------------------- CLI
+def _cli_cluster(tmp_path, churn):
+    from kubernetes_verification_tpu.ingest import dump_cluster
+
+    cluster, events, _ = churn
+    mdir = str(tmp_path / "manifests")
+    dump_cluster(cluster, mdir)
+    log = str(tmp_path / "events.jsonl")
+    write_events(events, log, start_seq=0)
+    return mdir, log
+
+
+def test_cli_serve_checkpoint_then_resume(tmp_path, churn, capsys):
+    mdir, log = _cli_cluster(tmp_path, churn)
+    ckdir = str(tmp_path / "ck")
+    rc = main([
+        "serve", mdir, "--events", log, "--checkpoint-dir", ckdir,
+        "--checkpoint-every", "1", "--batch-size", "40", "--json",
+    ])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == EXIT_OK
+    assert out["checkpoints"] >= 2  # periodic + the exit checkpoint
+    pairs = out["reachable_pairs"]
+    rc = main([
+        "serve", mdir, "--events", log, "--checkpoint-dir", ckdir,
+        "--resume", "--json",
+    ])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == EXIT_OK
+    assert out["recovery"]["outcome"] == "newest"
+    assert out["recovery"]["duplicates_skipped"] == 0
+    assert out["reachable_pairs"] == pairs
+
+
+def test_cli_recover_reports_and_exit_codes(tmp_path, churn, capsys):
+    mdir, log = _cli_cluster(tmp_path, churn)
+    ckdir = str(tmp_path / "ck")
+    assert main([
+        "serve", mdir, "--events", log, "--checkpoint-dir", ckdir, "--json",
+    ]) == EXIT_OK
+    capsys.readouterr()
+    rc = main(["recover", ckdir, "--events", log, "--json"])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == EXIT_OK and report["usable"]
+    assert report["generations"][0]["valid"]
+    assert report["wal"]["records"] == 120 and not report["wal"]["torn"]
+    # a torn tail is reported but NOT repaired (read-only triage)
+    with open(log, "a") as fh:
+        fh.write('{"half')
+    size = os.path.getsize(log)
+    rc = main(["recover", ckdir, "--events", log, "--json"])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == EXIT_OK and report["wal"]["torn"]
+    assert os.path.getsize(log) == size
+    # every generation damaged → exit 2
+    for name in os.listdir(ckdir):
+        if name.startswith("manifest"):
+            with open(os.path.join(ckdir, name), "w") as fh:
+                fh.write("junk")
+    assert main(["recover", ckdir, "--json"]) == EXIT_INPUT_ERROR
+    capsys.readouterr()
+    assert main(["recover", str(tmp_path / "nope")]) == EXIT_INPUT_ERROR

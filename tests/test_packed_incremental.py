@@ -335,6 +335,7 @@ def test_checkpoint_flag_mismatch_rejected(tmp_path):
         )
 
 
+@pytest.mark.slow
 def test_checkpoint_resume_with_zero_free_slots(tmp_path):
     """Regression: a checkpoint saved when every capacity slot is occupied
     (growth happens on the NEXT allocation) must resume without the prewarm
@@ -460,7 +461,13 @@ def test_pod_headroom_param():
 @pytest.mark.slow
 def test_fuzzed_pod_and_policy_churn():
     """Interleaved pod add/remove/relabel + policy add/remove/update must
-    track the CPU oracle at every step."""
+    track the CPU oracle at every step.
+
+    Self-validation: the fuzz is only meaningful if the churn actually
+    moves reachability bits — a seed whose ops all no-op would "pass"
+    while exercising nothing, so a floor on changed steps guards the
+    test against silently going vacuous (seed 4 currently changes the
+    matrix on 10 of 16 steps)."""
     cluster = random_cluster(
         GeneratorConfig(n_pods=37, n_policies=6, n_namespaces=3, seed=60)
     )
@@ -471,6 +478,8 @@ def test_fuzzed_pod_and_policy_churn():
     )
     rng = random.Random(4)
     added = 0
+    changed_steps = 0
+    prev = np.asarray(inc.reach_active()).copy()
     for step in range(16):
         op = rng.choice(
             ["add_pod", "rm_pod", "relabel", "add_pol", "rm_pol", "relabel_ns"]
@@ -502,9 +511,18 @@ def test_fuzzed_pod_and_policy_churn():
             inc.update_namespace_labels(
                 tgt.name, {**dict(donor_ns.labels), "fzns": f"s{step}"}
             )
+        cur = np.asarray(inc.reach_active())
         np.testing.assert_array_equal(
-            inc.reach_active(), _oracle_active(inc, cfg), err_msg=f"step {step}"
+            cur, _oracle_active(inc, cfg), err_msg=f"step {step}"
         )
+        if cur.shape != prev.shape or not np.array_equal(cur, prev):
+            changed_steps += 1
+        prev = cur.copy()
+    assert changed_steps >= 8, (
+        f"fuzz went vacuous: only {changed_steps}/16 steps changed the "
+        "reach matrix — the op mix or seed no longer exercises the "
+        "incremental paths"
+    )
 
 
 @pytest.mark.slow

@@ -421,6 +421,7 @@ def test_pairwise_masks_respect_direction_aware_flag():
     assert res.policy_conflict() == ref.policy_conflict()
 
 
+@pytest.mark.slow
 def test_materialize_policy_sets_matches_cpu():
     """The sharded-packed result can materialise the per-policy src/dst
     edge sets on demand (budget-guarded); they equal the CPU oracle's."""
