@@ -1,0 +1,196 @@
+"""``analysis/`` — the rule-based static-analysis framework behind
+``kv-tpu lint``.
+
+One framework, one finding shape, one baseline: every hygiene invariant the
+repo used to police with ad-hoc AST scripts (error taxonomy, bare excepts,
+atomic writes) plus the flow-aware JAX/TPU rules those scripts could never
+express (tracer host-sync leaks inside jit, recompile hazards, concurrency
+hygiene, metric discipline). Pure AST throughout — linting needs no JAX and
+runs on source strings.
+
+Entry points:
+
+* ``kv-tpu lint [PATHS] [--rules ...] [--format json] [--update-baseline]``
+* ``python -m kubernetes_verification_tpu.analysis`` (same flags, headless)
+* :func:`lint_source` / :func:`run_package` for tests and tooling
+
+See ``LINTS.md`` (generated via ``--write-docs``) for the rule catalog and
+the suppression / baseline contract.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Optional, Sequence
+
+from .baseline import (
+    default_baseline_path,
+    load_baseline,
+    over_budget,
+    save_baseline,
+    shrink,
+)
+from .core import (
+    RULES,
+    Finding,
+    LintResult,
+    Rule,
+    lint_source,
+    register,
+    rule_ids,
+    run_lint,
+    run_package,
+)
+from .report import catalog_markdown, check_docs, render_json, render_text
+
+__all__ = [
+    "Finding",
+    "LintResult",
+    "Rule",
+    "RULES",
+    "register",
+    "rule_ids",
+    "lint_source",
+    "run_lint",
+    "run_package",
+    "load_baseline",
+    "save_baseline",
+    "default_baseline_path",
+    "shrink",
+    "over_budget",
+    "catalog_markdown",
+    "render_text",
+    "render_json",
+    "main",
+    "add_lint_arguments",
+]
+
+
+def add_lint_arguments(ap: argparse.ArgumentParser) -> None:
+    """The shared flag surface (``kv-tpu lint`` and ``python -m ...analysis``)."""
+    ap.add_argument(
+        "paths", nargs="*",
+        help="files/directories to lint (default: the installed package)",
+    )
+    ap.add_argument(
+        "--rules", metavar="ID[,ID...]",
+        help="comma-separated rule ids to run (default: all; see --list)",
+    )
+    ap.add_argument(
+        "--format", choices=("text", "json"), default="text",
+        help="finding output format",
+    )
+    ap.add_argument(
+        "--baseline", metavar="PATH", default=None,
+        help="grandfather budgets (default: LINT_BASELINE.json at the repo "
+        "root; missing file = zero budgets everywhere)",
+    )
+    ap.add_argument(
+        "--update-baseline", action="store_true",
+        help="shrink baseline budgets down to the current counts and drop "
+        "cleaned-up entries (budgets may never grow — new findings must "
+        "be fixed or inline-suppressed)",
+    )
+    ap.add_argument(
+        "--list", action="store_true", dest="list_rules",
+        help="print the registered rule ids and exit",
+    )
+    ap.add_argument(
+        "--write-docs", metavar="PATH",
+        help="write the auto-generated LINTS.md rule catalog to PATH",
+    )
+    ap.add_argument(
+        "--check-docs", metavar="PATH",
+        help="exit 1 when PATH drifted from the generated rule catalog",
+    )
+    ap.add_argument(
+        "-v", "--verbose", action="store_true",
+        help="also list grandfathered findings in text output",
+    )
+
+
+def run_from_args(args) -> int:
+    """Drive a lint run from parsed :func:`add_lint_arguments` flags."""
+    if args.list_rules:
+        from .core import _select_rules
+
+        for rule in _select_rules(None):
+            first = rule.rationale.split(". ")[0].rstrip(".").strip()
+            print(f"{rule.id}: {first}.")
+        return 0
+    if args.write_docs:
+        with open(args.write_docs, "w") as fh:  # kvtpu: ignore[atomic-write] regenerated doc, not durable state
+            fh.write(catalog_markdown())
+        print(f"wrote {args.write_docs}")
+        return 0
+    if args.check_docs:
+        problem = check_docs(args.check_docs)
+        if problem:
+            print(problem, file=sys.stderr)
+            return 1
+        print(f"{args.check_docs} is in sync")
+        return 0
+
+    rules = None
+    if args.rules:
+        rules = [r.strip() for r in args.rules.split(",") if r.strip()]
+    baseline_path = args.baseline or default_baseline_path()
+    budgets = load_baseline(baseline_path)
+
+    if args.paths:
+        import os
+
+        sources = {}
+        from .core import iter_package_files
+
+        for p in args.paths:
+            base = os.path.abspath(p)
+            for rel, path in iter_package_files(base):
+                with open(path, "r") as fh:
+                    sources[rel] = fh.read()
+        result = run_lint(sources, rules=rules, baseline=budgets)
+    else:
+        result = run_package(rules=rules, baseline=budgets)
+
+    # lint health is an observable: the findings surface on the same
+    # dashboards as every other kvtpu_* family
+    try:
+        from ..observe.metrics import LINT_FINDINGS_TOTAL
+
+        for f in result.findings:
+            LINT_FINDINGS_TOTAL.labels(rule=f.rule).inc()
+    except ImportError:  # linting outside an installed package tree
+        pass
+
+    if args.update_baseline:
+        new_budgets = shrink(budgets, result)
+        if new_budgets != budgets:
+            save_baseline(new_budgets, baseline_path)
+            print(f"baseline shrunk: {baseline_path}")
+        else:
+            print("baseline already minimal")
+        grew = over_budget(budgets, result)
+        if grew:
+            print(
+                "counts grew past budget (fix or suppress, the baseline "
+                f"never grows): {grew}",
+                file=sys.stderr,
+            )
+            return 1
+        return 0
+
+    if args.format == "json":
+        print(render_json(result))
+    else:
+        print(render_text(result, verbose=args.verbose))
+    return 0 if result.ok else 1
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="kv-tpu lint",
+        description="flow-aware static analysis for the package "
+        "(see LINTS.md for the rule catalog)",
+    )
+    add_lint_arguments(ap)
+    return run_from_args(ap.parse_args(argv))

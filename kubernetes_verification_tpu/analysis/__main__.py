@@ -1,0 +1,11 @@
+"""Headless entry point: ``python -m kubernetes_verification_tpu.analysis``
+runs the same lint driver as ``kv-tpu lint`` (identical flags, identical
+exit codes) without importing the CLI or any backend."""
+from __future__ import annotations
+
+import sys
+
+from . import main
+
+if __name__ == "__main__":
+    sys.exit(main())

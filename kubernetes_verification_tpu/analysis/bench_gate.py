@@ -1,0 +1,76 @@
+"""The bench-history regression gate, relocated from
+``scripts/check_bench_regression.py`` (now a thin shim over this module)
+so every repo gate lives under ``analysis/``.
+
+Compares the newest run of every metric series against the trailing median
+of the previous runs (``observe/history.py``) and exits 1 when a series
+slipped more than ``--tolerance`` (relative). Reads ``bench_history.jsonl``
+when present, else the committed ``BENCH_r*.json`` trajectory snapshots —
+so the gate runs out of the box on a fresh checkout.
+
+``--dry-run`` exercises the full parse-and-compare path but always exits 0:
+tier-1 runs it on every PR so a malformed history entry (or a gate-logic
+regression) fails fast, without making perf noise a test failure.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import Optional, Sequence
+
+from .core import repo_root
+
+__all__ = ["main"]
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "paths", nargs="*",
+        help="history files: JSONL (bench_history.jsonl) and/or whole-file "
+        "JSON snapshots (BENCH_r*.json); default: bench_history.jsonl when "
+        "present, else BENCH_r*.json next to the repo root",
+    )
+    ap.add_argument(
+        "--tolerance", type=float, default=0.25,
+        help="relative slip vs. the trailing median before flagging "
+        "(default 0.25 — the recorded trajectory's ~10%% drift passes, a "
+        "2x slowdown fails)",
+    )
+    ap.add_argument(
+        "--window", type=int, default=5,
+        help="trailing runs the median is taken over (default 5)",
+    )
+    ap.add_argument(
+        "--dry-run", action="store_true",
+        help="parse and report but always exit 0 (the tier-1 CI mode)",
+    )
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    from ..observe.history import (
+        check_regression,
+        default_paths,
+        format_findings,
+        load_runs,
+    )
+
+    paths = args.paths or default_paths(repo_root())
+    runs = load_runs(paths)
+    ok, findings = check_regression(
+        runs, tolerance=args.tolerance, window=args.window
+    )
+    if args.json:
+        print(json.dumps({"ok": ok, "findings": findings}, sort_keys=True))
+    else:
+        print(
+            f"{len(runs)} runs from {len(paths)} file(s), "
+            f"tolerance {args.tolerance:g}, window {args.window}"
+        )
+        print(format_findings(findings))
+    if args.dry_run:
+        if not ok:
+            print("(dry run: regression found but exit forced to 0)")
+        return 0
+    return 0 if ok else 1

@@ -1,0 +1,94 @@
+"""Reporters: text / JSON output for lint runs, plus the auto-generated
+``LINTS.md`` rule catalog (same regime as ``METRICS.md``: the committed
+file is generated, and a drift check fails when the two diverge)."""
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from .core import RULES, LintResult, UNUSED_SUPPRESSION
+
+__all__ = [
+    "render_text",
+    "render_json",
+    "catalog_markdown",
+    "CATALOG_HEADER",
+]
+
+
+def render_text(result: LintResult, verbose: bool = False) -> str:
+    lines = [f.render() for f in result.findings]
+    if verbose and result.grandfathered:
+        lines.append("grandfathered (baseline budget, shrink to clear):")
+        lines += [f"  {f.render()}" for f in result.grandfathered]
+    lines.append(
+        f"{len(result.findings)} finding(s), "
+        f"{len(result.grandfathered)} grandfathered, "
+        f"{len(result.suppressed)} suppressed inline"
+    )
+    return "\n".join(lines)
+
+
+def render_json(result: LintResult) -> str:
+    return json.dumps(result.to_dict(), sort_keys=True)
+
+
+CATALOG_HEADER = """# Lint rule catalog
+
+One section per `kv-tpu lint` rule. Auto-generated from the rule metadata
+by `python -m kubernetes_verification_tpu.analysis --write-docs LINTS.md` —
+edit the `rationale`/`example` strings on the rule classes under
+`kubernetes_verification_tpu/analysis/`, not this file (`--check-docs`
+fails CI when the two drift).
+
+Suppress a finding inline with a trailing comment on the flagged line (or
+a comment-only line directly above it), always with a reason:
+
+```python
+self._fh = open(path, "a")  # kvtpu: ignore[atomic-write] WAL appends are torn-tail tolerant
+```
+
+Stale suppressions are themselves findings (`unused-suppression`).
+Grandfathered legacy counts live in `LINT_BASELINE.json` — budgets may
+shrink (`kv-tpu lint --update-baseline`) but never grow.
+"""
+
+
+def catalog_markdown() -> str:
+    """The LINTS.md body, one section per registered rule."""
+    from . import core  # ensure rule modules are imported
+
+    core._select_rules(None)
+    sections = [CATALOG_HEADER]
+    for rule in RULES.values():
+        sections.append(f"## `{rule.id}`\n")
+        sections.append(rule.rationale.strip() + "\n")
+        if rule.example:
+            sections.append("Flagged:\n")
+            sections.append("```python\n" + rule.example.rstrip() + "\n```\n")
+        sections.append(
+            f"Suppress with `# kvtpu: ignore[{rule.id}] <reason>`.\n"
+        )
+    sections.append(f"## `{UNUSED_SUPPRESSION}`\n")
+    sections.append(
+        "A `# kvtpu: ignore[...]` comment that silenced nothing — the "
+        "finding it covered moved or was fixed. Delete the comment; this "
+        "rule is not itself suppressible, so stale ignores rot loudly.\n"
+    )
+    return "\n".join(sections)
+
+
+def check_docs(path: str) -> Optional[str]:
+    """None when ``path`` matches the generated catalog, else a one-line
+    diagnosis."""
+    try:
+        with open(path) as fh:
+            on_disk = fh.read()
+    except OSError:
+        on_disk = ""
+    if on_disk != catalog_markdown():
+        return (
+            f"{path} is stale — regenerate with `python -m "
+            f"kubernetes_verification_tpu.analysis --write-docs {path}`"
+        )
+    return None

@@ -1,0 +1,536 @@
+"""Flow-aware JAX/TPU rules: host-sync leaks inside jitted code and
+recompile hazards around jit cache keys.
+
+These are the rules the ad-hoc scripts could never express: both need to
+know *which* functions are traced (decorated or wrapped with ``jax.jit`` /
+``shard_map`` / the ``parallel.mesh`` compat wrapper) and *which* values in
+them are tracer-origin. ``jit-host-sync`` runs a small within-function
+dataflow pass: non-static parameters seed a taint set, assignments
+propagate it (to a fixpoint, so loops converge), and attribute reads that
+return static metadata (``.shape``/``.dtype``/``.ndim``/...) *kill* it —
+``int(x.shape[0])`` inside jit is fine, ``int(x[0])`` is a trace-time
+crash. A flagged ``.item()``/``float()``/``np.asarray``/... on a tainted
+value is a host round-trip (or a ``ConcretizationTypeError`` /
+``TracerBoolConversionError``) caught before runtime — the bug class that
+silently destroys the ROADMAP's peak-FLOP/s batched-query target.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple, Union
+
+from .core import FileContext, Finding, Rule, register
+from .rules_hygiene import _dotted, _last_name
+
+FunctionNode = Union[ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda]
+
+#: attribute reads that return host-static metadata, not a tracer — taint
+#: stops here (`x.shape[0]` is a Python int during tracing)
+SHAPE_KILL_ATTRS = frozenset(
+    {"shape", "dtype", "ndim", "size", "itemsize", "nbytes", "weak_type",
+     "aval", "sharding"}
+)
+
+#: builtins that return static values even for tracer operands
+KILL_CALLS = frozenset({"len", "isinstance", "type", "id", "repr"})
+
+#: method calls that force a device→host sync on a traced/deviced value
+SYNC_METHODS = frozenset({"item", "tolist", "block_until_ready"})
+
+#: `bool(x)`/`float(x)`/`int(x)` on a tracer: trace-time crash
+CONCRETIZING_BUILTINS = frozenset({"bool", "float", "int", "complex"})
+
+#: host-materialising calls by dotted name
+HOST_FETCH_CALLS = frozenset({
+    "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+    "onp.asarray", "onp.array", "jax.device_get",
+})
+
+_JIT_NAMES = frozenset({"jit"})
+_WRAPPER_NAMES = frozenset({"shard_map", "pmap", "vmap_of_jit"})
+
+
+def _const_str_set(node: ast.expr) -> Set[str]:
+    """A ``static_argnames`` value → the set of names it pins."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        out: Set[str] = set()
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                out.add(elt.value)
+        return out
+    return set()
+
+
+def _const_int_tuple(node: ast.expr) -> Tuple[int, ...]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(
+            elt.value
+            for elt in node.elts
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, int)
+        )
+    return ()
+
+
+def _param_names(fn: FunctionNode) -> List[str]:
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return names
+
+
+class _JitSite:
+    """One traced function plus the statically-pinned parameter names."""
+
+    def __init__(self, fn: FunctionNode, static: Set[str], argnums: Tuple[int, ...]):
+        self.fn = fn
+        params = _param_names(fn)
+        self.static = set(static)
+        for i in argnums:
+            if 0 <= i < len(params):
+                self.static.add(params[i])
+
+
+def _jit_call_info(call: ast.Call) -> Optional[Tuple[Set[str], Tuple[int, ...]]]:
+    """(static_argnames, static_argnums) when ``call`` is jit-ish
+    (``jax.jit(...)`` or ``partial(jax.jit, ...)``), else None."""
+    name = _last_name(call.func)
+    static: Set[str] = set()
+    argnums: Tuple[int, ...] = ()
+    is_jit = False
+    if name in _JIT_NAMES:
+        is_jit = True
+    elif name == "partial" and call.args:
+        inner = _last_name(call.args[0])
+        if inner in _JIT_NAMES:
+            is_jit = True
+    if not is_jit:
+        return None
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            static |= _const_str_set(kw.value)
+        elif kw.arg == "static_argnums":
+            argnums = _const_int_tuple(kw.value)
+    return static, argnums
+
+
+def _unwrap_traced_target(node: ast.expr) -> Optional[ast.expr]:
+    """Peel ``shard_map(f, ...)`` / ``partial(f, ...)`` wrappers off a jit
+    argument until a Name / Lambda / def reference remains."""
+    seen = 0
+    while isinstance(node, ast.Call) and seen < 8:
+        name = _last_name(node.func)
+        if name in _WRAPPER_NAMES or name == "partial":
+            if not node.args:
+                return None
+            node = node.args[0]
+            seen += 1
+        else:
+            return None if name in _JIT_NAMES else node
+    return node
+
+
+def collect_jit_sites(tree: ast.AST) -> Tuple[List[_JitSite], Dict[str, _JitSite]]:
+    """Every traced function in a module: decorator forms
+    (``@jax.jit`` / ``@partial(jax.jit, static_argnames=...)`` /
+    ``@shard_map``-style wrappers) and call forms
+    (``f2 = jax.jit(shard_map(f, ...))`` / ``jax.jit(lambda x: ...)``).
+    Returns the sites plus a name → site map for call-site rules."""
+    defs_by_name: Dict[str, List[FunctionNode]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(node.name, []).append(node)
+
+    sites: List[_JitSite] = []
+    by_name: Dict[str, _JitSite] = {}
+    covered: Set[int] = set()
+
+    def add(fn: FunctionNode, static: Set[str], argnums: Tuple[int, ...],
+            name: Optional[str] = None) -> None:
+        if id(fn) in covered:
+            return
+        covered.add(id(fn))
+        site = _JitSite(fn, static, argnums)
+        sites.append(site)
+        if name:
+            by_name.setdefault(name, site)
+        elif isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            by_name.setdefault(fn.name, site)
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if _last_name(dec) in _JIT_NAMES | _WRAPPER_NAMES:
+                    add(node, set(), ())
+                elif isinstance(dec, ast.Call):
+                    info = _jit_call_info(dec)
+                    if info is not None:
+                        add(node, *info)
+                    elif _last_name(dec.func) in _WRAPPER_NAMES:
+                        add(node, set(), ())
+        elif isinstance(node, ast.Call):
+            info = _jit_call_info(node)
+            if info is None or not node.args:
+                continue
+            static, argnums = info
+            target = _unwrap_traced_target(node.args[0])
+            if isinstance(target, ast.Lambda):
+                add(target, static, argnums)
+            elif isinstance(target, ast.Name):
+                for fn in defs_by_name.get(target.id, ()):
+                    add(fn, static, argnums, name=target.id)
+
+    # bind `f2 = jax.jit(...)` assignment names so call-site rules can see
+    # through the alias
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Assign) and isinstance(node.value, ast.Call)):
+            continue
+        if _jit_call_info(node.value) is None or not node.value.args:
+            continue
+        target = _unwrap_traced_target(node.value.args[0])
+        bound: Optional[_JitSite] = None
+        if isinstance(target, ast.Name):
+            for fn in defs_by_name.get(target.id, ()):
+                if id(fn) in covered:
+                    bound = next(s for s in sites if s.fn is fn)
+                    break
+        elif isinstance(target, ast.Lambda) and id(target) in covered:
+            bound = next(s for s in sites if s.fn is target)
+        if bound is None:
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name):
+                by_name.setdefault(tgt.id, bound)
+    return sites, by_name
+
+
+class _TaintPass:
+    """Within-function forward dataflow over tracer-origin values."""
+
+    def __init__(self, site: _JitSite):
+        self.fn = site.fn
+        self.tainted: Set[str] = {
+            p for p in _param_names(site.fn) if p not in site.static
+        }
+        # nested defs/lambdas inside a traced function are trace callbacks
+        # (scan/cond/fori bodies): their parameters carry tracers too
+        for node in ast.walk(self.fn):
+            if node is self.fn:
+                continue
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                self.tainted.update(_param_names(node))
+
+    # ---------------------------------------------------------- expression
+    def is_tainted(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Constant):
+            return False
+        if isinstance(node, ast.Attribute):
+            if node.attr in SHAPE_KILL_ATTRS:
+                return False
+            return self.is_tainted(node.value)
+        if isinstance(node, ast.Call):
+            fname = _last_name(node.func)
+            if fname in KILL_CALLS:
+                return False
+            if self.is_tainted(node.func):
+                return True
+            return any(self.is_tainted(a) for a in node.args) or any(
+                self.is_tainted(kw.value) for kw in node.keywords
+            )
+        if isinstance(node, (ast.Lambda, ast.FunctionDef, ast.AsyncFunctionDef)):
+            return False
+        return any(self.is_tainted(c) for c in ast.iter_child_nodes(node))
+
+    # ----------------------------------------------------------- statements
+    def _bind(self, target: ast.expr, value_tainted: bool) -> bool:
+        changed = False
+        if isinstance(target, ast.Name):
+            if value_tainted and target.id not in self.tainted:
+                self.tainted.add(target.id)
+                changed = True
+            elif not value_tainted and target.id in self.tainted:
+                # a host-origin rebind (e.g. `x = np.ones(3)`) kills taint
+                self.tainted.discard(target.id)
+                changed = True
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                changed |= self._bind(elt, value_tainted)
+        elif isinstance(target, ast.Starred):
+            changed |= self._bind(target.value, value_tainted)
+        return changed
+
+    def run(self) -> None:
+        for _ in range(10):  # fixpoint; loops re-taint in later passes
+            changed = False
+            for node in ast.walk(self.fn):
+                if isinstance(node, ast.Assign):
+                    t = self.is_tainted(node.value)
+                    for tgt in node.targets:
+                        changed |= self._bind(tgt, t)
+                elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                    changed |= self._bind(node.target, self.is_tainted(node.value))
+                elif isinstance(node, ast.AugAssign):
+                    t = self.is_tainted(node.target) or self.is_tainted(node.value)
+                    if t and isinstance(node.target, ast.Name):
+                        if node.target.id not in self.tainted:
+                            self.tainted.add(node.target.id)
+                            changed = True
+                elif isinstance(node, ast.NamedExpr):
+                    changed |= self._bind(node.target, self.is_tainted(node.value))
+                elif isinstance(node, ast.For):
+                    changed |= self._bind(node.target, self.is_tainted(node.iter))
+                elif isinstance(node, ast.comprehension):
+                    changed |= self._bind(node.target, self.is_tainted(node.iter))
+                elif isinstance(node, ast.With):
+                    for item in node.items:
+                        if item.optional_vars is not None:
+                            changed |= self._bind(
+                                item.optional_vars,
+                                self.is_tainted(item.context_expr),
+                            )
+            if not changed:
+                break
+
+
+@register
+class JitHostSyncRule(Rule):
+    id = "jit-host-sync"
+    rationale = (
+        "Inside a function traced by `jax.jit`/`shard_map`, a "
+        "`.item()`/`.tolist()`/`bool()`/`float()`/`int()`/`np.asarray`/"
+        "`jax.device_get`/`.block_until_ready()` on a tracer-origin value "
+        "is at best a host round-trip serialising the hot path (the silent "
+        "killer of the peak-FLOP/s batched-query target) and at worst a "
+        "trace-time `ConcretizationTypeError`/`TracerBoolConversionError`. "
+        "The rule runs a within-function dataflow pass: non-static "
+        "parameters seed the tracer set, assignments propagate it, and "
+        "static-metadata reads (`.shape`, `.dtype`, `len()`) kill it — so "
+        "`int(x.shape[0])` passes while `int(x[0])` two assignments later "
+        "is still caught."
+    )
+    example = (
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    y = x * 2\n"
+        "    z = y.sum()\n"
+        "    return z.item()  # host sync inside jit"
+    )
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        sites, _ = collect_jit_sites(ctx.tree)
+        seen: Set[Tuple[int, str]] = set()
+        for site in sites:
+            taint = _TaintPass(site)
+            taint.run()
+            for f in self._scan_sinks(ctx, site, taint):
+                key = (f.line, f.message)
+                if key not in seen:
+                    seen.add(key)
+                    yield f
+
+    def _scan_sinks(self, ctx: FileContext, site: _JitSite, taint: _TaintPass):
+        for node in ast.walk(site.fn):
+            if isinstance(node, ast.Call):
+                fname = _last_name(node.func)
+                dotted = _dotted(node.func)
+                if (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in SYNC_METHODS
+                    and taint.is_tainted(node.func.value)
+                ):
+                    yield Finding(
+                        self.id, ctx.rel, node.lineno,
+                        f".{node.func.attr}() on a tracer-origin value "
+                        "inside a jitted function — device→host sync in "
+                        "the traced hot path; return the array and convert "
+                        "outside jit",
+                    )
+                elif (
+                    isinstance(node.func, ast.Name)
+                    and fname in CONCRETIZING_BUILTINS
+                    and node.args
+                    and taint.is_tainted(node.args[0])
+                ):
+                    yield Finding(
+                        self.id, ctx.rel, node.lineno,
+                        f"{fname}() concretises a tracer inside a jitted "
+                        "function — trace-time ConcretizationTypeError; "
+                        "keep it an array (jnp ops / lax.cond) or hoist "
+                        "out of jit",
+                    )
+                elif dotted in HOST_FETCH_CALLS and (
+                    any(taint.is_tainted(a) for a in node.args)
+                ):
+                    yield Finding(
+                        self.id, ctx.rel, node.lineno,
+                        f"{dotted}() materialises a tracer on the host "
+                        "inside a jitted function — use jnp equivalents "
+                        "or move the fetch outside jit",
+                    )
+            elif isinstance(node, (ast.If, ast.While)) and taint.is_tainted(
+                node.test
+            ):
+                yield Finding(
+                    self.id, ctx.rel, node.lineno,
+                    "Python branch on a tracer inside a jitted function — "
+                    "TracerBoolConversionError at trace time; use "
+                    "jnp.where / lax.cond / lax.while_loop",
+                )
+            elif isinstance(node, ast.Assert) and taint.is_tainted(node.test):
+                yield Finding(
+                    self.id, ctx.rel, node.lineno,
+                    "assert on a tracer inside a jitted function — "
+                    "TracerBoolConversionError at trace time; use "
+                    "checkify or assert on static metadata only",
+                )
+
+
+_KEYISH = ("key", "sig", "cache", "memo")
+
+
+def _contains_shape_attr(node: ast.AST) -> bool:
+    return any(
+        isinstance(n, ast.Attribute) and n.attr == "shape"
+        for n in ast.walk(node)
+    )
+
+
+@register
+class RecompileHazardRule(Rule):
+    id = "recompile-hazard"
+    rationale = (
+        "jit recompiles are the silent latency cliff "
+        "(`kvtpu_jit_recompiles_total` exists to count them at runtime; "
+        "this rule catches the causes statically). Flagged: (1) f-string/"
+        "`str()` of `.shape` used as a cache key — string keys collide "
+        "across dtypes and miss weak_type, so the cache lies about "
+        "recompiles (hash the `abstract_signature` tuple instead); "
+        "(2) `static_argnames` naming a parameter the function does not "
+        "have — the typo'd name is silently never static; (3) a Python "
+        "`float` or an unhashable list/dict/set literal passed for a "
+        "static parameter — every distinct float is a fresh compile cache "
+        "entry (and NaN never hits), unhashables raise at dispatch; "
+        "(4) `tuple(d.values()/items()/keys())` fed straight into a jitted "
+        "call — the signature then depends on dict iteration order "
+        "(`sorted(...)` first)."
+    )
+    example = 'key = f"{x.shape}-{backend}"\n_cache[key] = compiled'
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        sites, by_name = collect_jit_sites(ctx.tree)
+        yield from self._check_shape_keys(ctx)
+        yield from self._check_static_argnames(ctx, sites)
+        yield from self._check_call_sites(ctx, by_name)
+
+    # -------------------------------------------------- str(shape) keys
+    def _check_shape_keys(self, ctx: FileContext):
+        for node in ast.walk(ctx.tree):
+            is_shape_str = (
+                isinstance(node, ast.JoinedStr) and _contains_shape_attr(node)
+            ) or (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "str"
+                and node.args
+                and _contains_shape_attr(node.args[0])
+            )
+            if not is_shape_str:
+                continue
+            if self._used_as_key(ctx, node):
+                yield Finding(
+                    self.id, ctx.rel, node.lineno,
+                    "stringified .shape used as a cache key — collides "
+                    "across dtypes and misses weak_type, so the jit cache "
+                    "lies about recompiles; key on the abstract-signature "
+                    "tuple (observe.jit.abstract_signature) instead",
+                )
+
+    @staticmethod
+    def _used_as_key(ctx: FileContext, node: ast.AST) -> bool:
+        prev: ast.AST = node
+        for anc in ctx.ancestors(node):
+            if isinstance(anc, ast.Subscript) and prev is anc.slice:
+                return True
+            if isinstance(anc, ast.Assign):
+                for tgt in anc.targets:
+                    name = _last_name(tgt) or ""
+                    if any(k in name.lower() for k in _KEYISH):
+                        return True
+            if isinstance(anc, ast.Call) and prev is not anc.func:
+                name = _last_name(anc.func) or ""
+                if any(k in name.lower() for k in _KEYISH):
+                    return True
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return False
+            prev = anc
+        return False
+
+    # --------------------------------------- static_argnames typo check
+    def _check_static_argnames(self, ctx: FileContext, sites: Sequence[_JitSite]):
+        for site in sites:
+            if isinstance(site.fn, ast.Lambda):
+                continue
+            params = set(_param_names(site.fn))
+            unknown = sorted(site.static - params)
+            if unknown:
+                yield Finding(
+                    self.id, ctx.rel, site.fn.lineno,
+                    f"static_argnames {unknown} name no parameter of "
+                    f"{site.fn.name}() — the typo'd arg is silently "
+                    "traced, recompiling on every new value",
+                )
+
+    # ---------------------------------------------- jitted call sites
+    def _check_call_sites(self, ctx: FileContext, by_name: Dict[str, _JitSite]):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = _last_name(node.func)
+            site = by_name.get(callee or "")
+            if site is None:
+                continue
+            for kw in node.keywords:
+                if kw.arg not in site.static:
+                    continue
+                if isinstance(kw.value, ast.Constant) and isinstance(
+                    kw.value.value, float
+                ):
+                    yield Finding(
+                        self.id, ctx.rel, node.lineno,
+                        f"Python float for static arg {kw.arg!r} of "
+                        f"{callee}() — every distinct value is a fresh "
+                        "XLA compile (and NaN never cache-hits); pass it "
+                        "as a traced operand or quantise to int",
+                    )
+                elif isinstance(kw.value, (ast.List, ast.Dict, ast.Set)):
+                    yield Finding(
+                        self.id, ctx.rel, node.lineno,
+                        f"unhashable literal for static arg {kw.arg!r} of "
+                        f"{callee}() — jit static args must be hashable "
+                        "(use a tuple)",
+                    )
+            for arg in node.args:
+                if (
+                    isinstance(arg, ast.Call)
+                    and isinstance(arg.func, ast.Name)
+                    and arg.func.id == "tuple"
+                    and arg.args
+                    and isinstance(arg.args[0], ast.Call)
+                    and isinstance(arg.args[0].func, ast.Attribute)
+                    and arg.args[0].func.attr in ("values", "items", "keys")
+                ):
+                    yield Finding(
+                        self.id, ctx.rel, node.lineno,
+                        f"tuple(dict.{arg.args[0].func.attr}()) passed to "
+                        f"jitted {callee}() — the jit signature then "
+                        "depends on dict iteration order; sorted(...) it "
+                        "first",
+                    )

@@ -1,0 +1,172 @@
+"""Metric-discipline rules: the static twins of the import-time checks in
+``scripts/check_metrics_names.py``.
+
+The shim still validates the *live* registry (names that only exist after
+imports, METRICS.md help-string drift); these rules catch the same bug
+classes at the AST layer, which means they also run on fixture strings and
+on modules the import-based lint never loads.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .core import FileContext, Finding, Rule, register
+from .rules_hygiene import _last_name
+
+METRIC_NAME_RE = re.compile(r"^kvtpu_[a-z0-9_]+$")
+
+#: registry constructor names (observe/registry.py)
+_FAMILY_CLASSES = frozenset({"Counter", "Gauge", "Histogram"})
+
+#: labels per family above which the exposition cardinality explodes:
+#: every label multiplies the child count, and the dashboards key on
+#: stable low-dimensional families
+MAX_LABELS = 3
+
+
+def _registrations(ctx: FileContext) -> List[Tuple[ast.Call, str, Sequence[str]]]:
+    """(call, family-name, labelnames) for every static Counter/Gauge/
+    Histogram construction with a literal name."""
+    out = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _last_name(node.func) not in _FAMILY_CLASSES:
+            continue
+        if not (
+            node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+        ):
+            continue
+        name = node.args[0].value
+        if not name.startswith("kvtpu"):
+            continue  # not ours (fixture helpers, third-party shims)
+        labels: Sequence[str] = ()
+        label_node: Optional[ast.expr] = (
+            node.args[2] if len(node.args) >= 3 else None
+        )
+        for kw in node.keywords:
+            if kw.arg == "labelnames":
+                label_node = kw.value
+        if isinstance(label_node, (ast.Tuple, ast.List)):
+            labels = [
+                e.value
+                for e in label_node.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)
+            ]
+        out.append((node, name, labels))
+    return out
+
+
+def _required_families(ctx: FileContext) -> Optional[Tuple[int, Set[str]]]:
+    """(lineno, names) of a ``REQUIRED_FAMILIES = frozenset({...})`` /
+    set-literal assignment, when this file declares one."""
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(
+            isinstance(t, ast.Name) and t.id == "REQUIRED_FAMILIES"
+            for t in node.targets
+        ):
+            continue
+        value = node.value
+        if (
+            isinstance(value, ast.Call)
+            and _last_name(value.func) == "frozenset"
+            and value.args
+        ):
+            value = value.args[0]
+        if isinstance(value, (ast.Set, ast.Tuple, ast.List)):
+            names = {
+                e.value
+                for e in value.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)
+            }
+            return node.lineno, names
+    return None
+
+
+@register
+class MetricsNamesRule(Rule):
+    id = "metrics-names"
+    rationale = (
+        "Every family registered in the package must match "
+        "`^kvtpu_[a-z0-9_]+$`: the Prometheus/JSON exporter output is a "
+        "frozen contract (dashboards and scrape configs key on these "
+        "names), and one camelCase or un-prefixed family silently forks "
+        "the namespace. Static twin of the import-based lint in "
+        "`scripts/check_metrics_names.py`."
+    )
+    example = 'BAD = Counter("kvtpuBadName", "help")'
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for call, name, _labels in _registrations(ctx):
+            if not METRIC_NAME_RE.match(name):
+                yield Finding(
+                    self.id, ctx.rel, call.lineno,
+                    f"metric family {name!r} does not match "
+                    "^kvtpu_[a-z0-9_]+$ — the exporter namespace is a "
+                    "frozen dashboard contract",
+                )
+
+
+@register
+class MetricDisciplineRule(Rule):
+    id = "metric-discipline"
+    rationale = (
+        "Two failure modes the registry cannot catch at runtime: a family "
+        "emitted somewhere but never added to `REQUIRED_FAMILIES` (the "
+        "dashboard contract) disappears without a failing lint when its "
+        "registration site is later deleted; and a family with too many "
+        "labels multiplies exposition cardinality until scrapes fall over. "
+        f"Bound: at most {MAX_LABELS} labels per family."
+    )
+    example = (
+        'WIDE = Counter("kvtpu_wide_total", "help",\n'
+        '               ("a", "b", "c", "d"))  # 4 labels'
+    )
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for call, name, labels in _registrations(ctx):
+            if len(labels) > MAX_LABELS:
+                yield Finding(
+                    self.id, ctx.rel, call.lineno,
+                    f"family {name!r} declares {len(labels)} labels "
+                    f"({', '.join(labels)}) — exposition cardinality is "
+                    "multiplicative; bound is "
+                    f"{MAX_LABELS}",
+                )
+
+    def check_project(self, ctxs: Sequence[FileContext]) -> Iterable[Finding]:
+        required: Optional[Set[str]] = None
+        req_ctx: Optional[FileContext] = None
+        req_line = 0
+        registered: Dict[str, Tuple[FileContext, int]] = {}
+        for ctx in ctxs:
+            found = _required_families(ctx)
+            if found is not None:
+                req_line, required = found
+                req_ctx = ctx
+            for call, name, _labels in _registrations(ctx):
+                if METRIC_NAME_RE.match(name):
+                    registered.setdefault(name, (ctx, call.lineno))
+        if required is None:
+            return  # nothing to cross-check against (fixture snippets)
+        for name, (ctx, line) in sorted(registered.items()):
+            if name not in required:
+                yield Finding(
+                    self.id, ctx.rel, line,
+                    f"family {name!r} is emitted but never registered in "
+                    "REQUIRED_FAMILIES — it can vanish from the dashboard "
+                    "contract without a failing lint",
+                )
+        for name in sorted(required - set(registered)):
+            yield Finding(
+                self.id, req_ctx.rel, req_line,
+                f"REQUIRED_FAMILIES names {name!r} but no registration "
+                "site declares it — dead contract entry or a renamed "
+                "family",
+            )

@@ -625,7 +625,7 @@ def cmd_explain(args) -> int:
     )
     prog, _, _atoms = build_k8s_program(cluster, kv.VerifyConfig())
     dl = args.out + ".datalog"
-    with open(dl, "w") as fh:
+    with open(dl, "w") as fh:  # kvtpu: ignore[atomic-write] program-text export next to the .npz, regenerated on demand
         fh.write(prog.dump() + "\n")
     print(open(txt).read().rstrip())
     print(f"wrote {args.out}.npz, {txt}, {dl}")
@@ -1190,6 +1190,19 @@ def cmd_metrics(args) -> int:
     return 0
 
 
+def cmd_lint(args) -> int:
+    """``kv-tpu lint``: the analysis framework's driver behind the shared
+    KvTpuError → exit-code contract (a bad --rules id is exit 2, like any
+    other input error)."""
+    from .analysis import run_from_args
+    from .resilience.errors import KvTpuError
+
+    try:
+        return run_from_args(args)
+    except KvTpuError as e:
+        return _diagnose(args, e)
+
+
 def main(argv: Optional[list] = None) -> int:
     ap = argparse.ArgumentParser(prog="kv-tpu", description=__doc__)
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -1465,6 +1478,16 @@ def main(argv: Optional[list] = None) -> int:
         help="live-registry output format",
     )
     p.set_defaults(fn=cmd_metrics)
+
+    p = sub.add_parser(
+        "lint",
+        help="run the flow-aware static analysis over the package "
+        "(rule catalog: LINTS.md; budgets: LINT_BASELINE.json)",
+    )
+    from .analysis import add_lint_arguments
+
+    add_lint_arguments(p)
+    p.set_defaults(fn=cmd_lint)
 
     args = ap.parse_args(argv)
     return args.fn(args)

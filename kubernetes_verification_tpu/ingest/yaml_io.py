@@ -499,7 +499,7 @@ def dump_cluster(cluster: Cluster, directory: Union[str, os.PathLike]) -> List[s
         if not docs:
             return
         p = os.path.join(directory, fname)
-        with open(p, "w") as fh:
+        with open(p, "w") as fh:  # kvtpu: ignore[atomic-write] manifest export into a fresh directory, not durable state
             yaml.safe_dump_all(list(docs), fh, sort_keys=False)
         written.append(p)
 

@@ -2,6 +2,7 @@
 from __future__ import annotations
 
 import json
+import os
 from typing import Optional
 
 from .registry import REGISTRY, MetricsRegistry, _format_le
@@ -28,8 +29,14 @@ def write_metrics(
         body = to_prometheus(reg)
     else:
         body = json.dumps(reg.dump(), indent=2, sort_keys=True) + "\n"
-    with open(path, "w") as fh:
+    # scrape targets read this file concurrently: promote atomically so a
+    # reader never sees a half-written exposition
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
         fh.write(body)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
 
 
 def _escape(value: str) -> str:

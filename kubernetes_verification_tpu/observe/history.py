@@ -45,7 +45,7 @@ def append_run(record: dict, path: str = DEFAULT_HISTORY) -> dict:
     history file, stamping ``ts`` when absent. Returns the stored record."""
     rec = dict(record)
     rec.setdefault("ts", round(time.time(), 3))
-    with open(path, "a") as fh:
+    with open(path, "a") as fh:  # kvtpu: ignore[atomic-write] JSONL append; the gate reader skips undecodable torn lines
         fh.write(json.dumps(rec, sort_keys=True) + "\n")
     return rec
 

@@ -121,7 +121,8 @@ def set_introspection(on: bool) -> None:
     """Force introspection on/off for this process (overrides the
     KVTPU_INTROSPECT env var)."""
     global _enabled
-    _enabled = bool(on)
+    with _lock:
+        _enabled = bool(on)
 
 
 # ------------------------------------------------------------- publishing

@@ -48,6 +48,8 @@ __all__ = [
     "RECOVERIES_TOTAL",
     "WAL_TRUNCATIONS_TOTAL",
     "BREAKER_TRANSITIONS_TOTAL",
+    "LINT_FINDINGS_TOTAL",
+    "REQUIRED_FAMILIES",
 ]
 
 SPAN_SECONDS = Histogram(
@@ -287,4 +289,60 @@ BREAKER_TRANSITIONS_TOTAL = Counter(
     "open/half_open churn instead of burning the fallback chain and "
     "watchdog budget on every solve.",
     ("backend", "to"),
+)
+
+LINT_FINDINGS_TOTAL = Counter(
+    "kvtpu_lint_findings_total",
+    "Non-grandfathered findings reported by `kv-tpu lint` runs in this "
+    "process, by rule id — lint health rides the same dashboards as every "
+    "other kvtpu_* family.",
+    ("rule",),
+)
+
+#: The frozen dashboard contract: families that must exist in every build.
+#: New families are appended here by the PR that introduces them; the
+#: `metrics-names` lint rule and `scripts/check_metrics_names.py` both fail
+#: when one goes missing or a literal registration drifts off the list.
+REQUIRED_FAMILIES = frozenset(
+    {
+        "kvtpu_span_seconds",
+        "kvtpu_verify_total",
+        "kvtpu_pairs_per_second",
+        "kvtpu_bytes_transferred",
+        "kvtpu_closure_iterations_total",
+        "kvtpu_delta_closure_rounds_total",
+        "kvtpu_incremental_ops_total",
+        "kvtpu_stripe_width",
+        "kvtpu_stripes_solved_total",
+        "kvtpu_jit_recompiles_total",
+        "kvtpu_kernel_invocations_total",
+        "kvtpu_kernel_tiles_total",
+        "kvtpu_retries_total",
+        "kvtpu_fallbacks_total",
+        "kvtpu_faults_injected_total",
+        "kvtpu_degradations_total",
+        # introspection layer
+        "kvtpu_hbm_bytes_in_use",
+        "kvtpu_hbm_peak_bytes",
+        "kvtpu_kernel_flops",
+        "kvtpu_kernel_bytes_accessed",
+        "kvtpu_kernel_peak_bytes",
+        "kvtpu_cost_reports_total",
+        # serving layer (serve/)
+        "kvtpu_serve_events_total",
+        "kvtpu_serve_coalesced_total",
+        "kvtpu_serve_batches_total",
+        "kvtpu_serve_solves_total",
+        "kvtpu_serve_queries_total",
+        "kvtpu_serve_assertion_failures_total",
+        "kvtpu_serve_queue_depth",
+        "kvtpu_serve_staleness_seconds",
+        # durability layer (WAL / checkpoints / recovery / breaker)
+        "kvtpu_checkpoints_total",
+        "kvtpu_recoveries_total",
+        "kvtpu_wal_truncations_total",
+        "kvtpu_breaker_transitions_total",
+        # static analysis (analysis/)
+        "kvtpu_lint_findings_total",
+    }
 )

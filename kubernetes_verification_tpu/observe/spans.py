@@ -49,7 +49,7 @@ _memory_hook = None
 def set_memory_hook(hook) -> None:
     """Install (or clear, with None) the span memory snapshot hook."""
     global _memory_hook
-    _memory_hook = hook
+    _memory_hook = hook  # kvtpu: ignore[concurrency-hygiene] single atomic reference rebind; span readers tolerate either value
 
 
 def _memory_bytes():

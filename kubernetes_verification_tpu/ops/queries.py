@@ -96,6 +96,7 @@ def _gram(a: np.ndarray) -> np.ndarray:
         if jax is not None:
             global _gram_device
             if _gram_device is None:
+                # kvtpu: ignore[concurrency-hygiene] idempotent lazy jit cache; a racing rebind compiles the same function twice, harmlessly
                 _gram_device = jax.jit(
                     lambda x: jax.lax.dot_general(
                         x, x, (((1,), (1,)), ((), ())),

@@ -295,6 +295,7 @@ def install_kill_points(
     from ``parse_fault_spec("mid-log-append@7")``); returns the injector
     so a harness can inspect hit counters before the crash."""
     global _KILL_INJECTOR
+    # kvtpu: ignore[concurrency-hygiene] armed by the fuzz harness before any worker thread starts; arm/disarm is single-threaded
     _KILL_INJECTOR = KillPointInjector(rules, seed=seed, exit_code=exit_code)
     return _KILL_INJECTOR
 
@@ -302,7 +303,7 @@ def install_kill_points(
 def clear_kill_points() -> None:
     """Disarm every kill-point (tests; the child process never needs to)."""
     global _KILL_INJECTOR
-    _KILL_INJECTOR = None
+    _KILL_INJECTOR = None  # kvtpu: ignore[concurrency-hygiene] disarm happens on the harness thread after workers join
 
 
 def kill_point(name: str, flush=None) -> None:

@@ -1,78 +1,19 @@
 #!/usr/bin/env python
-"""Bench-history regression gate.
+"""Bench-history regression gate — thin shim.
 
-Compares the newest run of every metric series against the trailing median
-of the previous runs (``observe/history.py``) and exits 1 when a series
-slipped more than ``--tolerance`` (relative). Reads ``bench_history.jsonl``
-when present, else the committed ``BENCH_r*.json`` trajectory snapshots —
-so the gate runs out of the box on a fresh checkout.
-
-``--dry-run`` exercises the full parse-and-compare path but always exits 0:
-tier-1 runs it on every PR so a malformed history entry (or a gate-logic
-regression) fails fast, without making perf noise a test failure.
+The gate itself moved into the package
+(``kubernetes_verification_tpu/analysis/bench_gate.py``) so every repo
+gate lives under ``analysis/``; this script keeps the historical entry
+point, flags and exit codes byte-for-byte (tier-1 invokes ``main`` here).
 """
 from __future__ import annotations
 
-import argparse
-import json
 import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-
-def main(argv=None) -> int:
-    ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument(
-        "paths", nargs="*",
-        help="history files: JSONL (bench_history.jsonl) and/or whole-file "
-        "JSON snapshots (BENCH_r*.json); default: bench_history.jsonl when "
-        "present, else BENCH_r*.json next to the repo root",
-    )
-    ap.add_argument(
-        "--tolerance", type=float, default=0.25,
-        help="relative slip vs. the trailing median before flagging "
-        "(default 0.25 — the recorded trajectory's ~10%% drift passes, a "
-        "2x slowdown fails)",
-    )
-    ap.add_argument(
-        "--window", type=int, default=5,
-        help="trailing runs the median is taken over (default 5)",
-    )
-    ap.add_argument(
-        "--dry-run", action="store_true",
-        help="parse and report but always exit 0 (the tier-1 CI mode)",
-    )
-    ap.add_argument("--json", action="store_true")
-    args = ap.parse_args(argv)
-
-    from kubernetes_verification_tpu.observe.history import (
-        check_regression,
-        default_paths,
-        format_findings,
-        load_runs,
-    )
-
-    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    paths = args.paths or default_paths(root)
-    runs = load_runs(paths)
-    ok, findings = check_regression(
-        runs, tolerance=args.tolerance, window=args.window
-    )
-    if args.json:
-        print(json.dumps({"ok": ok, "findings": findings}, sort_keys=True))
-    else:
-        print(
-            f"{len(runs)} runs from {len(paths)} file(s), "
-            f"tolerance {args.tolerance:g}, window {args.window}"
-        )
-        print(format_findings(findings))
-    if args.dry_run:
-        if not ok:
-            print("(dry run: regression found but exit forced to 0)")
-        return 0
-    return 0 if ok else 1
-
+from kubernetes_verification_tpu.analysis.bench_gate import main  # noqa: E402
 
 if __name__ == "__main__":
     sys.exit(main())

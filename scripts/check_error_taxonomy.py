@@ -1,226 +1,61 @@
 #!/usr/bin/env python
-"""Lint the error taxonomy: package code must raise :class:`KvTpuError`
-subclasses (``resilience/errors.py``), not bare builtins — a bare
-``ValueError`` three layers deep cannot be mapped to the CLI exit-code
-contract (0 ok / 1 violations / 2 input error / 3 backend failure) and
-never carries ``transient``/``kind`` for the retry/fallback driver.
+"""Error-taxonomy / bare-except / atomic-write lint — thin shim.
 
-Pure AST walk — nothing is imported, so the lint runs without JAX. A raise
-is flagged when it is a call or bare reference to a DISALLOWED builtin name,
-unless
-
-* it is a bare re-raise (``raise`` / ``raise e``-where-e-is-caught is NOT
-  distinguished — only builtin *names* are matched, so re-raising a caught
-  variable is always fine),
-* the builtin is ALWAYS_ALLOWED (control-flow/API-misuse idioms the taxonomy
-  deliberately does not absorb: ``SystemExit`` is argparse/CLI vocabulary,
-  ``NotImplementedError`` is the abstract-method contract, ...), or
-* the file is GRANDFATHERED: the engine/model layers raise ``KeyError``/
-  ``ValueError`` as their documented API contract (tests pin those types).
-  The budget per file is the count at adoption time — a grandfathered file
-  may reduce its count but not grow it, so new code everywhere lands on the
-  taxonomy.
-
-A second pass flags bare ``except:`` handlers anywhere in the package —
-they swallow ``KeyboardInterrupt``/``SystemExit`` and hide taxonomy errors
-from the exit-code contract; catch a named type (``Exception`` at the
-broadest) instead. No budget: the package has none and must stay at none.
-
-A third pass enforces the crash-safety discipline in
-``serve/durability.py``: any function that opens a file for writing must
-also call ``os.replace`` (the tmp-file + fsync + rename promotion) —
-a bare ``open(..., "w")`` there is a torn-state bug waiting for a kill
-point, which is exactly what the recovery fuzz harness injects.
-
-Newer layers (``serve/`` and everything after it) are NOT grandfathered —
-they were written on the taxonomy from day one and get a zero budget like
-any other non-listed file.
-
-Run directly (exit 1 on a violation) — tier-1 runs it via
-``tests/test_resilience.py``.
+The checks themselves are now rules in the
+``kubernetes_verification_tpu/analysis/`` framework (``error-taxonomy``,
+``bare-except``, ``atomic-write``); this script keeps the historical entry
+point and exit codes (tier-1 asserts ``check() == []``). The old per-file
+``GRANDFATHERED`` budget table moved to the ``error-taxonomy`` section of
+``LINT_BASELINE.json`` at the repo root (shrink-only), and the old
+``ATOMIC_WRITE_FILES`` allowlist is replaced by inline
+``# kvtpu: ignore[atomic-write] <reason>`` suppressions at each
+torn-tolerant site. Run ``kv-tpu lint`` for the full rule set.
 """
 from __future__ import annotations
 
-import ast
 import os
 import sys
-from typing import Dict, List, Tuple
 
-ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PACKAGE = os.path.join(ROOT, "kubernetes_verification_tpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-#: builtins whose raise sites the taxonomy replaces
-DISALLOWED = frozenset({
-    "ValueError",
-    "RuntimeError",
-    "KeyError",
-    "TypeError",
-    "Exception",
-    "BaseException",
-    "OSError",
-    "IOError",
-    "IndexError",
-    "LookupError",
-    "ArithmeticError",
-})
+from kubernetes_verification_tpu.analysis import (  # noqa: E402
+    load_baseline,
+    run_package,
+)
+from kubernetes_verification_tpu.analysis.baseline import (  # noqa: E402
+    default_baseline_path,
+)
+from kubernetes_verification_tpu.analysis.rules_hygiene import (  # noqa: E402
+    ALWAYS_ALLOWED_RAISES as ALWAYS_ALLOWED,
+    DISALLOWED_RAISES as DISALLOWED,
+)
 
-#: idioms the taxonomy does not absorb (always fine to raise)
-ALWAYS_ALLOWED = frozenset({
-    "SystemExit",
-    "NotImplementedError",
-    "AssertionError",
-    "ImportError",
-    "ModuleNotFoundError",
-    "StopIteration",
-    "AttributeError",
-})
+#: historical name: the per-file raise budgets, now the ``error-taxonomy``
+#: section of LINT_BASELINE.json (shrink-only; see ``kv-tpu lint --help``)
+GRANDFATHERED = dict(
+    load_baseline(default_baseline_path()).get("error-taxonomy", {})
+)
 
-#: path (relative to the package) → builtin-raise budget at adoption time.
-#: These layers expose KeyError/ValueError as their API contract (tier-1
-#: tests pin the types); shrink the numbers as files migrate — never grow.
-GRANDFATHERED: Dict[str, int] = {
-    "backends/sharded_packed.py": 7,
-    "datalog/engine.py": 12,
-    "incremental.py": 6,
-    "models/core.py": 10,
-    "observe/registry.py": 7,
-    "ops/closure.py": 3,
-    "ops/pallas_kernels.py": 4,
-    "ops/tiled.py": 7,
-    "packed_incremental.py": 18,
-    "packed_incremental_ports.py": 7,
-    "parallel/mesh.py": 1,
-    "parallel/packed_sharded.py": 16,
-    # exit_code_for's guard against being handed a non-KvTpuError is the
-    # one place TypeError is the honest signal (caller bug, not input)
-    "resilience/errors.py": 1,
-}
+RULES = ("error-taxonomy", "bare-except", "atomic-write")
 
 
-#: the one file under the atomic-write discipline (package-relative)
-ATOMIC_WRITE_FILES = frozenset({"serve/durability.py"})
-
-#: open() modes that create or mutate bytes on disk
-_WRITE_MODE_CHARS = frozenset("wax+")
-
-
-def _raised_name(node: ast.Raise):
-    exc = node.exc
-    if isinstance(exc, ast.Call) and isinstance(exc.func, ast.Name):
-        return exc.func.id
-    if isinstance(exc, ast.Name):
-        return exc.id
-    return None
-
-
-def scan_file(path: str) -> List[Tuple[int, str]]:
-    with open(path, "r") as fh:
-        tree = ast.parse(fh.read(), filename=path)
-    out = []
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Raise) and node.exc is not None:
-            name = _raised_name(node)
-            if name in DISALLOWED and name not in ALWAYS_ALLOWED:
-                out.append((node.lineno, name))
-    return out
-
-
-def scan_bare_except(path: str) -> List[int]:
-    """Line numbers of ``except:`` handlers with no exception type."""
-    with open(path, "r") as fh:
-        tree = ast.parse(fh.read(), filename=path)
-    return [
-        node.lineno
-        for node in ast.walk(tree)
-        if isinstance(node, ast.ExceptHandler) and node.type is None
-    ]
-
-
-def scan_nonatomic_writes(path: str) -> List[Tuple[int, str]]:
-    """(line, mode) for every ``open()`` with a write mode inside a
-    function that never calls ``os.replace`` — in a crash-safe module
-    every durable write must be promoted atomically, so a bare write is
-    a torn-state bug."""
-    with open(path, "r") as fh:
-        tree = ast.parse(fh.read(), filename=path)
-    out: List[Tuple[int, str]] = []
-    for fn in ast.walk(tree):
-        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            continue
-        opens: List[Tuple[int, str]] = []
-        has_replace = False
-        for node in ast.walk(fn):
-            if not isinstance(node, ast.Call):
-                continue
-            if isinstance(node.func, ast.Name) and node.func.id == "open":
-                mode = "r"
-                if len(node.args) >= 2 and isinstance(
-                    node.args[1], ast.Constant
-                ):
-                    mode = node.args[1].value
-                for kw in node.keywords:
-                    if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
-                        mode = kw.value.value
-                if isinstance(mode, str) and set(mode) & _WRITE_MODE_CHARS:
-                    opens.append((node.lineno, mode))
-            if (
-                isinstance(node.func, ast.Attribute)
-                and node.func.attr == "replace"
-                and isinstance(node.func.value, ast.Name)
-                and node.func.value.id == "os"
-            ):
-                has_replace = True
-        if not has_replace:
-            out += opens
-    return out
-
-
-def check() -> List[str]:
-    problems: List[str] = []
-    for root, dirs, files in os.walk(PACKAGE):
-        dirs[:] = [d for d in dirs if d != "__pycache__"]
-        for fname in sorted(files):
-            if not fname.endswith(".py"):
-                continue
-            path = os.path.join(root, fname)
-            rel = os.path.relpath(path, PACKAGE).replace(os.sep, "/")
-            sites = scan_file(path)
-            problems += [
-                f"{rel}:{line}: bare `except:` — catch a named type "
-                "(Exception at the broadest) so KeyboardInterrupt and "
-                "taxonomy errors are not swallowed"
-                for line in scan_bare_except(path)
-            ]
-            if rel in ATOMIC_WRITE_FILES:
-                problems += [
-                    f"{rel}:{line}: open(..., {mode!r}) in a function "
-                    "without os.replace — durable writes here must use "
-                    "the tmp-file + fsync + os.replace promotion"
-                    for line, mode in scan_nonatomic_writes(path)
-                ]
-            budget = GRANDFATHERED.get(rel)
-            if budget is None:
-                problems += [
-                    f"{rel}:{line}: raise {name}(...) — raise a KvTpuError "
-                    "subclass from resilience/errors.py instead"
-                    for line, name in sites
-                ]
-            elif len(sites) > budget:
-                listing = ", ".join(f"{line}:{name}" for line, name in sites)
-                problems.append(
-                    f"{rel}: {len(sites)} builtin raises exceed the "
-                    f"grandfathered budget of {budget} ({listing}) — new "
-                    "raise sites must use the KvTpuError taxonomy"
-                )
-    return problems
+def check() -> list:
+    """Legacy entry point: non-grandfathered findings as rendered strings;
+    ``tests/test_resilience.py`` asserts it returns []."""
+    result = run_package(
+        rules=list(RULES), baseline=load_baseline(default_baseline_path())
+    )
+    return [f.render() for f in result.findings]
 
 
 def main() -> int:
     problems = check()
+    for p in problems:
+        print(p, file=sys.stderr)
     if problems:
-        print("\n".join(problems), file=sys.stderr)
+        print(f"{len(problems)} problem(s)", file=sys.stderr)
         return 1
-    print("error taxonomy OK")
+    print("error taxonomy lint OK")
     return 0
 
 

@@ -1,22 +1,19 @@
 #!/usr/bin/env python
-"""Lint the metric namespace and maintain the METRICS.md reference.
+"""Metric-namespace lint and METRICS.md maintenance — thin shim.
 
-Three duties (the first two run in tier-1 via ``tests/test_observe.py``):
+``REQUIRED_FAMILIES`` (the frozen dashboard contract) now lives in
+``kubernetes_verification_tpu/observe/metrics.py`` next to the
+registrations it pins, where the static ``metrics-names`` /
+``metric-discipline`` rules of ``kv-tpu lint`` cross-check it without
+importing anything. This script keeps the historical import-based entry
+points and exit codes (tier-1 uses ``check``/``check_required``/
+``docs_markdown``/``main``): the live registry is still the ground truth
+for what actually registered, which a pure AST scan cannot see.
 
-* every family registered at import time must match ``^kvtpu_[a-z0-9_]+$``
-  so the Prometheus/JSON exporter output stays stable (dashboards and
-  scrape configs key on these names);
-* every family in ``REQUIRED_FAMILIES`` must exist — this is the frozen
-  dashboard contract; renaming or dropping one must show up as a failing
-  lint, not a silently-empty panel;
-* ``--write METRICS.md`` regenerates the one-row-per-family reference
-  table from the live registry (name, kind, labels, help);
+* every family registered at import time must match ``^kvtpu_[a-z0-9_]+$``;
+* every family in ``REQUIRED_FAMILIES`` must exist;
+* ``--write METRICS.md`` regenerates the reference table;
   ``--check-docs METRICS.md`` fails when the file drifted from the code.
-
-Importing the modules below covers every registration site: the shared
-families live in ``observe/metrics.py``, and any module that registered a
-private family would do so at its own import. Run directly (exit 1 on a
-bad/missing name).
 """
 from __future__ import annotations
 
@@ -27,55 +24,15 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from kubernetes_verification_tpu.observe.metrics import (  # noqa: E402
+    REQUIRED_FAMILIES,
+)
+
 #: modules that register metric families at import time (observe.metrics is
 #: pulled in transitively, listed anyway so the lint stays explicit)
 MODULES = (
     "kubernetes_verification_tpu.observe",
     "kubernetes_verification_tpu.observe.metrics",
-)
-
-#: the dashboard contract: families that must exist in every build. New
-#: families are appended here by the PR that introduces them.
-REQUIRED_FAMILIES = frozenset(
-    {
-        "kvtpu_span_seconds",
-        "kvtpu_verify_total",
-        "kvtpu_pairs_per_second",
-        "kvtpu_bytes_transferred",
-        "kvtpu_closure_iterations_total",
-        "kvtpu_delta_closure_rounds_total",
-        "kvtpu_incremental_ops_total",
-        "kvtpu_stripe_width",
-        "kvtpu_stripes_solved_total",
-        "kvtpu_jit_recompiles_total",
-        "kvtpu_kernel_invocations_total",
-        "kvtpu_kernel_tiles_total",
-        "kvtpu_retries_total",
-        "kvtpu_fallbacks_total",
-        "kvtpu_faults_injected_total",
-        "kvtpu_degradations_total",
-        # introspection layer
-        "kvtpu_hbm_bytes_in_use",
-        "kvtpu_hbm_peak_bytes",
-        "kvtpu_kernel_flops",
-        "kvtpu_kernel_bytes_accessed",
-        "kvtpu_kernel_peak_bytes",
-        "kvtpu_cost_reports_total",
-        # serving layer (serve/)
-        "kvtpu_serve_events_total",
-        "kvtpu_serve_coalesced_total",
-        "kvtpu_serve_batches_total",
-        "kvtpu_serve_solves_total",
-        "kvtpu_serve_queries_total",
-        "kvtpu_serve_assertion_failures_total",
-        "kvtpu_serve_queue_depth",
-        "kvtpu_serve_staleness_seconds",
-        # durability layer (WAL / checkpoints / recovery / breaker)
-        "kvtpu_checkpoints_total",
-        "kvtpu_recoveries_total",
-        "kvtpu_wal_truncations_total",
-        "kvtpu_breaker_transitions_total",
-    }
 )
 
 DOCS_HEADER = """# Metrics reference

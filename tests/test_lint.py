@@ -1,0 +1,568 @@
+"""The static-analysis framework (``kubernetes_verification_tpu/analysis/``)
+behind ``kv-tpu lint``: one positive/negative fixture pair per rule (pure
+AST — no fixture imports JAX), the package-lints-clean self-check against
+the committed ``LINT_BASELINE.json``, the budget-monotonicity contract,
+inline suppressions, the LINTS.md docs gate, and the script shims."""
+import importlib.util
+import json
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from kubernetes_verification_tpu.analysis import (
+    lint_source,
+    load_baseline,
+    over_budget,
+    render_json,
+    render_text,
+    rule_ids,
+    run_lint,
+    run_package,
+    shrink,
+)
+from kubernetes_verification_tpu.analysis.baseline import default_baseline_path
+from kubernetes_verification_tpu.analysis.core import UNUSED_SUPPRESSION
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        name, REPO / "scripts" / f"{name}.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _lint(src, rules):
+    return lint_source(textwrap.dedent(src), rules=rules)
+
+
+# ------------------------------------------------------- per-rule fixtures
+def test_error_taxonomy_positive_and_negative():
+    bad = _lint('def f():\n    raise ValueError("bad tile")\n',
+                ["error-taxonomy"])
+    assert [f.rule for f in bad] == ["error-taxonomy"]
+    assert bad[0].line == 2
+    ok = _lint(
+        """
+        from kubernetes_verification_tpu.resilience.errors import ConfigError
+
+        def f():
+            raise ConfigError("bad tile")
+
+        def g():
+            raise NotImplementedError  # ALWAYS_ALLOWED idiom
+        """,
+        ["error-taxonomy"],
+    )
+    assert ok == []
+
+
+def test_bare_except_positive_and_negative():
+    bad = _lint(
+        """
+        def f():
+            try:
+                g()
+            except:
+                pass
+        """,
+        ["bare-except"],
+    )
+    assert [f.rule for f in bad] == ["bare-except"]
+    ok = _lint(
+        """
+        def f():
+            try:
+                g()
+            except Exception:
+                pass
+        """,
+        ["bare-except"],
+    )
+    assert ok == []
+
+
+def test_atomic_write_positive_and_negative():
+    bad = _lint(
+        """
+        def save(path, body):
+            with open(path, "w") as fh:
+                fh.write(body)
+        """,
+        ["atomic-write"],
+    )
+    assert [f.rule for f in bad] == ["atomic-write"]
+    ok = _lint(
+        """
+        import os
+
+        def save(path, body):
+            tmp = path + ".tmp"
+            with open(tmp, "w") as fh:
+                fh.write(body)
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, path)
+        """,
+        ["atomic-write"],
+    )
+    assert ok == []
+
+
+def test_atomic_write_does_not_double_count_nested_defs():
+    # the nested def's open belongs to the nested def only
+    bad = _lint(
+        """
+        def outer(path):
+            def inner():
+                with open(path, "w") as fh:
+                    fh.write("x")
+            inner()
+        """,
+        ["atomic-write"],
+    )
+    assert len(bad) == 1
+
+
+def test_concurrency_hygiene_thread_daemon():
+    bad = _lint(
+        """
+        import threading
+
+        def start():
+            t = threading.Thread(target=run)
+            t.start()
+        """,
+        ["concurrency-hygiene"],
+    )
+    assert [f.rule for f in bad] == ["concurrency-hygiene"]
+    assert "daemon" in bad[0].message
+    ok = _lint(
+        """
+        import threading
+
+        def start():
+            t = threading.Thread(target=run, daemon=True)
+            t.start()
+        """,
+        ["concurrency-hygiene"],
+    )
+    assert ok == []
+
+
+def test_concurrency_hygiene_subclass_and_acquire_and_globals():
+    bad = _lint(
+        """
+        import threading
+
+        _state = None
+        _lock = threading.Lock()
+
+        class Worker(threading.Thread):
+            def __init__(self):
+                super().__init__(name="w")
+
+        def set_state(v):
+            global _state
+            _state = v
+
+        def risky():
+            _lock.acquire()
+        """,
+        ["concurrency-hygiene"],
+    )
+    assert len(bad) == 3, [f.render() for f in bad]
+    msgs = "\n".join(f.message for f in bad)
+    assert "daemon=True" in msgs and "acquire" in msgs and "_state" in msgs
+    ok = _lint(
+        """
+        import threading
+
+        _state = None
+        _lock = threading.Lock()
+
+        class Worker(threading.Thread):
+            def __init__(self):
+                super().__init__(name="w", daemon=True)
+
+        def set_state(v):
+            global _state
+            with _lock:
+                _state = v
+
+        def safe():
+            with _lock:
+                pass
+        """,
+        ["concurrency-hygiene"],
+    )
+    assert ok == [], [f.render() for f in ok]
+
+
+def test_jit_host_sync_dataflow_acceptance():
+    # the acceptance criterion: a tracer-origin .item() TWO assignments
+    # away from the jitted boundary is flagged; the same call on a host
+    # array passes
+    bad = _lint(
+        """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            y = x * 2
+            z = jnp.sum(y)
+            return z.item()
+        """,
+        ["jit-host-sync"],
+    )
+    assert [f.rule for f in bad] == ["jit-host-sync"]
+    assert ".item()" in bad[0].message
+    ok = _lint(
+        """
+        import numpy as np
+
+        def g():
+            h = np.ones(3)
+            s = h.sum()
+            return s.item()
+        """,
+        ["jit-host-sync"],
+    )
+    assert ok == []
+
+
+def test_jit_host_sync_shape_kills_taint_and_branch_flags():
+    findings = _lint(
+        """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("tile",))
+        def f(x, tile):
+            n = int(x.shape[0])          # fine: shape is static metadata
+            if tile > 128:               # fine: tile is static
+                n += 1
+            if x.sum() > 0:              # TracerBoolConversionError
+                n += 2
+            return x * n
+        """,
+        ["jit-host-sync"],
+    )
+    assert len(findings) == 1
+    assert findings[0].line == 10
+    assert "branch on a tracer" in findings[0].message
+
+
+def test_jit_host_sync_sees_through_shard_map_wrapping():
+    bad = _lint(
+        """
+        import jax
+        from kubernetes_verification_tpu.parallel.mesh import shard_map
+
+        def _kernel(a):
+            c = a @ a
+            return float(c[0, 0])
+
+        solve = jax.jit(shard_map(_kernel, mesh=None))
+        """,
+        ["jit-host-sync"],
+    )
+    assert [f.rule for f in bad] == ["jit-host-sync"]
+    assert "float()" in bad[0].message
+
+
+def test_recompile_hazard_shape_string_key():
+    bad = _lint(
+        """
+        _cache = {}
+
+        def lookup(x, backend):
+            key = f"{x.shape}-{backend}"
+            return _cache[key]
+        """,
+        ["recompile-hazard"],
+    )
+    assert [f.rule for f in bad] == ["recompile-hazard"]
+    ok = _lint(
+        """
+        _cache = {}
+
+        def lookup(x, backend):
+            key = (tuple(x.shape), x.dtype, backend)
+            return _cache[key]
+        """,
+        ["recompile-hazard"],
+    )
+    assert ok == []
+
+
+def test_recompile_hazard_static_argnames_typo_and_bad_static_values():
+    bad = _lint(
+        """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("tiel",))
+        def f(x, tile):
+            return x * tile
+        """,
+        ["recompile-hazard"],
+    )
+    assert len(bad) == 1 and "tiel" in bad[0].message
+    bad = _lint(
+        """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("tol",))
+        def f(x, tol):
+            return x * tol
+
+        def caller(x):
+            return f(x, tol=0.25)
+        """,
+        ["recompile-hazard"],
+    )
+    assert len(bad) == 1 and "float" in bad[0].message
+    bad = _lint(
+        """
+        import jax
+
+        def f(a, b):
+            return a + b
+
+        g = jax.jit(f)
+
+        def caller(d):
+            return g(tuple(d.values()))
+        """,
+        ["recompile-hazard"],
+    )
+    assert len(bad) == 1 and "iteration order" in bad[0].message
+
+
+def test_metrics_names_rule():
+    bad = _lint(
+        'from registry import Counter\n'
+        'BAD = Counter("kvtpuBadName", "help")\n',
+        ["metrics-names"],
+    )
+    assert [f.rule for f in bad] == ["metrics-names"]
+    ok = _lint(
+        'from registry import Counter\n'
+        'GOOD = Counter("kvtpu_good_total", "help")\n',
+        ["metrics-names"],
+    )
+    assert ok == []
+
+
+def test_metric_discipline_label_cardinality():
+    bad = _lint(
+        'from registry import Counter\n'
+        'WIDE = Counter("kvtpu_wide_total", "help", ("a", "b", "c", "d"))\n',
+        ["metric-discipline"],
+    )
+    assert [f.rule for f in bad] == ["metric-discipline"]
+    ok = _lint(
+        'from registry import Counter\n'
+        'OK = Counter("kvtpu_ok_total", "help", ("a", "b", "c"))\n',
+        ["metric-discipline"],
+    )
+    assert ok == []
+
+
+def test_metric_discipline_required_families_cross_check():
+    # family registered but missing from REQUIRED_FAMILIES → flagged, and
+    # a dead REQUIRED_FAMILIES entry → flagged (both directions)
+    src = textwrap.dedent(
+        """
+        from registry import Counter
+
+        A = Counter("kvtpu_a_total", "help")
+        B = Counter("kvtpu_b_total", "help")
+
+        REQUIRED_FAMILIES = frozenset({"kvtpu_a_total", "kvtpu_gone_total"})
+        """
+    )
+    findings = run_lint({"m.py": src}, rules=["metric-discipline"]).findings
+    msgs = "\n".join(f.message for f in findings)
+    assert "kvtpu_b_total" in msgs and "kvtpu_gone_total" in msgs
+    assert len(findings) == 2
+
+
+# --------------------------------------------------- suppressions / stale
+def test_inline_suppression_silences_and_counts():
+    src = textwrap.dedent(
+        """
+        def save(path, body):
+            with open(path, "w") as fh:  # kvtpu: ignore[atomic-write] throwaway export
+                fh.write(body)
+        """
+    )
+    result = run_lint({"m.py": src}, rules=["atomic-write"])
+    assert result.findings == []
+    assert len(result.suppressed) == 1
+
+
+def test_suppression_on_own_line_covers_next_line():
+    src = textwrap.dedent(
+        """
+        def save(path, body):
+            # kvtpu: ignore[atomic-write] throwaway export
+            with open(path, "w") as fh:
+                fh.write(body)
+        """
+    )
+    result = run_lint({"m.py": src}, rules=["atomic-write"])
+    assert result.findings == [] and len(result.suppressed) == 1
+
+
+def test_unused_suppression_is_itself_a_finding():
+    src = "x = 1  # kvtpu: ignore[bare-except] nothing here\n"
+    findings = run_lint({"m.py": src}).findings
+    assert [f.rule for f in findings] == [UNUSED_SUPPRESSION]
+
+
+def test_suppression_pattern_in_string_literal_is_not_a_suppression():
+    src = 'DOC = "# kvtpu: ignore[bare-except] example syntax"\n'
+    assert run_lint({"m.py": src}).findings == []
+
+
+def test_unknown_rule_id_raises_config_error():
+    from kubernetes_verification_tpu.resilience.errors import ConfigError
+
+    with pytest.raises(ConfigError):
+        lint_source("x = 1\n", rules=["no-such-rule"])
+
+
+# ------------------------------------------------- package + baseline gates
+def test_package_lints_clean_against_committed_baseline():
+    result = run_package(baseline=load_baseline(default_baseline_path()))
+    assert result.ok, "\n" + "\n".join(f.render() for f in result.findings)
+
+
+def test_baseline_budgets_are_monotone():
+    # no grandfathered file may grow past its committed budget, and shrink
+    # never raises a number or adds an entry
+    budgets = load_baseline(default_baseline_path())
+    assert budgets, "LINT_BASELINE.json must exist with the adopted budgets"
+    result = run_package(baseline=budgets)
+    assert over_budget(budgets, result) == {}
+    shrunk = shrink(budgets, result)
+    for rule, files in shrunk.items():
+        for rel, n in files.items():
+            assert n <= budgets[rule][rel]
+    for rule in shrunk:
+        assert rule in budgets
+
+
+def test_every_registered_rule_has_catalog_metadata():
+    from kubernetes_verification_tpu.analysis.core import RULES, _select_rules
+
+    _select_rules(None)  # force rule-module registration
+    assert len(RULES) >= 8
+    for rule in RULES.values():
+        assert rule.id and rule.rationale and rule.example
+
+
+# ------------------------------------------------------------- reporters
+def test_reporters_text_and_json():
+    src = 'def f():\n    raise ValueError("x")\n'
+    result = run_lint({"m.py": src}, rules=["error-taxonomy"])
+    text = render_text(result)
+    assert "m.py:2: [error-taxonomy]" in text
+    assert "1 finding(s)" in text
+    payload = json.loads(render_json(result))
+    assert payload["ok"] is False
+    assert payload["findings"][0]["rule"] == "error-taxonomy"
+    assert payload["counts"]["error-taxonomy"]["m.py"] == 1
+
+
+# ------------------------------------------------------------ CLI surface
+def test_lint_cli_exits_zero_on_package_and_one_on_bad_fixture(tmp_path, capsys):
+    from kubernetes_verification_tpu.analysis import main
+
+    assert main([]) == 0
+    capsys.readouterr()
+    bad = tmp_path / "bad.py"
+    bad.write_text('def f():\n    raise ValueError("x")\n')
+    assert main([str(bad)]) == 1
+    out = capsys.readouterr().out
+    assert "[error-taxonomy]" in out
+
+
+def test_lint_cli_json_format(tmp_path, capsys):
+    from kubernetes_verification_tpu.analysis import main
+
+    bad = tmp_path / "bad.py"
+    bad.write_text("try:\n    pass\nexcept:\n    pass\n")
+    assert main([str(bad), "--format", "json"]) == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["findings"][0]["rule"] == "bare-except"
+
+
+def test_lint_cli_update_baseline_only_shrinks(tmp_path, capsys):
+    from kubernetes_verification_tpu.analysis import main
+
+    f = tmp_path / "m.py"
+    f.write_text('def f():\n    raise ValueError("x")\n')
+    base = tmp_path / "LINT_BASELINE.json"
+    # an over-generous budget shrinks to the observed count
+    base.write_text(json.dumps({"error-taxonomy": {"m.py": 5}}))
+    assert main([str(tmp_path), "--baseline", str(base),
+                 "--update-baseline"]) == 0
+    capsys.readouterr()
+    assert json.loads(base.read_text()) == {"error-taxonomy": {"m.py": 1}}
+    # a count past budget is never absorbed: exit 1, and the entry is
+    # dropped (a zero budget equals no entry), never raised to the count
+    base.write_text(json.dumps({"error-taxonomy": {"m.py": 0}}))
+    f.write_text('def f():\n    raise ValueError("x")\n')
+    assert main([str(tmp_path), "--baseline", str(base),
+                 "--update-baseline"]) == 1
+    capsys.readouterr()
+    assert json.loads(base.read_text()) == {}
+
+
+def test_kv_tpu_lint_subcommand_and_exit_code_contract(capsys):
+    from kubernetes_verification_tpu.cli import main as cli_main
+    from kubernetes_verification_tpu.resilience.errors import EXIT_INPUT_ERROR
+
+    assert cli_main(["lint", "--rules", "error-taxonomy,bare-except"]) == 0
+    capsys.readouterr()
+    assert cli_main(["lint", "--rules", "no-such-rule"]) == EXIT_INPUT_ERROR
+    err = capsys.readouterr().err
+    assert "ConfigError" in err and "no-such-rule" in err
+
+
+def test_lints_md_docs_in_sync(capsys):
+    from kubernetes_verification_tpu.analysis import main
+
+    assert main(["--check-docs", str(REPO / "LINTS.md")]) == 0
+
+
+def test_lint_findings_metric_family_exists():
+    from kubernetes_verification_tpu.observe import REGISTRY
+    from kubernetes_verification_tpu.observe.metrics import (
+        LINT_FINDINGS_TOTAL,
+        REQUIRED_FAMILIES,
+    )
+
+    assert "kvtpu_lint_findings_total" in REQUIRED_FAMILIES
+    assert REGISTRY.get("kvtpu_lint_findings_total") is not None
+
+
+# ------------------------------------------------------------ script shims
+def test_error_taxonomy_shim_matches_framework():
+    mod = _load_script("check_error_taxonomy")
+    assert mod.check() == []
+    # the historical tables survive the shim conversion
+    assert "ValueError" in mod.DISALLOWED
+    assert "NotImplementedError" in mod.ALWAYS_ALLOWED
+    assert mod.GRANDFATHERED  # budgets now live in LINT_BASELINE.json
+    baseline = load_baseline(default_baseline_path())
+    assert mod.GRANDFATHERED == baseline["error-taxonomy"]
