@@ -885,6 +885,174 @@ def bench_serve(args) -> None:
     )
 
 
+def bench_query(args) -> None:
+    """Batched query engine throughput: answer a mixed probe workload (95%%
+    any-port with an 80/20 hot-source skew, 5%% port-refined on a
+    hot-pair set) through
+    ``QueryEngine.can_reach_batch`` — one jitted device dispatch per batch,
+    generation-keyed row/port caching — against a loop of scalar
+    ``can_reach`` calls over the same distribution. Headline value is
+    steady-state queries/s on a dirty engine (the serving regime: churn has
+    invalidated the reach derivation and the batch answers from gathered
+    rows without paying a full solve); per-batch p50/p99 latency, the
+    cold-cache and post-churn figures, and the measured scalar comparison
+    ride along."""
+    import jax
+    import numpy as np
+
+    from kubernetes_verification_tpu.harness.generate import (
+        GeneratorConfig,
+        random_cluster,
+        random_event_stream,
+    )
+    from kubernetes_verification_tpu.serve import (
+        QueryEngine,
+        VerificationService,
+    )
+
+    dev = jax.devices()[0]
+    log(f"device: {dev} ({jax.default_backend()})")
+    n = args.pods
+    t0 = time.perf_counter()
+    cluster = random_cluster(
+        GeneratorConfig(
+            n_pods=n, n_policies=args.policies, n_namespaces=args.namespaces,
+            p_ipblock_peer=0.0, min_selector_labels=1, seed=0,
+        )
+    )
+    events = random_event_stream(cluster, n_events=128, seed=5)
+    t1 = time.perf_counter()
+    svc = VerificationService(cluster)
+    svc.reach()  # engine init + first derive: compiles out of steady figures
+    q = QueryEngine(svc)
+    pods = svc.engine.pods
+    ref = lambda i: f"{pods[i % n].namespace}/{pods[i % n].name}"
+    t2 = time.perf_counter()
+    log(f"generate {t1 - t0:.1f}s  service init+first solve {t2 - t1:.1f}s")
+
+    # mixed workload, the admission-control shape: 95% any-port probes
+    # whose sources follow an 80/20 hot-set skew (service traffic
+    # concentrates on a few hundred frontends; destinations stay uniform —
+    # a cached row answers every destination of its source), plus 5%
+    # port-refined probes drawn from 16 hot (src, dst) pairs x 3 ports
+    rng = np.random.default_rng(7)
+    hot = [(int(a), int(b)) for a, b in rng.integers(0, n, (16, 2))]
+    hot_ports = (80, 443, 5432)
+    hot_src = rng.integers(0, n, min(512, n))
+    sub = 512
+    n_batches = max(2, args.n_queries // sub)
+
+    def make_batch(seed: int):
+        rs = np.random.default_rng(1000 + seed)
+        out = []
+        for _ in range(sub):
+            if rs.random() < 0.05:
+                s, d = hot[int(rs.integers(len(hot)))]
+                out.append(
+                    (ref(s), ref(d), int(rs.choice(hot_ports)), "TCP")
+                )
+            else:
+                if rs.random() < 0.8:
+                    s = int(hot_src[int(rs.integers(hot_src.size))])
+                else:
+                    s = int(rs.integers(n))
+                out.append((ref(s), ref(int(rs.integers(n)))))
+        return out
+
+    batches = [make_batch(k) for k in range(n_batches)]
+    svc.apply(events[:64])  # dirty the engine: the serving-regime state
+    q.can_reach_batch(batches[0])  # kernel compiles + cache fill
+    # cold figure: a fresh engine's first batch on the warm jit caches —
+    # all rows miss, one device dispatch, port groups solved once
+    qc = QueryEngine(svc)
+    s = time.perf_counter()
+    qc.can_reach_batch(batches[0])
+    cold_s = time.perf_counter() - s
+    # steady state: warm generation-keyed cache, engine still dirty
+    lat = []
+    s_all = time.perf_counter()
+    for b in batches:
+        s = time.perf_counter()
+        q.can_reach_batch(b)
+        lat.append(time.perf_counter() - s)
+    wall = time.perf_counter() - s_all
+    n_timed = n_batches * sub
+    value = n_timed / wall
+    lat_sorted = sorted(lat)
+    p50 = lat_sorted[len(lat_sorted) // 2]
+    p99 = lat_sorted[min(len(lat_sorted) - 1, int(len(lat_sorted) * 0.99))]
+    batch_band = _band(lat)
+    log(
+        f"{n_timed} mixed queries in {wall * 1e3:.1f}ms = {value:,.0f} "
+        f"queries/s (batch={sub}: p50 {p50 * 1e3:.2f}ms p99 "
+        f"{p99 * 1e3:.2f}ms; cold batch {cold_s * 1e3:.1f}ms)"
+    )
+
+    # scalar comparator on the SAME distribution, measured per call. The
+    # scalar loop is given its best case: the first can_reach pays the
+    # full lazy solve (excluded), every later any-port call reads the
+    # clean matrix. Blend per the 95/5 workload mix.
+    q.can_reach(ref(0), ref(1))  # pays the solve; now clean
+    sc_any = []
+    rs = np.random.default_rng(2)
+    for _ in range(512):
+        a, b = rs.integers(0, n, 2)
+        s = time.perf_counter()
+        q.can_reach(ref(int(a)), ref(int(b)))
+        sc_any.append(time.perf_counter() - s)
+    sc_port = []
+    for k in range(4):
+        hs, hd = hot[k]
+        s = time.perf_counter()
+        q.can_reach(ref(hs), ref(hd), port=hot_ports[k % 3])
+        sc_port.append(time.perf_counter() - s)
+    any_med = sorted(sc_any)[len(sc_any) // 2]
+    port_med = sorted(sc_port)[len(sc_port) // 2]
+    scalar_per_query = 0.95 * any_med + 0.05 * port_med
+    scalar_qps = 1.0 / scalar_per_query
+    speedup = value / scalar_qps
+    speedup_any = value * any_med
+    log(
+        f"scalar loop: any-port {any_med * 1e6:.1f}us/query, ported "
+        f"{port_med * 1e3:.1f}ms/query -> blended {scalar_qps:,.0f} "
+        f"queries/s; batched speedup {speedup:.0f}x "
+        f"(vs pure any-port loop {speedup_any:.0f}x)"
+    )
+
+    # post-churn rider: another applied batch bumps the generation, the
+    # cache drops, and the next batch re-gathers rows on the dirty engine
+    svc.apply(events[64:])
+    s = time.perf_counter()
+    q.can_reach_batch(batches[0])
+    churn_s = time.perf_counter() - s
+    log(f"first batch after churn (cache invalidated): {churn_s * 1e3:.1f}ms")
+    _emit(
+        {
+            "metric": (
+                f"batched queries_per_second: mixed 95/5 any-port/ported "
+                f"can_reach_batch, {n} pods / {args.policies} policies, "
+                f"batch {sub}, 1 chip"
+            ),
+            "value": round(value, 1),
+            "unit": "queries/s",
+            # ROADMAP target: >=100k queries/s on one chip
+            "vs_baseline": round(value / 100_000.0, 4),
+            "batch_band": batch_band,
+            "p50_ms": round(p50 * 1e3, 3),
+            "p99_ms": round(p99 * 1e3, 3),
+            "cold_batch_ms": round(cold_s * 1e3, 2),
+            "post_churn_batch_ms": round(churn_s * 1e3, 2),
+            "scalar_any_us": round(any_med * 1e6, 2),
+            "scalar_ported_ms": round(port_med * 1e3, 2),
+            "scalar_queries_per_s": round(scalar_qps, 1),
+            "speedup_vs_scalar": round(speedup, 1),
+            "speedup_vs_scalar_any_port": round(speedup_any, 1),
+            "compile_s": round(t2 - t1, 2),
+            "steady_s": round(batch_band["median_s"], 4),
+        }
+    )
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--pods", type=int, default=None)
@@ -895,7 +1063,7 @@ def main() -> None:
         "--mode",
         choices=(
             "tiled", "k8s", "kano", "incremental", "closure", "stripe",
-            "headtohead", "serve",
+            "headtohead", "serve", "query",
         ),
         default="tiled",
         help="tiled = the BASELINE north-star config (100k pods / 10k "
@@ -906,7 +1074,10 @@ def main() -> None:
         "share; --full-sweep runs ALL dst tiles with an oracle cross-check); "
         "headtohead = interleaved xla-vs-pallas kernel A/B with bands; "
         "serve = churn event stream through the coalescing verification "
-        "service with interleaved queries (events/s + query latency)",
+        "service with interleaved queries (events/s + query latency); "
+        "query = mixed any-port/ported probe batches through "
+        "QueryEngine.can_reach_batch vs a scalar can_reach loop "
+        "(queries/s + per-batch p50/p99)",
     )
     ap.add_argument(
         "--full-sweep", action="store_true",
@@ -944,6 +1115,11 @@ def main() -> None:
         help="serve mode: length of the generated churn event stream",
     )
     ap.add_argument(
+        "--n-queries", type=int, default=8_192,
+        help="query mode: total probes in the timed steady-state workload "
+        "(answered in sub-batches of 512)",
+    )
+    ap.add_argument(
         "--introspect",
         action="store_true",
         help="lower+compile each dispatched kernel once per signature and "
@@ -961,11 +1137,13 @@ def main() -> None:
         args.pods = {
             "tiled": 100_000, "incremental": 100_000, "closure": 100_000,
             "stripe": 1_000_000, "headtohead": 100_000, "serve": 1_024,
+            "query": 10_000,
         }.get(args.mode, 10_000)
     if args.policies is None:
         args.policies = {
             "tiled": 10_000, "incremental": 10_000, "closure": 10_000,
             "stripe": 512, "headtohead": 10_000, "serve": 256,
+            "query": 1_000,
         }.get(args.mode, 1_000)
 
     import jax
@@ -982,6 +1160,8 @@ def main() -> None:
         return bench_headtohead(args)
     if args.mode == "serve":
         return bench_serve(args)
+    if args.mode == "query":
+        return bench_query(args)
 
     from kubernetes_verification_tpu.encode.encoder import (
         encode_cluster,

@@ -1051,7 +1051,7 @@ def cmd_query(args) -> int:
 
 
 def _run_query(args) -> int:
-    from .resilience.errors import EXIT_OK, EXIT_VIOLATIONS
+    from .resilience.errors import EXIT_OK, EXIT_VIOLATIONS, IngestError
     from .serve import (
         AddPolicy,
         QueryEngine,
@@ -1075,6 +1075,69 @@ def _run_query(args) -> int:
             "src": src, "dst": dst, "port": args.port,
             "protocol": args.protocol if args.port is not None else None,
             "allowed": ok,
+        }
+    if getattr(args, "batch", None):
+        probes = []
+        try:
+            with open(args.batch) as fh:
+                lines = fh.read().splitlines()
+        except OSError as e:
+            raise IngestError(
+                f"cannot read query batch {args.batch}: {e}"
+            ) from e
+        for ln_no, line in enumerate(lines, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except ValueError as e:
+                raise IngestError(
+                    f"{args.batch}:{ln_no}: not valid JSON: {e}"
+                ) from e
+            if not isinstance(obj, dict) or "src" not in obj or "dst" not in obj:
+                raise IngestError(
+                    f"{args.batch}:{ln_no}: each probe needs 'src' and "
+                    "'dst' (optional: 'port', 'protocol')"
+                )
+            unknown = set(obj) - {"src", "dst", "port", "protocol"}
+            if unknown:
+                raise IngestError(
+                    f"{args.batch}:{ln_no}: unknown field(s) "
+                    f"{sorted(unknown)}"
+                )
+            port = obj.get("port")
+            if port is not None:
+                try:
+                    port = int(port)
+                except (TypeError, ValueError):
+                    raise IngestError(
+                        f"{args.batch}:{ln_no}: port must be an integer, "
+                        f"got {obj['port']!r}"
+                    ) from None
+            probes.append(
+                (
+                    str(obj["src"]),
+                    str(obj["dst"]),
+                    port,
+                    str(obj.get("protocol", "TCP")),
+                )
+            )
+        answers = q.can_reach_batch(probes)
+        out["batch"] = {
+            "file": args.batch,
+            "n": len(probes),
+            "allowed": int(answers.sum()),
+            "results": [
+                {
+                    "src": s,
+                    "dst": d,
+                    "port": p,
+                    "protocol": proto if p is not None else None,
+                    "allowed": bool(a),
+                }
+                for (s, d, p, proto), a in zip(probes, answers)
+            ],
         }
     if args.who_can_reach:
         out["who_can_reach"] = {
@@ -1113,8 +1176,8 @@ def _run_query(args) -> int:
     if not out:
         raise SystemExit(
             "query: nothing to answer — give --can-reach SRC DST, "
-            "--who-can-reach DST, --blast-radius SRC, --what-if MANIFESTS "
-            "and/or --assert FILE"
+            "--batch FILE.jsonl, --who-can-reach DST, --blast-radius SRC, "
+            "--what-if MANIFESTS and/or --assert FILE"
         )
     if args.json:
         print(json.dumps(out, sort_keys=True))
@@ -1130,6 +1193,19 @@ def _run_query(args) -> int:
                 f"{c['src']} -> {c['dst']}{via}: "
                 f"{'ALLOWED' if c['allowed'] else 'DENIED'}"
             )
+        if "batch" in out:
+            b = out["batch"]
+            for r in b["results"]:
+                via = (
+                    f" on {r['protocol']}/{r['port']}"
+                    if r["port"] is not None
+                    else ""
+                )
+                print(
+                    f"{r['src']} -> {r['dst']}{via}: "
+                    f"{'ALLOWED' if r['allowed'] else 'DENIED'}"
+                )
+            print(f"batch {b['file']}: {b['allowed']}/{b['n']} allowed")
         if "who_can_reach" in out:
             w = out["who_can_reach"]
             print(f"{len(w['sources'])} pods can reach {w['dst']}: "
@@ -1428,7 +1504,8 @@ def main(argv: Optional[list] = None) -> int:
     p = sub.add_parser(
         "query",
         help="one-shot queries against a cluster or serve snapshot: "
-        "can-reach / who-can-reach / blast-radius / what-if admission",
+        "can-reach (scalar or --batch JSONL) / who-can-reach / "
+        "blast-radius / what-if admission",
     )
     p.add_argument("path", nargs="?", help="manifest file/dir")
     p.add_argument(
@@ -1445,6 +1522,13 @@ def main(argv: Optional[list] = None) -> int:
         "exact answer)",
     )
     p.add_argument("--protocol", default="TCP", help="with --port")
+    p.add_argument(
+        "--batch", metavar="FILE.jsonl",
+        help="answer a whole probe batch through one device dispatch: one "
+        'JSON object per line, {"src": "NS/POD", "dst": "NS/POD"} with '
+        'optional "port" (integer; omitted = any port) and "protocol" '
+        "(default TCP)",
+    )
     p.add_argument("--who-can-reach", metavar="DST")
     p.add_argument("--blast-radius", metavar="SRC")
     p.add_argument(

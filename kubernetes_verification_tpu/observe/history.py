@@ -15,7 +15,10 @@ understood, so the gate also runs directly over the repo's recorded
 Regression direction comes from the unit: throughput units are
 higher-is-better, latency units lower-is-better, anything unrecognised is
 reported but never gated (a delta-percent series has no universal "worse"
-direction).
+direction). Rate-shaped series are recognised structurally as a fallback —
+a ``*_per_second`` metric name or a ``.../s`` unit gates higher-is-better
+(so the ``queries_per_second`` series from BENCH rounds is gated even
+where its unit string predates the list above).
 """
 from __future__ import annotations
 
@@ -36,7 +39,18 @@ __all__ = [
 DEFAULT_HISTORY = "bench_history.jsonl"
 
 #: unit -> gate direction; anything else is "unknown" and not gated
-_HIGHER_IS_BETTER = frozenset({"pairs/s", "pairs_per_second", "ops/s", "qps"})
+_HIGHER_IS_BETTER = frozenset(
+    {
+        "pairs/s",
+        "pairs_per_second",
+        "ops/s",
+        "qps",
+        "queries/s",
+        "queries_per_second",
+        "events/s",
+        "events_per_second",
+    }
+)
 _LOWER_IS_BETTER = frozenset({"s", "ms", "us", "seconds", "bytes"})
 
 
@@ -113,11 +127,19 @@ def default_paths(root: str = ".") -> List[str]:
     return sorted(glob.glob(os.path.join(root, "BENCH_r*.json")))
 
 
-def _direction(unit: Optional[str]) -> str:
+def _direction(unit: Optional[str], metric: Optional[str] = None) -> str:
     if unit in _HIGHER_IS_BETTER:
         return "higher"
     if unit in _LOWER_IS_BETTER:
         return "lower"
+    # rate-shaped series gate higher-is-better even under a novel unit
+    # string: a ``*_per_second`` metric name or a ``.../s`` unit is a
+    # throughput by construction (the queries_per_second series from BENCH
+    # rounds predates its unit being listed above)
+    if metric is not None and metric.endswith("_per_second"):
+        return "higher"
+    if unit is not None and unit.endswith("/s"):
+        return "higher"
     return "unknown"
 
 
@@ -139,7 +161,7 @@ def check_regression(
         prev = rs[:-1][-window:]
         vals = sorted(r["value"] for r in prev)
         median = vals[len(vals) // 2]
-        direction = _direction(unit)
+        direction = _direction(unit, metric)
         finding = {
             "metric": metric,
             "unit": unit,

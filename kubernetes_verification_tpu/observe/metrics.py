@@ -44,6 +44,9 @@ __all__ = [
     "SERVE_ASSERTION_FAILURES_TOTAL",
     "SERVE_QUEUE_DEPTH",
     "SERVE_STALENESS_SECONDS",
+    "QUERY_CACHE_HITS_TOTAL",
+    "QUERY_CACHE_MISSES_TOTAL",
+    "QUERY_BATCH_SIZE",
     "CHECKPOINTS_TOTAL",
     "RECOVERIES_TOTAL",
     "WAL_TRUNCATIONS_TOTAL",
@@ -253,6 +256,30 @@ SERVE_QUEUE_DEPTH = Gauge(
     "engine, sampled when the worker drains a batch.",
 )
 
+QUERY_CACHE_HITS_TOTAL = Counter(
+    "kvtpu_query_cache_hits_total",
+    "Generation-keyed query-cache hits on the batched query path, by entry "
+    "kind: 'rows' (memoized packed reach rows, one per distinct source) or "
+    "'ports' (memoized per-pair port-atom tables).",
+    ("kind",),
+)
+
+QUERY_CACHE_MISSES_TOTAL = Counter(
+    "kvtpu_query_cache_misses_total",
+    "Generation-keyed query-cache misses on the batched query path, by "
+    "entry kind ('rows' / 'ports') — each rows miss is one gathered row in "
+    "the batch's single device dispatch, each ports miss one refined pair "
+    "in its group's oracle solve.",
+    ("kind",),
+)
+
+QUERY_BATCH_SIZE = Histogram(
+    "kvtpu_query_batch_size",
+    "Probes per can_reach_batch call — how much batching amortizes the "
+    "per-dispatch overhead the scalar path pays per query.",
+    buckets=(1.0, 8.0, 64.0, 512.0, 4096.0, 32768.0),
+)
+
 SERVE_STALENESS_SECONDS = Gauge(
     "kvtpu_serve_staleness_seconds",
     "Age of the oldest applied-but-unsolved mutation at the most recent "
@@ -337,6 +364,10 @@ REQUIRED_FAMILIES = frozenset(
         "kvtpu_serve_assertion_failures_total",
         "kvtpu_serve_queue_depth",
         "kvtpu_serve_staleness_seconds",
+        # batched query engine (ops/batched.py + serve/queries.py)
+        "kvtpu_query_cache_hits_total",
+        "kvtpu_query_cache_misses_total",
+        "kvtpu_query_batch_size",
         # durability layer (WAL / checkpoints / recovery / breaker)
         "kvtpu_checkpoints_total",
         "kvtpu_recoveries_total",

@@ -1,0 +1,10 @@
+"""Device kernels: tiled/packed reachability, closure, batched probes.
+
+Heavy kernel modules (``tiled``, ``closure``, ``pallas_kernels``) are
+imported by their full path so pulling in one does not compile-cache the
+others; only the lightweight batched-probe entry points are re-exported
+here.
+"""
+from .batched import batched_any_port, batched_reach_rows
+
+__all__ = ["batched_any_port", "batched_reach_rows"]

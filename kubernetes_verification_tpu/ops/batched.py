@@ -1,0 +1,182 @@
+"""Batched reachability probes: one device dispatch for a whole query batch.
+
+The serving query path used to be scalar — every ``can_reach`` probe either
+re-read the full reach matrix or (ported) re-ran a complete CPU verify on a
+synthesized sub-cluster. These kernels restructure the per-item lookup into
+one dense batched program (the TPU-KNN move): given the distinct source
+indices of a query batch, gather their reach *rows* straight from the
+incremental engine's count matrices in a single jitted dispatch, and answer
+every any-port probe with one gather/compare on the result.
+
+The row formula is ``incremental._derive_reach`` restricted to the gathered
+sources — bit-identical by construction::
+
+    ing_ok[s, j] = ing_count[s, j] > 0   (| ing_iso[j] == 0   under default-allow)
+    eg_ok [s, j] = eg_count [s, j] > 0   (| eg_iso [s] == 0   under default-allow)
+    row   [s, j] = ing_ok & eg_ok        (| s == j            under self-traffic)
+
+Dynamic batch dimensions are padded to the next power of two before entering
+jit so the number of compiled signatures stays logarithmic in batch size
+(the recompile-hazard rule's concern); padding rows reuse a valid source
+index and are sliced off on the host.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["batched_reach_rows", "batched_any_port"]
+
+_I32 = jnp.int32
+
+
+def _pow2(n: int) -> int:
+    return 1 << max(0, int(n) - 1).bit_length()
+
+
+@partial(
+    jax.jit,
+    static_argnames=("self_traffic", "default_allow_unselected"),
+)
+def _reach_rows_kernel(
+    ing_count,
+    eg_count,
+    ing_iso,
+    eg_iso,
+    src_idx,
+    *,
+    self_traffic: bool,
+    default_allow_unselected: bool,
+):
+    """Reach rows for the sources in ``src_idx`` — ``_derive_reach`` sliced
+    to [U, N] without materialising the full matrix."""
+    ing_ok = ing_count[src_idx, :] > 0
+    eg_ok = eg_count[src_idx, :] > 0
+    if default_allow_unselected:
+        ing_ok |= (ing_iso == 0)[None, :]
+        eg_ok |= (eg_iso[src_idx] == 0)[:, None]
+    rows = ing_ok & eg_ok
+    if self_traffic:
+        n = ing_count.shape[0]
+        rows |= src_idx[:, None] == jnp.arange(n)[None, :]
+    return rows
+
+
+@partial(
+    jax.jit,
+    static_argnames=("self_traffic", "default_allow_unselected"),
+)
+def _probe_rows_kernel(
+    ing_count,
+    eg_count,
+    ing_iso,
+    eg_iso,
+    src_idx,
+    q_row,
+    q_dst,
+    *,
+    self_traffic: bool,
+    default_allow_unselected: bool,
+):
+    """Rows for ``src_idx`` plus per-probe answers in the same dispatch:
+    probe ``k`` asks row ``q_row[k]`` (a position into ``src_idx``) against
+    destination ``q_dst[k]``."""
+    rows = _reach_rows_kernel(
+        ing_count,
+        eg_count,
+        ing_iso,
+        eg_iso,
+        src_idx,
+        self_traffic=self_traffic,
+        default_allow_unselected=default_allow_unselected,
+    )
+    return rows, rows[q_row, q_dst]
+
+
+def _pad_idx(idx: np.ndarray, length: int) -> jnp.ndarray:
+    """Pad an index vector to ``length`` by repeating its last entry (a
+    valid index, so padding lanes compute garbage-free rows)."""
+    out = np.empty(length, dtype=np.int32)
+    out[: idx.size] = idx
+    out[idx.size:] = idx[-1] if idx.size else 0
+    return jnp.asarray(out)
+
+
+def batched_reach_rows(
+    ing_count,
+    eg_count,
+    ing_iso,
+    eg_iso,
+    src_idx,
+    *,
+    self_traffic: bool,
+    default_allow_unselected: bool,
+) -> np.ndarray:
+    """Gather the reach rows of ``src_idx`` (host int array, [U]) from the
+    incremental engine's state in one device dispatch; returns bool [U, N].
+
+    ``ing_count``/``eg_count`` are the engine's device count matrices;
+    ``ing_iso``/``eg_iso`` its host isolation-count vectors. An empty
+    ``src_idx`` short-circuits to a (0, N) result without dispatching.
+    """
+    src_idx = np.asarray(src_idx, dtype=np.int64)
+    n = int(ing_count.shape[0])
+    if src_idx.size == 0:
+        return np.zeros((0, n), dtype=bool)
+    padded = _pad_idx(src_idx, _pow2(src_idx.size))
+    rows = _reach_rows_kernel(
+        ing_count,
+        eg_count,
+        jnp.asarray(ing_iso, dtype=_I32),
+        jnp.asarray(eg_iso, dtype=_I32),
+        padded,
+        self_traffic=self_traffic,
+        default_allow_unselected=default_allow_unselected,
+    )
+    return np.asarray(rows)[: src_idx.size]
+
+
+def batched_any_port(
+    ing_count,
+    eg_count,
+    ing_iso,
+    eg_iso,
+    src_idx,
+    q_row,
+    q_dst,
+    *,
+    self_traffic: bool,
+    default_allow_unselected: bool,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Answer a whole any-port probe batch in one dispatch.
+
+    ``src_idx`` [U] are the distinct source pod indices, ``q_row`` [Q] maps
+    each probe to its position in ``src_idx``, ``q_dst`` [Q] the destination
+    pod index. Returns ``(rows [U, N], answers [Q])`` — rows so the caller
+    can memoize them for the next batch.
+    """
+    src_idx = np.asarray(src_idx, dtype=np.int64)
+    q_row = np.asarray(q_row, dtype=np.int64)
+    q_dst = np.asarray(q_dst, dtype=np.int64)
+    n = int(ing_count.shape[0])
+    if q_row.size == 0:
+        return np.zeros((0, n), dtype=bool), np.zeros(0, dtype=bool)
+    rows, ans = _probe_rows_kernel(
+        ing_count,
+        eg_count,
+        jnp.asarray(ing_iso, dtype=_I32),
+        jnp.asarray(eg_iso, dtype=_I32),
+        _pad_idx(src_idx, _pow2(src_idx.size)),
+        _pad_idx(q_row, _pow2(q_row.size)),
+        _pad_idx(q_dst, _pow2(q_dst.size)),
+        self_traffic=self_traffic,
+        default_allow_unselected=default_allow_unselected,
+    )
+    return (
+        np.asarray(rows)[: src_idx.size],
+        np.asarray(ans)[: q_row.size],
+    )

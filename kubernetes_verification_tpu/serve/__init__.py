@@ -9,18 +9,22 @@ incremental engines were built for — BASELINE config 5):
 * ``service`` — :class:`VerificationService`: one incremental engine
   behind one worker thread, lazy solve scheduling, staleness bounds and
   warm-restart snapshots;
-* ``queries`` — :class:`QueryEngine` (``can_reach`` / ``who_can_reach`` /
-  ``blast_radius``), declarative allow/deny assertions with violating-pair
-  witnesses, and admission-style ``what_if`` dry runs on a copy-on-write
-  overlay;
+* ``queries`` — :class:`QueryEngine` (``can_reach`` / ``can_reach_batch``
+  / ``who_can_reach`` / ``blast_radius``), declarative allow/deny
+  assertions with violating-pair witnesses, and admission-style
+  ``what_if`` dry runs on a copy-on-write overlay. The batched path
+  answers thousands of probes through one jitted device dispatch
+  (``ops/batched.py``) with a generation-keyed :class:`QueryCache`;
 * ``durability`` — crash-safe checkpoints: :class:`CheckpointManager`
   (atomic snapshot + manifest generations) and :class:`RecoveryManager`
   (ladder recovery + WAL replay with duplicate-application skipping),
   over the sequenced WAL layer in ``events`` (:class:`WalWriter` /
   :func:`scan_wal`).
 
-CLI: ``kv-tpu serve`` / ``kv-tpu query`` / ``kv-tpu recover``; benchmark:
-``bench.py --mode serve``; metric families: ``kvtpu_serve_*``,
+CLI: ``kv-tpu serve`` / ``kv-tpu query`` (``--batch FILE.jsonl`` for the
+vectorized path) / ``kv-tpu recover``; benchmarks: ``bench.py --mode
+serve`` and ``--mode query``; metric families: ``kvtpu_serve_*``,
+``kvtpu_query_cache_*``, ``kvtpu_query_batch_size``,
 ``kvtpu_checkpoints_total``, ``kvtpu_recoveries_total``,
 ``kvtpu_wal_truncations_total``.
 """
@@ -53,6 +57,7 @@ from .events import (
 from .queries import (
     Assertion,
     PodSelector,
+    QueryCache,
     QueryEngine,
     Violation,
     WhatIfResult,
@@ -87,6 +92,7 @@ __all__ = [
     "ServeConfig",
     "ServeStats",
     "VerificationService",
+    "QueryCache",
     "QueryEngine",
     "PodSelector",
     "Assertion",

@@ -9,6 +9,15 @@ against a live :class:`~.service.VerificationService`, solving lazily —
   the port-precise form re-runs the CPU oracle on a 2-pod sub-cluster
   (pair reachability depends only on the policies plus the two pods'
   labels/namespaces, so the sub-problem is exact and tiny);
+* :meth:`QueryEngine.can_reach_batch` — the vectorized form: a whole batch
+  of probes answered through ONE jitted device dispatch
+  (:mod:`~..ops.batched` gathers the reach rows of every distinct source
+  straight from the engine's count matrices), with all missed ported
+  probes refined by ONE oracle solve over the sub-cluster they jointly
+  induce. Packed rows and per-pair port tables memoize in a
+  :class:`QueryCache` keyed on the service's engine generation —
+  invalidated by ``apply()``/``full_resync``, never populated by what-if
+  overlays;
 * :meth:`QueryEngine.who_can_reach` / :meth:`QueryEngine.blast_radius` —
   one column / one row of the reach matrix, as pod names;
 * :meth:`QueryEngine.what_if` — admission-style dry run: candidate policy
@@ -33,14 +42,26 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..backends.base import VerifyConfig
+# Cycle-safe: nothing under kubernetes_verification_tpu/__init__ imports
+# serve, so the parent package is always fully initialised before any serve
+# submodule loads. The ported-probe refinement goes through the public
+# ``kv.verify`` oracle on purpose (same entry point as the tests).
+import kubernetes_verification_tpu as kv
+
+from ..backends.base import VerifyConfig, VerifyResult
 from ..incremental import _derive_reach
 from ..models.core import Cluster, Pod
 from ..observe.metrics import (
+    QUERY_BATCH_SIZE,
+    QUERY_CACHE_HITS_TOTAL,
+    QUERY_CACHE_MISSES_TOTAL,
     SERVE_ASSERTION_FAILURES_TOTAL,
     SERVE_QUERIES_TOTAL,
+    SERVE_SOLVES_TOTAL,
 )
-from ..resilience.errors import IngestError, ServeError
+from ..ops.batched import batched_any_port, batched_reach_rows
+from ..resilience.breaker import CLOSED
+from ..resilience.errors import BackendError, IngestError, ServeError
 from .events import AddPolicy, Event, RemovePolicy, UpdatePolicy
 
 __all__ = [
@@ -48,6 +69,7 @@ __all__ = [
     "Assertion",
     "Violation",
     "WhatIfResult",
+    "QueryCache",
     "QueryEngine",
     "load_assertions",
     "check_assertions",
@@ -194,6 +216,29 @@ def _pod_name(pod: Pod) -> str:
     return f"{pod.namespace}/{pod.name}"
 
 
+def _violation_from(
+    a: Assertion,
+    sub: np.ndarray,
+    src_idx: np.ndarray,
+    dst_idx: np.ndarray,
+    pods: Sequence[Pod],
+) -> Optional[Violation]:
+    """Verdict for one assertion given its (src × dst) reach submatrix."""
+    bad = ~sub if a.kind == "allow" else sub.copy()
+    if a.ignore_self:
+        bad &= src_idx[:, None] != dst_idx[None, :]
+    si, di = np.nonzero(bad)
+    if si.size == 0:
+        return None
+    return Violation(
+        assertion=a.name,
+        kind=a.kind,
+        witness_src=_pod_name(pods[int(src_idx[si[0]])]),
+        witness_dst=_pod_name(pods[int(dst_idx[di[0]])]),
+        pairs=int(si.size),
+    )
+
+
 def _violations_on(
     assertions: Sequence[Assertion],
     reach: np.ndarray,
@@ -205,36 +250,91 @@ def _violations_on(
         dst_idx = a.dst.indices(pods)
         if src_idx.size == 0 or dst_idx.size == 0:
             continue
-        sub = reach[np.ix_(src_idx, dst_idx)]
-        bad = ~sub if a.kind == "allow" else sub.copy()
-        if a.ignore_self:
-            bad &= src_idx[:, None] != dst_idx[None, :]
-        si, di = np.nonzero(bad)
-        if si.size == 0:
-            continue
-        found.append(
-            Violation(
-                assertion=a.name,
-                kind=a.kind,
-                witness_src=_pod_name(pods[int(src_idx[si[0]])]),
-                witness_dst=_pod_name(pods[int(dst_idx[di[0]])]),
-                pairs=int(si.size),
-            )
+        v = _violation_from(
+            a, reach[np.ix_(src_idx, dst_idx)], src_idx, dst_idx, pods
         )
+        if v is not None:
+            found.append(v)
     return found
 
 
 def check_assertions(service, assertions: Sequence[Assertion]) -> List[Violation]:
-    """Check ``assertions`` against the service's current state (solving
-    if stale, trigger=``assertions``); counts each violated assertion on
-    ``kvtpu_serve_assertion_failures_total``."""
+    """Check ``assertions`` against the service's current state; counts
+    each violated assertion on ``kvtpu_serve_assertion_failures_total``.
+
+    When the engine's reach derivation is already clean (or a fallback
+    matrix is standing in) the check reads the full matrix for free. On a
+    DIRTY engine the check rides the batched row-gather kernel instead:
+    only the rows of the assertions' source pods are derived, in one device
+    dispatch, counted under the ``assertion_rows`` solve trigger — the
+    full-matrix derivation stays lazy for the next query."""
     if not assertions:
         return []
-    reach = service._solve("assertions")
-    found = _violations_on(assertions, reach, service.engine.pods)
+    with service._lock:
+        pods = service.engine.pods
+        plan = []
+        for a in assertions:
+            src_idx = a.src.indices(pods)
+            dst_idx = a.dst.indices(pods)
+            if src_idx.size and dst_idx.size:
+                plan.append((a, src_idx, dst_idx))
+        if not plan:
+            return []
+        sub_of = _assertion_submatrices(service, plan)
+        found: List[Violation] = []
+        for a, src_idx, dst_idx in plan:
+            v = _violation_from(
+                a, sub_of(src_idx, dst_idx), src_idx, dst_idx, pods
+            )
+            if v is not None:
+                found.append(v)
     for v in found:
         SERVE_ASSERTION_FAILURES_TOTAL.labels(assertion=v.assertion).inc()
     return found
+
+
+def _assertion_submatrices(service, plan):
+    """A ``(src_idx, dst_idx) -> reach submatrix`` provider for assertion
+    checks: full matrix when it is free (clean engine, standing fallback)
+    or forced (breaker not closed); batched source-row gather otherwise."""
+    eng = service.engine
+    br = service._breaker
+    rows_path = (
+        service._fallback_reach is None
+        and (eng._reach is None or eng._reach_dirty)
+        and (br is None or br.state == CLOSED)
+    )
+    if rows_path:
+        uniq = np.unique(np.concatenate([p[1] for p in plan]))
+        cfg = eng.config
+        try:
+            rows = batched_reach_rows(
+                eng._ing_count,
+                eng._eg_count,
+                eng._ing_iso,
+                eng._eg_iso,
+                uniq,
+                self_traffic=cfg.self_traffic,
+                default_allow_unselected=cfg.default_allow_unselected,
+            )
+        except BackendError:
+            rows = None  # engine state unusable: the solve ladder owns it
+        if rows is not None:
+            SERVE_SOLVES_TOTAL.labels(trigger="assertion_rows").inc()
+            service.stats.solves["assertion_rows"] = (
+                service.stats.solves.get("assertion_rows", 0) + 1
+            )
+            pos = {int(u): j for j, u in enumerate(uniq)}
+
+            def sub_of(src_idx, dst_idx):
+                r = np.fromiter(
+                    (pos[int(x)] for x in src_idx), np.int64, src_idx.size
+                )
+                return rows[np.ix_(r, dst_idx)]
+
+            return sub_of
+    reach = service._solve("assertions")
+    return lambda src_idx, dst_idx: reach[np.ix_(src_idx, dst_idx)]
 
 
 # ----------------------------------------------------------------- what-if
@@ -261,11 +361,114 @@ class WhatIfResult:
         }
 
 
+def _port_answer(
+    res: VerifyResult, s: int, d: int, port: int, protocol: str
+) -> bool:
+    """Port-refined verdict from a ``compute_ports`` result: the numeric
+    atom covering ``(protocol, port)`` decides; when no numbered atom
+    covers it (degenerate universe — no relevant rule mentions ports) the
+    any-port answer IS the exact refinement."""
+    if res.reach_ports is not None:
+        for q, atom in enumerate(res.port_atoms):
+            if (
+                atom.name is None
+                and atom.protocol == protocol
+                and atom.lo <= port <= atom.hi
+            ):
+                return bool(res.reach_ports[s, d, q])
+    return bool(res.reach[s, d])
+
+
+def _atom_table(
+    res: VerifyResult, s: int, d: int
+) -> Tuple[tuple, bool]:
+    """The whole-pair port table :class:`QueryCache` memoizes: every
+    numeric atom's verdict in atom order plus the any-port fallback.
+    ``_table_answer`` over this table is ``_port_answer`` by construction
+    — same atoms, same order, same fallback."""
+    atoms = ()
+    if res.reach_ports is not None:
+        atoms = tuple(
+            (a.protocol, a.lo, a.hi, bool(res.reach_ports[s, d, q]))
+            for q, a in enumerate(res.port_atoms)
+            if a.name is None
+        )
+    return atoms, bool(res.reach[s, d])
+
+
+def _table_answer(
+    entry: Tuple[tuple, bool], port: int, protocol: str
+) -> bool:
+    atoms, fallback = entry
+    for proto, lo, hi, ok in atoms:
+        if proto == protocol and lo <= port <= hi:
+            return ok
+    return fallback
+
+
+@dataclass
+class QueryCache:
+    """Generation-keyed memo for the batched query path.
+
+    Valid exactly while ``VerificationService.generation`` is unchanged —
+    ``sync`` drops everything on a bump (any applied batch, including a
+    ``full_resync``). What-if overlays never touch this: they derive on
+    copy-on-write buffers and answer from their own matrices.
+
+    * ``row_pos``/``row_mat`` — packed any-port reach rows by source pod
+      index, stored as one [capacity, N] matrix (geometric growth, so a
+      long probe stream costs amortized O(1) copies per cached row — a
+      per-batch concatenate would re-copy the whole cache every miss
+      batch and dominate steady-state latency) answered with a single
+      two-array fancy gather;
+    * ``ports`` — per-(src, dst) port tables: every numeric port-atom
+      verdict plus the any-port fallback, so ONE group solve answers
+      every later port probe on the pair, not just the port that missed;
+    * ``ref_idx`` — "namespace/name" → engine row, rebuilt per generation
+      (a resync renumbers pods).
+    """
+
+    generation: int = -1
+    row_pos: Dict[int, int] = field(default_factory=dict)
+    row_mat: Optional[np.ndarray] = None  # bool [cached, N]
+    ports: Dict[Tuple[int, int], Tuple[tuple, bool]] = field(
+        default_factory=dict
+    )
+    ref_idx: Dict[str, int] = field(default_factory=dict)
+
+    def sync(self, service) -> None:
+        gen = service.generation
+        if gen == self.generation:
+            return
+        self.row_pos.clear()
+        self.row_mat = None
+        self.ports.clear()
+        self.ref_idx = {
+            f"{ns}/{name}": i
+            for (ns, name), i in service._pod_idx.items()
+        }
+        self.generation = gen
+
+    def add_rows(self, src_idx: np.ndarray, rows: np.ndarray) -> None:
+        base = len(self.row_pos)
+        need = base + rows.shape[0]
+        if self.row_mat is None or self.row_mat.shape[0] < need:
+            cap = max(need, 2 * base, 64)
+            grown = np.empty((cap, rows.shape[1]), dtype=bool)
+            if base:
+                grown[:base] = self.row_mat[:base]
+            self.row_mat = grown
+        self.row_mat[base:need] = rows
+        for k, s in enumerate(src_idx):
+            self.row_pos[int(s)] = base + k
+
+
 class QueryEngine:
     """Query front end over a :class:`~.service.VerificationService`."""
 
     def __init__(self, service) -> None:
         self.service = service
+        self._cache = QueryCache()
 
     # ------------------------------------------------------------- helpers
     def _count(self, kind: str) -> None:
@@ -310,14 +513,18 @@ class QueryEngine:
         pair = [cluster.pods[si]] + (
             [cluster.pods[di]] if di != si else []
         )
+        # a NetworkPolicy only ever selects pods in its own namespace, so
+        # only the pair's namespaces can contribute grants or isolation —
+        # the rest of the policy list is dead weight for the 2-pod oracle
+        pair_ns = {p.namespace for p in pair}
         cfg = eng.config
-        import kubernetes_verification_tpu as kv
-
         res = kv.verify(
             Cluster(
                 pods=pair,
                 namespaces=list(cluster.namespaces),
-                policies=list(cluster.policies),
+                policies=[
+                    p for p in cluster.policies if p.namespace in pair_ns
+                ],
             ),
             VerifyConfig(
                 backend="cpu",
@@ -328,17 +535,236 @@ class QueryEngine:
             ),
         )
         s, d = (0, 0) if di == si else (0, 1)
-        if res.reach_ports is not None:
-            for q, atom in enumerate(res.port_atoms):
-                if (
-                    atom.name is None
-                    and atom.protocol == protocol
-                    and atom.lo <= port <= atom.hi
-                ):
-                    return bool(res.reach_ports[s, d, q])
-        # no numbered atom covers the port (degenerate universe): the
-        # any-port answer is the best available refinement
-        return bool(res.reach[s, d])
+        return _port_answer(res, s, d, port, protocol)
+
+    # ------------------------------------------------------------- batched
+    def can_reach_batch(
+        self,
+        queries: Optional[Sequence] = None,
+        *,
+        srcs: Optional[Sequence[str]] = None,
+        dsts: Optional[Sequence[str]] = None,
+        ports: Optional[Sequence[Optional[int]]] = None,
+        protocols: Optional[Sequence[str]] = None,
+    ) -> np.ndarray:
+        """Answer a whole probe batch; returns bool [Q], bit-identical to
+        calling :meth:`can_reach` per query.
+
+        Accepts either ``queries`` — a sequence of ``(src, dst)``,
+        ``(src, dst, port)`` or ``(src, dst, port, protocol)`` tuples
+        (``port=None`` = any port; protocol defaults to TCP) — or the
+        columnar keyword form. Any-port probes are answered from packed
+        reach rows gathered for all distinct sources in ONE jitted device
+        dispatch; missed ported probes are refined together by one oracle
+        solve over the sub-cluster they jointly induce. Rows and per-pair
+        port tables memoize in the generation-keyed :class:`QueryCache`."""
+        if queries is not None:
+            if srcs is not None or dsts is not None:
+                raise ServeError(
+                    "can_reach_batch takes queries= OR srcs=/dsts=, not both"
+                )
+            srcs, dsts, ports, protocols = [], [], [], []
+            for i, q in enumerate(queries):
+                q = tuple(q)
+                if not 2 <= len(q) <= 4:
+                    raise ServeError(
+                        f"query {i}: expected (src, dst[, port[, protocol]])"
+                        f", got {len(q)} fields"
+                    )
+                srcs.append(q[0])
+                dsts.append(q[1])
+                ports.append(q[2] if len(q) > 2 else None)
+                protocols.append(q[3] if len(q) > 3 else "TCP")
+        else:
+            if srcs is None or dsts is None:
+                raise ServeError(
+                    "can_reach_batch needs queries= or both srcs= and dsts="
+                )
+            srcs, dsts = list(srcs), list(dsts)
+            ports = list(ports) if ports is not None else [None] * len(srcs)
+            protocols = (
+                list(protocols)
+                if protocols is not None
+                else ["TCP"] * len(srcs)
+            )
+            if not len(srcs) == len(dsts) == len(ports) == len(protocols):
+                raise ServeError(
+                    "can_reach_batch columnar inputs must have equal length"
+                )
+        n_q = len(srcs)
+        ans = np.zeros(n_q, dtype=bool)
+        if n_q == 0:
+            return ans
+        QUERY_BATCH_SIZE.observe(float(n_q))
+        SERVE_QUERIES_TOTAL.labels(kind="can_reach_batch").inc(n_q)
+        st = self.service.stats
+        st.queries["can_reach_batch"] = (
+            st.queries.get("can_reach_batch", 0) + n_q
+        )
+        svc = self.service
+        svc.flush()
+        with svc._lock:
+            cache = self._cache
+            cache.sync(svc)
+            ref_idx = cache.ref_idx
+            try:
+                si = np.fromiter(
+                    (ref_idx[r] for r in srcs), np.int64, n_q
+                )
+                di = np.fromiter(
+                    (ref_idx[r] for r in dsts), np.int64, n_q
+                )
+            except KeyError:
+                for r in list(srcs) + list(dsts):
+                    self._idx(r)  # raises ServeError naming the bad ref
+                raise
+            ported = np.fromiter(
+                (p is not None for p in ports), bool, n_q
+            )
+            if not ported.all():
+                idx = np.nonzero(~ported)[0]
+                ans[idx] = self._any_port_batch(si[idx], di[idx])
+            if ported.any():
+                items = [
+                    (
+                        int(k),
+                        int(si[k]),
+                        int(di[k]),
+                        int(ports[k]),
+                        str(protocols[k]),
+                    )
+                    for k in np.nonzero(ported)[0]
+                ]
+                for k, ok in self._ported_batch(items):
+                    ans[k] = ok
+        return ans
+
+    def _any_port_batch(self, s: np.ndarray, d: np.ndarray) -> np.ndarray:
+        """Any-port answers for index pairs (lock held). The cache ladder
+        mirrors the service's solve ladder: standing fallback matrix →
+        clean engine → breaker not closed (delegate to the service) →
+        batched row gather with generation-keyed memoization."""
+        svc = self.service
+        if svc._fallback_reach is not None:
+            return svc._fallback_reach[s, d]
+        eng = svc.engine
+        if eng._reach is not None and not eng._reach_dirty:
+            return np.asarray(eng.reach)[s, d]
+        br = svc._breaker
+        if br is not None and br.state != CLOSED:
+            # open/half-open: let the service ladder decide whether this
+            # is a fallback answer or the one half-open probe
+            return svc._solve("query")[s, d]
+        cache = self._cache
+        uniq, inv = np.unique(s, return_inverse=True)
+        row_pos = cache.row_pos
+        hit = np.fromiter(
+            (int(u) in row_pos for u in uniq), bool, uniq.size
+        )
+        missing = uniq[~hit]
+        if hit.any():
+            QUERY_CACHE_HITS_TOTAL.labels(kind="rows").inc(
+                int(hit.sum())
+            )
+        if missing.size:
+            QUERY_CACHE_MISSES_TOTAL.labels(kind="rows").inc(
+                int(missing.size)
+            )
+        cfg = eng.config
+        try:
+            if not row_pos:
+                # cold cache: rows + per-probe answers in one dispatch
+                rows, out = batched_any_port(
+                    eng._ing_count,
+                    eng._eg_count,
+                    eng._ing_iso,
+                    eng._eg_iso,
+                    uniq,
+                    inv,
+                    d,
+                    self_traffic=cfg.self_traffic,
+                    default_allow_unselected=cfg.default_allow_unselected,
+                )
+                cache.add_rows(uniq, rows)
+                return out
+            if missing.size:
+                rows = batched_reach_rows(
+                    eng._ing_count,
+                    eng._eg_count,
+                    eng._ing_iso,
+                    eng._eg_iso,
+                    missing,
+                    self_traffic=cfg.self_traffic,
+                    default_allow_unselected=cfg.default_allow_unselected,
+                )
+                cache.add_rows(missing, rows)
+        except BackendError:
+            # engine state unusable even for the row gather: the service
+            # ladder (breaker bookkeeping + from-scratch fallback) owns it
+            return svc._solve("query")[s, d]
+        pos = np.fromiter(
+            (row_pos[int(u)] for u in uniq), np.int64, uniq.size
+        )
+        return cache.row_mat[pos[inv], d]
+
+    def _ported_batch(self, items) -> List[Tuple[int, bool]]:
+        """Port-refined answers for ``(k, si, di, port, protocol)`` items
+        (lock held). ALL cache misses of the batch induce one sub-cluster
+        — their distinct pods plus the policies of their namespaces — and
+        are settled by ONE oracle solve instead of a verify per probe.
+        Exact: pair reachability is pair-local (no closure) and a policy
+        only selects pods in its own namespace, so policies outside the
+        involved namespaces cannot touch any probed pair, and policies of
+        *other* involved namespaces only refine the port-atom partition,
+        never a per-port verdict."""
+        svc = self.service
+        eng = svc.engine
+        cache = self._cache
+        out: List[Tuple[int, bool]] = []
+        misses = []
+        n_hits = 0
+        for k, si, di, port, proto in items:
+            entry = cache.ports.get((si, di))
+            if entry is not None:
+                n_hits += 1
+                out.append((k, _table_answer(entry, port, proto)))
+            else:
+                misses.append((k, si, di, port, proto))
+        if n_hits:
+            QUERY_CACHE_HITS_TOTAL.labels(kind="ports").inc(n_hits)
+        if not misses:
+            return out
+        QUERY_CACHE_MISSES_TOTAL.labels(kind="ports").inc(len(misses))
+        pods = eng.pods
+        involved = sorted({i for it in misses for i in (it[1], it[2])})
+        loc = {p: j for j, p in enumerate(involved)}
+        ns_set = {pods[i].namespace for i in involved}
+        cfg = eng.config
+        res = kv.verify(
+            Cluster(
+                pods=[pods[i] for i in involved],
+                namespaces=list(eng.namespaces),
+                policies=[
+                    p
+                    for p in eng.policies.values()
+                    if p.namespace in ns_set
+                ],
+            ),
+            VerifyConfig(
+                backend="cpu",
+                compute_ports=True,
+                self_traffic=cfg.self_traffic,
+                default_allow_unselected=cfg.default_allow_unselected,
+                direction_aware_isolation=cfg.direction_aware_isolation,
+            ),
+        )
+        for k, si, di, port, proto in misses:
+            entry = cache.ports.get((si, di))
+            if entry is None:
+                entry = _atom_table(res, loc[si], loc[di])
+                cache.ports[(si, di)] = entry
+            out.append((k, _table_answer(entry, port, proto)))
+        return out
 
     def who_can_reach(self, dst: str) -> List[str]:
         """Every pod that can reach ``dst`` (one column of the matrix)."""

@@ -180,6 +180,11 @@ class VerificationService:
         self._stop = threading.Event()
         self._worker_error: Optional[KvTpuError] = None
         self._dirty_since: Optional[float] = None
+        #: monotone engine-state generation: bumped whenever an applied
+        #: batch mutates the engine (including full_resync). The query
+        #: cache in ``serve.queries`` keys its memoized reach rows and
+        #: port refinements on this — see :attr:`generation`.
+        self._generation = 0
         #: reach matrix from a from-scratch fallback solve; valid until the
         #: next mutation (the incremental counts may be what broke)
         self._fallback_reach: Optional[np.ndarray] = None
@@ -234,6 +239,15 @@ class VerificationService:
         return self._engine
 
     @property
+    def generation(self) -> int:
+        """Event-sequence generation of the engine state: bumped once per
+        applied batch that actually mutated the engine. Memoized query
+        answers (packed reach rows, port refinements) are valid exactly as
+        long as this does not change."""
+        with self._lock:
+            return self._generation
+
+    @property
     def n_pods(self) -> int:
         return len(self._engine.pods)
 
@@ -282,6 +296,7 @@ class VerificationService:
                 self.stats.batches += 1
                 SERVE_BATCHES_TOTAL.inc()
                 if kept:
+                    self._generation += 1
                     self._fallback_reach = None
                     if self._dirty_since is None:
                         self._dirty_since = time.monotonic()

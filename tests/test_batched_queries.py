@@ -1,0 +1,392 @@
+"""Batched query engine (``ops/batched.py`` + ``QueryEngine.can_reach_batch``):
+bit-identity of the one-dispatch path against the scalar oracle (any-port and
+port-refined, cold and warm cache), the pair-namespace policy filter of the
+2-pod oracle against an unfiltered full-policy verify, generation-keyed cache
+invalidation (applied batches invalidate, what-if never populates, resync
+survives), assertions riding the batched row path, the ``--batch`` CLI
+contract, and the new metric/history surfaces."""
+import json
+
+import numpy as np
+import pytest
+
+import kubernetes_verification_tpu as kv
+from kubernetes_verification_tpu.cli import main
+from kubernetes_verification_tpu.harness.generate import (
+    GeneratorConfig,
+    random_cluster,
+    random_event_stream,
+)
+from kubernetes_verification_tpu.models.core import (
+    Cluster,
+    NetworkPolicy,
+    Peer,
+    Pod,
+    PortSpec,
+    Rule,
+    Selector,
+)
+from kubernetes_verification_tpu.observe.metrics import REQUIRED_FAMILIES
+from kubernetes_verification_tpu.ops.batched import batched_reach_rows
+from kubernetes_verification_tpu.resilience import (
+    EXIT_INPUT_ERROR,
+    EXIT_OK,
+    ServeError,
+)
+from kubernetes_verification_tpu.serve import (
+    AddPolicy,
+    Assertion,
+    FullResync,
+    PodSelector,
+    QueryEngine,
+    VerificationService,
+    check_assertions,
+)
+
+PORTS = (80, 443, 5432, 8080)
+
+
+def _service(seed=13, n_pods=48, n_policies=16, n_namespaces=5):
+    cluster = random_cluster(
+        GeneratorConfig(
+            n_pods=n_pods, n_policies=n_policies, n_namespaces=n_namespaces,
+            seed=seed, p_ipblock_peer=0.0, min_selector_labels=1,
+        )
+    )
+    return cluster, VerificationService(cluster)
+
+
+def _refs(svc):
+    return [f"{p.namespace}/{p.name}" for p in svc.engine.pods]
+
+
+def _mixed_batch(svc, n_q, seed):
+    """Random mixed probes: ~40% port-refined (TCP/UDP), rest any-port."""
+    refs = _refs(svc)
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n_q):
+        s, d = rng.integers(0, len(refs), 2)
+        if rng.random() < 0.4:
+            proto = "UDP" if rng.random() < 0.25 else "TCP"
+            out.append(
+                (refs[int(s)], refs[int(d)],
+                 int(PORTS[int(rng.integers(len(PORTS)))]), proto)
+            )
+        else:
+            out.append((refs[int(s)], refs[int(d)]))
+    return out
+
+
+def _scalar(q, batch):
+    return np.array(
+        [
+            q.can_reach(t[0], t[1], port=t[2] if len(t) > 2 else None,
+                        protocol=t[3] if len(t) > 3 else "TCP")
+            for t in batch
+        ]
+    )
+
+
+# ----------------------------------------------------- batch == scalar
+def test_batch_matches_scalar_property():
+    """Property check: randomized mixed batches answer bit-identically to
+    the scalar loop — on a dirty engine (rows path), again on the warm
+    cache, and after churn re-dirties the engine."""
+    cluster, svc = _service()
+    q = QueryEngine(svc)
+    events = random_event_stream(cluster, n_events=60, seed=4)
+    svc.apply(events[:30])  # dirty: the batched rows path, not a full solve
+    for trial in range(3):
+        batch = _mixed_batch(svc, 96, seed=100 + trial)
+        got = q.can_reach_batch(batch)
+        assert got.dtype == np.bool_ and got.shape == (96,)
+        # scalar can_reach solves the engine clean; run it second so the
+        # batch answered from gathered rows, then must agree with the oracle
+        want = _scalar(q, batch)
+        np.testing.assert_array_equal(got, want)
+        # warm pass: every row and port answer now comes from the cache
+        np.testing.assert_array_equal(q.can_reach_batch(batch), want)
+        if trial < 2:
+            svc.apply(events[30 + trial * 10: 40 + trial * 10])
+
+
+def test_columnar_form_and_rows_kernel():
+    """The columnar srcs/dsts/ports/protocols form equals the tuple form,
+    and the raw ops-level row gather equals the engine's derived matrix."""
+    _, svc = _service(seed=29, n_pods=32, n_policies=10)
+    q = QueryEngine(svc)
+    batch = _mixed_batch(svc, 40, seed=8)
+    srcs = [t[0] for t in batch]
+    dsts = [t[1] for t in batch]
+    ports = [t[2] if len(t) > 2 else None for t in batch]
+    protos = [t[3] if len(t) > 3 else "TCP" for t in batch]
+    np.testing.assert_array_equal(
+        q.can_reach_batch(batch),
+        q.can_reach_batch(srcs=srcs, dsts=dsts, ports=ports, protocols=protos),
+    )
+    eng = svc.engine
+    reach = np.asarray(svc.reach())
+    cfg = eng.config
+    src_idx = np.array([5, 0, 31, 5, 17], dtype=np.int64)
+    rows = batched_reach_rows(
+        eng._ing_count, eng._eg_count, eng._ing_iso, eng._eg_iso, src_idx,
+        self_traffic=cfg.self_traffic,
+        default_allow_unselected=cfg.default_allow_unselected,
+    )
+    np.testing.assert_array_equal(rows, reach[src_idx])
+
+
+def test_empty_batch_and_unknown_pod():
+    _, svc = _service(seed=3, n_pods=12, n_policies=4, n_namespaces=3)
+    q = QueryEngine(svc)
+    out = q.can_reach_batch([])
+    assert out.shape == (0,) and out.dtype == np.bool_
+    ref = _refs(svc)[0]
+    with pytest.raises(ServeError):
+        q.can_reach_batch([(ref, "nowhere/ghost")])
+
+
+# ------------------------------------------- pair-namespace policy filter
+def _cross_ns_cluster():
+    """ns-a/web → ns-b/db locked to TCP 5432 by a policy in ns-b, plus a
+    noise namespace whose policy must not change the pair's answers."""
+    pods = [
+        Pod("web", "ns-a", labels={"app": "web"}),
+        Pod("db", "ns-b", labels={"app": "db"}),
+        Pod("noise", "ns-c", labels={"app": "noise"}),
+    ]
+    lock = NetworkPolicy(
+        name="db-only-5432", namespace="ns-b",
+        pod_selector=Selector(match_labels={"app": "db"}),
+        policy_types=("Ingress",),
+        ingress=(
+            Rule(
+                peers=(Peer(namespace_selector=Selector()),),
+                ports=(PortSpec(protocol="TCP", port=5432),),
+            ),
+        ),
+    )
+    noise = NetworkPolicy(
+        name="noise-80", namespace="ns-c",
+        pod_selector=Selector(),
+        policy_types=("Ingress",),
+        ingress=(Rule(ports=(PortSpec(protocol="TCP", port=80),)),),
+    )
+    return Cluster(pods=pods, policies=[lock, noise])
+
+
+def test_ported_filter_matches_full_policy_oracle():
+    """The 2-pod oracle filters the policy list to the pair's namespaces; a
+    cross-namespace ported query must answer exactly as the unfiltered
+    full-policy verify (policies only select pods in their own namespace,
+    so the dropped ones are provably irrelevant)."""
+    cluster = _cross_ns_cluster()
+    svc = VerificationService(cluster)
+    q = QueryEngine(svc)
+    cases = [
+        ("ns-a/web", "ns-b/db", 5432, "TCP"),
+        ("ns-a/web", "ns-b/db", 80, "TCP"),
+        ("ns-a/web", "ns-b/db", 5432, "UDP"),
+        ("ns-b/db", "ns-a/web", 443, "TCP"),
+        ("ns-c/noise", "ns-b/db", 5432, "TCP"),
+    ]
+    cfg = svc.engine.config
+    for src, dst, port, proto in cases:
+        # unfiltered oracle: the SAME 2-pod sub-cluster but with every
+        # policy in the cluster, noise namespace included
+        pair = [p for p in cluster.pods
+                if f"{p.namespace}/{p.name}" in (src, dst)]
+        res = kv.verify(
+            Cluster(pods=[Pod(p.name, p.namespace, labels=dict(p.labels))
+                          for p in pair],
+                    namespaces=list(cluster.namespaces),
+                    policies=list(cluster.policies)),
+            kv.VerifyConfig(
+                backend="cpu", compute_ports=True,
+                self_traffic=cfg.self_traffic,
+                default_allow_unselected=cfg.default_allow_unselected,
+                direction_aware_isolation=cfg.direction_aware_isolation,
+            ),
+        )
+        s = next(i for i, p in enumerate(pair)
+                 if f"{p.namespace}/{p.name}" == src)
+        d = next(i for i, p in enumerate(pair)
+                 if f"{p.namespace}/{p.name}" == dst)
+        want = None
+        for qi, atom in enumerate(res.port_atoms):
+            if (atom.name is None and atom.protocol == proto
+                    and atom.lo <= port <= atom.hi):
+                want = bool(res.reach_ports[s, d, qi])
+                break
+        if want is None:
+            want = bool(res.reach[s, d])
+        assert q.can_reach(src, dst, port=port, protocol=proto) == want
+        assert bool(q.can_reach_batch([(src, dst, port, proto)])[0]) == want
+    # sanity: the lock policy actually bites (5432 allowed, 80 denied)
+    assert q.can_reach("ns-a/web", "ns-b/db", port=5432) is True
+    assert q.can_reach("ns-a/web", "ns-b/db", port=80) is False
+
+
+# --------------------------------------------------- cache invalidation
+def _tiny_service():
+    pods = [Pod("a0", "x"), Pod("a1", "x"), Pod("b0", "y")]
+    return VerificationService(Cluster(pods=pods))
+
+
+def _lockdown(ns):
+    # present-but-empty ingress: selected pods isolated with no grants
+    return NetworkPolicy(name=f"lockdown-{ns}", namespace=ns,
+                         pod_selector=Selector(), ingress=())
+
+
+def test_cache_invalidated_by_applied_update():
+    svc = _tiny_service()
+    q = QueryEngine(svc)
+    probes = [("x/a0", "y/b0"), ("x/a0", "y/b0", 443, "TCP"),
+              ("x/a1", "x/a0")]
+    before = q.can_reach_batch(probes)
+    assert before.tolist() == [True, True, True]  # default-allow cluster
+    gen0 = svc.generation
+    svc.apply([AddPolicy(policy=_lockdown("y"))])
+    assert svc.generation == gen0 + 1
+    after = q.can_reach_batch(probes)
+    assert after.tolist() == [False, False, True]
+    np.testing.assert_array_equal(after, _scalar(q, probes))
+
+
+def test_what_if_never_touches_cache():
+    svc = _tiny_service()
+    q = QueryEngine(svc)
+    probes = [("x/a0", "y/b0"), ("x/a0", "y/b0", 5432, "TCP")]
+    before = q.can_reach_batch(probes)
+    gen = svc.generation
+    rows = dict(q._cache.row_pos)
+    ports = dict(q._cache.ports)
+    res = q.what_if([AddPolicy(policy=_lockdown("y"))])
+    assert res.removed  # the dry run saw the lockdown bite...
+    assert svc.generation == gen  # ...but committed nothing
+    assert q._cache.row_pos == rows and q._cache.ports == ports
+    np.testing.assert_array_equal(q.can_reach_batch(probes), before)
+
+
+def test_cache_survives_full_resync():
+    svc = _tiny_service()
+    q = QueryEngine(svc)
+    assert bool(q.can_reach_batch([("x/a0", "y/b0")])[0]) is True
+    new = Cluster(
+        pods=[Pod("a0", "x"), Pod("b0", "y"), Pod("c0", "z")],
+        policies=[_lockdown("y")],
+    )
+    svc.apply([FullResync(cluster=new)])
+    got = q.can_reach_batch(
+        [("x/a0", "y/b0"), ("z/c0", "x/a0"), ("x/a0", "y/b0", 80, "TCP")]
+    )
+    assert got.tolist() == [False, True, False]
+    # the pod dropped by the relist is gone from the rebuilt ref index
+    with pytest.raises(ServeError):
+        q.can_reach_batch([("x/a1", "x/a0")])
+
+
+# ------------------------------------------------- assertions ride rows
+def test_assertions_ride_batched_rows():
+    cluster, svc = _service(seed=17, n_pods=40, n_policies=12)
+    assertions = [
+        Assertion(name="ns0-open", kind="allow",
+                  src=PodSelector(namespace=cluster.namespaces[0].name),
+                  dst=PodSelector(namespace=cluster.namespaces[0].name)),
+        Assertion(name="sealed", kind="deny",
+                  src=PodSelector(namespace=cluster.namespaces[1].name),
+                  dst=PodSelector(namespace=cluster.namespaces[2].name)),
+    ]
+    svc.apply(random_event_stream(cluster, n_events=40, seed=9)[:20])
+    dirty_viol = check_assertions(svc, assertions)
+    assert svc.stats.solves.get("assertion_rows", 0) >= 1
+    # oracle: identical service state checked on the fully-solved matrix
+    svc.reach()  # clean -> the full-matrix branch
+    clean_viol = check_assertions(svc, assertions)
+    assert [(v.assertion, v.witness_src, v.witness_dst, v.pairs)
+            for v in dirty_viol] == \
+           [(v.assertion, v.witness_src, v.witness_dst, v.pairs)
+            for v in clean_viol]
+
+
+# ------------------------------------------------------------------ CLI
+def test_cli_batch_query(tmp_path, capsys):
+    d = str(tmp_path / "cluster")
+    assert main(["generate", d, "--pods", "16", "--policies", "4",
+                 "--namespaces", "3"]) == EXIT_OK
+    capsys.readouterr()
+    base, _ = kv.load_cluster(d)
+    r0 = f"{base.pods[0].namespace}/{base.pods[0].name}"
+    r1 = f"{base.pods[1].namespace}/{base.pods[1].name}"
+    bf = str(tmp_path / "probes.jsonl")
+    with open(bf, "w") as fh:
+        fh.write(json.dumps({"src": r0, "dst": r1}) + "\n")
+        fh.write("\n")  # blank lines are skipped
+        fh.write(json.dumps({"src": r0, "dst": r1, "port": 443}) + "\n")
+        fh.write(json.dumps(
+            {"src": r1, "dst": r0, "port": 53, "protocol": "UDP"}) + "\n")
+    assert main(["query", d, "--batch", bf, "--json"]) == EXIT_OK
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    batch = out["batch"]
+    assert batch["n"] == 3 and 0 <= batch["allowed"] <= 3
+    assert [r["port"] for r in batch["results"]] == [None, 443, 53]
+    svc = VerificationService(base)
+    q = QueryEngine(svc)
+    want = [q.can_reach(r0, r1), q.can_reach(r0, r1, port=443),
+            q.can_reach(r1, r0, port=53, protocol="UDP")]
+    assert [r["allowed"] for r in batch["results"]] == want
+    # malformed line -> input error, file:line in the message
+    bad = str(tmp_path / "bad.jsonl")
+    with open(bad, "w") as fh:
+        fh.write(json.dumps({"src": r0}) + "\n")
+    assert main(["query", d, "--batch", bad]) == EXIT_INPUT_ERROR
+    assert main(["query", d, "--batch",
+                 str(tmp_path / "missing.jsonl")]) == EXIT_INPUT_ERROR
+
+
+# ------------------------------------------------- metrics and history
+def test_query_metric_families_required():
+    for fam in ("kvtpu_query_cache_hits_total",
+                "kvtpu_query_cache_misses_total",
+                "kvtpu_query_batch_size"):
+        assert fam in REQUIRED_FAMILIES
+
+
+def test_batch_counts_cache_traffic():
+    from kubernetes_verification_tpu.observe.metrics import (
+        QUERY_BATCH_SIZE,
+        QUERY_CACHE_HITS_TOTAL,
+        QUERY_CACHE_MISSES_TOTAL,
+    )
+    cluster, svc = _service(seed=23, n_pods=20, n_policies=6, n_namespaces=3)
+    svc.apply(random_event_stream(cluster, n_events=20, seed=2)[:10])
+    q = QueryEngine(svc)
+    batch = _mixed_batch(svc, 32, seed=5)
+    m0 = QUERY_CACHE_MISSES_TOTAL.labels(kind="rows").value
+    h0 = QUERY_CACHE_HITS_TOTAL.labels(kind="rows").value
+    c0 = QUERY_BATCH_SIZE._default().count
+    q.can_reach_batch(batch)  # cold: misses fill the cache
+    q.can_reach_batch(batch)  # warm: pure hits
+    assert QUERY_CACHE_MISSES_TOTAL.labels(kind="rows").value > m0
+    assert QUERY_CACHE_HITS_TOTAL.labels(kind="rows").value > h0
+    assert QUERY_BATCH_SIZE._default().count == c0 + 2
+
+
+def test_history_gates_queries_per_second_higher():
+    from kubernetes_verification_tpu.observe.history import (
+        _direction,
+        check_regression,
+    )
+    assert _direction("queries/s") == "higher"
+    assert _direction("queries_per_second") == "higher"
+    assert _direction(None, "batched queries_per_second") == "higher"
+    assert _direction("probes/s") == "higher"  # structural: unit .../s
+    assert _direction("bytes") == "lower"
+    runs = [
+        {"metric": "queries_per_second", "unit": "widgets", "value": 100.0},
+        {"metric": "queries_per_second", "unit": "widgets", "value": 10.0},
+    ]
+    ok, findings = check_regression(runs, tolerance=0.25)
+    assert not ok and findings[0]["direction"] == "higher"
