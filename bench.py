@@ -391,17 +391,29 @@ def bench_closure(args) -> None:
     # its closure, so repeats go straight at the kernel). The compile+first
     # sample stays OUT of the band — mixing one-time compile cost into it
     # would misread a stable kernel as noisy.
+    from kubernetes_verification_tpu.observe.metrics import (
+        CLOSURE_ITERATIONS,
+    )
     from kubernetes_verification_tpu.ops.closure import packed_closure
 
     full_times = []
+    iter_counts = []
     for _ in range(3):
+        it0 = CLOSURE_ITERATIONS.value
         s = time.perf_counter()
         sync(packed_closure(inc._packed, tile=args.closure_tile))
         full_times.append(time.perf_counter() - s)
+        iter_counts.append(CLOSURE_ITERATIONS.value - it0)
     full_band = _band(full_times)
     full_s = full_band["median_s"]
+    iter_band = {
+        "min": int(min(iter_counts)),
+        "median": int(sorted(iter_counts)[len(iter_counts) // 2]),
+        "max": int(max(iter_counts)),
+    }
     log(f"full packed closure: median {full_s:.1f}s "
-        f"(min {full_band['min_s']:.1f} max {full_band['max_s']:.1f})")
+        f"(min {full_band['min_s']:.1f} max {full_band['max_s']:.1f}), "
+        f"{iter_band['median']} squaring passes")
     pols = list(cluster.policies)
     # adds-only diff: append a NARROW rule to an existing policy — its
     # selection (so every isolation count) is unchanged and grants only
@@ -482,9 +494,25 @@ def bench_closure(args) -> None:
             "full_band": full_band,
             "mixed_diff_s": round(mixed_s, 2),
             "adds_diff_real": adds_real,
+            "iterations": iter_band,
             # first full closure includes compile; full_s is its steady median
             "compile_s": round(full_first, 2),
             "steady_s": round(full_s, 4),
+        }
+    )
+    # second record: the closure THROUGHPUT series — all-pairs transitive
+    # reachability per steady-state second. Its own metric name so the
+    # history gate tracks it as a higher-is-better series (explicitly
+    # listed in observe/history.py) independent of the latency headline.
+    _emit(
+        {
+            "metric": "closure_pairs_per_second",
+            "value": round(float(n) * float(n) / full_s, 1) if full_s else 0.0,
+            "unit": "pairs/s",
+            "pods": n,
+            "policies": args.policies,
+            "full_band": full_band,
+            "iterations": iter_band,
         }
     )
 

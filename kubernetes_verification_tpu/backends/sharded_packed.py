@@ -228,8 +228,16 @@ class ShardedPackedBackend(VerifierBackend):
 
             # closure_tile is its own knob: the dst-sweep "tile" shapes the
             # broadcast geometry and is often tuned small; the squaring
-            # kernel wants its larger default
-            closure_packed = pk.closure(tile=config.opt("closure_tile", 7168))
+            # kernel wants its larger default. The closure rides the SAME
+            # mesh as the sweep — row stripes over the pod axis — so the
+            # per-device working set scales down with the fleet, and the
+            # pre-flight HBM guard (ClosureBudgetError → exit 2) refuses
+            # configs that would OOM instead of letting the device die
+            closure_packed = pk.closure(
+                tile=config.opt("closure_tile", 7168),
+                mesh=mesh,
+                hbm_limit=config.opt("hbm_limit"),
+            )
             if dense_ok:
                 closure = unpack_cols(closure_packed, cluster.n_pods)
         from ..ops.tiled import policy_pair_masks_sharded, policy_sets_sharded

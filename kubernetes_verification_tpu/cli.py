@@ -1149,6 +1149,18 @@ def _run_query(args) -> int:
             "src": args.blast_radius,
             "targets": q.blast_radius(args.blast_radius),
         }
+    if getattr(args, "path_exists", None):
+        src, dst = args.path_exists
+        out["path_exists"] = {
+            "src": src, "dst": dst, "max_hops": args.max_hops,
+            "exists": q.path_exists(src, dst, max_hops=args.max_hops),
+        }
+    if getattr(args, "hops", None):
+        src, dst = args.hops
+        out["hops"] = {
+            "src": src, "dst": dst, "max_hops": args.max_hops,
+            "hops": q.hops(src, dst, max_hops=args.max_hops),
+        }
     if args.what_if:
         import kubernetes_verification_tpu as kv
 
@@ -1177,6 +1189,7 @@ def _run_query(args) -> int:
         raise SystemExit(
             "query: nothing to answer — give --can-reach SRC DST, "
             "--batch FILE.jsonl, --who-can-reach DST, --blast-radius SRC, "
+            "--path-exists SRC DST, --hops SRC DST, "
             "--what-if MANIFESTS and/or --assert FILE"
         )
     if args.json:
@@ -1214,6 +1227,28 @@ def _run_query(args) -> int:
             b = out["blast_radius"]
             print(f"{b['src']} can reach {len(b['targets'])} pods: "
                   f"{b['targets']}")
+        if "path_exists" in out:
+            pe = out["path_exists"]
+            bound = (
+                f" within {pe['max_hops']} hops"
+                if pe["max_hops"] is not None
+                else ""
+            )
+            print(
+                f"path {pe['src']} ->* {pe['dst']}{bound}: "
+                f"{'EXISTS' if pe['exists'] else 'NONE'}"
+            )
+        if "hops" in out:
+            h = out["hops"]
+            bound = (
+                f" within {h['max_hops']} hops"
+                if h["max_hops"] is not None
+                else ""
+            )
+            print(
+                f"hops {h['src']} ->* {h['dst']}{bound}: "
+                + (str(h["hops"]) if h["hops"] > 0 else "UNREACHABLE")
+            )
         if "what_if" in out:
             w = out["what_if"]
             print(
@@ -1505,7 +1540,8 @@ def main(argv: Optional[list] = None) -> int:
         "query",
         help="one-shot queries against a cluster or serve snapshot: "
         "can-reach (scalar or --batch JSONL) / who-can-reach / "
-        "blast-radius / what-if admission",
+        "blast-radius / path-exists & hops (bounded closure) / "
+        "what-if admission",
     )
     p.add_argument("path", nargs="?", help="manifest file/dir")
     p.add_argument(
@@ -1531,6 +1567,22 @@ def main(argv: Optional[list] = None) -> int:
     )
     p.add_argument("--who-can-reach", metavar="DST")
     p.add_argument("--blast-radius", metavar="SRC")
+    p.add_argument(
+        "--path-exists", nargs=2, metavar=("SRC", "DST"),
+        help="is there a multi-hop path SRC -> ... -> DST? Rides the "
+        "bounded multi-source closure — per level one [1, N] frontier, "
+        "never an N x N closure, so it answers at matrix-free scale",
+    )
+    p.add_argument(
+        "--hops", nargs=2, metavar=("SRC", "DST"),
+        help="shortest allowed-path hop count SRC -> DST (1 = direct "
+        "edge; exit text says UNREACHABLE when there is none)",
+    )
+    p.add_argument(
+        "--max-hops", type=int, default=None, metavar="H",
+        help="with --path-exists/--hops: bound the search to paths of at "
+        "most H edges (default: unbounded)",
+    )
     p.add_argument(
         "--what-if", metavar="MANIFESTS",
         help="admission dry run: would adding these NetworkPolicy "

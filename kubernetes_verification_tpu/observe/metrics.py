@@ -19,6 +19,10 @@ __all__ = [
     "PAIRS_PER_SECOND",
     "BYTES_TRANSFERRED",
     "CLOSURE_ITERATIONS",
+    "CLOSURE_SHARDED_ITERATIONS",
+    "CLOSURE_STRIPE_ROWS",
+    "CLOSURE_BOUNDED_LEVELS",
+    "HBM_GUARD_REFUSALS",
     "DELTA_CLOSURE_ROUNDS",
     "INCREMENTAL_OPS",
     "STRIPE_WIDTH",
@@ -87,6 +91,35 @@ CLOSURE_ITERATIONS = Counter(
     "Boolean matrix squarings executed by host-driven transitive-closure "
     "loops (packed fixpoint + NumPy oracle). Unlabeled so it appears in "
     "every dump.",
+)
+
+CLOSURE_SHARDED_ITERATIONS = Counter(
+    "kvtpu_closure_sharded_iterations_total",
+    "Mesh-sharded squaring passes executed by sharded_packed_closure — each "
+    "one is a full all-gather + per-stripe retile sweep over the (pods, "
+    "grants) mesh, converged on the globally-reduced change flag.",
+)
+
+CLOSURE_STRIPE_ROWS = Gauge(
+    "kvtpu_closure_stripe_rows",
+    "Row-stripe height (source rows per device) of the most recent "
+    "sharded closure dispatch — N padded to the mesh geometry over the pod "
+    "axis; how wide the closure was sharded.",
+)
+
+CLOSURE_BOUNDED_LEVELS = Counter(
+    "kvtpu_closure_bounded_levels_total",
+    "Frontier levels (one-hop [K, N] extensions) executed by the bounded "
+    "multi-source closure instead of full N x N squarings — the path-query "
+    "work metric at matrix-free scale.",
+)
+
+HBM_GUARD_REFUSALS = Counter(
+    "kvtpu_hbm_guard_refusals_total",
+    "Closure dispatches refused by the pre-flight HBM guard because the "
+    "estimated working set exceeded the device budget — each refusal "
+    "replaced a device OOM with actionable guidance (shard wider / bounded "
+    "mode / lower tile cap).",
 )
 
 DELTA_CLOSURE_ROUNDS = Counter(
@@ -337,6 +370,11 @@ REQUIRED_FAMILIES = frozenset(
         "kvtpu_pairs_per_second",
         "kvtpu_bytes_transferred",
         "kvtpu_closure_iterations_total",
+        # distributed/bounded closure engine (parallel/sharded_closure.py)
+        "kvtpu_closure_sharded_iterations_total",
+        "kvtpu_closure_stripe_rows",
+        "kvtpu_closure_bounded_levels_total",
+        "kvtpu_hbm_guard_refusals_total",
         "kvtpu_delta_closure_rounds_total",
         "kvtpu_incremental_ops_total",
         "kvtpu_stripe_width",

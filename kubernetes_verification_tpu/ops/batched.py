@@ -29,7 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["batched_reach_rows", "batched_any_port"]
+__all__ = ["batched_reach_rows", "batched_reach_cols", "batched_any_port"]
 
 _I32 = jnp.int32
 
@@ -97,6 +97,40 @@ def _probe_rows_kernel(
     return rows, rows[q_row, q_dst]
 
 
+@partial(
+    jax.jit,
+    static_argnames=("self_traffic", "default_allow_unselected"),
+)
+def _reach_cols_kernel(
+    ing_count,
+    eg_count,
+    ing_iso,
+    eg_iso,
+    dst_idx,
+    *,
+    self_traffic: bool,
+    default_allow_unselected: bool,
+):
+    """Reach COLUMNS for the destinations in ``dst_idx`` — the transpose
+    twin of ``_reach_rows_kernel`` (``who_can_reach``: fix dst, vary every
+    source) as a [N, U] gather, never the full matrix::
+
+        ing_ok[i, d] = ing_count[i, d] > 0   (| ing_iso[d] == 0)
+        eg_ok [i, d] = eg_count [i, d] > 0   (| eg_iso [i] == 0)
+        col   [i, d] = ing_ok & eg_ok        (| i == d)
+    """
+    ing_ok = ing_count[:, dst_idx] > 0
+    eg_ok = eg_count[:, dst_idx] > 0
+    if default_allow_unselected:
+        ing_ok |= (ing_iso[dst_idx] == 0)[None, :]
+        eg_ok |= (eg_iso == 0)[:, None]
+    cols = ing_ok & eg_ok
+    if self_traffic:
+        n = ing_count.shape[0]
+        cols |= jnp.arange(n)[:, None] == dst_idx[None, :]
+    return cols
+
+
 def _pad_idx(idx: np.ndarray, length: int) -> jnp.ndarray:
     """Pad an index vector to ``length`` by repeating its last entry (a
     valid index, so padding lanes compute garbage-free rows)."""
@@ -138,6 +172,37 @@ def batched_reach_rows(
         default_allow_unselected=default_allow_unselected,
     )
     return np.asarray(rows)[: src_idx.size]
+
+
+def batched_reach_cols(
+    ing_count,
+    eg_count,
+    ing_iso,
+    eg_iso,
+    dst_idx,
+    *,
+    self_traffic: bool,
+    default_allow_unselected: bool,
+) -> np.ndarray:
+    """Gather the reach columns of ``dst_idx`` (host int array, [U]) in one
+    device dispatch; returns bool [N, U] — column ``k`` lists every source
+    that reaches ``dst_idx[k]``. Same padding discipline as the row path:
+    batch padded to the next power of two, pad lanes sliced off."""
+    dst_idx = np.asarray(dst_idx, dtype=np.int64)
+    n = int(ing_count.shape[0])
+    if dst_idx.size == 0:
+        return np.zeros((n, 0), dtype=bool)
+    padded = _pad_idx(dst_idx, _pow2(dst_idx.size))
+    cols = _reach_cols_kernel(
+        ing_count,
+        eg_count,
+        jnp.asarray(ing_iso, dtype=_I32),
+        jnp.asarray(eg_iso, dtype=_I32),
+        padded,
+        self_traffic=self_traffic,
+        default_allow_unselected=default_allow_unselected,
+    )
+    return np.asarray(cols)[:, : dst_idx.size]
 
 
 def batched_any_port(

@@ -80,6 +80,7 @@ from .encode.ports import ALL_ATOM
 from .models.core import Cluster, Namespace, NetworkPolicy, Pod
 from .observe import DispatchTracker
 from .observe.metrics import INCREMENTAL_OPS, STRIPE_WIDTH, STRIPES_SOLVED
+from .resilience.errors import ConfigError
 from .resilience.retry import RetryPolicy, retry_transient
 from .ops.tiled import (
     PackedReach,
@@ -477,6 +478,44 @@ def _stripe_step(
     r &= row_valid[:, None] > 0
     mask_t = jax.lax.dynamic_slice(col_mask, (d0 // 32,), (width // 32,))
     return pack_bool_cols(r) & mask_t[None, :]
+
+
+@partial(jax.jit, static_argnames=("self_traffic", "default_allow"))
+def _rows_step(
+    sel_ing8,
+    sel_eg8,
+    ing_by_pol,
+    eg_by_pol,
+    ing_cnt,
+    eg_cnt,
+    col_mask,
+    row_valid,
+    rows,  # int32 [K] — source pod ids (pads repeat a valid id)
+    *,
+    self_traffic: bool,
+    default_allow: bool,
+):
+    """Re-solve the packed reach ROWS of ``rows`` straight from the
+    resident per-policy maps — the transpose of ``_stripe_step``: skinny
+    [K, Np] instead of [Np, width]. This is the row oracle the bounded
+    multi-source closure BFS rides at matrix-free scale (one frontier's
+    out-edges per level, the N x N matrix never materialised). Returns
+    uint32 [K, Np/32]."""
+    C, Np = sel_ing8.shape
+    r = _reach_block(
+        jnp.take(ing_by_pol, rows, axis=1),
+        sel_ing8,
+        jnp.take(sel_eg8, rows, axis=1),
+        eg_by_pol,
+        ing_cnt,
+        jnp.take(eg_cnt, rows),
+        rows,
+        jnp.arange(Np, dtype=jnp.int32),
+        self_traffic,
+        default_allow,
+    )
+    r &= jnp.take(row_valid, rows)[:, None] > 0
+    return pack_bool_cols(r) & col_mask[None, :]
 
 
 @partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5))
@@ -1794,6 +1833,46 @@ class PackedIncrementalVerifier:
             backend=self.metrics_engine,
         )
         return np.asarray(out[: self.n_pods])
+
+    def solve_rows(self, rows) -> np.ndarray:
+        """Re-solve the packed reach ROWS of the given source pod indices
+        straight from the current maps → uint32 [K, n_padded/32] (word
+        columns cover the full padded dst range; padded/tombstoned columns
+        are masked off). The transpose of :meth:`solve_stripe` and the row
+        oracle for :func:`~.ops.closure.bounded_closure_rows` at config-5
+        scale — a path query's whole BFS touches K rows per level, never
+        the N x N matrix. The batch is padded to the next power of two
+        (pads repeat a valid id) so compiled signatures stay logarithmic
+        in K."""
+        rows = np.asarray(rows, dtype=np.int32)
+        if rows.ndim != 1:
+            raise ConfigError("rows must be a 1-D index array")
+        if rows.size == 0:
+            return np.zeros((0, self._n_padded // 32), dtype=np.uint32)
+        if rows.min() < 0 or rows.max() >= self.n_pods:
+            raise ConfigError(
+                f"row index out of range [0, {self.n_pods})"
+            )
+        k = rows.size
+        pad = 1 << max(0, k - 1).bit_length()
+        padded = np.empty(pad, dtype=np.int32)
+        padded[:k] = rows
+        padded[k:] = rows[-1]
+        row_args = (
+            *self._maps, self._col_mask, self._row_valid,
+            self._put(padded, "rep"),
+        )
+        _TRACKER.track(
+            "_rows_step", self._maps,
+            static=(pad,) + tuple(sorted(self._flags.items())),
+            lower=lambda: _rows_step.lower(*row_args, **self._flags),
+        )
+        out = retry_transient(
+            lambda: _rows_step(*row_args, **self._flags),
+            policy=self.retry_policy,
+            backend=self.metrics_engine,
+        )
+        return np.asarray(out[:k])
 
     def packed_reach(self) -> PackedReach:
         """Current state as a :class:`~.ops.tiled.PackedReach` (the packed

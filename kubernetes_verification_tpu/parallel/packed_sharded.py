@@ -192,16 +192,39 @@ class PackedShardedResult:
         self._require_full("to_bool")
         return unpack_cols(self.packed, self.n_pods)
 
-    def closure(self, tile: int = 7168, max_iter: int = 32) -> np.ndarray:
-        """Packed-domain transitive closure of the kept matrix
-        (``ops/closure.packed_closure``) → uint32 [N, W]. Needs
-        ``keep_matrix=True`` and a full sweep."""
+    def closure(
+        self,
+        tile: int = 7168,
+        max_iter: int = 32,
+        mesh=None,
+        hbm_limit: Optional[int] = None,
+    ) -> np.ndarray:
+        """Packed-domain transitive closure of the kept matrix → uint32
+        [N, W]. Needs ``keep_matrix=True`` and a full sweep.
+
+        With ``mesh`` (any device count, including 1) the squaring runs
+        mesh-sharded (:func:`~.sharded_closure.sharded_packed_closure`):
+        each device owns a row stripe, the per-pass working set shrinks by
+        the device count, and the pre-flight HBM guard refuses dispatches
+        that would OOM (``hbm_limit`` overrides the detected budget).
+        Without a mesh it is the single-device ``packed_closure`` — the
+        two paths are bit-identical by the fixpoint argument."""
         if self.packed is None:
             raise ValueError(
                 "closure needs keep_matrix=True (the packed matrix is the "
                 "closure's operand); re-run with keep_matrix"
             )
         self._require_full("closure")
+        if mesh is not None:
+            from .sharded_closure import sharded_packed_closure
+
+            return sharded_packed_closure(
+                mesh,
+                np.asarray(self.packed[: self.n_pods]),
+                tile=tile,
+                max_iter=max_iter,
+                hbm_limit=hbm_limit,
+            )
         from ..ops.closure import packed_closure
 
         W = self.packed.shape[1]

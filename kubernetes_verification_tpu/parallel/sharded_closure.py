@@ -1,0 +1,353 @@
+"""Mesh-sharded transitive closure — config 5's path-query engine.
+
+``packed_closure`` (``ops/closure.py``) is single-device: both packed
+matrices plus the unpacked dot transients must fit one HBM, which caps it
+around ~200k pods. This module distributes the same squaring over the
+``(pods, grants)`` mesh with **row-stripe ownership** — the block-distributed
+matmul schedule of PAPERS.md (*Large Scale Distributed Linear Algebra With
+Tensor Processing Units*) specialised to the boolean-squaring fixpoint:
+
+* each of the ``dp`` pod-axis devices owns a ``[N/dp, W]`` packed row stripe
+  of the matrix, end-to-end across passes — stripes never move;
+* the ``mp`` grant-axis devices split the **destination** axis: member ``g``
+  computes the output word-columns of its ``N/mp`` dst range, so the per-pass
+  MXU work divides by the full ``dp·mp`` device count;
+* per dst tile, the needed operand is the full matrix's column block — an
+  ``all_gather`` of each stripe's word slice over the pod axis (``N ×
+  dst_tile/8`` bytes per tile, riding ICI), unpacked transiently to int8
+  exactly like the single-device kernel;
+* the rectangular retile of ``_packed_square_step`` is preserved per stripe
+  (dst loop outer so ``b`` unpacks once per stripe, wide ``dst_tile``, row
+  tile sizing the dot's M dimension);
+* the grant members' outputs cover disjoint word ranges, so a ``psum`` over
+  the grant axis doubles as the bitwise OR, and the host loop converges on a
+  **globally-reduced change flag** (``psum`` over both axes) instead of the
+  fixed ⌈log₂N⌉ schedule — real policy graphs close in 2-3 passes.
+
+The pre-flight **HBM guard** (:func:`check_closure_budget`) estimates the
+per-device working set from ``(N, W, tile, D)`` and refuses with actionable
+guidance — shard wider, switch to the bounded multi-source closure
+(``ops.closure.bounded_packed_closure`` / ``bounded_closure_rows``), or
+lower the tile caps — instead of letting XLA OOM mid-fixpoint.
+"""
+from __future__ import annotations
+
+import os
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..observe.metrics import (
+    CLOSURE_ITERATIONS,
+    CLOSURE_SHARDED_ITERATIONS,
+    CLOSURE_STRIPE_ROWS,
+    HBM_GUARD_REFUSALS,
+)
+from ..ops.closure import _fit_tile, _unpack_rows_i8
+from ..resilience.errors import ConfigError
+from .mesh import GRANT_AXIS, POD_AXIS, shard_map
+
+__all__ = [
+    "ClosureBudgetError",
+    "estimate_closure_hbm",
+    "check_closure_budget",
+    "sharded_packed_closure",
+]
+
+_I32 = jnp.int32
+_U32 = jnp.uint32
+
+#: env override for the per-device closure budget (bytes); useful to force
+#: refusals in tests and to declare the real HBM on platforms whose
+#: ``memory_stats()`` is absent (the CPU backend)
+_LIMIT_ENV = "KVTPU_HBM_LIMIT_BYTES"
+
+
+class ClosureBudgetError(ConfigError):
+    """The closure pre-flight guard refused dispatch: the estimated
+    per-device working set exceeds the HBM budget. Carries the estimate so
+    callers can render the guidance table. Exit-code contract: input/config
+    error (2) — fixed by changing the geometry, not by retrying."""
+
+    def __init__(self, message: str, *, estimate: Optional[dict] = None):
+        super().__init__(message)
+        self.estimate = estimate or {}
+
+
+def estimate_closure_hbm(
+    n: int,
+    *,
+    row_tile: int,
+    dst_tile: int,
+    n_devices: int = 1,
+    grant_devices: int = 1,
+) -> dict:
+    """Per-device working-set estimate (bytes) of one sharded squaring pass
+    at ``N=n`` over ``dp=n_devices`` row stripes and ``mp=grant_devices``
+    dst ranges. Components mirror the kernel's live buffers:
+
+    - ``stripe``: the owned packed rows, ``(N/dp)·(N/32)·4`` — held twice
+      (input stripe + accumulating output) plus once more for the psum
+      scratch of the grant-axis OR;
+    - ``gather``: the all-gathered packed dst column block, ``N·dst_tile/8``;
+    - ``b``: its transient int8 unpack, ``N·dst_tile``;
+    - ``a``: the unpacked row tile, ``row_tile·N``;
+    - ``counts``: the int32 dot output, ``4·row_tile·dst_tile``.
+
+    ``n_devices=1, grant_devices=1`` prices the single-device
+    ``packed_closure`` (the stripe is the whole matrix)."""
+    n = int(n)
+    dp = max(1, int(n_devices))
+    mp = max(1, int(grant_devices))
+    w_bytes = (n // 32) * 4
+    stripe = -(-n // dp) * w_bytes
+    gather = n * (dst_tile // 32) * 4
+    b = n * dst_tile
+    a = row_tile * n
+    counts = 4 * row_tile * dst_tile
+    total = 3 * stripe + gather + b + a + counts
+    return {
+        "n": n,
+        "n_devices": dp,
+        "grant_devices": mp,
+        "row_tile": int(row_tile),
+        "dst_tile": int(dst_tile),
+        "stripe_bytes": stripe,
+        "gather_bytes": gather,
+        "b_bytes": b,
+        "a_bytes": a,
+        "counts_bytes": counts,
+        "total_bytes": total,
+    }
+
+
+def _device_budget() -> Optional[int]:
+    """The per-device byte budget: ``KVTPU_HBM_LIMIT_BYTES`` when set, else
+    the platform's ``memory_stats()['bytes_limit']`` (real chips), else
+    ``None`` — no implicit budget on platforms that don't declare one (the
+    CPU backend), so dryruns never false-refuse."""
+    env = os.environ.get(_LIMIT_ENV)
+    if env:
+        try:
+            return int(float(env))
+        except ValueError:
+            raise ConfigError(
+                f"{_LIMIT_ENV}={env!r} is not a byte count"
+            ) from None
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:
+        return None
+    if stats and "bytes_limit" in stats:
+        return int(stats["bytes_limit"])
+    return None
+
+
+def check_closure_budget(
+    n: int,
+    *,
+    row_tile: int,
+    dst_tile: int,
+    n_devices: int = 1,
+    grant_devices: int = 1,
+    limit_bytes: Optional[int] = None,
+) -> dict:
+    """Pre-flight HBM guard: estimate the closure working set and raise
+    :class:`ClosureBudgetError` with actionable guidance when it exceeds
+    the budget (``limit_bytes``, else env / device-declared — see
+    :func:`_device_budget`; no declared budget means no refusal). Returns
+    the estimate dict on acceptance. Increments
+    ``kvtpu_hbm_guard_refusals_total`` on refusal."""
+    est = estimate_closure_hbm(
+        n,
+        row_tile=row_tile,
+        dst_tile=dst_tile,
+        n_devices=n_devices,
+        grant_devices=grant_devices,
+    )
+    limit = limit_bytes if limit_bytes is not None else _device_budget()
+    est["limit_bytes"] = limit
+    if limit is None or est["total_bytes"] <= limit:
+        return est
+    HBM_GUARD_REFUSALS.inc()
+    gb = 1e9
+    # guidance: each suggestion re-prices the dominant terms
+    wider = estimate_closure_hbm(
+        n,
+        row_tile=row_tile,
+        dst_tile=dst_tile,
+        n_devices=2 * n_devices,
+        grant_devices=grant_devices,
+    )["total_bytes"]
+    lower_cap = max(32, ((limit // max(3 * n, 1)) // 32) * 32)
+    raise ClosureBudgetError(
+        f"closure refused pre-flight: estimated working set "
+        f"{est['total_bytes'] / gb:.2f} GB/device exceeds the "
+        f"{limit / gb:.2f} GB budget at N={n}, row_tile={row_tile}, "
+        f"dst_tile={dst_tile}, devices={n_devices}x{grant_devices} "
+        f"(stripe {3 * est['stripe_bytes'] / gb:.2f} GB, dst transients "
+        f"{(est['gather_bytes'] + est['b_bytes']) / gb:.2f} GB, row tile "
+        f"{est['a_bytes'] / gb:.2f} GB). Options: (1) shard wider — "
+        f"{2 * n_devices} row-stripe devices brings it to "
+        f"{wider / gb:.2f} GB/device; (2) use the bounded multi-source "
+        f"closure (seed the rows of interest — serve path_exists/hops, "
+        f"ops.closure.bounded_packed_closure) which never holds N x N; "
+        f"(3) lower the tile caps (try tile/dst_tile <= {lower_cap}) to "
+        f"shrink the unpacked transients.",
+        estimate=est,
+    )
+
+
+def _sharded_square_local(
+    stripe: jnp.ndarray,
+    *,
+    n_total: int,
+    row_tile: int,
+    dst_tile: int,
+    mp: int,
+):
+    """SPMD body: one squaring-with-union pass on this device's packed row
+    stripe. The grant member computes its own ``N/mp`` dst word range (tile
+    starts are traced — one executable serves every member); contributions
+    land in disjoint word columns, so the grant-axis ``psum`` is the OR.
+    Returns the updated stripe and the globally-reduced change count."""
+    from ..ops.tiled import pack_bool_cols
+
+    n_loc, W = stripe.shape
+    N = n_total
+    my_grant = jax.lax.axis_index(GRANT_AXIS)
+    cols_per_dev = N // mp
+    n_dst = cols_per_dev // dst_tile
+    n_row = n_loc // row_tile
+
+    def dst_body(dt, out):
+        d0 = my_grant * cols_per_dev + dt * dst_tile
+        w0 = d0 // 32
+        # the dst operand is the FULL matrix's column block: gather each
+        # stripe's word slice over the pod axis, then unpack transiently —
+        # the all-gathered dst stripe of the block-distributed schedule
+        col_loc = jax.lax.dynamic_slice(
+            stripe, (0, w0), (n_loc, dst_tile // 32)
+        )
+        col_full = jax.lax.all_gather(col_loc, POD_AXIS, axis=0, tiled=True)
+        b = _unpack_rows_i8(col_full, dst_tile)  # int8 [N, dst_tile]
+
+        def row_body(rt, o):
+            s0 = rt * row_tile
+            a = _unpack_rows_i8(
+                jax.lax.dynamic_slice(stripe, (s0, 0), (row_tile, W)), N
+            )  # int8 [row_tile, N]
+            counts = jax.lax.dot_general(
+                a, b, (((1,), (0,)), ((), ())), preferred_element_type=_I32
+            )
+            return jax.lax.dynamic_update_slice(
+                o, pack_bool_cols(counts > 0), (s0, w0)
+            )
+
+        return jax.lax.fori_loop(0, n_row, row_body, out)
+
+    sq = jax.lax.fori_loop(
+        0, n_dst, dst_body, jnp.zeros((n_loc, W), dtype=_U32)
+    )
+    # disjoint word ranges per grant member: uint32 add == bitwise OR
+    sq = jax.lax.psum(sq, GRANT_AXIS)
+    new = stripe | sq
+    changed = jnp.any(new != stripe).astype(_I32)
+    changed = jax.lax.psum(changed, (POD_AXIS, GRANT_AXIS))
+    return new, changed
+
+
+def sharded_packed_closure(
+    mesh: jax.sharding.Mesh,
+    packed,
+    *,
+    tile: int = 7168,
+    dst_tile: int = 14336,
+    max_iter: int = 32,
+    hbm_limit: Optional[int] = None,
+    guard: bool = True,
+) -> np.ndarray:
+    """Transitive closure of a packed matrix (``uint32 [n, W]``, column pad
+    bits zero) over the ``(pods, grants)`` mesh. Bit-for-bit equal to
+    ``packed_closure`` — same dots, same union, distributed schedule; a
+    single-device mesh degenerates to exactly the single-device pass
+    sequence. Returns the packed closure as ``np.ndarray [n, W]``.
+
+    ``n`` need not divide the mesh: rows and word columns are zero-padded
+    to the stripe geometry (padded nodes have no edges, so the closure of
+    the padded graph restricted to the real nodes is unchanged) and trimmed
+    on return. ``hbm_limit`` (bytes/device) feeds the pre-flight guard;
+    ``guard=False`` skips it (the single-device fallback caller already
+    priced dispatch)."""
+    dp = mesh.shape[POD_AXIS]
+    mp = mesh.shape[GRANT_AXIS]
+    packed_np = np.asarray(packed)
+    if packed_np.ndim != 2 or packed_np.dtype != np.uint32:
+        raise ConfigError(
+            f"packed matrix must be uint32 [n, W]; got "
+            f"{packed_np.dtype} {packed_np.shape}"
+        )
+    n, W0 = packed_np.shape
+    if n > W0 * 32:
+        raise ConfigError(
+            f"packed matrix has {n} rows but only {W0 * 32} bit columns"
+        )
+    if n == 0:
+        return packed_np.copy()
+    # pad N so every row stripe splits into 32-multiple row tiles and every
+    # grant member owns a whole number of 32-bit dst words
+    mult = 32 * dp * mp // np.gcd(dp, mp)
+    Np = n + (-n) % mult
+    Wp = Np // 32
+    padded = np.zeros((Np, Wp), dtype=np.uint32)
+    padded[:n, : min(W0, Wp)] = packed_np[:, : min(W0, Wp)]
+    n_loc = Np // dp
+    t = _fit_tile(n_loc, tile)
+    dt = _fit_tile(Np // mp, dst_tile)
+    if guard:
+        check_closure_budget(
+            Np,
+            row_tile=t,
+            dst_tile=dt,
+            n_devices=dp,
+            grant_devices=mp,
+            limit_bytes=hbm_limit,
+        )
+    CLOSURE_STRIPE_ROWS.set(n_loc)
+    fn = jax.jit(
+        shard_map(
+            partial(
+                _sharded_square_local,
+                n_total=Np,
+                row_tile=t,
+                dst_tile=dt,
+                mp=mp,
+            ),
+            mesh=mesh,
+            in_specs=P(POD_AXIS, None),
+            out_specs=(P(POD_AXIS, None), P()),
+            check_vma=False,
+        )
+    )
+    cur = jnp.asarray(padded)
+    for _ in range(max_iter):
+        CLOSURE_ITERATIONS.inc()
+        CLOSURE_SHARDED_ITERATIONS.inc()
+        cur, changed = fn(cur)
+        # the one sanctioned host sync of the loop: the globally-psum'd
+        # change flag decides convergence — without the readback every run
+        # would pay the full ⌈log₂N⌉ schedule
+        if int(np.asarray(changed)) == 0:
+            break
+    out = np.asarray(cur)
+    if (Np, Wp) == (n, W0):
+        return out
+    # trim pad rows; restore the caller's word width (columns >= Np are pad
+    # bits — zero by contract and untouched by the closure)
+    res = np.zeros((n, W0), dtype=np.uint32)
+    res[:, : min(W0, Wp)] = out[:n, : min(W0, Wp)]
+    return res

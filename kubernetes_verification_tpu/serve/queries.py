@@ -59,7 +59,11 @@ from ..observe.metrics import (
     SERVE_QUERIES_TOTAL,
     SERVE_SOLVES_TOTAL,
 )
-from ..ops.batched import batched_any_port, batched_reach_rows
+from ..ops.batched import (
+    batched_any_port,
+    batched_reach_cols,
+    batched_reach_rows,
+)
 from ..resilience.breaker import CLOSED
 from ..resilience.errors import BackendError, IngestError, ServeError
 from .events import AddPolicy, Event, RemovePolicy, UpdatePolicy
@@ -766,26 +770,191 @@ class QueryEngine:
             out.append((k, _table_answer(entry, port, proto)))
         return out
 
+    def _reach_rows(self, src_idx: np.ndarray) -> np.ndarray:
+        """Reach ROWS bool [U, N] for index array ``src_idx`` (lock held).
+        Same ladder as :meth:`_any_port_batch` — standing fallback matrix →
+        clean engine → breaker not closed (service ladder) → cached batched
+        row gather — but returning whole rows instead of probe answers."""
+        svc = self.service
+        src_idx = np.asarray(src_idx, dtype=np.int64)
+        if svc._fallback_reach is not None:
+            return np.asarray(svc._fallback_reach)[src_idx, :]
+        eng = svc.engine
+        if eng._reach is not None and not eng._reach_dirty:
+            return np.asarray(eng.reach)[src_idx, :]
+        br = svc._breaker
+        if br is not None and br.state != CLOSED:
+            return svc._solve("query")[src_idx, :]
+        cache = self._cache
+        row_pos = cache.row_pos
+        uniq, inv = np.unique(src_idx, return_inverse=True)
+        hit = np.fromiter(
+            (int(u) in row_pos for u in uniq), bool, uniq.size
+        )
+        missing = uniq[~hit]
+        if hit.any():
+            QUERY_CACHE_HITS_TOTAL.labels(kind="rows").inc(int(hit.sum()))
+        if missing.size:
+            QUERY_CACHE_MISSES_TOTAL.labels(kind="rows").inc(
+                int(missing.size)
+            )
+        cfg = eng.config
+        try:
+            if missing.size:
+                rows = batched_reach_rows(
+                    eng._ing_count,
+                    eng._eg_count,
+                    eng._ing_iso,
+                    eng._eg_iso,
+                    missing,
+                    self_traffic=cfg.self_traffic,
+                    default_allow_unselected=cfg.default_allow_unselected,
+                )
+                cache.add_rows(missing, rows)
+        except BackendError:
+            return svc._solve("query")[src_idx, :]
+        pos = np.fromiter(
+            (row_pos[int(u)] for u in uniq), np.int64, uniq.size
+        )
+        return cache.row_mat[pos[inv], :]
+
+    def _reach_cols(self, dst_idx: np.ndarray) -> np.ndarray:
+        """Reach COLUMNS bool [N, U] for index array ``dst_idx`` (lock
+        held) — the ``who_can_reach`` ladder over the batched column
+        gather; columns are not memoized (sources repeat across probe
+        streams, destinations rarely do)."""
+        svc = self.service
+        dst_idx = np.asarray(dst_idx, dtype=np.int64)
+        if svc._fallback_reach is not None:
+            return np.asarray(svc._fallback_reach)[:, dst_idx]
+        eng = svc.engine
+        if eng._reach is not None and not eng._reach_dirty:
+            return np.asarray(eng.reach)[:, dst_idx]
+        br = svc._breaker
+        if br is not None and br.state != CLOSED:
+            return svc._solve("query")[:, dst_idx]
+        cfg = eng.config
+        try:
+            return batched_reach_cols(
+                eng._ing_count,
+                eng._eg_count,
+                eng._ing_iso,
+                eng._eg_iso,
+                dst_idx,
+                self_traffic=cfg.self_traffic,
+                default_allow_unselected=cfg.default_allow_unselected,
+            )
+        except BackendError:
+            return svc._solve("query")[:, dst_idx]
+
     def who_can_reach(self, dst: str) -> List[str]:
-        """Every pod that can reach ``dst`` (one column of the matrix)."""
+        """Every pod that can reach ``dst`` (one column of the matrix) —
+        one batched column gather, never a full solve on a clean ladder."""
         self._count("who_can_reach")
-        di = self._idx(dst)
-        reach = self.service.reach()
-        pods = self.service.engine.pods
-        return [
-            _pod_name(pods[i]) for i in np.nonzero(reach[:, di])[0] if i != di
-        ]
+        return self._who_can_reach_idx([self._idx(dst)])[0]
+
+    def who_can_reach_batch(self, dsts: Sequence[str]) -> List[List[str]]:
+        """``who_can_reach`` for many destinations in ONE device dispatch
+        (the column-gather twin of ``can_reach_batch``'s row path)."""
+        n_q = len(dsts)
+        SERVE_QUERIES_TOTAL.labels(kind="who_can_reach_batch").inc(n_q)
+        st = self.service.stats
+        st.queries["who_can_reach_batch"] = (
+            st.queries.get("who_can_reach_batch", 0) + n_q
+        )
+        return self._who_can_reach_idx([self._idx(d) for d in dsts])
+
+    def _who_can_reach_idx(self, idx: List[int]) -> List[List[str]]:
+        svc = self.service
+        svc.flush()
+        with svc._lock:
+            self._cache.sync(svc)
+            cols = self._reach_cols(np.asarray(idx, dtype=np.int64))
+            pods = svc.engine.pods
+            return [
+                [
+                    _pod_name(pods[i])
+                    for i in np.nonzero(cols[:, k])[0]
+                    if i != di
+                ]
+                for k, di in enumerate(idx)
+            ]
 
     def blast_radius(self, src: str) -> List[str]:
         """Every pod that ``src`` can reach (one row of the matrix) — the
-        exposure set if ``src`` is compromised."""
+        exposure set if ``src`` is compromised. Rides the same cached
+        batched row gather as ``can_reach_batch``."""
         self._count("blast_radius")
-        si = self._idx(src)
-        reach = self.service.reach()
-        pods = self.service.engine.pods
-        return [
-            _pod_name(pods[i]) for i in np.nonzero(reach[si, :])[0] if i != si
-        ]
+        return self._blast_radius_idx([self._idx(src)])[0]
+
+    def blast_radius_batch(self, srcs: Sequence[str]) -> List[List[str]]:
+        """``blast_radius`` for many sources in one dispatch, rows memoized
+        in the generation-keyed cache."""
+        n_q = len(srcs)
+        SERVE_QUERIES_TOTAL.labels(kind="blast_radius_batch").inc(n_q)
+        st = self.service.stats
+        st.queries["blast_radius_batch"] = (
+            st.queries.get("blast_radius_batch", 0) + n_q
+        )
+        return self._blast_radius_idx([self._idx(s) for s in srcs])
+
+    def _blast_radius_idx(self, idx: List[int]) -> List[List[str]]:
+        svc = self.service
+        svc.flush()
+        with svc._lock:
+            self._cache.sync(svc)
+            rows = self._reach_rows(np.asarray(idx, dtype=np.int64))
+            pods = svc.engine.pods
+            return [
+                [
+                    _pod_name(pods[i])
+                    for i in np.nonzero(rows[k, :])[0]
+                    if i != si
+                ]
+                for k, si in enumerate(idx)
+            ]
+
+    # ------------------------------------------------------------- paths
+    def path_exists(
+        self, src: str, dst: str, max_hops: Optional[int] = None
+    ) -> bool:
+        """Is there a multi-hop path ``src`` → ... → ``dst`` of at most
+        ``max_hops`` edges (``None`` = any length)? Rides the bounded
+        multi-source closure (``ops.closure.bounded_closure_rows``) seeded
+        at ``src`` over the engine's batched row gather — per level the
+        state is one ``[1, N]`` frontier, never an N×N closure."""
+        self._count("path_exists")
+        si, di = self._idx(src), self._idx(dst)
+        acc, _ = self._bounded([si], max_hops)
+        return bool(acc[0, di])
+
+    def hops(
+        self, src: str, dst: str, max_hops: Optional[int] = None
+    ) -> int:
+        """Shortest hop count of an allowed path ``src`` → ``dst`` (1 = a
+        direct edge; with self-traffic ``src == dst`` is 1 via its own
+        edge). Returns -1 when unreachable (within ``max_hops`` if
+        given)."""
+        self._count("hops")
+        si, di = self._idx(src), self._idx(dst)
+        _, hop = self._bounded([si], max_hops)
+        h = int(hop[0, di])
+        return h if h > 0 else -1
+
+    def _bounded(self, seeds: Sequence[int], max_hops: Optional[int]):
+        """Bounded closure from ``seeds`` over the serving ladder's row
+        oracle (lock held for the whole BFS so every level answers from one
+        generation)."""
+        from ..ops.closure import bounded_closure_rows
+
+        svc = self.service
+        svc.flush()
+        with svc._lock:
+            self._cache.sync(svc)
+            n = len(svc.engine.pods)
+            return bounded_closure_rows(
+                self._reach_rows, seeds, n, hops=max_hops
+            )
 
     # ------------------------------------------------------------- what-if
     def what_if(

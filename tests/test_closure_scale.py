@@ -1,0 +1,395 @@
+"""Closure at scale (config 5): mesh-sharded squaring vs the single-device
+``packed_closure`` bit-for-bit (every mesh factorisation, N not divisible by
+the device count, single-device degeneration), the bounded multi-source
+closure (K=1 and K=N seeds, hop counts vs a dense BFS oracle, the matrix-free
+row-oracle form over ``solve_rows``), the pre-flight HBM guard (refusal with
+guidance, refusals counter, backend exit-2 contract), ``path_upto`` in both
+dense and packed forms, the column-gather batch queries, the serve
+``path_exists``/``hops`` query kinds, and the bench-gate direction of
+``closure_pairs_per_second``."""
+import json
+
+import jax
+import numpy as np
+import pytest
+
+import kubernetes_verification_tpu as kv
+from kubernetes_verification_tpu.cli import main
+from kubernetes_verification_tpu.harness.generate import (
+    GeneratorConfig,
+    random_cluster,
+    random_event_stream,
+)
+from kubernetes_verification_tpu.observe.metrics import (
+    HBM_GUARD_REFUSALS,
+    REQUIRED_FAMILIES,
+)
+from kubernetes_verification_tpu.ops.closure import (
+    bounded_closure_rows,
+    bounded_packed_closure,
+    packed_closure,
+    path_upto,
+)
+from kubernetes_verification_tpu.ops.tiled import pack_bool_cols, unpack_cols
+from kubernetes_verification_tpu.packed_incremental import (
+    PackedIncrementalVerifier,
+)
+from kubernetes_verification_tpu.parallel.mesh import mesh_for
+from kubernetes_verification_tpu.parallel.sharded_closure import (
+    ClosureBudgetError,
+    check_closure_budget,
+    estimate_closure_hbm,
+    sharded_packed_closure,
+)
+from kubernetes_verification_tpu.resilience import ConfigError
+from kubernetes_verification_tpu.serve import QueryEngine, VerificationService
+
+MESHES = [(8, 1), (4, 2), (2, 4), (1, 8)]
+
+
+def _random_packed(n, seed, density=None):
+    """Random packed adjacency uint32 [n, ceil(n/32)], pad bits zero."""
+    rng = np.random.default_rng(seed)
+    adj = rng.random((n, n)) < (density if density else 6.0 / n)
+    pad = (-n) % 32
+    padded = np.pad(adj, ((0, pad), (0, pad)))
+    return np.asarray(pack_bool_cols(padded))[:n], adj
+
+
+def _bfs_hops(adj):
+    """Dense BFS oracle: int32 [n, n] shortest hop counts, 0 = unreachable
+    (a self-loop edge gives hop[i, i] = 1 — same convention as the bounded
+    closure)."""
+    n = adj.shape[0]
+    hop = np.zeros((n, n), np.int32)
+    acc = adj.copy()
+    hop[adj] = 1
+    frontier = adj.copy()
+    level = 1
+    while frontier.any() and level < n:
+        nxt = (frontier.astype(np.uint8) @ adj.astype(np.uint8)) > 0
+        fresh = nxt & ~acc
+        acc |= fresh
+        level += 1
+        hop[fresh] = level
+        frontier = fresh
+    return acc, hop
+
+
+# ------------------------------------------------------- sharded closure
+@pytest.mark.parametrize("shape", MESHES)
+def test_sharded_matches_single_device(shape):
+    """Bit-for-bit vs ``packed_closure`` on every mesh factorisation,
+    at an N (96) that is a 32-multiple but NOT divisible by 8 devices
+    after padding-free striping — the pad path is exercised."""
+    packed, _ = _random_packed(96, seed=5)
+    ref = np.asarray(packed_closure(packed, tile=32))
+    got = sharded_packed_closure(mesh_for(shape), packed, tile=32)
+    assert got.dtype == np.uint32 and got.shape == ref.shape
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_sharded_odd_n_and_single_device_mesh():
+    """N=37 (not a 32-multiple, not divisible by any device count): the
+    row/column pad must be invisible in the trimmed result; a 1x1 mesh
+    degenerates to the exact single-device pass sequence."""
+    packed, _ = _random_packed(37, seed=9, density=0.15)
+    padded = np.zeros((37 + (-37) % 32, packed.shape[1]), np.uint32)
+    padded[:37] = packed
+    ref = np.asarray(packed_closure(padded, tile=32))[:37]
+    got = sharded_packed_closure(mesh_for((8, 1)), packed, tile=32)
+    np.testing.assert_array_equal(got, ref)
+    one = sharded_packed_closure(
+        mesh_for((1, 1), devices=[jax.devices()[0]]), packed, tile=32
+    )
+    np.testing.assert_array_equal(one, ref)
+
+
+def test_sharded_rejects_malformed():
+    with pytest.raises(ConfigError):
+        sharded_packed_closure(
+            mesh_for((8, 1)), np.zeros((4, 4), np.float32)
+        )
+    # more rows than bit columns: not a square bit matrix
+    with pytest.raises(ConfigError):
+        sharded_packed_closure(mesh_for((8, 1)), np.zeros((64, 1), np.uint32))
+
+
+# ------------------------------------------------------- bounded closure
+def test_bounded_k1_and_kn_seeds():
+    """K=1 seeds match one closure row; K=N seeds match the full closure
+    bit-for-bit; hop counts match the dense BFS oracle."""
+    packed, adj = _random_packed(64, seed=21, density=0.06)
+    full = np.asarray(packed_closure(packed, tile=32))
+    acc_all, hop_all = bounded_packed_closure(packed, np.arange(64), tile=32)
+    np.testing.assert_array_equal(np.asarray(acc_all), full)
+    _, hop_ref = _bfs_hops(adj)
+    np.testing.assert_array_equal(hop_all, hop_ref)
+    for s in (0, 17, 63):
+        acc1, hop1 = bounded_packed_closure(packed, [s], tile=32)
+        np.testing.assert_array_equal(np.asarray(acc1)[0], full[s])
+        np.testing.assert_array_equal(hop1[0], hop_ref[s])
+
+
+def test_bounded_hop_cap_equals_path_upto():
+    """``hops=h`` equals the ∨ of the first h boolean matrix powers — the
+    ``path_upto`` contract — in both packed and dense forms."""
+    packed, adj = _random_packed(64, seed=33, density=0.05)
+    a8 = adj.astype(np.uint8)
+    want = adj.copy()
+    power = adj.copy()
+    for _ in range(2):
+        power = (power.astype(np.uint8) @ a8) > 0
+        want |= power
+    acc, _ = bounded_packed_closure(packed, np.arange(64), hops=3, tile=32)
+    np.testing.assert_array_equal(
+        unpack_cols(np.asarray(acc), 64), want
+    )
+    np.testing.assert_array_equal(
+        np.asarray(path_upto(packed, 3)), np.asarray(acc)
+    )
+    dense_out = np.asarray(path_upto(adj, 3))
+    assert dense_out.dtype == np.bool_ and dense_out.shape == adj.shape
+    np.testing.assert_array_equal(dense_out, want)
+    # hops<=1 is the identity in both forms
+    np.testing.assert_array_equal(np.asarray(path_upto(adj, 1)), adj)
+    np.testing.assert_array_equal(np.asarray(path_upto(packed, 1)), packed)
+
+
+def test_bounded_rejects_bad_seeds():
+    packed, _ = _random_packed(32, seed=1)
+    with pytest.raises(ConfigError):
+        bounded_packed_closure(packed, [32])
+    with pytest.raises(ConfigError):
+        bounded_closure_rows(lambda i: np.zeros((len(i), 8), bool), [-1], 8)
+
+
+def test_bounded_rows_matches_packed_form():
+    """The matrix-free row-oracle form equals the packed form: same acc,
+    same hop counts, including a hop cap, with a chunk smaller than the
+    frontier so the chunked dot path runs."""
+    packed, adj = _random_packed(96, seed=45, density=0.04)
+    seeds = [3, 40, 95]
+
+    def row_fn(idx):
+        return adj[np.asarray(idx, dtype=np.int64)]
+
+    for hops in (None, 2):
+        acc_p, hop_p = bounded_packed_closure(packed, seeds, hops=hops,
+                                              tile=32)
+        acc_r, hop_r = bounded_closure_rows(row_fn, seeds, 96, hops=hops,
+                                            chunk=7)
+        np.testing.assert_array_equal(acc_r, unpack_cols(np.asarray(acc_p),
+                                                         96))
+        np.testing.assert_array_equal(hop_r, hop_p)
+    empty_acc, empty_hop = bounded_closure_rows(row_fn, [], 96)
+    assert empty_acc.shape == (0, 96) and empty_hop.shape == (0, 96)
+
+
+# ---------------------------------------------- solve_rows (row oracle)
+@pytest.mark.parametrize("keep_matrix", [True, False])
+def test_solve_rows_matches_reach(keep_matrix):
+    cluster = random_cluster(
+        GeneratorConfig(n_pods=41, n_policies=9, n_namespaces=3, seed=17)
+    )
+    cfg = kv.VerifyConfig(compute_ports=False)
+    inc = PackedIncrementalVerifier(cluster, cfg, keep_matrix=keep_matrix)
+    ref = kv.verify(
+        cluster, kv.VerifyConfig(backend="cpu", compute_ports=False)
+    ).reach
+    rows = np.array([0, 7, 40, 7], dtype=np.int64)
+    got = inc.solve_rows(rows)
+    assert got.dtype == np.uint32 and got.shape[0] == 4
+    np.testing.assert_array_equal(
+        unpack_cols(got, inc._n_padded)[:, : inc.n_pods], ref[rows]
+    )
+    empty = inc.solve_rows(np.array([], dtype=np.int64))
+    assert empty.shape == (0, inc._n_padded // 32)
+    with pytest.raises(ConfigError):
+        inc.solve_rows(np.array([inc.n_pods]))
+    with pytest.raises(ConfigError):
+        inc.solve_rows(np.zeros((2, 2), dtype=np.int64))
+
+
+def test_bounded_rows_over_matrix_free_engine():
+    """The config-5 shape in miniature: a matrix-free engine's
+    ``solve_rows`` as the row oracle — a path query never materialises
+    N x N."""
+    cluster = random_cluster(
+        GeneratorConfig(n_pods=37, n_policies=8, n_namespaces=3, seed=23)
+    )
+    cfg = kv.VerifyConfig(compute_ports=False)
+    inc = PackedIncrementalVerifier(cluster, cfg, keep_matrix=False)
+    ref = kv.verify(
+        cluster, kv.VerifyConfig(backend="cpu", compute_ports=False)
+    ).reach
+    _, hop_ref = _bfs_hops(np.asarray(ref, dtype=bool))
+
+    def row_fn(idx):
+        return unpack_cols(
+            inc.solve_rows(np.asarray(idx, dtype=np.int64)), inc._n_padded
+        )[:, : inc.n_pods]
+
+    acc, hop = bounded_closure_rows(row_fn, [0, 19], inc.n_pods, chunk=8)
+    closure, hop_full = _bfs_hops(np.asarray(ref, dtype=bool))
+    np.testing.assert_array_equal(acc, closure[[0, 19]])
+    np.testing.assert_array_equal(hop, hop_full[[0, 19]])
+
+
+# -------------------------------------------------------- HBM guard
+def test_hbm_guard_refuses_with_guidance():
+    assert "kvtpu_hbm_guard_refusals_total" in REQUIRED_FAMILIES
+    est = estimate_closure_hbm(1 << 20, row_tile=7168, dst_tile=14336,
+                               n_devices=8)
+    assert est["total_bytes"] > 0
+    # wider sharding shrinks the stripe terms
+    wider = estimate_closure_hbm(1 << 20, row_tile=7168, dst_tile=14336,
+                                 n_devices=16)
+    assert wider["stripe_bytes"] < est["stripe_bytes"]
+    before = HBM_GUARD_REFUSALS.value
+    with pytest.raises(ClosureBudgetError) as exc:
+        check_closure_budget(1 << 20, row_tile=7168, dst_tile=14336,
+                             n_devices=8, limit_bytes=1 << 30)
+    assert HBM_GUARD_REFUSALS.value == before + 1
+    msg = str(exc.value)
+    assert "shard wider" in msg and "bounded" in msg and "tile" in msg
+    # the refusal is a ConfigError -> the CLI's exit-2 (input error) path
+    assert isinstance(exc.value, ConfigError)
+    # an accepted config returns the estimate and does NOT count a refusal
+    ok = check_closure_budget(1024, row_tile=32, dst_tile=32,
+                              limit_bytes=1 << 30)
+    assert ok["limit_bytes"] == 1 << 30
+    assert HBM_GUARD_REFUSALS.value == before + 1
+
+
+def test_backend_closure_mesh_and_guard():
+    """``--opt mesh=8 closure`` routes through the sharded engine and
+    equals the CPU oracle's closure; an hbm_limit too small refuses
+    before any device work."""
+    cluster = random_cluster(
+        GeneratorConfig(n_pods=40, n_policies=10, n_namespaces=3, seed=31)
+    )
+    ref = kv.verify(
+        cluster,
+        kv.VerifyConfig(backend="cpu", compute_ports=False, closure=True),
+    )
+    got = kv.verify(
+        cluster,
+        kv.VerifyConfig(
+            backend="sharded-packed", compute_ports=False, closure=True,
+            backend_options=(
+                ("mesh", 8), ("tile", 32), ("chunk", 8),
+                ("keep_matrix", True), ("closure_tile", 32),
+            ),
+        ),
+    )
+    np.testing.assert_array_equal(got.closure, ref.closure)
+    with pytest.raises(ClosureBudgetError):
+        kv.verify(
+            cluster,
+            kv.VerifyConfig(
+                backend="sharded-packed", compute_ports=False, closure=True,
+                backend_options=(
+                    ("mesh", 8), ("tile", 32), ("chunk", 8),
+                    ("keep_matrix", True), ("closure_tile", 32),
+                    ("hbm_limit", 1024),
+                ),
+            ),
+        )
+
+
+# ------------------------------------------------- serve column gathers
+def _service(seed=13, n_pods=36):
+    cluster = random_cluster(
+        GeneratorConfig(
+            n_pods=n_pods, n_policies=12, n_namespaces=4, seed=seed,
+            p_ipblock_peer=0.0, min_selector_labels=1,
+        )
+    )
+    return cluster, VerificationService(cluster)
+
+
+def test_who_can_reach_blast_radius_batch_identity():
+    """Batch column/row gathers answer bit-identically to the scalar loop
+    and to the full-matrix oracle — on a dirty engine (the gather path),
+    then again warm."""
+    cluster, svc = _service()
+    q = QueryEngine(svc)
+    pods = svc.engine.pods
+    name = lambda p: f"{p.namespace}/{p.name}"
+    refs = [name(p) for p in pods]
+    events = random_event_stream(cluster, n_events=40, seed=5)
+    svc.apply(events)  # dirty: answers come from batched gathers
+    who_b = q.who_can_reach_batch(refs)
+    blast_b = q.blast_radius_batch(refs)
+    reach = np.asarray(svc.reach())  # solves clean; oracle from the matrix
+    for i, r in enumerate(refs):
+        want_who = [refs[s] for s in np.nonzero(reach[:, i])[0] if s != i]
+        want_blast = [refs[d] for d in np.nonzero(reach[i, :])[0] if d != i]
+        assert who_b[i] == want_who
+        assert blast_b[i] == want_blast
+        assert q.who_can_reach(r) == want_who
+        assert q.blast_radius(r) == want_blast
+    assert q.who_can_reach_batch([]) == []
+    assert q.blast_radius_batch([]) == []
+
+
+def test_path_exists_and_hops_queries():
+    cluster, svc = _service(seed=19, n_pods=30)
+    q = QueryEngine(svc)
+    pods = svc.engine.pods
+    refs = [f"{p.namespace}/{p.name}" for p in pods]
+    reach = np.asarray(svc.reach(), dtype=bool)
+    closure, hop = _bfs_hops(reach)
+    rng = np.random.default_rng(3)
+    for _ in range(20):
+        s, d = (int(x) for x in rng.integers(0, len(refs), 2))
+        assert q.path_exists(refs[s], refs[d]) == bool(closure[s, d])
+        want = int(hop[s, d]) if hop[s, d] else -1
+        assert q.hops(refs[s], refs[d]) == want
+        # max_hops=1 is exactly the direct edge
+        assert q.path_exists(refs[s], refs[d], max_hops=1) == bool(
+            reach[s, d]
+        )
+    # a hop cap below the true distance answers unreachable
+    multi = np.argwhere(hop > 1)
+    if multi.size:
+        s, d = (int(x) for x in multi[0])
+        assert q.hops(refs[s], refs[d], max_hops=int(hop[s, d]) - 1) == -1
+
+
+def test_cli_path_exists_and_hops(tmp_path, capsys):
+    d = str(tmp_path / "cluster")
+    assert main(["generate", d, "--pods", "24", "--policies", "6"]) == 0
+    capsys.readouterr()
+    from kubernetes_verification_tpu.ingest import load_cluster
+
+    svc = VerificationService(load_cluster(d)[0])
+    q = QueryEngine(svc)
+    pods = svc.engine.pods
+    refs = [f"{p.namespace}/{p.name}" for p in pods]
+    reach = np.asarray(svc.reach(), dtype=bool)
+    closure, hop = _bfs_hops(reach)
+    s, dst = 0, len(refs) - 1
+    assert main(["query", d, "--path-exists", refs[s], refs[dst],
+                 "--json"]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["path_exists"]["exists"] == bool(closure[s, dst])
+    assert main(["query", d, "--hops", refs[s], refs[dst], "--json"]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    want = int(hop[s, dst]) if hop[s, dst] else -1
+    assert out["hops"]["hops"] == want
+    # text renderer + --max-hops plumb through
+    assert main(["query", d, "--path-exists", refs[s], refs[dst],
+                 "--max-hops", "1"]) == 0
+    txt = capsys.readouterr().out
+    assert ("EXISTS" if reach[s, dst] else "NONE") in txt
+
+
+# ------------------------------------------------------- bench direction
+def test_closure_pairs_per_second_direction():
+    from kubernetes_verification_tpu.observe.history import _direction
+
+    assert _direction("pairs/s", "closure_pairs_per_second") == "higher"
+    assert _direction(None, "closure_pairs_per_second") == "higher"
+    assert _direction("s", "closure_full_seconds") == "lower"

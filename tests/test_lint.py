@@ -276,6 +276,57 @@ def test_jit_host_sync_sees_through_shard_map_wrapping():
     assert "float()" in bad[0].message
 
 
+def test_jit_host_sync_sharded_closure_loop_shape():
+    """The sharded-closure convergence pattern: a change-flag readback in
+    the HOST driver loop around a jitted shard_map body is the one
+    sanctioned sync — it must lint clean with no suppression (a stale
+    inline ignore would itself be a finding). The same readback moved
+    INSIDE the traced body is the bug the rule exists for."""
+    bad = _lint(
+        """
+        import jax
+        import jax.numpy as jnp
+        from kubernetes_verification_tpu.parallel.mesh import shard_map
+
+        def _square_local(stripe):
+            sq = stripe | stripe
+            changed = jnp.any(sq != stripe)
+            if bool(changed):             # tracer -> host inside the trace
+                sq = sq | sq
+            return sq
+
+        step = jax.jit(shard_map(_square_local, mesh=None))
+        """,
+        ["jit-host-sync"],
+    )
+    assert bad and {f.rule for f in bad} == {"jit-host-sync"}
+    ok = _lint(
+        """
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from kubernetes_verification_tpu.parallel.mesh import shard_map
+
+        def _square_local(stripe):
+            sq = stripe | stripe
+            changed = jnp.any(sq != stripe).astype(jnp.int32)
+            return sq, jax.lax.psum(changed, "pods")
+
+        def closure_driver(mesh, cur, max_iter):
+            fn = jax.jit(shard_map(_square_local, mesh=mesh))
+            for _ in range(max_iter):
+                cur, changed = fn(cur)
+                # host convergence readback OUTSIDE any traced body: the
+                # sanctioned sync of the sharded closure loop
+                if int(np.asarray(changed)) == 0:
+                    break
+            return cur
+        """,
+        ["jit-host-sync"],
+    )
+    assert ok == [], [f.render() for f in ok]
+
+
 def test_recompile_hazard_shape_string_key():
     bad = _lint(
         """
