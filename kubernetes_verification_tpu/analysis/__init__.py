@@ -10,7 +10,8 @@ runs on source strings.
 
 Entry points:
 
-* ``kv-tpu lint [PATHS] [--rules ...] [--format json] [--update-baseline]``
+* ``kv-tpu lint [PATHS] [--rules ...] [--format json|sarif] [--changed]
+  [--no-cache] [--update-baseline]``
 * ``python -m kubernetes_verification_tpu.analysis`` (same flags, headless)
 * :func:`lint_source` / :func:`run_package` for tests and tooling
 
@@ -41,7 +42,13 @@ from .core import (
     run_lint,
     run_package,
 )
-from .report import catalog_markdown, check_docs, render_json, render_text
+from .report import (
+    catalog_markdown,
+    check_docs,
+    render_json,
+    render_sarif,
+    render_text,
+)
 
 __all__ = [
     "Finding",
@@ -61,6 +68,7 @@ __all__ = [
     "catalog_markdown",
     "render_text",
     "render_json",
+    "render_sarif",
     "main",
     "add_lint_arguments",
 ]
@@ -77,8 +85,20 @@ def add_lint_arguments(ap: argparse.ArgumentParser) -> None:
         help="comma-separated rule ids to run (default: all; see --list)",
     )
     ap.add_argument(
-        "--format", choices=("text", "json"), default="text",
-        help="finding output format",
+        "--format", choices=("text", "json", "sarif"), default="text",
+        help="finding output format (sarif: 2.1.0, for CI PR annotation)",
+    )
+    ap.add_argument(
+        "--changed", action="store_true",
+        help="report findings only for files changed vs "
+        "`git merge-base HEAD origin/main` (the whole package is still "
+        "parsed, so interprocedural rules stay sound); falls back to a "
+        "full run outside a git repo",
+    )
+    ap.add_argument(
+        "--no-cache", action="store_true",
+        help="skip the warm-run summary cache (.kvtpu_lint_cache.json at "
+        "the repo root, keyed by file content hash)",
     )
     ap.add_argument(
         "--baseline", metavar="PATH", default=None,
@@ -107,6 +127,46 @@ def add_lint_arguments(ap: argparse.ArgumentParser) -> None:
         "-v", "--verbose", action="store_true",
         help="also list grandfathered findings in text output",
     )
+
+
+def changed_package_rels(base_ref: str = "origin/main"):
+    """Package-relative paths of ``.py`` files modified vs
+    ``git merge-base HEAD origin/main``. None means "cannot tell" (not a
+    git checkout, no such ref, git missing) and the caller falls back to a
+    full run — `--changed` must never silently lint nothing."""
+    import os
+    import subprocess
+
+    from .core import package_root
+
+    root = package_root()
+
+    def _git(*argv):
+        try:
+            proc = subprocess.run(
+                ["git", *argv], capture_output=True, text=True,
+                cwd=root, timeout=30,
+            )
+        except (OSError, subprocess.SubprocessError):
+            return None
+        return proc.stdout.strip() if proc.returncode == 0 else None
+
+    toplevel = _git("rev-parse", "--show-toplevel")
+    merge_base = _git("merge-base", "HEAD", base_ref)
+    if toplevel is None or merge_base is None:
+        return None
+    diff = _git("diff", "--name-only", merge_base)
+    if diff is None:
+        return None
+    rels = []
+    for line in diff.splitlines():
+        if not line.endswith(".py"):
+            continue
+        abs_path = os.path.join(toplevel, line)
+        rel = os.path.relpath(abs_path, root).replace(os.sep, "/")
+        if not rel.startswith(".."):
+            rels.append(rel)
+    return sorted(rels)
 
 
 def run_from_args(args) -> int:
@@ -150,7 +210,25 @@ def run_from_args(args) -> int:
                     sources[rel] = fh.read()
         result = run_lint(sources, rules=rules, baseline=budgets)
     else:
-        result = run_package(rules=rules, baseline=budgets)
+        # the summary cache only keys package-relative paths, so it is
+        # scoped to full-package runs (explicit paths rel differently)
+        cache_path = (
+            None
+            if getattr(args, "no_cache", False)
+            else _default_cache_path()
+        )
+        only = None
+        if getattr(args, "changed", False):
+            only = changed_package_rels()
+            if only is None:
+                print(
+                    "lint --changed: not a git checkout (or origin/main "
+                    "unknown) — running the full package",
+                    file=sys.stderr,
+                )
+        result = run_package(
+            rules=rules, baseline=budgets, cache_path=cache_path, only=only
+        )
 
     # lint health is an observable: the findings surface on the same
     # dashboards as every other kvtpu_* family
@@ -181,9 +259,17 @@ def run_from_args(args) -> int:
 
     if args.format == "json":
         print(render_json(result))
+    elif args.format == "sarif":
+        print(render_sarif(result))
     else:
         print(render_text(result, verbose=args.verbose))
     return 0 if result.ok else 1
+
+
+def _default_cache_path():
+    from .summaries import default_cache_path
+
+    return default_cache_path()
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:

@@ -93,6 +93,9 @@ class FileContext:
     used_suppressions: set = field(default_factory=set)
     #: child AST node (by id) → parent node, for context-sensitive rules
     parents: Dict[int, ast.AST] = field(default_factory=dict)
+    #: the interprocedural :class:`~.summaries.Program` for the run this
+    #: context belongs to (attached by :func:`run_lint`; None in isolation)
+    program: Optional[object] = None
 
     def parent(self, node: ast.AST) -> Optional[ast.AST]:
         return self.parents.get(id(node))
@@ -256,7 +259,12 @@ class LintResult:
 def _select_rules(rules: Optional[Sequence[str]]) -> List[Rule]:
     # rule modules register on import; pull them in exactly once here so
     # `from analysis.core import run_lint` alone is enough
-    from . import rules_hygiene, rules_jax, rules_metrics  # noqa: F401
+    from . import (  # noqa: F401
+        rules_hygiene,
+        rules_interproc,
+        rules_jax,
+        rules_metrics,
+    )
 
     if rules is None:
         return list(RULES.values())
@@ -275,16 +283,26 @@ def run_lint(
     sources: Mapping[str, str],
     rules: Optional[Sequence[str]] = None,
     baseline: Optional[Mapping[str, Mapping[str, int]]] = None,
+    cache_path: Optional[str] = None,
 ) -> LintResult:
     """Lint ``{relative-path: source}`` with the selected rules.
 
-    Pipeline: parse each file once → per-file rules → project rules →
-    inline suppressions (marking each one used) → stale-suppression
-    findings → baseline budgets (a file's per-rule count at or under its
-    budget is grandfathered wholesale; over budget, every site reports)."""
+    Pipeline: parse each file once → build the interprocedural program
+    (callgraph + summaries, attached to every context) → per-file rules →
+    project rules → inline suppressions (marking each one used) →
+    stale-suppression findings → baseline budgets (a file's per-rule count
+    at or under its budget is grandfathered wholesale; over budget, every
+    site reports). ``cache_path`` enables the local-summary cache."""
     selected = _select_rules(rules)
     ctxs = [build_context(rel, src) for rel, src in sources.items()]
     by_rel = {c.rel: c for c in ctxs}
+    parsed = [c for c in ctxs if c.tree is not None]
+
+    from .summaries import build_program
+
+    program = build_program(parsed, cache_path=cache_path)
+    for ctx in parsed:
+        ctx.program = program
 
     raw: List[Finding] = []
     for ctx in ctxs:
@@ -298,7 +316,6 @@ def run_lint(
             continue
         for rule in selected:
             raw.extend(rule.check(ctx))
-    parsed = [c for c in ctxs if c.tree is not None]
     for rule in selected:
         raw.extend(rule.check_project(parsed))
 
@@ -366,10 +383,30 @@ def run_package(
     rules: Optional[Sequence[str]] = None,
     baseline: Optional[Mapping[str, Mapping[str, int]]] = None,
     root: Optional[str] = None,
+    cache_path: Optional[str] = None,
+    only: Optional[Sequence[str]] = None,
 ) -> LintResult:
-    """Lint every ``.py`` file in the package (or under ``root``)."""
+    """Lint every ``.py`` file in the package (or under ``root``).
+
+    ``only`` restricts *reporting* to the given relative paths while the
+    whole package is still parsed and summarised — interprocedural rules
+    need the full program even when only a few files changed."""
     sources = {}
     for rel, path in iter_package_files(root):
         with open(path, "r") as fh:
             sources[rel] = fh.read()
-    return run_lint(sources, rules=rules, baseline=baseline)
+    result = run_lint(
+        sources, rules=rules, baseline=baseline, cache_path=cache_path
+    )
+    if only is None:
+        return result
+    keep = set(only)
+    return LintResult(
+        findings=[f for f in result.findings if f.path in keep],
+        grandfathered=[f for f in result.grandfathered if f.path in keep],
+        suppressed=[f for f in result.suppressed if f.path in keep],
+        counts={
+            rule: {p: n for p, n in by_path.items() if p in keep}
+            for rule, by_path in result.counts.items()
+        },
+    )

@@ -11,6 +11,7 @@ from .core import RULES, LintResult, UNUSED_SUPPRESSION
 __all__ = [
     "render_text",
     "render_json",
+    "render_sarif",
     "catalog_markdown",
     "CATALOG_HEADER",
 ]
@@ -31,6 +32,75 @@ def render_text(result: LintResult, verbose: bool = False) -> str:
 
 def render_json(result: LintResult) -> str:
     return json.dumps(result.to_dict(), sort_keys=True)
+
+
+#: pinned schema pointer — CI annotators key on the exact 2.1.0 shape
+SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
+
+
+def render_sarif(result: LintResult) -> str:
+    """SARIF 2.1.0 for CI PR annotation: one run, one `result` per
+    actionable finding (grandfathered/suppressed stay out — SARIF is the
+    merge gate's view), rule metadata inlined so viewers can render the
+    rationale without the repo checked out."""
+    from . import core  # ensure rule modules are imported
+
+    core._select_rules(None)
+    used = sorted({f.rule for f in result.findings})
+    rules = []
+    for rid in used:
+        rule = RULES.get(rid)
+        desc = (
+            rule.rationale.split(". ")[0].rstrip(".") + "."
+            if rule is not None and rule.rationale
+            else rid
+        )
+        rules.append({
+            "id": rid,
+            "shortDescription": {"text": desc},
+        })
+    index = {rid: i for i, rid in enumerate(used)}
+    results = [
+        {
+            "ruleId": f.rule,
+            "ruleIndex": index[f.rule],
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {
+                            "uri": f.path,
+                            "uriBaseId": "SRCROOT",
+                        },
+                        "region": {"startLine": f.line},
+                    }
+                }
+            ],
+        }
+        for f in result.findings
+    ]
+    doc = {
+        "$schema": SARIF_SCHEMA,
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "kv-tpu-lint",
+                        "informationUri": "LINTS.md",
+                        "rules": rules,
+                    }
+                },
+                "columnKind": "utf16CodeUnits",
+                "results": results,
+            }
+        ],
+    }
+    return json.dumps(doc, indent=2, sort_keys=True)
 
 
 CATALOG_HEADER = """# Lint rule catalog

@@ -1,0 +1,614 @@
+"""Interprocedural rules: mesh/collective consistency, use-after-donate,
+and the CLI exit-code contract.
+
+All three stand on the :mod:`.callgraph` + :mod:`.summaries` program view
+attached to every :class:`~.core.FileContext` by the runner:
+
+* ``collective-axis`` — the static form of the distributed-kernel abort
+  class PAPERS.md's TPU linear-algebra work calls out: a ``psum`` /
+  ``all_gather`` whose ``axis_name`` does not name an axis of the enclosing
+  ``shard_map`` mesh fails at trace time on device (and on a mesh that
+  *happens* to define the name, silently reduces over the wrong axis).
+  Reachability is computed over the call graph, so a collective buried two
+  helpers below the ``shard_map``-wrapped body is still checked.
+* ``donation-hazard`` — ``donate_argnums`` hands the buffer to XLA; any
+  later read sees invalidated memory (jax raises on CPU, garbage is
+  possible elsewhere). The read-after-donate scan follows donation through
+  helper calls via summaries.
+* ``exit-contract`` — every CLI subcommand handler registered with
+  ``set_defaults(fn=...)`` must keep its reachable ``KvTpuError`` raises
+  inside the documented 0/1/2/3 exit-code mapping; a taxonomy error that
+  can escape a handler uncaught is a lint failure, not a field bug.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .core import FileContext, Finding, Rule, register
+from .rules_hygiene import _last_name, walk_own
+from .rules_jax import _param_names, _unwrap_traced_target, collect_jit_sites
+
+#: wrappers that establish named mesh axes for the code they trace
+_SHARD_WRAPPERS = frozenset({"shard_map", "pmap", "xmap"})
+
+_DefNode = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _program(ctxs: Sequence[FileContext]):
+    for ctx in ctxs:
+        if ctx.program is not None:
+            return ctx.program
+    return None
+
+
+# ------------------------------------------------------------ mesh axes
+def _axis_strings(graph, module: str, node: ast.expr) -> Optional[Set[str]]:
+    """The axis-name strings a Mesh axis-names argument pins, or None when
+    any element is not statically resolvable."""
+    elts = node.elts if isinstance(node, (ast.Tuple, ast.List)) else [node]
+    out: Set[str] = set()
+    for elt in elts:
+        s = graph.resolve_str(module, elt)
+        if s is None:
+            return None
+        out.add(s)
+    return out
+
+
+def _mesh_call_axes(graph, module: str, call: ast.Call) -> Optional[Set[str]]:
+    """Axes of a literal ``Mesh(devices, ("a", "b"))`` / ``make_mesh``-style
+    construction, or None."""
+    if _last_name(call.func) not in ("Mesh", "make_mesh", "AbstractMesh"):
+        return None
+    axis_arg: Optional[ast.expr] = None
+    if len(call.args) >= 2:
+        axis_arg = call.args[1]
+    for kw in call.keywords:
+        if kw.arg in ("axis_names", "axis_name"):
+            axis_arg = kw.value
+    if axis_arg is None:
+        return None
+    return _axis_strings(graph, module, axis_arg)
+
+
+def _resolve_mesh_axes(
+    ctx: FileContext, graph, module: str, mesh_expr: ast.expr
+) -> Optional[Set[str]]:
+    """Axes of the ``mesh=`` argument of a shard_map site, when statically
+    known: a literal Mesh construction, or a name assigned one anywhere in
+    the same file. An opaque mesh (function parameter, factory call) maps
+    to None and the caller falls back to the program-wide axis universe."""
+    if isinstance(mesh_expr, ast.Call):
+        return _mesh_call_axes(graph, module, mesh_expr)
+    if isinstance(mesh_expr, ast.Name):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not any(
+                isinstance(t, ast.Name) and t.id == mesh_expr.id
+                for t in node.targets
+            ):
+                continue
+            if isinstance(node.value, ast.Call):
+                axes = _mesh_call_axes(graph, module, node.value)
+                if axes is not None:
+                    return axes
+    return None
+
+
+def _axis_universe(ctxs: Sequence[FileContext], graph) -> Set[str]:
+    """Every axis name the program mentions anywhere: ``*_AXIS`` string
+    constants, literal Mesh constructions, and ``P(...)`` partition specs.
+    The fallback oracle for shard_map sites whose mesh is opaque — an axis
+    name outside even this set names no mesh axis in the whole program."""
+    from .callgraph import module_name
+
+    out: Set[str] = set()
+    for consts in graph.str_constants.values():
+        for name, val in consts.items():
+            if name.endswith("_AXIS"):
+                out.add(val)
+    for ctx in ctxs:
+        if ctx.tree is None:
+            continue
+        mod = module_name(ctx.rel)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            axes = _mesh_call_axes(graph, mod, node)
+            if axes:
+                out |= axes
+            if _last_name(node.func) in ("P", "PartitionSpec"):
+                for a in node.args:
+                    s = graph.resolve_str(mod, a)
+                    if s is not None:
+                        out.add(s)
+    return out
+
+
+def _partial_bindings(node: ast.expr) -> Tuple[int, Set[str], ast.expr]:
+    """Peel ``partial(f, a, b, kw=...)`` → (n positionals bound, kw names
+    bound, the innermost target expression)."""
+    n_pos = 0
+    kw_names: Set[str] = set()
+    depth = 0
+    while (
+        isinstance(node, ast.Call)
+        and _last_name(node.func) == "partial"
+        and node.args
+        and depth < 8
+    ):
+        n_pos += len(node.args) - 1
+        kw_names |= {kw.arg for kw in node.keywords if kw.arg}
+        node = node.args[0]
+        depth += 1
+    return n_pos, kw_names, node
+
+
+def _literal_return_arity(fn: ast.AST) -> Optional[int]:
+    """The tuple arity every ``return`` in ``fn`` (own scope) agrees on,
+    or None when returns are not all literal tuples of one length."""
+    arity: Optional[int] = None
+    for node in walk_own(fn):
+        if not isinstance(node, ast.Return) or node.value is None:
+            continue
+        if not isinstance(node.value, ast.Tuple):
+            return None
+        n = len(node.value.elts)
+        if arity is None:
+            arity = n
+        elif arity != n:
+            return None
+    return arity
+
+
+@register
+class CollectiveAxisRule(Rule):
+    id = "collective-axis"
+    rationale = (
+        "A `psum`/`all_gather`/`ppermute`/`psum_scatter` with an "
+        "`axis_name` the enclosing `shard_map` mesh does not define aborts "
+        "at trace time on device — and when an unrelated mesh *does* "
+        "define the name, silently reduces over the wrong axis (the "
+        "block-distributed-matmul failure mode PAPERS.md's TPU "
+        "linear-algebra paper warns about). The rule resolves each "
+        "shard_map site's mesh axes (literal `Mesh((...))` constructions, "
+        "or the program-wide axis universe of `*_AXIS` constants and "
+        "`P(...)` specs when the mesh is an opaque parameter), walks the "
+        "call graph so collectives in helpers below the wrapped body are "
+        "covered, checks `in_specs`/`out_specs` arity against the wrapped "
+        "function's signature, and flags collectives only reachable from "
+        "un-sharded entry points — a collective outside any axis-binding "
+        "wrapper is a guaranteed `NameError`-style trace abort."
+    )
+    example = (
+        "mesh = Mesh(devs, (\"pods\", \"grants\"))\n"
+        "def body(x):\n"
+        "    return jax.lax.psum(x, \"rows\")  # no such mesh axis\n"
+        "f = jax.jit(shard_map(body, mesh=mesh, in_specs=P(\"pods\"),\n"
+        "                      out_specs=P(\"pods\")))"
+    )
+
+    def check_project(self, ctxs: Sequence[FileContext]) -> Iterable[Finding]:
+        from .callgraph import module_name
+
+        program = _program(ctxs)
+        if program is None:
+            return
+        graph = program.graph
+        universe = _axis_universe(ctxs, graph)
+        by_rel = {c.rel: c for c in ctxs}
+
+        # 1. shard roots: functions wrapped by shard_map/pmap/xmap, with
+        #    the mesh axes each wrap binds (None → opaque mesh)
+        roots: Dict[str, Optional[Set[str]]] = {}
+
+        def add_root(qn: str, axes: Optional[Set[str]]) -> None:
+            if qn in roots:
+                prev = roots[qn]
+                roots[qn] = (
+                    None if prev is None or axes is None else prev | axes
+                )
+            else:
+                roots[qn] = axes
+
+        for ctx in ctxs:
+            if ctx.tree is None:
+                continue
+            mod = module_name(ctx.rel)
+            for node in ast.walk(ctx.tree):
+                if isinstance(node, _DefNode):
+                    for dec in node.decorator_list:
+                        dname = _last_name(
+                            dec.func if isinstance(dec, ast.Call) else dec
+                        )
+                        if dname in _SHARD_WRAPPERS:
+                            qn = graph.qname_of(node)
+                            if qn:
+                                axes = None
+                                if isinstance(dec, ast.Call):
+                                    for kw in dec.keywords:
+                                        if kw.arg == "mesh":
+                                            axes = _resolve_mesh_axes(
+                                                ctx, graph, mod, kw.value
+                                            )
+                                add_root(qn, axes)
+                    continue
+                if not (
+                    isinstance(node, ast.Call)
+                    and _last_name(node.func) in _SHARD_WRAPPERS
+                    and node.args
+                ):
+                    continue
+                n_bound, kw_bound, target = _partial_bindings(node.args[0])
+                # follow one level of local aliasing:
+                # `body = partial(_k8s_local, ...)` → shard_map(body, ...)
+                if (
+                    isinstance(target, ast.Name)
+                    and target.id
+                    not in graph.module_scopes.get(mod, {})
+                ):
+                    for asn in ast.walk(ctx.tree):
+                        if not (
+                            isinstance(asn, ast.Assign)
+                            and any(
+                                isinstance(t, ast.Name)
+                                and t.id == target.id
+                                for t in asn.targets
+                            )
+                        ):
+                            continue
+                        n2, kw2, inner = _partial_bindings(asn.value)
+                        if isinstance(inner, ast.Name) and inner is not target:
+                            n_bound += n2
+                            kw_bound |= kw2
+                            target = inner
+                            break
+                axes = None
+                in_specs = out_specs = None
+                for kw in node.keywords:
+                    if kw.arg == "mesh":
+                        axes = _resolve_mesh_axes(ctx, graph, mod, kw.value)
+                    elif kw.arg == "in_specs":
+                        in_specs = kw.value
+                    elif kw.arg == "out_specs":
+                        out_specs = kw.value
+                fn_node: Optional[ast.AST] = None
+                qn = None
+                if isinstance(target, ast.Name):
+                    qn = graph.module_scopes.get(mod, {}).get(target.id)
+                    if qn and qn in graph.functions:
+                        fn_node = graph.functions[qn].node
+                        add_root(qn, axes)
+                elif isinstance(target, ast.Lambda):
+                    fn_node = target
+                if fn_node is not None:
+                    yield from self._check_specs(
+                        ctx, node, fn_node, n_bound, kw_bound,
+                        in_specs, out_specs,
+                    )
+
+        # 2. allowed axes per function, propagated root → callees
+        allowed: Dict[str, Set[str]] = {}
+        work: List[Tuple[str, Set[str]]] = [
+            (qn, axes if axes is not None else set(universe))
+            for qn, axes in roots.items()
+        ]
+        while work:
+            qn, axes = work.pop()
+            cur = allowed.get(qn)
+            if cur is not None and axes <= cur:
+                continue
+            allowed[qn] = (cur or set()) | axes
+            info = graph.functions.get(qn)
+            if info is None:
+                continue
+            for call in info.calls:
+                work.append((call.callee, axes))
+
+        # 3. judge every collective against its function's allowed axes
+        for qn, summary in sorted(program.summaries.items()):
+            if not summary.local.collectives:
+                continue
+            rel = summary.info.rel
+            ctx = by_rel.get(rel)
+            axes_here = allowed.get(qn)
+            for coll in summary.local.collectives:
+                if axes_here is None:
+                    yield Finding(
+                        self.id, rel, coll["line"],
+                        f"{coll['kind']}() in {summary.info.node.name}() is "
+                        "not reachable from any shard_map/pmap-wrapped "
+                        "entry point — collectives outside an axis-binding "
+                        "wrapper fail at trace time (unbound axis name)",
+                    )
+                    continue
+                for axis in coll["axes"]:
+                    name = (
+                        program.resolve_axis(summary.info.module, axis)
+                        if ctx is not None else None
+                    )
+                    if name is None and "s" in axis:
+                        name = axis["s"]
+                    if name is not None and name not in axes_here:
+                        have = ", ".join(sorted(axes_here)) or "(none)"
+                        yield Finding(
+                            self.id, rel, coll["line"],
+                            f"{coll['kind']}(axis_name={name!r}) — the "
+                            f"enclosing shard_map mesh defines axes "
+                            f"[{have}]; a collective over an undefined "
+                            "axis aborts at trace time (or reduces over "
+                            "the wrong axis on a mesh that happens to "
+                            "define it)",
+                        )
+
+    def _check_specs(
+        self,
+        ctx: FileContext,
+        call: ast.Call,
+        fn_node: ast.AST,
+        n_bound: int,
+        kw_bound: Set[str],
+        in_specs: Optional[ast.expr],
+        out_specs: Optional[ast.expr],
+    ) -> Iterable[Finding]:
+        """Literal-tuple in_specs/out_specs arity vs the wrapped function's
+        unbound signature. A single (non-tuple) spec legally broadcasts
+        over the argument pytree, so only literal tuples are judged."""
+        params = [
+            p for p in _param_names(fn_node)[n_bound:] if p not in kw_bound
+        ]
+        name = getattr(fn_node, "name", "<lambda>")
+        if isinstance(in_specs, ast.Tuple) and len(in_specs.elts) != len(params):
+            yield Finding(
+                self.id, ctx.rel, call.lineno,
+                f"in_specs has {len(in_specs.elts)} entries but {name}() "
+                f"takes {len(params)} unbound argument(s) "
+                f"({', '.join(params) or 'none'}) — shard_map raises a "
+                "structure mismatch at trace time",
+            )
+        if isinstance(out_specs, ast.Tuple):
+            arity = _literal_return_arity(fn_node)
+            if arity is not None and arity != len(out_specs.elts):
+                yield Finding(
+                    self.id, ctx.rel, call.lineno,
+                    f"out_specs has {len(out_specs.elts)} entries but "
+                    f"{name}() returns {arity}-tuples — shard_map raises "
+                    "a structure mismatch at trace time",
+                )
+
+
+# ------------------------------------------------------- donation hazard
+@register
+class DonationHazardRule(Rule):
+    id = "donation-hazard"
+    rationale = (
+        "`donate_argnums`/`donate_argnames` hands the buffer to XLA for "
+        "in-place reuse; any read after the jitted call sees invalidated "
+        "memory (jax raises `RuntimeError: Array has been deleted` on CPU "
+        "— on other backends the failure can be silent). The rule finds "
+        "every call to a donating jitted callable (same-file sites "
+        "directly, helpers that forward a parameter into a donating call "
+        "through summaries), then scans the enclosing scope for reads of "
+        "the donated name after the call: a straight-line read before any "
+        "rebind, or any read in an enclosing loop whose body never "
+        "rebinds the name (the second iteration reads a donated buffer). "
+        "`cur = step(cur)` is the sanctioned pattern — the rebind makes "
+        "later reads see the fresh buffer."
+    )
+    example = (
+        "@partial(jax.jit, donate_argnums=(0,))\n"
+        "def step(buf):\n"
+        "    return buf + 1\n"
+        "out = step(buf)\n"
+        "print(buf.sum())  # use-after-donate"
+    )
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        from .callgraph import module_name
+
+        _sites, by_name = collect_jit_sites(ctx.tree)
+        donators = {
+            name: site.donated
+            for name, site in by_name.items()
+            if site.donated
+        }
+        program = ctx.program
+        mod = module_name(ctx.rel)
+
+        scopes: List[ast.AST] = [ctx.tree]
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, _DefNode):
+                scopes.append(node)
+        for scope in scopes:
+            yield from self._scan_scope(ctx, scope, donators, program, mod)
+
+    def _donated_args(
+        self, call: ast.Call, donators: Dict[str, Set[int]], program, mod: str,
+        class_name: Optional[str],
+    ) -> List[Tuple[str, str, Tuple[str, ...]]]:
+        """(donated-name, callee-name, via-chain) for each bare-Name
+        argument this call donates, directly or through a helper."""
+        out: List[Tuple[str, str, Tuple[str, ...]]] = []
+        callee_name = _last_name(call.func)
+        direct = donators.get(callee_name or "")
+        if direct:
+            for i in direct:
+                if i < len(call.args) and isinstance(call.args[i], ast.Name):
+                    out.append((call.args[i].id, callee_name, ()))
+            return out
+        if program is None:
+            return out
+        qn = program.graph.resolve_call(mod, call, class_name)
+        summary = program.summaries.get(qn) if qn else None
+        if summary is None or not summary.donates:
+            return out
+        offset = (
+            1
+            if summary.info.class_name
+            and isinstance(call.func, ast.Attribute)
+            and isinstance(call.func.value, ast.Name)
+            and call.func.value.id in ("self", "cls")
+            else 0
+        )
+        for j, (_line, via) in sorted(summary.donates.items()):
+            pos = j - offset
+            if 0 <= pos < len(call.args) and isinstance(
+                call.args[pos], ast.Name
+            ):
+                out.append(
+                    (call.args[pos].id, summary.info.node.name, via)
+                )
+        return out
+
+    def _scan_scope(
+        self, ctx: FileContext, scope: ast.AST,
+        donators: Dict[str, Set[int]], program, mod: str,
+    ) -> Iterable[Finding]:
+        class_name = None
+        if isinstance(scope, _DefNode):
+            parent = ctx.parent(scope)
+            if isinstance(parent, ast.ClassDef):
+                class_name = parent.name
+
+        nodes = list(walk_own(scope))
+        loads: Dict[str, List[int]] = {}
+        stores: Dict[str, List[int]] = {}
+        for node in nodes:
+            if isinstance(node, ast.Name):
+                if isinstance(node.ctx, ast.Load):
+                    loads.setdefault(node.id, []).append(node.lineno)
+                else:
+                    stores.setdefault(node.id, []).append(node.lineno)
+
+        # loop extents in this scope (own walk: nested defs excluded)
+        loops: List[Tuple[int, int]] = []
+        for node in nodes:
+            if isinstance(node, (ast.For, ast.While)):
+                end = max(
+                    (n.lineno for n in ast.walk(node)
+                     if hasattr(n, "lineno")),
+                    default=node.lineno,
+                )
+                loops.append((node.lineno, end))
+
+        for node in nodes:
+            if not isinstance(node, ast.Call):
+                continue
+            for name, callee, via in self._donated_args(
+                node, donators, program, mod, class_name
+            ):
+                chain = f" (via {' -> '.join(via)})" if via else ""
+                line = node.lineno
+                # loop case: the call re-executes; a read anywhere in the
+                # loop without a rebind in the loop is a hazard
+                in_loop = next(
+                    ((s, e) for s, e in loops if s <= line <= e), None
+                )
+                if in_loop is not None:
+                    s, e = in_loop
+                    rebinds = [
+                        ln for ln in stores.get(name, []) if s <= ln <= e
+                    ]
+                    if not rebinds:
+                        reads = [
+                            ln for ln in loads.get(name, []) if s <= ln <= e
+                        ]
+                        if reads:
+                            yield Finding(
+                                self.id, ctx.rel, line,
+                                f"{name!r} is donated to {callee}(){chain} "
+                                "inside a loop and never rebound there — "
+                                "the next iteration reads a donated "
+                                "buffer; rebind it "
+                                f"(`{name} = {callee}(...)`) or drop the "
+                                "donation",
+                            )
+                            continue
+                first_rebind = min(
+                    (ln for ln in stores.get(name, []) if ln >= line),
+                    default=None,
+                )
+                late_reads = [
+                    ln for ln in loads.get(name, [])
+                    if ln > line
+                    and (first_rebind is None or ln < first_rebind)
+                ]
+                if late_reads:
+                    yield Finding(
+                        self.id, ctx.rel, late_reads[0],
+                        f"{name!r} read after being donated to "
+                        f"{callee}(){chain} at line {line} — "
+                        "use-after-donate (jax invalidates donated "
+                        "buffers); read the call's result instead or "
+                        "remove it from donate_argnums",
+                    )
+
+
+# --------------------------------------------------------- exit contract
+@register
+class ExitContractRule(Rule):
+    id = "exit-contract"
+    rationale = (
+        "The CLI documents a 0/1/2/3 exit-code contract (ok / violations "
+        "found / input error / backend failure) and `resilience.errors."
+        "exit_code_for` implements it — but only for `KvTpuError`s a "
+        "handler actually catches. This rule discovers every subcommand "
+        "handler registered via `set_defaults(fn=...)`, takes its "
+        "summary's transitive escaped-raise set (guards are "
+        "hierarchy-aware: `except KvTpuError` catches every subclass), "
+        "and flags any `KvTpuError`-family type that can escape — a new "
+        "taxonomy subclass nobody routes through `exit_code_for` would "
+        "otherwise surface as a raw traceback in the field instead of a "
+        "diagnosable exit code."
+    )
+    example = (
+        "def cmd_new(args):\n"
+        "    run()  # can raise ConfigError — no except KvTpuError\n"
+        "p.set_defaults(fn=cmd_new)"
+    )
+
+    def check_project(self, ctxs: Sequence[FileContext]) -> Iterable[Finding]:
+        from .callgraph import module_name
+        from .summaries import exception_ancestors
+
+        program = _program(ctxs)
+        if program is None:
+            return
+        graph = program.graph
+        for ctx in ctxs:
+            if ctx.tree is None:
+                continue
+            mod = module_name(ctx.rel)
+            handlers: Dict[str, int] = {}
+            for node in ast.walk(ctx.tree):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "set_defaults"
+                ):
+                    continue
+                for kw in node.keywords:
+                    if kw.arg == "fn" and isinstance(kw.value, ast.Name):
+                        handlers.setdefault(kw.value.id, node.lineno)
+            for name in sorted(handlers):
+                qn = graph.module_scopes.get(mod, {}).get(name)
+                summary = program.summaries.get(qn) if qn else None
+                if summary is None:
+                    continue
+                escaped = sorted(
+                    r for r in summary.raises
+                    if "KvTpuError" in exception_ancestors(
+                        r, graph.class_bases
+                    )
+                )
+                for exc in escaped:
+                    yield Finding(
+                        self.id, ctx.rel, summary.info.node.lineno,
+                        f"subcommand handler {name}() can raise {exc} "
+                        "uncaught — it escapes the documented 0/1/2/3 "
+                        "exit-code contract as a raw traceback; wrap the "
+                        "body in `except KvTpuError` and exit via "
+                        "`exit_code_for`",
+                    )

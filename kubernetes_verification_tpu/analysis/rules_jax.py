@@ -46,8 +46,8 @@ HOST_FETCH_CALLS = frozenset({
     "onp.asarray", "onp.array", "jax.device_get",
 })
 
-_JIT_NAMES = frozenset({"jit"})
-_WRAPPER_NAMES = frozenset({"shard_map", "pmap", "vmap_of_jit"})
+_JIT_NAMES = frozenset({"jit", "pjit"})
+_WRAPPER_NAMES = frozenset({"shard_map", "pmap", "xmap", "vmap_of_jit"})
 
 
 def _const_str_set(node: ast.expr) -> Set[str]:
@@ -86,23 +86,36 @@ def _param_names(fn: FunctionNode) -> List[str]:
 
 
 class _JitSite:
-    """One traced function plus the statically-pinned parameter names."""
+    """One traced function plus the statically-pinned parameter names and
+    the donated parameter indices (``donate_argnums``/``donate_argnames``)."""
 
-    def __init__(self, fn: FunctionNode, static: Set[str], argnums: Tuple[int, ...]):
+    def __init__(self, fn: FunctionNode, static: Set[str],
+                 argnums: Tuple[int, ...],
+                 donate_nums: Tuple[int, ...] = (),
+                 donate_names: Set[str] = frozenset()):
         self.fn = fn
         params = _param_names(fn)
         self.static = set(static)
         for i in argnums:
             if 0 <= i < len(params):
                 self.static.add(params[i])
+        self.donated: Set[int] = {i for i in donate_nums if 0 <= i < len(params)}
+        for name in donate_names:
+            if name in params:
+                self.donated.add(params.index(name))
 
 
-def _jit_call_info(call: ast.Call) -> Optional[Tuple[Set[str], Tuple[int, ...]]]:
-    """(static_argnames, static_argnums) when ``call`` is jit-ish
-    (``jax.jit(...)`` or ``partial(jax.jit, ...)``), else None."""
+def _jit_call_info(
+    call: ast.Call,
+) -> Optional[Tuple[Set[str], Tuple[int, ...], Tuple[int, ...], Set[str]]]:
+    """(static_argnames, static_argnums, donate_argnums, donate_argnames)
+    when ``call`` is jit-ish (``jax.jit(...)`` / ``jax.pjit(...)`` or
+    ``partial(jax.jit, ...)``), else None."""
     name = _last_name(call.func)
     static: Set[str] = set()
     argnums: Tuple[int, ...] = ()
+    donate_nums: Tuple[int, ...] = ()
+    donate_names: Set[str] = set()
     is_jit = False
     if name in _JIT_NAMES:
         is_jit = True
@@ -117,7 +130,11 @@ def _jit_call_info(call: ast.Call) -> Optional[Tuple[Set[str], Tuple[int, ...]]]
             static |= _const_str_set(kw.value)
         elif kw.arg == "static_argnums":
             argnums = _const_int_tuple(kw.value)
-    return static, argnums
+        elif kw.arg == "donate_argnums":
+            donate_nums = _const_int_tuple(kw.value)
+        elif kw.arg == "donate_argnames":
+            donate_names = _const_str_set(kw.value)
+    return static, argnums, donate_nums, donate_names
 
 
 def _unwrap_traced_target(node: ast.expr) -> Optional[ast.expr]:
@@ -152,11 +169,13 @@ def collect_jit_sites(tree: ast.AST) -> Tuple[List[_JitSite], Dict[str, _JitSite
     covered: Set[int] = set()
 
     def add(fn: FunctionNode, static: Set[str], argnums: Tuple[int, ...],
+            donate_nums: Tuple[int, ...] = (),
+            donate_names: Set[str] = frozenset(),
             name: Optional[str] = None) -> None:
         if id(fn) in covered:
             return
         covered.add(id(fn))
-        site = _JitSite(fn, static, argnums)
+        site = _JitSite(fn, static, argnums, donate_nums, donate_names)
         sites.append(site)
         if name:
             by_name.setdefault(name, site)
@@ -178,13 +197,13 @@ def collect_jit_sites(tree: ast.AST) -> Tuple[List[_JitSite], Dict[str, _JitSite
             info = _jit_call_info(node)
             if info is None or not node.args:
                 continue
-            static, argnums = info
+            static, argnums, dnums, dnames = info
             target = _unwrap_traced_target(node.args[0])
             if isinstance(target, ast.Lambda):
-                add(target, static, argnums)
+                add(target, static, argnums, dnums, dnames)
             elif isinstance(target, ast.Name):
                 for fn in defs_by_name.get(target.id, ()):
-                    add(fn, static, argnums, name=target.id)
+                    add(fn, static, argnums, dnums, dnames, name=target.id)
 
     # bind `f2 = jax.jit(...)` assignment names so call-site rules can see
     # through the alias
@@ -314,7 +333,10 @@ class JitHostSyncRule(Rule):
         "parameters seed the tracer set, assignments propagate it, and "
         "static-metadata reads (`.shape`, `.dtype`, `len()`) kill it — so "
         "`int(x.shape[0])` passes while `int(x[0])` two assignments later "
-        "is still caught."
+        "is still caught. With interprocedural summaries (PR 9) the rule "
+        "also crosses call boundaries: a helper that syncs one of its "
+        "parameters is flagged at the jitted call site feeding it a "
+        "tracer, even when the sink is several helpers down the chain."
     )
     example = (
         "@jax.jit\n"
@@ -327,14 +349,68 @@ class JitHostSyncRule(Rule):
     def check(self, ctx: FileContext) -> Iterable[Finding]:
         sites, _ = collect_jit_sites(ctx.tree)
         seen: Set[Tuple[int, str]] = set()
+        program = getattr(ctx, "program", None)
         for site in sites:
             taint = _TaintPass(site)
             taint.run()
-            for f in self._scan_sinks(ctx, site, taint):
+            found = self._scan_sinks(ctx, site, taint)
+            if program is not None:
+                found = list(found) + list(
+                    self._scan_helper_calls(ctx, site, taint, program)
+                )
+            for f in found:
                 key = (f.line, f.message)
                 if key not in seen:
                     seen.add(key)
                     yield f
+
+    def _scan_helper_calls(self, ctx: FileContext, site: _JitSite,
+                           taint: _TaintPass, program):
+        """Cross-function sinks: a call inside traced code whose argument
+        feeds a callee parameter that (transitively) hits a host sync."""
+        from .callgraph import module_name
+
+        module = module_name(ctx.rel)
+        qn = program.graph.qname_of(site.fn)
+        own = program.summaries.get(qn) if qn else None
+        class_name = own.info.class_name if own else None
+        for node in ast.walk(site.fn):
+            if not isinstance(node, ast.Call):
+                continue
+            callee_qn = program.graph.resolve_call(module, node, class_name)
+            callee = program.summaries.get(callee_qn) if callee_qn else None
+            if callee is None or not callee.param_syncs:
+                continue
+            offset = (
+                1
+                if callee.info.class_name
+                and isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id in ("self", "cls")
+                else 0
+            )
+            params = callee.local.params
+            for j, sinks in sorted(callee.param_syncs.items()):
+                expr: Optional[ast.expr] = None
+                pos = j - offset
+                if 0 <= pos < len(node.args):
+                    expr = node.args[pos]
+                elif j < len(params):
+                    for kw in node.keywords:
+                        if kw.arg == params[j]:
+                            expr = kw.value
+                if expr is None or not taint.is_tainted(expr):
+                    continue
+                pname = params[j] if j < len(params) else f"#{j}"
+                helper = callee.info.node.name
+                yield Finding(
+                    self.id, ctx.rel, node.lineno,
+                    f"tracer passed to {helper}() parameter {pname!r}, "
+                    f"which performs {sinks[0].described()} — host sync "
+                    "reached from a jitted function through a helper "
+                    "call; keep the value an array through the chain or "
+                    "hoist the sync out of jit",
+                )
 
     def _scan_sinks(self, ctx: FileContext, site: _JitSite, taint: _TaintPass):
         for node in ast.walk(site.fn):

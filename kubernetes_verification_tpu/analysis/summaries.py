@@ -1,0 +1,736 @@
+"""Per-function summaries and their bottom-up interprocedural propagation.
+
+Each indexed function (:mod:`.callgraph`) gets a **local summary** — facts
+computed from its own AST with a parameter-label dataflow pass (which
+parameters reach a host-sync sink / a return / a ``donate_argnums`` slot,
+which collectives it calls with which axis names, which exception types its
+``raise`` statements can leak) — and a **propagated summary** folding in its
+callees, computed over Tarjan SCCs in callee-first order with a fixpoint
+inside each SCC so mutual recursion terminates at the least solution.
+
+The label pass generalises :class:`~.rules_jax._TaintPass` from one boolean
+("tracer-origin?") to *which parameter(s)* a value derives from: the same
+kill set (``.shape``/``.dtype``/``len()`` return static metadata), the same
+assignment fixpoint, but an environment of parameter-index sets. A helper's
+summary is therefore caller-agnostic — ``jit-host-sync`` decides at each
+jitted call site whether the argument feeding a syncing parameter is a
+tracer *there*.
+
+Local summaries are pure functions of one file's bytes, so they cache:
+``.kvtpu_lint_cache.json`` (repo root, gitignored) maps each file's sha256
+to its serialised local summaries. A warm ``kv-tpu lint`` run re-parses
+(every per-file rule needs the tree anyway) but skips the dataflow, the
+dominant analysis cost; propagation is a cheap graph pass and always runs,
+so cross-file facts are never stale. Cache health and graph size are
+observables: ``kvtpu_lint_cache_hits_total`` and
+``kvtpu_lint_callgraph_{nodes,edges}``.
+"""
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .callgraph import CallGraph, FunctionInfo, build_callgraph
+from .core import FileContext
+from .rules_hygiene import _dotted, _last_name
+from .rules_jax import (
+    CONCRETIZING_BUILTINS,
+    HOST_FETCH_CALLS,
+    KILL_CALLS,
+    SHAPE_KILL_ATTRS,
+    SYNC_METHODS,
+    collect_jit_sites,
+)
+
+__all__ = [
+    "CACHE_NAME",
+    "SyncSite",
+    "LocalSummary",
+    "Summary",
+    "Program",
+    "build_program",
+    "default_cache_path",
+]
+
+CACHE_NAME = ".kvtpu_lint_cache.json"
+_CACHE_VERSION = 1
+
+#: collective primitives whose axis names must name a mesh axis
+COLLECTIVES = {
+    "psum": 1,
+    "pmean": 1,
+    "pmax": 1,
+    "pmin": 1,
+    "all_gather": 1,
+    "ppermute": 1,
+    "psum_scatter": 1,
+    "all_to_all": 1,
+    "axis_index": 0,
+    "pbroadcast": 1,
+}
+
+
+def default_cache_path() -> str:
+    from .core import repo_root
+
+    return os.path.join(repo_root(), CACHE_NAME)
+
+
+# ------------------------------------------------------------- summaries
+@dataclass
+class SyncSite:
+    """One host-sync (or concretisation) sink, with the helper chain that
+    leads to it — ``via`` is empty for a direct sink."""
+
+    kind: str
+    rel: str
+    line: int
+    via: Tuple[str, ...] = ()
+
+    def to_dict(self) -> dict:
+        return {"kind": self.kind, "rel": self.rel, "line": self.line,
+                "via": list(self.via)}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "SyncSite":
+        return cls(d["kind"], d["rel"], int(d["line"]), tuple(d["via"]))
+
+    def described(self) -> str:
+        chain = " -> ".join(self.via)
+        where = f"{self.rel}:{self.line}"
+        if chain:
+            return f"{self.kind} at {where} (via {chain})"
+        return f"{self.kind} at {where}"
+
+
+@dataclass
+class LocalSummary:
+    """Cacheable per-function facts (see module docstring)."""
+
+    params: List[str] = field(default_factory=list)
+    #: param indices whose value can reach a ``return``
+    returns_params: List[int] = field(default_factory=list)
+    #: param index → direct host-sync sinks on values derived from it
+    syncs: Dict[int, List[SyncSite]] = field(default_factory=dict)
+    #: direct collective calls: {kind, line, axes: [axis-expr dicts]}
+    collectives: List[dict] = field(default_factory=list)
+    #: direct raises escaping local handlers: {name, guards: [...]}
+    raises: List[dict] = field(default_factory=list)
+    #: param index → line of a jit call donating that parameter's buffer
+    donates: Dict[int, int] = field(default_factory=dict)
+    #: resolved-shape call sites: {shape, line, args: [[labels]],
+    #: kwargs: {name: [labels]}, guards: [...]}
+    calls: List[dict] = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return {
+            "params": self.params,
+            "returns_params": self.returns_params,
+            "syncs": {str(i): [s.to_dict() for s in v]
+                      for i, v in self.syncs.items()},
+            "collectives": self.collectives,
+            "raises": self.raises,
+            "donates": {str(i): ln for i, ln in self.donates.items()},
+            "calls": self.calls,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "LocalSummary":
+        return cls(
+            params=list(d.get("params", [])),
+            returns_params=[int(i) for i in d.get("returns_params", [])],
+            syncs={int(i): [SyncSite.from_dict(s) for s in v]
+                   for i, v in d.get("syncs", {}).items()},
+            collectives=list(d.get("collectives", [])),
+            raises=list(d.get("raises", [])),
+            donates={int(i): int(ln) for i, ln in d.get("donates", {}).items()},
+            calls=list(d.get("calls", [])),
+        )
+
+
+@dataclass
+class Summary:
+    """A function's propagated (callee-folded) summary."""
+
+    info: FunctionInfo
+    local: LocalSummary
+    #: param index → every sync sink reachable from it, any call depth
+    param_syncs: Dict[int, List[SyncSite]] = field(default_factory=dict)
+    #: exception type names that can escape this function
+    raises: Set[str] = field(default_factory=set)
+    #: param index → (line, via-chain) of a reachable buffer donation
+    donates: Dict[int, Tuple[int, Tuple[str, ...]]] = field(default_factory=dict)
+
+
+@dataclass
+class Program:
+    """The interprocedural view rules consume: graph + summaries."""
+
+    graph: CallGraph
+    summaries: Dict[str, Summary]
+    cache_hits: int = 0
+    cache_misses: int = 0
+
+    def summary_for_node(self, node: ast.AST) -> Optional[Summary]:
+        qn = self.graph.qname_of(node)
+        return self.summaries.get(qn) if qn else None
+
+    def resolve_axis(self, module: str, axis: dict) -> Optional[str]:
+        """A serialised axis expression → its string value, when static."""
+        if "s" in axis:
+            return axis["s"]
+        if "n" in axis:
+            return self.graph.str_constants.get(module, {}).get(axis["n"])
+        if "a" in axis:
+            base, attr = axis["a"]
+            target = self.graph.module_aliases.get(module, {}).get(base)
+            if target is not None:
+                return self.graph.str_constants.get(target, {}).get(attr)
+        return None
+
+
+# ------------------------------------------------------- label dataflow
+class _LabelFlow:
+    """Forward dataflow mapping each local name to the set of parameter
+    indices its value may derive from."""
+
+    def __init__(self, fn: ast.AST, params: List[str]):
+        self.fn = fn
+        self.env: Dict[str, Set[int]] = {p: {i} for i, p in enumerate(params)}
+
+    def labels(self, node: ast.AST) -> Set[int]:
+        if isinstance(node, ast.Name):
+            return self.env.get(node.id, set())
+        if isinstance(node, ast.Constant):
+            return set()
+        if isinstance(node, ast.Attribute):
+            if node.attr in SHAPE_KILL_ATTRS:
+                return set()
+            return self.labels(node.value)
+        if isinstance(node, ast.Call):
+            if _last_name(node.func) in KILL_CALLS:
+                return set()
+            out = self.labels(node.func)
+            for a in node.args:
+                out |= self.labels(a)
+            for kw in node.keywords:
+                out |= self.labels(kw.value)
+            return out
+        if isinstance(node, (ast.Lambda, ast.FunctionDef, ast.AsyncFunctionDef)):
+            return set()
+        out: Set[int] = set()
+        for child in ast.iter_child_nodes(node):
+            out |= self.labels(child)
+        return out
+
+    def _bind(self, target: ast.expr, labels: Set[int]) -> bool:
+        changed = False
+        if isinstance(target, ast.Name):
+            cur = self.env.get(target.id)
+            if cur != labels:
+                self.env[target.id] = set(labels)
+                changed = True
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                changed |= self._bind(elt, labels)
+        elif isinstance(target, ast.Starred):
+            changed |= self._bind(target.value, labels)
+        return changed
+
+    def run(self) -> None:
+        for _ in range(10):
+            changed = False
+            for node in ast.walk(self.fn):
+                if isinstance(node, ast.Assign):
+                    lab = self.labels(node.value)
+                    for tgt in node.targets:
+                        changed |= self._bind(tgt, lab)
+                elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                    changed |= self._bind(node.target, self.labels(node.value))
+                elif isinstance(node, ast.AugAssign):
+                    if isinstance(node.target, ast.Name):
+                        lab = self.labels(node.target) | self.labels(node.value)
+                        changed |= self._bind(node.target, lab)
+                elif isinstance(node, ast.NamedExpr):
+                    changed |= self._bind(node.target, self.labels(node.value))
+                elif isinstance(node, ast.For):
+                    changed |= self._bind(node.target, self.labels(node.iter))
+                elif isinstance(node, ast.comprehension):
+                    changed |= self._bind(node.target, self.labels(node.iter))
+                elif isinstance(node, ast.With):
+                    for item in node.items:
+                        if item.optional_vars is not None:
+                            changed |= self._bind(
+                                item.optional_vars,
+                                self.labels(item.context_expr),
+                            )
+            if not changed:
+                break
+
+
+def _branch_labels(flow: _LabelFlow, test: ast.expr) -> Set[int]:
+    """Labels of a branch condition, minus ``is``/``is not`` comparisons —
+    identity tests (``if x is not None:``) inspect pytree *structure*, not
+    tracer values, and are legal in traced code."""
+    if isinstance(test, ast.Compare) and all(
+        isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops
+    ):
+        return set()
+    if isinstance(test, ast.BoolOp):
+        out: Set[int] = set()
+        for v in test.values:
+            out |= _branch_labels(flow, v)
+        return out
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        return _branch_labels(flow, test.operand)
+    return flow.labels(test)
+
+
+def _param_names(fn) -> List[str]:
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return names
+
+
+def _call_shape(call: ast.Call) -> Optional[dict]:
+    """Serialise how a call names its callee, for later resolution."""
+    func = call.func
+    if isinstance(func, ast.Name):
+        return {"name": func.id}
+    if isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name):
+        if func.value.id in ("self", "cls"):
+            return {"method": func.attr}
+        return {"attr": [func.value.id, func.attr]}
+    return None
+
+
+def _resolve_shape(
+    graph: CallGraph, module: str, class_name: Optional[str], shape: dict
+) -> Optional[str]:
+    if "name" in shape:
+        return graph.module_scopes.get(module, {}).get(shape["name"])
+    if "method" in shape and class_name:
+        qn = f"{module}:{class_name}.{shape['method']}"
+        return qn if qn in graph.functions else None
+    if "attr" in shape:
+        base, attr = shape["attr"]
+        target = graph.module_aliases.get(module, {}).get(base)
+        if target is not None:
+            qn = f"{target}:{attr}"
+            if qn in graph.functions:
+                return qn
+    return None
+
+
+def _axis_exprs(node: ast.expr) -> List[dict]:
+    """Serialise an ``axis_name`` argument: literal strings, names, and
+    module-attribute reads survive; anything else is dynamic."""
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: List[dict] = []
+        for elt in node.elts:
+            out.extend(_axis_exprs(elt))
+        return out
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [{"s": node.value}]
+    if isinstance(node, ast.Name):
+        return [{"n": node.id}]
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name):
+        return [{"a": [node.value.id, node.attr]}]
+    return [{"dyn": True}]
+
+
+def _is_collective(call: ast.Call) -> Optional[Tuple[str, int]]:
+    name = _last_name(call.func)
+    if name not in COLLECTIVES:
+        return None
+    dotted = _dotted(call.func)
+    # accept `lax.psum` / `jax.lax.psum` / bare `psum` (from-import); a
+    # `psum` method on some unrelated object would need a dotted receiver
+    # that is neither `lax` nor `jax.lax`, which the package never has
+    if dotted is not None and "." in dotted:
+        head = dotted.rsplit(".", 1)[0]
+        if head not in ("lax", "jax.lax"):
+            return None
+    return name, COLLECTIVES[name]
+
+
+def _exc_name(node: Optional[ast.expr]) -> Optional[str]:
+    if node is None:
+        return None
+    if isinstance(node, ast.Call):
+        return _last_name(node.func)
+    return _last_name(node)
+
+
+def _handler_names(handler: ast.ExceptHandler) -> List[str]:
+    if handler.type is None:
+        return ["BaseException"]
+    if isinstance(handler.type, ast.Tuple):
+        return [n for n in (_last_name(e) for e in handler.type.elts) if n]
+    n = _last_name(handler.type)
+    return [n] if n else []
+
+
+def _compute_local(info: FunctionInfo, donate_map: Dict[str, Set[int]]) -> LocalSummary:
+    """One function's local summary: label dataflow + sink/collective/raise
+    extraction. ``donate_map`` maps local jitted-callable names to the
+    parameter indices they donate."""
+    fn = info.node
+    params = _param_names(fn)
+    flow = _LabelFlow(fn, params)
+    flow.run()
+    out = LocalSummary(params=params)
+
+    returns: Set[int] = set()
+    syncs: Dict[int, List[SyncSite]] = {}
+
+    def add_sync(labels: Set[int], kind: str, line: int) -> None:
+        for i in labels:
+            syncs.setdefault(i, []).append(SyncSite(kind, info.rel, line))
+
+    # guards: exception type names caught by try blocks enclosing a node
+    guard_of: Dict[int, Tuple[str, ...]] = {}
+
+    def walk_guarded(node: ast.AST, guards: Tuple[str, ...]) -> None:
+        if isinstance(node, ast.Try):
+            inner = guards + tuple(
+                n for h in node.handlers for n in _handler_names(h)
+            )
+            for child in node.body:
+                guard_of[id(child)] = inner
+                walk_guarded(child, inner)
+            for part in (node.orelse, node.finalbody):
+                for child in part:
+                    walk_guarded(child, guards)
+            for h in node.handlers:
+                for child in h.body:
+                    walk_guarded(child, guards)
+            return
+        for child in ast.iter_child_nodes(node):
+            walk_guarded(child, guards)
+            guard_of.setdefault(id(child), guards)
+
+    walk_guarded(fn, ())
+
+    for node in ast.walk(fn):
+        guards = list(guard_of.get(id(node), ()))
+        if isinstance(node, ast.Return) and node.value is not None:
+            returns |= flow.labels(node.value)
+        elif isinstance(node, ast.Raise):
+            name = _exc_name(node.exc)
+            if name:
+                out.raises.append(
+                    {"name": name, "guards": guards, "line": node.lineno}
+                )
+        elif isinstance(node, (ast.If, ast.While)):
+            add_sync(_branch_labels(flow, node.test), "Python branch",
+                     node.lineno)
+        elif isinstance(node, ast.Assert):
+            add_sync(_branch_labels(flow, node.test), "assert", node.lineno)
+        elif isinstance(node, ast.Call):
+            coll = _is_collective(node)
+            if coll is not None:
+                kind, axis_pos = coll
+                axis_node: Optional[ast.expr] = None
+                if len(node.args) > axis_pos:
+                    axis_node = node.args[axis_pos]
+                for kw in node.keywords:
+                    if kw.arg == "axis_name":
+                        axis_node = kw.value
+                out.collectives.append({
+                    "kind": kind,
+                    "line": node.lineno,
+                    "axes": _axis_exprs(axis_node) if axis_node is not None
+                    else [],
+                })
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in SYNC_METHODS
+            ):
+                add_sync(
+                    flow.labels(node.func.value),
+                    f".{node.func.attr}()", node.lineno,
+                )
+            elif (
+                isinstance(node.func, ast.Name)
+                and node.func.id in CONCRETIZING_BUILTINS
+                and node.args
+            ):
+                add_sync(
+                    flow.labels(node.args[0]),
+                    f"{node.func.id}()", node.lineno,
+                )
+            elif _dotted(node.func) in HOST_FETCH_CALLS:
+                lab: Set[int] = set()
+                for a in node.args:
+                    lab |= flow.labels(a)
+                add_sync(lab, f"{_dotted(node.func)}()", node.lineno)
+
+            shape = _call_shape(node)
+            if shape is not None:
+                # donation: a bare parameter fed to a donating slot of a
+                # local jitted callable marks that parameter donated
+                if "name" in shape and shape["name"] in donate_map:
+                    for i in donate_map[shape["name"]]:
+                        if i < len(node.args) and isinstance(
+                            node.args[i], ast.Name
+                        ):
+                            for j in flow.env.get(node.args[i].id, set()):
+                                out.donates.setdefault(j, node.lineno)
+                out.calls.append({
+                    "shape": shape,
+                    "line": node.lineno,
+                    "args": [sorted(flow.labels(a)) for a in node.args],
+                    "kwargs": {
+                        kw.arg: sorted(flow.labels(kw.value))
+                        for kw in node.keywords
+                        if kw.arg is not None
+                    },
+                    "guards": guards,
+                })
+
+    out.returns_params = sorted(returns)
+    out.syncs = syncs
+    return out
+
+
+def _donate_map(tree: ast.AST) -> Dict[str, Set[int]]:
+    """Local jitted-callable name → donated parameter indices."""
+    _sites, by_name = collect_jit_sites(tree)
+    return {
+        name: site.donated for name, site in by_name.items() if site.donated
+    }
+
+
+# ----------------------------------------------------------- propagation
+#: builtin exception hierarchy the guard filter understands (the package's
+#: own taxonomy is read from class defs at propagation time)
+_BUILTIN_BASES: Dict[str, Tuple[str, ...]] = {
+    "ValueError": ("Exception",),
+    "TypeError": ("Exception",),
+    "KeyError": ("LookupError",),
+    "IndexError": ("LookupError",),
+    "LookupError": ("Exception",),
+    "RuntimeError": ("Exception",),
+    "NotImplementedError": ("RuntimeError",),
+    "OSError": ("Exception",),
+    "IOError": ("OSError",),
+    "ArithmeticError": ("Exception",),
+    "ZeroDivisionError": ("ArithmeticError",),
+    "AttributeError": ("Exception",),
+    "StopIteration": ("Exception",),
+    "ImportError": ("Exception",),
+    "ModuleNotFoundError": ("ImportError",),
+    "AssertionError": ("Exception",),
+    "Exception": ("BaseException",),
+    "KeyboardInterrupt": ("BaseException",),
+    "SystemExit": ("BaseException",),
+}
+
+
+def exception_ancestors(
+    name: str, class_bases: Dict[str, Tuple[str, ...]]
+) -> Set[str]:
+    """All (known) ancestors of an exception type, itself included."""
+    seen: Set[str] = set()
+    todo = [name]
+    while todo:
+        cur = todo.pop()
+        if cur in seen:
+            continue
+        seen.add(cur)
+        todo.extend(class_bases.get(cur, ()))
+        todo.extend(_BUILTIN_BASES.get(cur, ()))
+    return seen
+
+
+def _caught_by(
+    name: str, guards: Sequence[str], class_bases: Dict[str, Tuple[str, ...]]
+) -> bool:
+    if not guards:
+        return False
+    ancestors = exception_ancestors(name, class_bases)
+    return any(g in ancestors for g in guards)
+
+
+def _map_call_labels(call: dict, callee: Summary) -> Dict[int, Set[int]]:
+    """Callee param index → caller labels flowing into it at this site."""
+    offset = 1 if "method" in call["shape"] and callee.info.class_name else 0
+    out: Dict[int, Set[int]] = {}
+    for k, labels in enumerate(call["args"]):
+        if labels:
+            out.setdefault(k + offset, set()).update(labels)
+    if call["kwargs"]:
+        index_of = {p: i for i, p in enumerate(callee.local.params)}
+        for pname, labels in call["kwargs"].items():
+            if labels and pname in index_of:
+                out.setdefault(index_of[pname], set()).update(labels)
+    return out
+
+
+_MAX_SYNCS_PER_PARAM = 4  # keep summaries (and messages) bounded
+
+
+def _propagate(graph: CallGraph, summaries: Dict[str, Summary]) -> None:
+    for scc in graph.sccs_bottom_up():
+        for _ in range(len(scc) + 1):
+            changed = False
+            for qn in scc:
+                s = summaries[qn]
+                info = s.info
+                for call in s.local.calls:
+                    callee_qn = _resolve_shape(
+                        graph, info.module, info.class_name, call["shape"]
+                    )
+                    if callee_qn is None or callee_qn not in summaries:
+                        continue
+                    callee = summaries[callee_qn]
+                    label_map = _map_call_labels(call, callee)
+                    step = callee.info.node.name
+                    # syncs: callee param j syncs + our labels reach j
+                    for j, sites in callee.param_syncs.items():
+                        for i in label_map.get(j, ()):
+                            mine = s.param_syncs.setdefault(i, [])
+                            for site in sites:
+                                if len(site.via) >= 6:
+                                    continue
+                                lifted = SyncSite(
+                                    site.kind, site.rel, site.line,
+                                    (step,) + site.via,
+                                )
+                                if lifted not in mine and len(mine) < _MAX_SYNCS_PER_PARAM:
+                                    mine.append(lifted)
+                                    changed = True
+                    # donations lift the same way
+                    for j, (line, via) in callee.donates.items():
+                        for i in label_map.get(j, ()):
+                            if i not in s.donates and len(via) < 6:
+                                s.donates[i] = (call["line"], (step,) + via)
+                                changed = True
+                    # raises: callee escapes filtered by this site's guards
+                    for r in callee.raises:
+                        if r in s.raises:
+                            continue
+                        if _caught_by(r, call["guards"], graph.class_bases):
+                            continue
+                        s.raises.add(r)
+                        changed = True
+            if not changed:
+                break
+
+
+# ------------------------------------------------------------------ cache
+def _load_cache(path: str) -> dict:
+    try:
+        with open(path, "r") as fh:
+            data = json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        return {}
+    if data.get("version") != _CACHE_VERSION:
+        return {}
+    files = data.get("files")
+    return files if isinstance(files, dict) else {}
+
+
+def _save_cache(path: str, files: dict) -> None:
+    body = json.dumps({"version": _CACHE_VERSION, "files": files})
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        fh.write(body)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+
+
+def build_program(
+    ctxs: Sequence[FileContext],
+    cache_path: Optional[str] = None,
+) -> Program:
+    """Callgraph + summaries for a set of parsed files. ``cache_path``
+    enables the content-hash local-summary cache (propagation always runs
+    fresh, so cross-file facts cannot go stale)."""
+    graph = build_callgraph(ctxs)
+    by_rel: Dict[str, List[FunctionInfo]] = {}
+    for info in graph.functions.values():
+        by_rel.setdefault(info.rel, []).append(info)
+
+    cache = _load_cache(cache_path) if cache_path else {}
+    new_cache: dict = {}
+    hits = misses = 0
+    locals_by_qname: Dict[str, LocalSummary] = {}
+
+    for ctx in ctxs:
+        if ctx.tree is None:
+            continue
+        infos = by_rel.get(ctx.rel, [])
+        digest = hashlib.sha256(ctx.source.encode("utf-8")).hexdigest()
+        entry = cache.get(ctx.rel)
+        cached_fns = (
+            entry.get("functions", {})
+            if entry and entry.get("hash") == digest
+            else None
+        )
+        if cached_fns is not None and set(cached_fns) == {
+            i.qname for i in infos
+        }:
+            hits += 1
+            for info in infos:
+                locals_by_qname[info.qname] = LocalSummary.from_dict(
+                    cached_fns[info.qname]
+                )
+            new_cache[ctx.rel] = entry
+            continue
+        misses += 1
+        donate_map = _donate_map(ctx.tree)
+        fresh: Dict[str, dict] = {}
+        for info in infos:
+            local = _compute_local(info, donate_map)
+            locals_by_qname[info.qname] = local
+            fresh[info.qname] = local.to_dict()
+        new_cache[ctx.rel] = {"hash": digest, "functions": fresh}
+
+    summaries: Dict[str, Summary] = {}
+    for qn, info in graph.functions.items():
+        local = locals_by_qname.get(qn, LocalSummary())
+        summaries[qn] = Summary(
+            info=info,
+            local=local,
+            param_syncs={i: list(v) for i, v in local.syncs.items()},
+            raises={
+                r["name"]
+                for r in local.raises
+                if not _caught_by(r["name"], r["guards"], graph.class_bases)
+            },
+            donates={i: (ln, ()) for i, ln in local.donates.items()},
+        )
+    _propagate(graph, summaries)
+
+    if cache_path and misses:
+        try:
+            _save_cache(cache_path, new_cache)
+        except OSError:
+            pass  # read-only checkout: the cache is an optimisation only
+
+    program = Program(graph, summaries, cache_hits=hits, cache_misses=misses)
+    try:
+        from ..observe.metrics import (
+            LINT_CACHE_HITS_TOTAL,
+            LINT_CALLGRAPH_EDGES,
+            LINT_CALLGRAPH_NODES,
+        )
+
+        LINT_CALLGRAPH_NODES.set(len(graph.functions))
+        LINT_CALLGRAPH_EDGES.set(graph.n_edges)
+        if hits:
+            LINT_CACHE_HITS_TOTAL.inc(hits)
+    except ImportError:  # linting outside an installed package tree
+        pass
+    return program

@@ -354,6 +354,15 @@ def _inc_aggregates(inc) -> dict:
 
 
 def cmd_snapshot(args) -> int:
+    from .resilience.errors import KvTpuError
+
+    try:
+        return _run_snapshot(args)
+    except KvTpuError as e:
+        return _diagnose(args, e)
+
+
+def _run_snapshot(args) -> int:
     import kubernetes_verification_tpu as kv
 
     from .packed_incremental import PackedIncrementalVerifier
@@ -738,6 +747,15 @@ def cmd_history(args) -> int:
 
 
 def cmd_generate(args) -> int:
+    from .resilience.errors import KvTpuError
+
+    try:
+        return _run_generate(args)
+    except KvTpuError as e:
+        return _diagnose(args, e)
+
+
+def _run_generate(args) -> int:
     from .harness.generate import GeneratorConfig, random_cluster
     from .ingest import dump_cluster
 

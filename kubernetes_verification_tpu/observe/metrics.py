@@ -359,6 +359,29 @@ LINT_FINDINGS_TOTAL = Counter(
     ("rule",),
 )
 
+LINT_CALLGRAPH_NODES = Gauge(
+    "kvtpu_lint_callgraph_nodes",
+    "Functions indexed by the interprocedural lint call graph on the last "
+    "`kv-tpu lint` run in this process — a sudden drop means the "
+    "module/import resolver stopped seeing part of the package and the "
+    "cross-function rules silently lost coverage.",
+)
+
+LINT_CALLGRAPH_EDGES = Gauge(
+    "kvtpu_lint_callgraph_edges",
+    "Resolved call edges in the interprocedural lint call graph on the "
+    "last `kv-tpu lint` run — the denominator for how much of the package "
+    "the summary propagation (taint, raises, donation) can traverse.",
+)
+
+LINT_CACHE_HITS_TOTAL = Counter(
+    "kvtpu_lint_cache_hits_total",
+    "Files whose per-function lint summaries were served from the "
+    "content-hash cache (.kvtpu_lint_cache.json) instead of re-running "
+    "the label dataflow — the warm-run speedup `kv-tpu lint` budgets "
+    "against.",
+)
+
 #: The frozen dashboard contract: families that must exist in every build.
 #: New families are appended here by the PR that introduces them; the
 #: `metrics-names` lint rule and `scripts/check_metrics_names.py` both fail
@@ -413,5 +436,9 @@ REQUIRED_FAMILIES = frozenset(
         "kvtpu_breaker_transitions_total",
         # static analysis (analysis/)
         "kvtpu_lint_findings_total",
+        # interprocedural engine (analysis/callgraph.py + summaries.py)
+        "kvtpu_lint_callgraph_nodes",
+        "kvtpu_lint_callgraph_edges",
+        "kvtpu_lint_cache_hits_total",
     }
 )

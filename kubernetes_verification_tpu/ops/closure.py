@@ -184,7 +184,7 @@ def packed_closure(
     packed = jnp.asarray(packed)
     N, W = packed.shape
     if N != W * 32:
-        raise ValueError(
+        raise ConfigError(
             f"packed matrix must be square in bits ([{N}, {N}/32]); "
             f"got [{N}, {W}]"
         )
@@ -365,12 +365,12 @@ def packed_closure_delta(
     prev = jnp.asarray(prev_closure)
     N, W = new_base.shape
     if prev.shape != (N, W):
-        raise ValueError(
+        raise ConfigError(
             f"previous closure shape {prev.shape} != base shape {(N, W)}"
         )
     dirty = np.asarray(dirty, dtype=bool)
     if dirty.shape != (N,):
-        raise ValueError(f"dirty mask must be bool [{N}]")
+        raise ConfigError(f"dirty mask must be bool [{N}]")
     # ``t`` is the ROW tile of the dense-suspect fallback's full squaring
     # (same semantics as packed_closure's ``tile``); the frontier kernels
     # below take their own dst stripes. ``_closure_rows_step``'s counts

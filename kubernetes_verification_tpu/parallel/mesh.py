@@ -23,6 +23,8 @@ from typing import Optional, Sequence, Tuple, Union
 import jax
 import numpy as np
 
+from ..resilience.errors import ConfigError
+
 __all__ = [
     "POD_AXIS",
     "GRANT_AXIS",
@@ -74,7 +76,7 @@ def mesh_for(
         shape = (shape, 1)
     dp, mp = shape
     if dp * mp != len(devices):
-        raise ValueError(f"mesh shape {shape} != {len(devices)} devices")
+        raise ConfigError(f"mesh shape {shape} != {len(devices)} devices")
     arr = np.asarray(devices).reshape(dp, mp)
     return jax.sharding.Mesh(arr, (POD_AXIS, GRANT_AXIS))
 

@@ -1,0 +1,508 @@
+"""The interprocedural analysis engine (``analysis/callgraph.py`` +
+``analysis/summaries.py``) and the rules built on it: cross-function
+taint for ``jit-host-sync``, ``collective-axis`` mesh consistency,
+``donation-hazard`` use-after-donate, and the ``exit-contract`` CLI
+raise-reachability check — plus the content-hash summary cache, the
+SARIF reporter golden file, and the ``--changed`` git plumbing."""
+import json
+import textwrap
+from pathlib import Path
+
+from kubernetes_verification_tpu.analysis import (
+    changed_package_rels,
+    render_sarif,
+    run_lint,
+)
+from kubernetes_verification_tpu.analysis.core import build_context
+from kubernetes_verification_tpu.analysis.summaries import build_program
+
+REPO = Path(__file__).resolve().parent.parent
+GOLDEN = Path(__file__).resolve().parent / "golden"
+
+
+def _lint(sources, rules, cache_path=None):
+    """Multi-file fixture helper: {rel: dedented source} -> findings."""
+    srcs = {rel: textwrap.dedent(src) for rel, src in sources.items()}
+    return run_lint(srcs, rules=rules, cache_path=cache_path).findings
+
+
+def _program(sources, cache_path=None):
+    ctxs = [
+        build_context(rel, textwrap.dedent(src))
+        for rel, src in sources.items()
+    ]
+    return build_program(ctxs, cache_path=cache_path)
+
+
+# ------------------------------------------------- cross-function taint
+def test_jit_host_sync_through_two_helpers():
+    """The acceptance fixture: a jitted function reaches ``.item()`` two
+    calls away, and the finding lands at the jitted call site with the
+    via-chain naming the route."""
+    found = _lint(
+        {
+            "a.py": """
+            import jax
+
+            def inner(p):
+                return int(p.item())
+
+            def outer(q):
+                return inner(q) + 1
+
+            @jax.jit
+            def f(x):
+                return outer(x)
+            """
+        },
+        ["jit-host-sync"],
+    )
+    assert len(found) == 1
+    f = found[0]
+    assert f.path == "a.py"
+    assert "outer" in f.message and "via inner" in f.message
+    assert "host sync" in f.message
+
+
+def test_jit_host_sync_cross_file_helper():
+    found = _lint(
+        {
+            "util.py": """
+            def pull(v):
+                return float(v)
+            """,
+            "main.py": """
+            import jax
+            from util import pull
+
+            @jax.jit
+            def f(x):
+                return pull(x)
+            """,
+        },
+        ["jit-host-sync"],
+    )
+    assert [f.path for f in found] == ["main.py"]
+    assert "pull" in found[0].message
+
+
+def test_jit_host_sync_clean_helper_not_flagged():
+    found = _lint(
+        {
+            "a.py": """
+            import jax
+            import jax.numpy as jnp
+
+            def double(p):
+                return p * 2
+
+            @jax.jit
+            def f(x):
+                return double(x) + jnp.sum(x)
+            """
+        },
+        ["jit-host-sync"],
+    )
+    assert found == []
+
+
+def test_scc_recursion_fixpoint_terminates():
+    """Mutually recursive helpers form an SCC; the fixpoint must converge
+    and still lift the sync out of the cycle."""
+    found = _lint(
+        {
+            "a.py": """
+            import jax
+
+            def ping(p, n):
+                if n == 0:
+                    return int(p.item())
+                return pong(p, n - 1)
+
+            def pong(p, n):
+                return ping(p, n - 1)
+
+            @jax.jit
+            def f(x):
+                return ping(x, 3)
+            """
+        },
+        ["jit-host-sync"],
+    )
+    assert len(found) == 1
+    assert "ping" in found[0].message
+
+
+# ------------------------------------------------------- summary cache
+def test_summary_cache_hit_and_invalidation_on_edit(tmp_path):
+    cache = str(tmp_path / "cache.json")
+    sources = {
+        "a.py": """
+        def helper(p):
+            return p.item()
+        """,
+        "b.py": """
+        def other(q):
+            return q * 2
+        """,
+    }
+    cold = _program(sources, cache_path=cache)
+    assert cold.cache_hits == 0 and cold.cache_misses == 2
+    warm = _program(sources, cache_path=cache)
+    assert warm.cache_hits == 2 and warm.cache_misses == 0
+    # same qnames, same facts: the cached summaries agree with the fresh ones
+    assert set(warm.summaries) == set(cold.summaries)
+    qn = "a:helper"
+    assert set(warm.summaries[qn].param_syncs) == {0}
+
+    edited = dict(sources)
+    edited["a.py"] = sources["a.py"].replace("p.item()", "p * 3")
+    third = _program(edited, cache_path=cache)
+    assert third.cache_hits == 1 and third.cache_misses == 1
+    assert third.summaries[qn].param_syncs == {}
+
+
+def test_cache_corruption_falls_back_to_cold(tmp_path):
+    cache = tmp_path / "cache.json"
+    cache.write_text("{not json")
+    prog = _program({"a.py": "def f(p):\n    return p\n"},
+                    cache_path=str(cache))
+    assert prog.cache_misses == 1
+
+
+# ----------------------------------------------------- collective-axis
+_MESH_FIXTURE_HEAD = """
+import jax
+from functools import partial
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+POD_AXIS = "pods"
+GRANT_AXIS = "grants"
+"""
+
+
+def test_collective_axis_undefined_axis_flagged():
+    found = _lint(
+        {
+            "p.py": _MESH_FIXTURE_HEAD + textwrap.dedent("""
+            def body(x):
+                return lax.psum(x, "rows")
+
+            def run(devs, x):
+                m = Mesh(devs, ("pods", "grants"))
+                f = shard_map(body, m, in_specs=(P("pods"),),
+                              out_specs=P("pods"))
+                return f(x)
+            """)
+        },
+        ["collective-axis"],
+    )
+    assert len(found) == 1
+    assert "psum" in found[0].message
+    assert "pods" in found[0].message and "grants" in found[0].message
+
+
+def test_collective_axis_matching_axis_and_partial_alias_pass():
+    """Distilled from ``parallel/sharded_closure.py``: the wrapped target
+    is a local ``partial`` alias and the axis comes from a module
+    constant — both must resolve cleanly."""
+    found = _lint(
+        {
+            "p.py": _MESH_FIXTURE_HEAD + textwrap.dedent("""
+            def _local(tile, x, y):
+                s = lax.psum(x, POD_AXIS)
+                return s + y * tile
+
+            def run(devs, x, y):
+                m = Mesh(devs, ("pods", "grants"))
+                body = partial(_local, 128)
+                f = shard_map(body, m,
+                              in_specs=(P("pods"), P("pods")),
+                              out_specs=P("pods"))
+                return f(x, y)
+            """)
+        },
+        ["collective-axis"],
+    )
+    assert found == []
+
+
+def test_collective_axis_unreachable_collective_flagged():
+    found = _lint(
+        {
+            "p.py": _MESH_FIXTURE_HEAD + textwrap.dedent("""
+            def stray(x):
+                return lax.psum(x, POD_AXIS)
+            """)
+        },
+        ["collective-axis"],
+    )
+    assert len(found) == 1
+    assert "not reachable" in found[0].message
+
+
+def test_collective_axis_in_specs_arity_mismatch():
+    found = _lint(
+        {
+            "p.py": _MESH_FIXTURE_HEAD + textwrap.dedent("""
+            def body(x, y):
+                return lax.psum(x + y, POD_AXIS)
+
+            def run(devs, x, y):
+                m = Mesh(devs, ("pods", "grants"))
+                f = shard_map(body, m, in_specs=(P("pods"),),
+                              out_specs=P("pods"))
+                return f(x, y)
+            """)
+        },
+        ["collective-axis"],
+    )
+    assert any("in_specs has 1 entries" in f.message for f in found)
+
+
+# ----------------------------------------------------- donation-hazard
+def test_donation_read_after_donate_flagged():
+    found = _lint(
+        {
+            "d.py": """
+            import jax
+            from functools import partial
+
+            @partial(jax.jit, donate_argnums=(0,))
+            def step(buf):
+                return buf + 1
+
+            def run(buf):
+                out = step(buf)
+                return out + buf.sum()
+            """
+        },
+        ["donation-hazard"],
+    )
+    assert len(found) == 1
+    assert "use-after-donate" in found[0].message
+
+
+def test_donation_loop_rebind_is_clean_missing_rebind_is_not():
+    src = """
+    import jax
+    from functools import partial
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def step(buf):
+        return buf + 1
+
+    def good(buf):
+        for _ in range(4):
+            buf = step(buf)
+        return buf
+
+    def bad(buf):
+        acc = 0.0
+        for _ in range(4):
+            acc = acc + step(buf)
+        return acc
+    """
+    found = _lint({"d.py": src}, ["donation-hazard"])
+    assert len(found) == 1
+    assert "inside a loop" in found[0].message
+
+
+def test_donation_through_helper_flagged():
+    """The donation is a fact of the *callee's* summary: calling a plain
+    helper that internally donates its parameter still invalidates the
+    caller's buffer."""
+    found = _lint(
+        {
+            "d.py": """
+            import jax
+            from functools import partial
+
+            @partial(jax.jit, donate_argnums=(0,))
+            def _kernel(buf):
+                return buf + 1
+
+            def helper(b):
+                return _kernel(b)
+
+            def run(buf):
+                out = helper(buf)
+                return out + buf.mean()
+            """
+        },
+        ["donation-hazard"],
+    )
+    assert len(found) == 1
+    assert "use-after-donate" in found[0].message
+
+
+# ------------------------------------------------------- exit-contract
+def test_exit_contract_escaped_raise_flagged_and_wrapped_clean():
+    head = """
+    import argparse
+
+    class KvTpuError(Exception):
+        pass
+
+    class BoomError(KvTpuError):
+        pass
+
+    def exit_code_for(e):
+        return 2
+    """
+    bad = head + """
+    def cmd_boom(args):
+        raise BoomError("x")
+
+    def build(sub):
+        p = sub.add_parser("boom")
+        p.set_defaults(fn=cmd_boom)
+    """
+    found = _lint({"cli.py": bad}, ["exit-contract"])
+    assert len(found) == 1
+    assert "cmd_boom" in found[0].message
+    assert "BoomError" in found[0].message
+
+    good = head + """
+    def cmd_boom(args):
+        try:
+            raise BoomError("x")
+        except KvTpuError as e:
+            return exit_code_for(e)
+
+    def build(sub):
+        p = sub.add_parser("boom")
+        p.set_defaults(fn=cmd_boom)
+    """
+    assert _lint({"cli.py": good}, ["exit-contract"]) == []
+
+
+# --------------------------------------------------------- pjit / xmap
+def test_pjit_call_form_is_a_jit_site():
+    found = _lint(
+        {
+            "a.py": """
+            from jax.experimental.pjit import pjit
+
+            def body(x):
+                return float(x)
+
+            f = pjit(body)
+            """
+        },
+        ["jit-host-sync"],
+    )
+    assert len(found) == 1
+    assert "float(" in found[0].message
+
+
+def test_xmap_wrapper_is_unwrapped():
+    found = _lint(
+        {
+            "a.py": """
+            import jax
+            from jax.experimental.maps import xmap
+
+            def body(x):
+                return x.item()
+
+            f = jax.jit(xmap(body))
+            """
+        },
+        ["jit-host-sync"],
+    )
+    assert len(found) == 1
+    assert ".item()" in found[0].message
+
+
+# --------------------------------------------------------------- SARIF
+def test_sarif_golden():
+    """The SARIF 2.1.0 shape is a wire contract with CI annotators —
+    golden-filed, regenerate with the snippet in the assertion message."""
+    result = run_lint(
+        {
+            "pkg/work.py": textwrap.dedent(
+                """
+                import jax
+
+                def pull(p):
+                    return int(p.item())
+
+                @jax.jit
+                def f(x):
+                    raise ValueError("bad")
+                    return pull(x)
+                """
+            )
+        },
+        rules=["jit-host-sync", "error-taxonomy"],
+    )
+    got = render_sarif(result)
+    doc = json.loads(got)
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "kv-tpu-lint"
+    rule_ids = [r["id"] for r in run["tool"]["driver"]["rules"]]
+    assert rule_ids == sorted(rule_ids)
+    for res in run["results"]:
+        assert rule_ids[res["ruleIndex"]] == res["ruleId"]
+        loc = res["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uriBaseId"] == "SRCROOT"
+        assert loc["region"]["startLine"] >= 1
+
+    golden = GOLDEN / "lint_sarif_golden.json"
+    want = golden.read_text()
+    assert got + "\n" == want, (
+        "SARIF output drifted from tests/golden/lint_sarif_golden.json — "
+        "if the change is intentional, regenerate the golden file by "
+        "running this test body and writing `got` to it"
+    )
+
+
+# ------------------------------------------------------------ --changed
+def test_changed_package_rels_shapes():
+    # against HEAD the diff is the working tree: a (possibly empty) sorted
+    # list of package-relative .py paths
+    rels = changed_package_rels(base_ref="HEAD")
+    assert rels is not None
+    assert rels == sorted(rels)
+    assert all(r.endswith(".py") and not r.startswith("..") for r in rels)
+    # an unknown base ref must return None (callers fall back to full runs)
+    assert changed_package_rels(base_ref="refs/no/such/ref") is None
+
+
+# -------------------------------------------------------------- metrics
+def test_callgraph_metric_families_registered():
+    from kubernetes_verification_tpu.observe import REGISTRY
+    from kubernetes_verification_tpu.observe.metrics import REQUIRED_FAMILIES
+
+    for fam in (
+        "kvtpu_lint_callgraph_nodes",
+        "kvtpu_lint_callgraph_edges",
+        "kvtpu_lint_cache_hits_total",
+    ):
+        assert fam in REQUIRED_FAMILIES
+        assert REGISTRY.get(fam) is not None
+
+
+def test_build_program_sets_callgraph_gauges():
+    from kubernetes_verification_tpu.observe.metrics import (
+        LINT_CALLGRAPH_EDGES,
+        LINT_CALLGRAPH_NODES,
+    )
+
+    _program(
+        {
+            "a.py": """
+            def f(x):
+                return g(x)
+
+            def g(x):
+                return x
+            """
+        }
+    )
+    assert LINT_CALLGRAPH_NODES.value >= 2
+    assert LINT_CALLGRAPH_EDGES.value >= 1
