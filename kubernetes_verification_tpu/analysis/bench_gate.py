@@ -8,10 +8,13 @@ slipped more than ``--tolerance`` (relative). Reads ``bench_history.jsonl``
 when present, else the committed ``BENCH_r*.json`` trajectory snapshots —
 so the gate runs out of the box on a fresh checkout. Throughput series are
 gated higher-is-better: names with an explicit direction
-(``closure_pairs_per_second``, the ``bench.py --mode closure`` headline)
-plus rate-shaped ones recognised structurally — a ``*_per_second`` metric
-name or a ``.../s`` unit (the ``queries_per_second`` series ``bench.py
---mode query`` emits rides the gate with no further configuration).
+(``closure_pairs_per_second`` and ``aggregate_queries_per_second``, the
+``bench.py --mode closure`` / ``--mode replicate`` headlines) plus
+rate-shaped ones recognised structurally — a ``*_per_second`` metric name
+or a ``.../s`` unit (the ``queries_per_second`` series ``bench.py --mode
+query`` emits rides the gate with no further configuration). Latency-like
+series gate lower-is-better, by unit or by explicit name
+(``replica_lag_seconds``).
 
 ``--dry-run`` exercises the full parse-and-compare path but always exits 0:
 tier-1 runs it on every PR so a malformed history entry (or a gate-logic

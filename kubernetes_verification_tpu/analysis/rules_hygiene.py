@@ -200,6 +200,72 @@ class AtomicWriteRule(Rule):
                 )
 
 
+@register
+class LeaseAtomicRule(Rule):
+    id = "lease-atomic"
+    rationale = (
+        "The leader lease is the failover protocol's ground truth: a torn "
+        "or unsynced `leader.lease` can elect two leaders (a reader sees "
+        "the old epoch while the new one is only in the page cache). "
+        "Stricter than `atomic-write`: any lease-scoped function that "
+        "opens a file for writing must BOTH promote via `os.replace`/"
+        "`os.rename` AND `os.fsync` before promoting — replace without "
+        "fsync survives a process crash but not a power cut, which is "
+        "precisely the window the `before-lease-renew` kill point fuzzes. "
+        "A function is lease-scoped when its name, its class's name, or "
+        "the opened path expression mentions `lease`."
+    )
+    example = (
+        'def write_lease(path, body):\n'
+        '    with open(path + ".tmp", "w") as fh:\n'
+        '        fh.write(body)\n'
+        '    os.replace(path + ".tmp", path)  # no fsync before promote'
+    )
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            scoped = "lease" in fn.name.lower() or any(
+                isinstance(a, ast.ClassDef) and "lease" in a.name.lower()
+                for a in ctx.ancestors(fn)
+            )
+            opens: List[Tuple[int, str]] = []
+            has_replace = False
+            has_fsync = False
+            for node in walk_own(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                mode = _open_write_mode(node)
+                if mode is not None:
+                    target = node.args[0] if node.args else None
+                    if scoped or (
+                        target is not None
+                        and "lease" in ast.dump(target).lower()
+                    ):
+                        opens.append((node.lineno, mode))
+                dotted = _dotted(node.func)
+                if dotted in ("os.replace", "os.rename"):
+                    has_replace = True
+                if dotted == "os.fsync":
+                    has_fsync = True
+            if not opens or (has_replace and has_fsync):
+                continue
+            missing = []
+            if not has_replace:
+                missing.append("os.replace")
+            if not has_fsync:
+                missing.append("os.fsync")
+            for line, mode in opens:
+                yield Finding(
+                    self.id, ctx.rel, line,
+                    f"lease write open(..., {mode!r}) without "
+                    f"{' + '.join(missing)} — leader leases must be "
+                    "promoted tmp + fsync + os.replace, or a reader can "
+                    "see a torn/unsynced epoch and elect two leaders",
+                )
+
+
 def _is_thread_class(node: ast.ClassDef) -> bool:
     return any(_last_name(b) == "Thread" for b in node.bases)
 

@@ -858,6 +858,8 @@ def _run_serve(args) -> int:
     )
     from .serve import EventSource, ServeConfig, load_assertions
 
+    if getattr(args, "follow", None):
+        return _run_follow(args)
     serve_config = ServeConfig(
         staleness_bound=args.staleness,
         batch_size=args.batch_size,
@@ -903,6 +905,7 @@ def _run_serve(args) -> int:
             if source is not None and args.events:
                 batch_iter = (
                     source.tail(
+                        poll_interval=args.tail_poll,
                         idle_timeout=args.idle_timeout,
                         batch_size=args.batch_size,
                     )
@@ -932,6 +935,7 @@ def _run_serve(args) -> int:
             if source is not None and args.events:
                 if args.tail:
                     for batch in source.tail(
+                        poll_interval=args.tail_poll,
                         idle_timeout=args.idle_timeout,
                         batch_size=args.batch_size,
                     ):
@@ -997,6 +1001,79 @@ def _run_serve(args) -> int:
     return EXIT_VIOLATIONS if svc.violations else EXIT_OK
 
 
+def _run_follow(args) -> int:
+    """Follower replica: bootstrap from the newest checkpoint generation
+    in ``--follow DIR``, tail the leader's WAL under the ``--staleness``
+    bound, and (with ``--promote-on-lease-expiry``) take over when the
+    lease expires and the leader-probe breaker opens."""
+    import time as _time
+
+    from .resilience.errors import EXIT_OK, EXIT_VIOLATIONS
+    from .serve import FollowerService, load_assertions
+
+    follower = FollowerService(
+        args.follow,
+        log_path=args.events,
+        replica=args.replica,
+        max_lag_seconds=args.staleness,
+        proxy_stale=args.proxy_stale,
+        lease_ttl=args.lease_ttl,
+        batch_size=args.batch_size,
+    )
+    svc = follower.service
+    if getattr(args, "assert_file", None):
+        svc.assertions.extend(load_assertions(args.assert_file))
+    # tail loop: the same capped exponential backoff EventSource.tail
+    # uses, with a leader heartbeat (and, opted in, a promotion check)
+    # between drains
+    interval = args.tail_poll
+    max_interval = max(args.tail_poll, min(1.0, args.tail_poll * 32))
+    idle_since = _time.monotonic()
+    while True:
+        applied = follower.poll()
+        follower.heartbeat()
+        if args.promote_on_lease_expiry and follower.maybe_promote():
+            break
+        now = _time.monotonic()
+        if applied:
+            interval = args.tail_poll
+            idle_since = now
+            continue
+        if now - idle_since >= args.idle_timeout:
+            break
+        _time.sleep(min(interval, args.idle_timeout))
+        interval = min(interval * 2, max_interval)
+    # the final answer rides the same staleness gate as any client read:
+    # over-bound exits 2 with the measured lag (or proxies under
+    # --proxy-stale)
+    follower._guard()
+    reach = svc.reach(trigger="query" if not svc.assertions else "assertions")
+    pairs = int(reach.sum())
+    out = {
+        **follower.describe(),
+        "pods": svc.n_pods,
+        "policies": len(svc.engine.policies),
+        "reachable_pairs": pairs,
+        "assertions": len(svc.assertions),
+        "violations": [v.describe() for v in svc.violations],
+        **svc.stats.to_dict(),
+    }
+    if args.json:
+        print(json.dumps(out, sort_keys=True))
+    else:
+        print(
+            f"replica {out['replica']} ({out['outcome']} bootstrap): "
+            f"{out['pods']} pods after {out['applied']} applied events "
+            f"(last_seq {out['last_seq']}, lag {out['lag_seq']} records): "
+            f"{pairs} reachable pairs"
+        )
+        if follower.promoted:
+            print(f"  PROMOTED to leader at epoch {follower.epoch}")
+        for v in svc.violations:
+            print(f"  VIOLATION: {v.describe()}")
+    return EXIT_VIOLATIONS if svc.violations else EXIT_OK
+
+
 def cmd_recover(args) -> int:
     from .resilience.errors import KvTpuError
 
@@ -1052,6 +1129,18 @@ def _run_recover(args) -> int:
                     f"wal {wal['path']}: {wal['records']} records "
                     f"({wal['sequenced']} sequenced, "
                     f"last_seq={wal['last_seq']}){tail}"
+                )
+        lease = report.get("lease")
+        if lease:
+            if "error" in lease:
+                print(f"lease {lease['path']}: ERROR {lease['error']}")
+            else:
+                state = "EXPIRED" if lease["expired"] else "live"
+                print(
+                    f"lease {lease['path']}: epoch {lease['epoch']} held "
+                    f"by {lease['holder']} ({state}, "
+                    f"age {lease['age_seconds']:.1f}s / "
+                    f"ttl {lease['ttl']:.1f}s)"
                 )
     if report["generations"] and not report["usable"]:
         return EXIT_INPUT_ERROR
@@ -1488,7 +1577,42 @@ def main(argv: Optional[list] = None) -> int:
     )
     p.add_argument(
         "--idle-timeout", type=float, default=1.0, metavar="SECONDS",
-        help="with --tail: stop after this long with no stream growth",
+        help="with --tail / --follow: stop after this long with no "
+        "stream growth",
+    )
+    p.add_argument(
+        "--tail-poll", type=float, default=0.05, metavar="SECONDS",
+        help="base WAL poll interval while tailing; backs off "
+        "exponentially (up to ~32x, capped at 1s) while the stream is "
+        "idle and snaps back on growth",
+    )
+    p.add_argument(
+        "--follow", metavar="DIR",
+        help="run as a read-only follower replica of the leader whose "
+        "checkpoints live in DIR: bootstrap from the newest valid "
+        "generation, tail its WAL (--events overrides the manifest's "
+        "log path), answer queries under the --staleness bound",
+    )
+    p.add_argument(
+        "--replica", default="follower", metavar="NAME",
+        help="with --follow: this replica's name (lag gauges, lease "
+        "holder on promotion)",
+    )
+    p.add_argument(
+        "--proxy-stale", action="store_true",
+        help="with --follow: answer over-bound reads with leader-fresh "
+        "state instead of raising StaleReadError",
+    )
+    p.add_argument(
+        "--promote-on-lease-expiry", action="store_true",
+        help="with --follow: promote to leader when the leader.lease "
+        "expires AND the leader-probe breaker opens (fencing the old "
+        "leader via the lease epoch)",
+    )
+    p.add_argument(
+        "--lease-ttl", type=float, default=5.0, metavar="SECONDS",
+        help="with --follow: lease time-to-live used when judging "
+        "leader liveness and when renewing after a promotion",
     )
     p.add_argument(
         "--assert", dest="assert_file", metavar="FILE",

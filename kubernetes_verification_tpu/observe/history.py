@@ -16,8 +16,11 @@ Regression direction comes from the unit: throughput units are
 higher-is-better, latency units lower-is-better, anything unrecognised is
 reported but never gated (a delta-percent series has no universal "worse"
 direction). A few metric NAMES carry an explicit direction regardless of
-unit string (``closure_pairs_per_second`` — the ``bench.py --mode
-closure`` throughput series). Rate-shaped series are recognised
+unit string (``closure_pairs_per_second`` and
+``aggregate_queries_per_second`` gate higher-is-better — the ``bench.py
+--mode closure`` / ``--mode replicate`` throughput series;
+``replica_lag_seconds`` gates lower-is-better). Rate-shaped series are
+recognised
 structurally as a fallback — a ``*_per_second`` metric name or a
 ``.../s`` unit gates higher-is-better (so the ``queries_per_second``
 series from BENCH rounds is gated even where its unit string predates the
@@ -57,9 +60,14 @@ _HIGHER_IS_BETTER = frozenset(
 _LOWER_IS_BETTER = frozenset({"s", "ms", "us", "seconds", "bytes"})
 
 #: metric name -> explicit direction, consulted before the unit sets; the
-#: closure throughput series must gate higher-is-better even if a future
-#: emitter changes its unit string
-_HIGHER_IS_BETTER_METRICS = frozenset({"closure_pairs_per_second"})
+#: closure and replicate throughput series must gate higher-is-better even
+#: if a future emitter changes its unit string
+_HIGHER_IS_BETTER_METRICS = frozenset(
+    {"closure_pairs_per_second", "aggregate_queries_per_second"}
+)
+#: and the replica-lag series gates lower-is-better by NAME — a follower
+#: falling further behind the leader is a regression whatever the unit
+_LOWER_IS_BETTER_METRICS = frozenset({"replica_lag_seconds"})
 
 
 def append_run(record: dict, path: str = DEFAULT_HISTORY) -> dict:
@@ -138,6 +146,8 @@ def default_paths(root: str = ".") -> List[str]:
 def _direction(unit: Optional[str], metric: Optional[str] = None) -> str:
     if metric in _HIGHER_IS_BETTER_METRICS:
         return "higher"
+    if metric in _LOWER_IS_BETTER_METRICS:
+        return "lower"
     if unit in _HIGHER_IS_BETTER:
         return "higher"
     if unit in _LOWER_IS_BETTER:

@@ -55,6 +55,10 @@ __all__ = [
     "RECOVERIES_TOTAL",
     "WAL_TRUNCATIONS_TOTAL",
     "BREAKER_TRANSITIONS_TOTAL",
+    "REPLICA_LAG_SECONDS",
+    "REPLICA_LAG_SEQ",
+    "PROMOTIONS_TOTAL",
+    "STALE_READS_TOTAL",
     "LINT_FINDINGS_TOTAL",
     "REQUIRED_FAMILIES",
 ]
@@ -351,6 +355,38 @@ BREAKER_TRANSITIONS_TOTAL = Counter(
     ("backend", "to"),
 )
 
+REPLICA_LAG_SECONDS = Gauge(
+    "kvtpu_replica_lag_seconds",
+    "Seconds since this follower last caught up to the leader's WAL tip, "
+    "per replica — 0 while fully caught up; the measured half of every "
+    "staleness-bounded read.",
+    ("replica",),
+)
+
+REPLICA_LAG_SEQ = Gauge(
+    "kvtpu_replica_lag_seq",
+    "WAL records the leader has committed that this follower has not yet "
+    "applied, per replica — the sequence-space twin of "
+    "kvtpu_replica_lag_seconds.",
+    ("replica",),
+)
+
+PROMOTIONS_TOTAL = Counter(
+    "kvtpu_promotions_total",
+    "Follower-to-leader promotions: the lease expired, the leader-probe "
+    "breaker opened, and this replica won the epoch claim — each one bumps "
+    "the fencing epoch stamped into every subsequent WAL record.",
+    ("replica",),
+)
+
+STALE_READS_TOTAL = Counter(
+    "kvtpu_stale_reads_total",
+    "Follower reads that arrived past their staleness bound, by outcome: "
+    "'rejected' (typed StaleReadError returned to the caller) or 'proxied' "
+    "(answered with leader-fresh state under --proxy-stale).",
+    ("outcome",),
+)
+
 LINT_FINDINGS_TOTAL = Counter(
     "kvtpu_lint_findings_total",
     "Non-grandfathered findings reported by `kv-tpu lint` runs in this "
@@ -434,6 +470,11 @@ REQUIRED_FAMILIES = frozenset(
         "kvtpu_recoveries_total",
         "kvtpu_wal_truncations_total",
         "kvtpu_breaker_transitions_total",
+        # replicated serving (serve/replication.py)
+        "kvtpu_replica_lag_seconds",
+        "kvtpu_replica_lag_seq",
+        "kvtpu_promotions_total",
+        "kvtpu_stale_reads_total",
         # static analysis (analysis/)
         "kvtpu_lint_findings_total",
         # interprocedural engine (analysis/callgraph.py + summaries.py)

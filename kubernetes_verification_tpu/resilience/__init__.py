@@ -32,10 +32,12 @@ from .errors import (  # noqa: F401  (re-exported)
     ConfigError,
     DeviceLost,
     EncodeError,
+    FencedError,
     IngestError,
     KvTpuError,
     PersistError,
     ServeError,
+    StaleReadError,
     UnknownBackendError,
     classify_exception,
     exit_code_for,
@@ -48,6 +50,8 @@ __all__ = [
     "EncodeError",
     "ConfigError",
     "ServeError",
+    "StaleReadError",
+    "FencedError",
     "BackendError",
     "BackendOOM",
     "BackendTimeout",

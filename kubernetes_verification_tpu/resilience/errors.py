@@ -35,6 +35,8 @@ __all__ = [
     "EncodeError",
     "ConfigError",
     "ServeError",
+    "StaleReadError",
+    "FencedError",
     "BackendError",
     "BackendOOM",
     "BackendTimeout",
@@ -99,6 +101,51 @@ class ServeError(KvTpuError, ValueError):
     ) -> None:
         super().__init__(message)
         self.event_index = event_index
+
+
+class StaleReadError(ServeError):
+    """A follower read exceeded its staleness bound: the replica's applied
+    state lags the leader's WAL by more than ``max_lag_seconds`` /
+    ``max_lag_seq``, and the caller asked for a bounded read rather than a
+    possibly-stale verdict. Carries the *measured* lag alongside the bound
+    that was violated, so callers can retry, widen the bound, or route to
+    the leader. Exit-code contract: input error (2), like every
+    :class:`ServeError` — the replica is healthy, the bound is just unmet.
+    """
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        lag_seconds: Optional[float] = None,
+        lag_seq: Optional[int] = None,
+        bound_seconds: Optional[float] = None,
+        bound_seq: Optional[int] = None,
+    ) -> None:
+        super().__init__(message)
+        self.lag_seconds = lag_seconds
+        self.lag_seq = lag_seq
+        self.bound_seconds = bound_seconds
+        self.bound_seq = bound_seq
+
+
+class FencedError(ServeError):
+    """A writer holding a superseded epoch tried to append to the WAL (or
+    renew the lease) after a follower promoted past it. ``epoch`` is the
+    writer's stale reign, ``lease_epoch`` the current one in
+    ``leader.lease``. The only correct reaction is to stop writing — the
+    cluster has moved on."""
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        epoch: Optional[int] = None,
+        lease_epoch: Optional[int] = None,
+    ) -> None:
+        super().__init__(message)
+        self.epoch = epoch
+        self.lease_epoch = lease_epoch
 
 
 class BackendError(KvTpuError, RuntimeError):

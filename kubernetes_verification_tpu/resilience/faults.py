@@ -28,7 +28,9 @@ Every injection increments ``kvtpu_faults_injected_total{backend,kind}``.
 
 Crash kill-points: the spec grammar also accepts the named points in the
 durability write path (``after-tmp-write``, ``before-rename``,
-``mid-log-append``, ``after-manifest``). These are not backend faults —
+``mid-log-append``, ``after-manifest``) and the replication control plane
+(``before-lease-renew``, ``after-promote-epoch``). These are not backend
+faults —
 :func:`install_kill_points` arms them process-wide and the durability code
 calls :func:`kill_point` at each site; a firing point hard-kills the
 process with ``os._exit`` (no cleanup, no atexit — the closest userspace
@@ -66,13 +68,17 @@ __all__ = [
     "kill_point",
 ]
 
-#: named crash points in the durability write path (serve/durability.py
-#: and the WAL append path) — process-killing, not backend faults
+#: named crash points in the durability write path (serve/durability.py,
+#: the WAL append path) and the replication control plane
+#: (serve/replication.py lease renewal / promotion) — process-killing,
+#: not backend faults
 KILL_POINTS = (
     "after-tmp-write",
     "before-rename",
     "mid-log-append",
     "after-manifest",
+    "before-lease-renew",
+    "after-promote-epoch",
 )
 
 FAULT_KINDS = ("oom", "timeout", "device_loss", "flaky") + KILL_POINTS

@@ -19,14 +19,21 @@ incremental engines were built for — BASELINE config 5):
   (atomic snapshot + manifest generations) and :class:`RecoveryManager`
   (ladder recovery + WAL replay with duplicate-application skipping),
   over the sequenced WAL layer in ``events`` (:class:`WalWriter` /
-  :func:`scan_wal`).
+  :func:`scan_wal`);
+* ``replication`` — leader/follower read scaling over the same WAL +
+  checkpoint substrate: :class:`FollowerService` (checkpoint bootstrap,
+  exactly-once WAL tailing, staleness-bounded reads) and
+  :class:`LeaseFile` (the atomic heartbeat whose monotonic epoch fences
+  a deposed leader after a breaker-gated promotion).
 
-CLI: ``kv-tpu serve`` / ``kv-tpu query`` (``--batch FILE.jsonl`` for the
-vectorized path) / ``kv-tpu recover``; benchmarks: ``bench.py --mode
-serve`` and ``--mode query``; metric families: ``kvtpu_serve_*``,
-``kvtpu_query_cache_*``, ``kvtpu_query_batch_size``,
-``kvtpu_checkpoints_total``, ``kvtpu_recoveries_total``,
-``kvtpu_wal_truncations_total``.
+CLI: ``kv-tpu serve`` (``--follow DIR`` for a replica) / ``kv-tpu query``
+(``--batch FILE.jsonl`` for the vectorized path) / ``kv-tpu recover``;
+benchmarks: ``bench.py --mode serve`` / ``--mode query`` / ``--mode
+replicate``; metric families: ``kvtpu_serve_*``, ``kvtpu_query_cache_*``,
+``kvtpu_query_batch_size``, ``kvtpu_checkpoints_total``,
+``kvtpu_recoveries_total``, ``kvtpu_wal_truncations_total``,
+``kvtpu_replica_lag_seconds``/``_seq``, ``kvtpu_promotions_total``,
+``kvtpu_stale_reads_total``.
 """
 from .durability import (
     CheckpointInfo,
@@ -53,6 +60,13 @@ from .events import (
     read_events,
     scan_wal,
     write_events,
+)
+from .replication import (
+    FollowerService,
+    Lease,
+    LeaseFile,
+    ReplicaLag,
+    lease_path,
 )
 from .queries import (
     Assertion,
@@ -92,6 +106,11 @@ __all__ = [
     "ServeConfig",
     "ServeStats",
     "VerificationService",
+    "FollowerService",
+    "Lease",
+    "LeaseFile",
+    "ReplicaLag",
+    "lease_path",
     "QueryCache",
     "QueryEngine",
     "PodSelector",

@@ -377,12 +377,20 @@ class RecoveryManager:
                     "records": wal.records,
                     "sequenced": wal.sequenced,
                     "last_seq": wal.last_seq,
+                    "last_epoch": wal.last_epoch,
                     "valid_bytes": wal.valid_bytes,
                     "torn": wal.torn,
                     "torn_bytes": wal.truncated_bytes,
                 }
             except Exception as e:  # noqa: BLE001 — report, don't die
                 report["wal"] = {"path": log_path, "error": str(e)}
+        # replication status: lease/epoch triage rides the same report
+        # (import deferred — replication imports this module at top level)
+        from .replication import LeaseFile, lease_path
+
+        lp = lease_path(self.directory)
+        if os.path.exists(lp):
+            report["lease"] = LeaseFile(lp).describe()
         return report
 
     def recover(

@@ -32,6 +32,15 @@ sequence from the existing log, and hosts the ``mid-log-append`` kill
 point for the crash-fault harness. Unsequenced (legacy) logs keep working
 everywhere: records without ``seq``/``crc`` decode as before and simply
 don't participate in duplicate-application skipping.
+
+Replication adds a third framing field: ``epoch``, the writer's monotonic
+reign counter from ``leader.lease`` (serve/replication.py). The epoch is
+covered by the record crc, so a fenced stray writer cannot forge a newer
+reign; :func:`scan_wal` rejects epoch *regressions* mid-log (a lower epoch
+after a higher one is a stale leader that kept writing past its fencing),
+and :class:`EventSource` can drop sub-``min_epoch`` records on the read
+side (counted in ``fenced``) as defence in depth. Records without an
+``epoch`` stay valid — pre-replication logs keep replaying.
 """
 from __future__ import annotations
 
@@ -66,6 +75,7 @@ __all__ = [
     "encode_event",
     "decode_event",
     "decode_record",
+    "decode_wal",
     "write_events",
     "read_events",
     "EventSource",
@@ -75,9 +85,10 @@ __all__ = [
     "scan_wal",
 ]
 
-#: reserved record keys for WAL framing; no event body uses either
+#: reserved record keys for WAL framing; no event body uses any of them
 WAL_SEQ_KEY = "seq"
 WAL_CRC_KEY = "crc"
+WAL_EPOCH_KEY = "epoch"
 
 
 @dataclass(frozen=True)
@@ -208,10 +219,15 @@ def _wal_crc(canonical: str) -> str:
     return format(zlib.crc32(canonical.encode("utf-8")) & 0xFFFFFFFF, "08x")
 
 
-def encode_event(ev: Event, seq: Optional[int] = None) -> str:
+def encode_event(
+    ev: Event, seq: Optional[int] = None, epoch: Optional[int] = None
+) -> str:
     """One JSON line (no trailing newline) for one event. With ``seq`` the
     record is WAL-framed: it carries the sequence number plus a crc over
-    the canonical body, so a torn or bit-rotted tail is detectable."""
+    the canonical body, so a torn or bit-rotted tail is detectable.
+    ``epoch`` (only meaningful on framed records) stamps the writer's
+    lease reign and is covered by the crc — a fenced writer cannot be
+    edited into a newer one."""
     if isinstance(ev, (AddPolicy, UpdatePolicy)):
         body = {"policy": network_policy_to_dict(ev.policy)}
     elif isinstance(ev, RemovePolicy):
@@ -233,6 +249,8 @@ def encode_event(ev: Event, seq: Optional[int] = None) -> str:
     if seq is None:
         return json.dumps(obj, sort_keys=True)
     obj[WAL_SEQ_KEY] = int(seq)
+    if epoch is not None:
+        obj[WAL_EPOCH_KEY] = int(epoch)
     obj[WAL_CRC_KEY] = _wal_crc(json.dumps(obj, sort_keys=True))
     return json.dumps(obj, sort_keys=True)
 
@@ -248,7 +266,20 @@ def decode_record(
 ) -> Tuple[Event, Optional[int]]:
     """Parse one JSONL line into ``(event, seq)``; ``seq`` is None on
     unsequenced (legacy) records. A present ``crc`` is verified against
-    the canonical body and a mismatch raises :class:`IngestError`."""
+    the canonical body and a mismatch raises :class:`IngestError`. The
+    epoch-aware callers (scan/tail/replication) use :func:`decode_wal`."""
+    ev, seq, _ = decode_wal(line, where=where)
+    return ev, seq
+
+
+def decode_wal(
+    line: str, *, where: str = "<event>"
+) -> Tuple[Event, Optional[int], Optional[int]]:
+    """Parse one JSONL line into ``(event, seq, epoch)``; ``seq`` and
+    ``epoch`` are None on records written without WAL framing / before
+    replication. A present ``crc`` is verified against the canonical body
+    (seq *and* epoch re-inserted) and a mismatch raises
+    :class:`IngestError`."""
     try:
         obj = json.loads(line)
     except json.JSONDecodeError as e:
@@ -256,13 +287,18 @@ def decode_record(
     if not isinstance(obj, dict) or "event" not in obj:
         raise IngestError(f"{where}: event line lacks an 'event' tag")
     seq = obj.pop(WAL_SEQ_KEY, None)
+    epoch = obj.pop(WAL_EPOCH_KEY, None)
     crc = obj.pop(WAL_CRC_KEY, None)
     if seq is not None and not isinstance(seq, int):
         raise IngestError(f"{where}: WAL seq {seq!r} is not an integer")
+    if epoch is not None and not isinstance(epoch, int):
+        raise IngestError(f"{where}: WAL epoch {epoch!r} is not an integer")
     if crc is not None:
         body = dict(obj)
         if seq is not None:
             body[WAL_SEQ_KEY] = seq
+        if epoch is not None:
+            body[WAL_EPOCH_KEY] = epoch
         want = _wal_crc(json.dumps(body, sort_keys=True))
         if crc != want:
             raise IngestError(
@@ -278,24 +314,26 @@ def decode_record(
         )
     try:
         if cls in (AddPolicy, UpdatePolicy):
-            return cls(policy=parse_network_policy(obj["policy"])), seq
+            return cls(policy=parse_network_policy(obj["policy"])), seq, epoch
         if cls is RemovePolicy:
             return RemovePolicy(
                 namespace=obj["namespace"], name=obj["name"]
-            ), seq
+            ), seq, epoch
         if cls is UpdatePodLabels:
             return UpdatePodLabels(
                 namespace=obj["namespace"], pod=obj["pod"],
                 labels=dict(obj.get("labels") or {}),
-            ), seq
+            ), seq, epoch
         if cls is UpdateNamespaceLabels:
             return UpdateNamespaceLabels(
                 namespace=obj["namespace"],
                 labels=dict(obj.get("labels") or {}),
-            ), seq
+            ), seq, epoch
         if cls is RemoveNamespace:
-            return RemoveNamespace(namespace=obj["namespace"]), seq
-        return FullResync(cluster=_cluster_from_dict(obj["cluster"])), seq
+            return RemoveNamespace(namespace=obj["namespace"]), seq, epoch
+        return (
+            FullResync(cluster=_cluster_from_dict(obj["cluster"])), seq, epoch
+        )
     except IngestError:
         raise
     except (KeyError, TypeError, ValueError) as e:
@@ -352,7 +390,10 @@ class EventSource:
     (sequenced) streams, ``start_after_seq`` skips records whose ``seq``
     is already applied — the zero-duplicate-application half of recovery —
     counting them in ``skipped``; ``last_seq`` tracks the highest applied
-    sequence number (-1 until one is seen).
+    sequence number (-1 until one is seen). ``min_epoch`` is read-side
+    fencing: records stamped with a lower lease epoch (a superseded leader
+    that kept writing) are dropped and counted in ``fenced`` instead of
+    applied; ``last_epoch`` tracks the highest epoch seen.
     """
 
     def __init__(
@@ -361,6 +402,7 @@ class EventSource:
         offset: int = 0,
         *,
         start_after_seq: Optional[int] = None,
+        min_epoch: Optional[int] = None,
         strict: bool = False,
     ) -> None:
         self.path = path
@@ -368,7 +410,10 @@ class EventSource:
         self.lineno = 0
         self.strict = strict
         self.last_seq = -1 if start_after_seq is None else int(start_after_seq)
+        self.min_epoch = min_epoch
+        self.last_epoch: Optional[int] = None
         self.skipped = 0
+        self.fenced = 0
 
     def _drain(self) -> List[Event]:
         with open(self.path, "rb") as fh:
@@ -385,7 +430,7 @@ class EventSource:
                 self.lineno += 1
                 continue
             try:
-                ev, seq = decode_record(
+                ev, seq, epoch = decode_wal(
                     line, where=f"{self.path}:{self.lineno + 1}"
                 )
             except IngestError:
@@ -397,6 +442,12 @@ class EventSource:
                 raise
             self.offset += len(raw)
             self.lineno += 1
+            if epoch is not None:
+                if self.min_epoch is not None and epoch < self.min_epoch:
+                    self.fenced += 1
+                    continue
+                if self.last_epoch is None or epoch > self.last_epoch:
+                    self.last_epoch = epoch
             if seq is not None:
                 if seq <= self.last_seq:
                     self.skipped += 1
@@ -424,12 +475,28 @@ class EventSource:
         idle_timeout: Optional[float] = 1.0,
         batch_size: int = 256,
         sleep: Callable[[float], None] = time.sleep,
+        max_poll_interval: Optional[float] = None,
     ) -> Iterator[List[Event]]:
         """Yield batches of newly appended events until the stream goes
-        quiet for ``idle_timeout`` seconds (None = tail forever)."""
+        quiet for ``idle_timeout`` seconds (None = tail forever).
+
+        The poll interval backs off exponentially while the stream is idle
+        — each empty drain doubles the sleep up to ``max_poll_interval``
+        (default ``32 × poll_interval``, capped at 1s and never below
+        ``poll_interval``) — and snaps back to ``poll_interval`` the
+        moment a drain yields events, so a quiet cluster stops burning CPU
+        without slowing catch-up on a busy one. ``idle_timeout`` (when
+        set) also caps a single sleep, so the timeout is still honoured
+        promptly."""
+        if max_poll_interval is None:
+            max_poll_interval = max(poll_interval, min(1.0, poll_interval * 32))
+        max_poll_interval = max(max_poll_interval, poll_interval)
+        interval = poll_interval
         last_growth = time.monotonic()
         while True:
             got = self._drain() if os.path.exists(self.path) else []
+            if got:
+                interval = poll_interval
             while got:
                 yield got[:batch_size]
                 got = got[batch_size:]
@@ -439,7 +506,11 @@ class EventSource:
                 and time.monotonic() - last_growth >= idle_timeout
             ):
                 return
-            sleep(poll_interval)
+            delay = interval
+            if idle_timeout is not None:
+                delay = min(delay, idle_timeout)
+            sleep(delay)
+            interval = min(interval * 2, max_poll_interval)
 
 
 # ------------------------------------------------------------------- WAL
@@ -460,6 +531,9 @@ class WalInfo:
     truncated_bytes: int = 0
     #: True when the scan found a torn tail (regardless of repair)
     torn: bool = False
+    #: highest lease epoch stamped in the valid prefix (None = no record
+    #: carried one — a pre-replication log)
+    last_epoch: Optional[int] = None
 
 
 def scan_wal(
@@ -473,7 +547,8 @@ def scan_wal(
     ``repair`` is set (counted on ``kvtpu_wal_truncations_total``) or left
     on disk when not; ``strict`` raises :class:`ServeError` instead. An
     invalid record *followed by* a valid one is not a tear but corruption
-    (or interleaved writers) and always raises.
+    (or interleaved writers) and always raises, as does a sequence or
+    lease-epoch regression anywhere in the valid prefix.
     """
     from ..observe import log_event
     from ..observe.metrics import WAL_TRUNCATIONS_TOTAL
@@ -499,7 +574,7 @@ def scan_wal(
             info.valid_bytes = offset
             continue
         try:
-            _, seq = decode_record(line, where=f"{path}:{lineno}")
+            _, seq, epoch = decode_wal(line, where=f"{path}:{lineno}")
         except IngestError as e:
             bad_at, bad_why = offset, str(e)
             break
@@ -512,6 +587,14 @@ def scan_wal(
                 )
             info.last_seq = seq
             info.sequenced += 1
+        if epoch is not None:
+            if info.last_epoch is not None and epoch < info.last_epoch:
+                raise ServeError(
+                    f"{path}:{lineno}: WAL epoch regressed ({epoch} after "
+                    f"{info.last_epoch}) — a fenced leader kept writing "
+                    "past its lease; the log needs manual triage"
+                )
+            info.last_epoch = epoch
         info.records += 1
         offset += len(raw)
         info.valid_bytes = offset
@@ -561,24 +644,69 @@ class WalWriter:
     ever written to one path has a unique, monotonically increasing
     ``seq``. ``fsync`` (default) makes each :meth:`append` durable before
     returning — the write-ahead half of the checkpoint protocol.
+
+    Replication fencing: a leader passes its lease ``epoch`` (stamped into
+    every record, under the crc) and the :class:`~.replication.LeaseFile`
+    itself via ``lease``; each :meth:`append` first re-reads the lease and
+    raises :class:`~..resilience.errors.FencedError` when a newer epoch
+    holds it — a deposed leader stops writing instead of corrupting the
+    log a promoted follower now owns. Opening also refuses a log whose
+    records already carry a *newer* epoch than ours.
     """
 
     def __init__(
-        self, path: str, *, fsync: bool = True, strict: bool = False
+        self,
+        path: str,
+        *,
+        fsync: bool = True,
+        strict: bool = False,
+        epoch: Optional[int] = None,
+        lease=None,
     ) -> None:
+        from ..resilience.errors import FencedError
+
         self.path = path
         self.fsync = fsync
+        self.epoch = epoch
+        self.lease = lease
         info = scan_wal(path, strict=strict)
+        if (
+            epoch is not None
+            and info.last_epoch is not None
+            and info.last_epoch > epoch
+        ):
+            raise FencedError(
+                f"{path}: log already carries epoch {info.last_epoch}, "
+                f"newer than this writer's {epoch} — a follower promoted "
+                "past us",
+                epoch=epoch, lease_epoch=info.last_epoch,
+            )
         self.next_seq = info.last_seq + 1
         self._fh = open(path, "a")  # kvtpu: ignore[atomic-write] WAL append handle: torn tails are repaired by scan_wal on the next open
+
+    def _check_fence(self) -> None:
+        """Raise :class:`FencedError` when the lease moved past our epoch."""
+        from ..resilience.errors import FencedError
+
+        if self.lease is None or self.epoch is None:
+            return
+        cur = self.lease.read()
+        if cur is not None and cur.epoch > self.epoch:
+            raise FencedError(
+                f"{self.path}: lease epoch {cur.epoch} (held by "
+                f"{cur.holder!r}) supersedes this writer's {self.epoch} — "
+                "append refused",
+                epoch=self.epoch, lease_epoch=cur.epoch,
+            )
 
     def append(self, events: Sequence[Event]) -> int:
         """Append ``events`` as WAL-framed records; returns the last
         sequence number written (``next_seq - 1`` when empty)."""
         from ..resilience.faults import kill_point
 
+        self._check_fence()
         for ev in events:
-            line = encode_event(ev, seq=self.next_seq) + "\n"
+            line = encode_event(ev, seq=self.next_seq, epoch=self.epoch) + "\n"
             half = max(1, len(line) // 2)
             self._fh.write(line[:half])
             # crash-fault hook: fires (if armed) with only the first half

@@ -1,0 +1,567 @@
+"""Replicated serving: follower bootstrap, WAL tailing, lease failover.
+
+The single-process :class:`~.service.VerificationService` is the
+availability bottleneck of the serving story — one SIGKILL takes the
+query plane down until the recovery ladder finishes. This module fans the
+*read* path out while keeping exactly one write path, the same shape the
+TPU papers use for read-mostly replicated state (PAPERS.md):
+
+* the **leader** owns the directory: it appends WAL records
+  (:class:`~.events.WalWriter`), commits checkpoint generations
+  (:class:`~.durability.CheckpointManager`) and renews ``leader.lease``;
+* each **follower** (:class:`FollowerService`) bootstraps from the newest
+  valid ``gen-N/`` checkpoint via the PR 5 recovery ladder — a torn WAL
+  tail or corrupt generation degrades down the ladder instead of
+  crashing — then tails the leader's WAL with
+  ``EventSource.start_after_seq`` exactly-once resume, applying batches
+  to its *own* engine and answering queries from its own
+  generation-keyed :class:`~.queries.QueryEngine`. It never writes.
+
+**Staleness bounds.** Every follower read is bounded: ``max_lag_seconds``
+/ ``max_lag_seq`` (CLI ``--staleness``) cap how far behind the leader's
+WAL tip an answer may be. An over-bound read either raises a typed
+:class:`~..resilience.errors.StaleReadError` carrying the measured lag
+(outcome ``rejected`` on ``kvtpu_stale_reads_total``) or — under
+``--proxy-stale`` — transparently answers with leader-fresh state
+(outcome ``proxied``): through an injected leader-side query engine when
+one is wired, else by forcing a full catch-up to the WAL tip, which on
+the shared-filesystem substrate *is* the leader's committed state.
+
+**Failover.** The lease file is a heartbeat: the leader re-writes
+``leader.lease`` (atomically, tmp + fsync + ``os.replace``) every
+``ttl/2`` or so; each record carries a monotonic ``epoch`` — the reign
+counter. A follower promotes only when BOTH hold: the lease has expired
+*and* its leader-probe circuit breaker has opened (several consecutive
+failed probes — one missed renewal is jitter, not death). Promotion is
+arbitrated by an ``O_CREAT|O_EXCL`` claim file per target epoch, so
+exactly one follower wins; the winner bumps the lease epoch and stamps
+it into every WAL record it subsequently writes. The deposed leader is
+*fenced* twice: write-side (its :class:`WalWriter` re-reads the lease
+per append and raises :class:`~..resilience.errors.FencedError` on a
+newer epoch) and read-side (``scan_wal`` rejects epoch regressions;
+followers drop sub-``min_epoch`` records). Kill-points
+``before-lease-renew`` and ``after-promote-epoch`` let the fault
+harness SIGKILL either side of the handover.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from ..observe import log_event
+from ..observe.metrics import (
+    PROMOTIONS_TOTAL,
+    REPLICA_LAG_SECONDS,
+    REPLICA_LAG_SEQ,
+    STALE_READS_TOTAL,
+)
+from ..resilience.breaker import OPEN, CircuitBreaker
+from ..resilience.errors import (
+    FencedError,
+    PersistError,
+    ServeError,
+    StaleReadError,
+)
+from ..resilience.faults import kill_point
+from .durability import RecoveryManager, _fsync_dir
+from .events import EventSource, WalWriter
+from .queries import QueryEngine
+
+__all__ = [
+    "LEASE_FILENAME",
+    "Lease",
+    "LeaseFile",
+    "ReplicaLag",
+    "FollowerService",
+    "lease_path",
+]
+
+#: the lease lives next to the checkpoint generations it governs
+LEASE_FILENAME = "leader.lease"
+
+
+def lease_path(directory: str) -> str:
+    """Canonical ``leader.lease`` path for a serving directory."""
+    return os.path.join(directory, LEASE_FILENAME)
+
+
+@dataclass(frozen=True)
+class Lease:
+    """One parsed ``leader.lease``: who reigns, since when, for how long.
+
+    ``renewed_at`` is wall-clock (``time.time``) because leader and
+    followers are different processes — monotonic clocks don't compare
+    across them."""
+
+    epoch: int
+    holder: str
+    renewed_at: float
+    ttl: float
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        if now is None:
+            now = time.time()
+        return now - self.renewed_at >= self.ttl
+
+    def to_dict(self) -> dict:
+        return {
+            "epoch": int(self.epoch),
+            "holder": self.holder,
+            "renewed_at": float(self.renewed_at),
+            "ttl": float(self.ttl),
+        }
+
+
+class LeaseFile:
+    """The atomic heartbeat file behind the failover protocol.
+
+    Writes go tmp + fsync + ``os.replace`` (the same discipline every
+    durable artifact in ``serve/`` uses), so a reader sees either the old
+    lease or the new one, never a prefix — and :meth:`renew` refuses to
+    move the epoch backwards (:class:`FencedError`): a deposed leader's
+    heartbeat cannot overwrite its successor's reign. ``clock`` is
+    injectable (wall-clock semantics) so tests expire leases without
+    sleeping.
+    """
+
+    def __init__(
+        self, path: str, *, clock: Callable[[], float] = time.time
+    ) -> None:
+        if os.path.isdir(path):
+            path = lease_path(path)
+        self.path = path
+        self._clock = clock
+
+    def read(self) -> Optional[Lease]:
+        """The current lease, or None when none was ever written. A
+        damaged lease file raises :class:`PersistError` — it is written
+        atomically, so damage is bit rot, not a torn write."""
+        try:
+            with open(self.path) as fh:
+                obj = json.load(fh)
+            return Lease(
+                epoch=int(obj["epoch"]),
+                holder=str(obj["holder"]),
+                renewed_at=float(obj["renewed_at"]),
+                ttl=float(obj["ttl"]),
+            )
+        except FileNotFoundError:
+            return None
+        except (OSError, ValueError, TypeError, KeyError) as e:
+            raise PersistError(
+                f"{self.path}: unreadable leader lease: {e}", path=self.path
+            ) from e
+
+    def renew(self, holder: str, epoch: int, ttl: float) -> Lease:
+        """Atomically (re-)write the lease for ``holder`` at ``epoch``.
+
+        Fencing lives here too: renewing below the on-disk epoch raises
+        :class:`FencedError` — the one thing a deposed leader's heartbeat
+        loop must never do is clobber its successor's lease."""
+        kill_point("before-lease-renew")
+        cur = self.read()
+        if cur is not None and cur.epoch > epoch:
+            raise FencedError(
+                f"{self.path}: lease epoch {cur.epoch} (held by "
+                f"{cur.holder!r}) supersedes {epoch} — renewal refused",
+                epoch=epoch, lease_epoch=cur.epoch,
+            )
+        lease = Lease(
+            epoch=int(epoch), holder=holder,
+            renewed_at=float(self._clock()), ttl=float(ttl),
+        )
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(lease.to_dict(), fh, sort_keys=True)
+            fh.write("\n")
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, self.path)
+        _fsync_dir(os.path.dirname(self.path) or ".")
+        return lease
+
+    def acquire(self, holder: str, ttl: float) -> Lease:
+        """Take the lease for a *new* reign: epoch = on-disk epoch + 1
+        (1 for a fresh directory). The leader calls this once at startup;
+        promotion goes through :meth:`FollowerService.promote`."""
+        cur = self.read()
+        return self.renew(holder, (cur.epoch if cur else 0) + 1, ttl)
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        """True when the lease is missing or past its ttl (both mean "no
+        live leader" to a follower)."""
+        cur = self.read()
+        if cur is None:
+            return True
+        return cur.expired(self._clock() if now is None else now)
+
+    def describe(self) -> dict:
+        """Machine-readable lease status for ``kv-tpu recover --json``."""
+        try:
+            cur = self.read()
+        except PersistError as e:
+            return {"path": self.path, "present": True, "error": str(e)}
+        if cur is None:
+            return {"path": self.path, "present": False}
+        now = self._clock()
+        return {
+            "path": self.path,
+            "present": True,
+            "epoch": cur.epoch,
+            "holder": cur.holder,
+            "ttl": cur.ttl,
+            "renewed_at": cur.renewed_at,
+            "age_seconds": max(0.0, now - cur.renewed_at),
+            "expired": cur.expired(now),
+        }
+
+
+@dataclass(frozen=True)
+class ReplicaLag:
+    """One lag measurement: how far this follower trails the WAL tip."""
+
+    #: seconds since this follower was last fully caught up (0.0 = at tip)
+    seconds: float
+    #: complete WAL records appended past our replay position
+    seq: int
+
+    @property
+    def caught_up(self) -> bool:
+        return self.seq == 0
+
+
+class FollowerService:
+    """A read-only replica: checkpoint bootstrap + WAL tail + bounded reads.
+
+    Bootstraps through :class:`~.durability.RecoveryManager` (so every
+    corruption mode a crashed leader can leave behind walks the recovery
+    ladder instead of crashing the follower), then owns a positioned
+    :class:`~.events.EventSource` whose ``start_after_seq`` resume
+    guarantees zero duplicate applications. Queries go through the
+    follower's own generation-keyed :class:`~.queries.QueryEngine`; the
+    underlying service is marked ``read_only`` so nothing on this side
+    can ever produce a durable artifact.
+
+    ``auto_catch_up`` (default True) drains the WAL before every guarded
+    read; tests and the bench turn it off to control lag explicitly.
+    ``clock`` must be wall-clock compatible with the leader's lease clock
+    (both default to ``time.time``); tests inject fakes to run the whole
+    failover protocol in microseconds.
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        *,
+        log_path: Optional[str] = None,
+        replica: str = "follower-0",
+        serve_config=None,
+        config=None,
+        device=None,
+        initial_cluster=None,
+        max_lag_seconds: Optional[float] = None,
+        max_lag_seq: Optional[int] = None,
+        proxy_stale: bool = False,
+        leader_proxy: Optional[QueryEngine] = None,
+        auto_catch_up: bool = True,
+        lease_ttl: float = 5.0,
+        breaker_threshold: int = 3,
+        breaker_cooldown: float = 30.0,
+        batch_size: int = 256,
+        clock: Callable[[], float] = time.time,
+    ) -> None:
+        self.directory = directory
+        self.replica = replica
+        self.max_lag_seconds = max_lag_seconds
+        self.max_lag_seq = max_lag_seq
+        self.proxy_stale = proxy_stale
+        self.leader_proxy = leader_proxy
+        self.auto_catch_up = auto_catch_up
+        self.lease_ttl = lease_ttl
+        self.batch_size = batch_size
+        self._clock = clock
+        self.lease = LeaseFile(lease_path(directory), clock=clock)
+        self.promoted = False
+        self.epoch: Optional[int] = None
+        #: the fenced WalWriter a successful :meth:`promote` leaves behind
+        self.writer: Optional[WalWriter] = None
+        self.applied = 0
+
+        recovery = RecoveryManager(directory).recover(
+            log_path=log_path,
+            initial_cluster=initial_cluster,
+            config=config,
+            serve_config=serve_config,
+            device=device,
+            batch_size=batch_size,
+        )
+        self.recovery = recovery
+        self.service = recovery.service
+        self.service.read_only = True
+        self.applied += recovery.replayed
+        if recovery.source is not None:
+            self.source = recovery.source
+            self.log_path = recovery.source.path
+        else:
+            if log_path is None:
+                raise ServeError(
+                    f"{directory}: recovered checkpoint names no event log "
+                    "and no log_path= was given — a follower without a WAL "
+                    "to tail can never catch up"
+                )
+            self.log_path = log_path
+            self.source = EventSource(
+                log_path, start_after_seq=recovery.last_seq
+            )
+        #: leader-probe breaker: consecutive expired-lease observations
+        #: must exceed the threshold before failover even becomes
+        #: *possible* — one missed renewal is scheduler jitter, not death
+        self.probe = CircuitBreaker(
+            f"leader-probe:{replica}",
+            failure_threshold=breaker_threshold,
+            cooldown=breaker_cooldown,
+            clock=clock,
+        )
+        self._caught_up_at = self._clock()
+        self.query = QueryEngine(self.service)
+        self._set_lag_gauges(self.lag())
+        log_event(
+            "follower_bootstrap", replica=replica, directory=directory,
+            outcome=recovery.outcome, generation=recovery.generation,
+            replayed=recovery.replayed, last_seq=recovery.last_seq,
+        )
+
+    # ------------------------------------------------------------ replication
+    def _pending_records(self) -> int:
+        """Complete WAL records appended past our replay position — the
+        sequence-space lag, measured without decoding."""
+        try:
+            size = os.path.getsize(self.log_path)
+        except OSError:
+            return 0
+        if size <= self.source.offset:
+            return 0
+        with open(self.log_path, "rb") as fh:
+            fh.seek(self.source.offset)
+            chunk = fh.read()
+        return chunk.count(b"\n")
+
+    def lag(self) -> ReplicaLag:
+        """Measure (don't repair) how far we trail the leader's tip."""
+        pending = self._pending_records()
+        now = self._clock()
+        if pending == 0:
+            self._caught_up_at = now
+            return ReplicaLag(seconds=0.0, seq=0)
+        return ReplicaLag(
+            seconds=max(0.0, now - self._caught_up_at), seq=pending
+        )
+
+    def _set_lag_gauges(self, lag: ReplicaLag) -> None:
+        REPLICA_LAG_SECONDS.labels(replica=self.replica).set(lag.seconds)
+        REPLICA_LAG_SEQ.labels(replica=self.replica).set(float(lag.seq))
+
+    def poll(self) -> int:
+        """Drain whatever the WAL has and apply it; returns events applied.
+        One call is one replication step — the follower's heartbeat."""
+        applied = 0
+        for batch in self.source.batches(self.batch_size):
+            self.service.apply(batch)
+            applied += len(batch)
+        self.applied += applied
+        self._set_lag_gauges(self.lag())
+        return applied
+
+    def catch_up(self) -> int:
+        """Drain to the current WAL tip (poll until nothing is pending)."""
+        applied = self.poll()
+        while self._pending_records() > 0:
+            applied += self.poll()
+        return applied
+
+    # ----------------------------------------------------------- bounded reads
+    def _guard(self) -> QueryEngine:
+        """The staleness gate every read goes through: catch up (unless
+        ``auto_catch_up`` is off), measure lag, and either answer from our
+        own engine, proxy to leader-fresh state, or raise
+        :class:`StaleReadError` with the measurement."""
+        if self.auto_catch_up:
+            self.catch_up()
+        lag = self.lag()
+        self._set_lag_gauges(lag)
+        over = (
+            self.max_lag_seconds is not None
+            and lag.seconds > self.max_lag_seconds
+        ) or (self.max_lag_seq is not None and lag.seq > self.max_lag_seq)
+        if not over:
+            return self.query
+        if self.proxy_stale:
+            STALE_READS_TOTAL.labels(outcome="proxied").inc()
+            if self.leader_proxy is not None:
+                return self.leader_proxy
+            # shared-filesystem substrate: the WAL tip *is* the leader's
+            # committed state — forcing a full catch-up is the proxy
+            self.catch_up()
+            return self.query
+        STALE_READS_TOTAL.labels(outcome="rejected").inc()
+        raise StaleReadError(
+            f"replica {self.replica!r} is {lag.seconds:.3f}s / {lag.seq} "
+            f"records behind the leader (bounds: "
+            f"{self.max_lag_seconds}s / {self.max_lag_seq} records)",
+            lag_seconds=lag.seconds, lag_seq=lag.seq,
+            bound_seconds=self.max_lag_seconds, bound_seq=self.max_lag_seq,
+        )
+
+    def can_reach(self, *args, **kwargs):
+        return self._guard().can_reach(*args, **kwargs)
+
+    def can_reach_batch(self, *args, **kwargs):
+        return self._guard().can_reach_batch(*args, **kwargs)
+
+    def who_can_reach(self, *args, **kwargs):
+        return self._guard().who_can_reach(*args, **kwargs)
+
+    def who_can_reach_batch(self, *args, **kwargs):
+        return self._guard().who_can_reach_batch(*args, **kwargs)
+
+    def blast_radius(self, *args, **kwargs):
+        return self._guard().blast_radius(*args, **kwargs)
+
+    def blast_radius_batch(self, *args, **kwargs):
+        return self._guard().blast_radius_batch(*args, **kwargs)
+
+    def path_exists(self, *args, **kwargs):
+        return self._guard().path_exists(*args, **kwargs)
+
+    def hops(self, *args, **kwargs):
+        return self._guard().hops(*args, **kwargs)
+
+    def what_if(self, *args, **kwargs):
+        return self._guard().what_if(*args, **kwargs)
+
+    # --------------------------------------------------------------- failover
+    def heartbeat(self) -> bool:
+        """One leader-liveness probe: feed the breaker, raise our fencing
+        floor to the observed epoch, and return True when the leader
+        looked alive."""
+        try:
+            cur = self.lease.read()
+        except PersistError:
+            cur = None
+        now = self._clock()
+        alive = cur is not None and not cur.expired(now)
+        if cur is not None:
+            # every record of the current reign carries epoch >= this, so
+            # raising the floor only drops a *deposed* writer's strays
+            if self.source.min_epoch is None or cur.epoch > self.source.min_epoch:
+                self.source.min_epoch = cur.epoch
+        if alive:
+            self.probe.record_success()
+        else:
+            self.probe.record_failure()
+        return alive
+
+    def maybe_promote(self) -> bool:
+        """Breaker-gated failover step: promote only when the lease has
+        expired AND the leader-probe breaker is open (enough consecutive
+        failed heartbeats). Returns True when *this* replica won."""
+        if self.promoted:
+            return True
+        if not self.lease.expired():
+            return False
+        if self.probe.state != OPEN:
+            return False
+        return self.promote() is not None
+
+    def _claim(self, target_epoch: int) -> bool:
+        """Exactly-one-winner arbitration: an ``O_CREAT|O_EXCL`` claim
+        file per target epoch. A stale claim (older than the lease ttl
+        with the epoch still unbumped — its creator died mid-promotion)
+        is swept so the reign isn't deadlocked."""
+        claim = os.path.join(
+            self.directory, f"promote-{target_epoch:08d}.claim"
+        )
+        for attempt in (0, 1):
+            try:
+                fd = os.open(claim, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            except FileExistsError:
+                if attempt:
+                    return False
+                try:
+                    age = time.time() - os.path.getmtime(claim)
+                except OSError:
+                    return False
+                cur = self.lease.read()
+                stale = age > self.lease_ttl and (
+                    cur is None or cur.epoch < target_epoch
+                )
+                if not stale:
+                    return False
+                try:
+                    os.remove(claim)
+                except OSError:
+                    return False
+                continue
+            # the claim file IS the atomic primitive — O_EXCL creation
+            # decides the race; the content is advisory
+            with os.fdopen(fd, "w") as fh:
+                fh.write(f"{self.replica}\n")
+            return True
+        return False
+
+    def promote(self) -> Optional[WalWriter]:
+        """Take over as leader: catch up to the tip, win the epoch claim,
+        bump the lease, and return a fenced :class:`WalWriter` stamping
+        the new epoch (None = another follower won the claim).
+
+        Callers that only need read-side promotion can drop the writer —
+        holding the lease is what fences the old leader."""
+        self.catch_up()
+        cur = self.lease.read()
+        prior = cur.epoch if cur is not None else (self.source.last_epoch or 0)
+        target_epoch = prior + 1
+        if not self._claim(target_epoch):
+            log_event(
+                "promotion_lost", replica=self.replica, epoch=target_epoch
+            )
+            return None
+        self.lease.renew(self.replica, target_epoch, self.lease_ttl)
+        kill_point("after-promote-epoch")
+        self.promoted = True
+        self.epoch = target_epoch
+        self.source.min_epoch = target_epoch
+        self.service.read_only = False
+        PROMOTIONS_TOTAL.labels(replica=self.replica).inc()
+        log_event(
+            "promotion", replica=self.replica, epoch=target_epoch,
+            applied=self.applied, last_seq=self.source.last_seq,
+        )
+        self.writer = WalWriter(
+            self.log_path, epoch=target_epoch, lease=self.lease
+        )
+        return self.writer
+
+    # ------------------------------------------------------------------ misc
+    @property
+    def generation(self) -> int:
+        return self.service.generation
+
+    def describe(self) -> dict:
+        """One status dict (CLI summaries, tests)."""
+        lag = self.lag()
+        return {
+            "replica": self.replica,
+            "directory": self.directory,
+            "log_path": self.log_path,
+            "applied": self.applied,
+            "last_seq": self.source.last_seq,
+            "lag_seconds": lag.seconds,
+            "lag_seq": lag.seq,
+            "promoted": self.promoted,
+            "epoch": self.epoch,
+            "breaker": self.probe.state,
+            "outcome": self.recovery.outcome,
+        }

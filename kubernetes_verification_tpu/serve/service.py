@@ -154,6 +154,7 @@ class VerificationService:
         *,
         engine: Optional[IncrementalVerifier] = None,
         device=None,
+        read_only: bool = False,
     ) -> None:
         if (cluster is None) == (engine is None):
             raise ServeError(
@@ -166,6 +167,11 @@ class VerificationService:
         self._engine = engine
         self.config = engine.config
         self.serve_config = serve_config or ServeConfig()
+        #: follower mode (serve/replication.py): this replica applies the
+        #: leader's WAL but must never produce durable artifacts of its own
+        #: — snapshot() and the ingest worker refuse, keeping one write
+        #: path per directory
+        self.read_only = read_only
         self._pod_idx: Dict[Tuple[str, str], int] = {
             (p.namespace, p.name): i for i, p in enumerate(engine.pods)
         }
@@ -221,6 +227,11 @@ class VerificationService:
 
     def snapshot(self, directory: Optional[str] = None) -> str:
         """Checkpoint the warm engine state for crash-recovery restart."""
+        if self.read_only:
+            raise ServeError(
+                "read-only (follower) service cannot snapshot — the "
+                "leader owns every durable artifact in the directory"
+            )
         target = directory or self.serve_config.snapshot_dir
         if not target:
             raise ServeError(
@@ -430,6 +441,11 @@ class VerificationService:
     def start(self) -> None:
         """Spawn the single worker thread that owns engine writes."""
         with self._lock:
+            if self.read_only:
+                raise ServeError(
+                    "read-only (follower) service takes no submissions — "
+                    "events arrive only by tailing the leader's WAL"
+                )
             if self._worker is not None and self._worker.is_alive():
                 raise ServeError("service worker already running")
             self._stop.clear()

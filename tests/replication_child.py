@@ -1,0 +1,135 @@
+"""Subprocess body for the replication failover chaos test
+(tests/test_replication.py).
+
+Two roles:
+
+* ``leader`` (default) — the durable write path with a lease heartbeat:
+  acquires ``leader.lease`` at epoch 1, then appends WAL batches (epoch-
+  stamped), applies them, renews the lease every batch and checkpoints
+  periodically, with one armed kill-point from ``--kill``. The armed
+  point hard-kills the process with ``os._exit(137)`` mid-write — a
+  SIGKILLed leader whose followers must then notice the dead lease.
+* ``follower --promote`` — bootstraps a :class:`FollowerService` from the
+  leader's checkpoints and promotes as soon as the breaker gate allows,
+  with ``after-promote-epoch`` armable: the child dies AFTER bumping the
+  lease epoch but BEFORE writing anything at the new epoch, leaving the
+  half-promoted state the next follower must take over from.
+
+Deliberately never solves reach: the child's job is to die while writing,
+not to derive answers nobody will read.
+"""
+import argparse
+import os
+import sys
+import time
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--workdir", required=True)
+    ap.add_argument(
+        "--kill", default="",
+        help="fault spec armed via install_kill_points, e.g. "
+        "'before-lease-renew@5' (empty = run to completion)",
+    )
+    ap.add_argument("--role", choices=("leader", "follower"), default="leader")
+    ap.add_argument("--promote", action="store_true")
+    ap.add_argument("--seed", type=int, default=3)
+    ap.add_argument("--n-events", type=int, default=500)
+    ap.add_argument("--pods", type=int, default=64)
+    ap.add_argument("--batch", type=int, default=25)
+    ap.add_argument("--checkpoint-every", type=int, default=3)
+    ap.add_argument("--lease-ttl", type=float, default=0.3)
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+    import kubernetes_verification_tpu as kv
+    from kubernetes_verification_tpu.harness.generate import (
+        GeneratorConfig,
+        random_cluster,
+        random_event_stream,
+    )
+    from kubernetes_verification_tpu.resilience.faults import (
+        install_kill_points,
+        parse_fault_spec,
+    )
+    from kubernetes_verification_tpu.serve import (
+        CheckpointManager,
+        EventSource,
+        FollowerService,
+        LeaseFile,
+        VerificationService,
+        WalWriter,
+    )
+
+    # MUST mirror the parent test's generator knobs exactly: the parent
+    # rebuilds this cluster for the from-scratch oracle
+    cluster = random_cluster(
+        GeneratorConfig(
+            n_pods=args.pods, n_policies=24, n_namespaces=6, seed=7,
+            p_ipblock_peer=0.0, min_selector_labels=1,
+        )
+    )
+    cfg = kv.VerifyConfig(backend="cpu", compute_ports=False)
+    log = os.path.join(args.workdir, "events.jsonl")
+    ck = os.path.join(args.workdir, "ck")
+
+    if args.role == "follower":
+        if args.kill:
+            install_kill_points(parse_fault_spec(args.kill), seed=args.seed)
+        f = FollowerService(
+            ck, log_path=log, replica="child-follower",
+            initial_cluster=cluster, config=cfg,
+            lease_ttl=args.lease_ttl, breaker_threshold=3,
+        )
+        if args.promote:
+            deadline = time.time() + 30.0
+            while time.time() < deadline:
+                f.poll()
+                f.heartbeat()
+                if f.maybe_promote():
+                    print(f"promoted epoch={f.epoch}")
+                    return 0
+                time.sleep(args.lease_ttl / 4)
+            print("never promoted", file=sys.stderr)
+            return 1
+        return 0
+
+    events = random_event_stream(
+        cluster, n_events=args.n_events, seed=args.seed
+    )
+    if args.kill:
+        install_kill_points(parse_fault_spec(args.kill), seed=args.seed)
+
+    svc = VerificationService(cluster, cfg)
+    cm = CheckpointManager(ck, retain=3)
+    os.makedirs(ck, exist_ok=True)
+    lease = LeaseFile(ck)
+    lease.acquire("leader-0", ttl=args.lease_ttl)  # epoch 1
+    writer = WalWriter(log, epoch=1, lease=lease)
+    source = EventSource(log)
+    batches_since = 0
+    for i in range(0, len(events), args.batch):
+        lease.renew("leader-0", 1, args.lease_ttl)
+        writer.append(events[i:i + args.batch])
+        for batch in source.batches(args.batch):
+            svc.apply(batch)
+        batches_since += 1
+        if batches_since >= args.checkpoint_every:
+            cm.checkpoint(
+                svc.engine, log_path=log,
+                log_offset=source.offset, last_seq=source.last_seq,
+            )
+            batches_since = 0
+    cm.checkpoint(
+        svc.engine, log_path=log,
+        log_offset=source.offset, last_seq=source.last_seq,
+    )
+    writer.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -128,6 +128,60 @@ def test_atomic_write_does_not_double_count_nested_defs():
     assert len(bad) == 1
 
 
+def test_lease_atomic_positive_and_negative():
+    # replace without fsync: crash-safe but not power-cut-safe — flagged
+    bad = _lint(
+        """
+        import os
+
+        def write_lease(path, body):
+            with open(path + ".tmp", "w") as fh:
+                fh.write(body)
+            os.replace(path + ".tmp", path)
+        """,
+        ["lease-atomic"],
+    )
+    assert [f.rule for f in bad] == ["lease-atomic"]
+    assert "os.fsync" in bad[0].message
+    # scoping by the opened path expression, not just the function name
+    bad = _lint(
+        """
+        def refresh(lease_path, body):
+            with open(lease_path, "w") as fh:
+                fh.write(body)
+        """,
+        ["lease-atomic"],
+    )
+    assert [f.rule for f in bad] == ["lease-atomic"]
+    assert "os.replace" in bad[0].message and "os.fsync" in bad[0].message
+    ok = _lint(
+        """
+        import os
+
+        class LeaseFile:
+            def renew(self, path, body):
+                tmp = path + ".tmp"
+                with open(tmp, "w") as fh:
+                    fh.write(body)
+                    fh.flush()
+                    os.fsync(fh.fileno())
+                os.replace(tmp, path)
+        """,
+        ["lease-atomic"],
+    )
+    assert ok == []
+    # non-lease writes are atomic-write's business, not this rule's
+    ok = _lint(
+        """
+        def save(path, body):
+            with open(path, "w") as fh:
+                fh.write(body)
+        """,
+        ["lease-atomic"],
+    )
+    assert ok == []
+
+
 def test_concurrency_hygiene_thread_daemon():
     bad = _lint(
         """

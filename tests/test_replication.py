@@ -1,0 +1,605 @@
+"""Replicated serving: the epoch-stamped WAL codec, the atomic lease
+heartbeat and its fencing, follower bootstrap + exactly-once tailing with
+staleness-bounded reads, breaker-gated promotion with exactly-one-winner
+claim arbitration, the ``serve --follow`` / ``recover`` CLI surface, the
+bench-gate direction entries, and the SIGKILL failover chaos run (leader
+killed at every named kill-point with two followers attached)."""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import kubernetes_verification_tpu as kv
+from kubernetes_verification_tpu.cli import main
+from kubernetes_verification_tpu.harness.generate import (
+    GeneratorConfig,
+    random_cluster,
+    random_event_stream,
+)
+from kubernetes_verification_tpu.observe import REGISTRY
+from kubernetes_verification_tpu.observe.history import _direction
+from kubernetes_verification_tpu.observe.metrics import REQUIRED_FAMILIES
+from kubernetes_verification_tpu.resilience import (
+    EXIT_OK,
+    FencedError,
+    PersistError,
+    ServeError,
+    StaleReadError,
+)
+from kubernetes_verification_tpu.resilience.breaker import CLOSED, OPEN
+from kubernetes_verification_tpu.resilience.errors import exit_code_for
+from kubernetes_verification_tpu.resilience.faults import (
+    KILL_POINTS,
+    clear_kill_points,
+)
+from kubernetes_verification_tpu.serve import (
+    CheckpointManager,
+    EventSource,
+    FollowerService,
+    LeaseFile,
+    UpdatePodLabels,
+    VerificationService,
+    WalWriter,
+    decode_record,
+    encode_event,
+    lease_path,
+    scan_wal,
+)
+from kubernetes_verification_tpu.serve.events import decode_wal
+
+CHILD = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "replication_child.py"
+)
+
+
+def _counter(name, key):
+    return REGISTRY.dump()["counters"].get(name, {}).get(key, 0.0)
+
+
+class Clock:
+    """Injectable wall clock. Starts at the REAL time.time() — Lease
+    timestamps are wall-clock, so a fake below real time never expires
+    anything written with the real clock."""
+
+    def __init__(self):
+        self.t = time.time()
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+@pytest.fixture(scope="module")
+def churn():
+    cluster = random_cluster(
+        GeneratorConfig(
+            n_pods=24, n_policies=10, n_namespaces=3, seed=7,
+            p_ipblock_peer=0.0, min_selector_labels=1,
+        )
+    )
+    events = random_event_stream(cluster, n_events=120, seed=3)
+    cfg = kv.VerifyConfig(backend="cpu", compute_ports=False)
+    return cluster, events, cfg
+
+
+def _reach(svc):
+    return np.asarray(svc.reach())
+
+
+def _leader_dir(tmp_path, churn, *, ttl=60.0, ck_at=60, clock=time.time):
+    """Write a leader's on-disk footprint: epoch-1 WAL, one mid-stream
+    checkpoint, and a renewed lease. Returns (log, ckdir, leader svc)."""
+    cluster, events, cfg = churn
+    log = str(tmp_path / "events.jsonl")
+    ckdir = str(tmp_path / "ck")
+    os.makedirs(ckdir, exist_ok=True)
+    lease = LeaseFile(ckdir, clock=clock)
+    lease.acquire("leader-0", ttl=ttl)
+    svc = VerificationService(cluster, cfg)
+    cm = CheckpointManager(ckdir)
+    writer = WalWriter(log, epoch=1, lease=lease)
+    src = EventSource(log)
+    writer.append(events[:ck_at])
+    for b in src.batches(64):
+        svc.apply(b)
+    cm.checkpoint(
+        svc.engine, log_path=log, log_offset=src.offset, last_seq=src.last_seq
+    )
+    writer.append(events[ck_at:])
+    for b in src.batches(64):
+        svc.apply(b)
+    writer.close()
+    lease.renew("leader-0", 1, ttl)
+    return log, ckdir, svc
+
+
+def _relabel(svc, k):
+    """An idempotent-safe churn event: flip one label on an existing pod."""
+    pods = svc.engine.pods
+    p = pods[k % len(pods)]
+    labels = dict(p.labels)
+    labels["churn"] = str(k)
+    return UpdatePodLabels(namespace=p.namespace, pod=p.name, labels=labels)
+
+
+# -------------------------------------------------------------- epoch codec
+def test_epoch_codec_round_trips_inside_crc(churn):
+    _, events, _ = churn
+    line = encode_event(events[0], seq=5, epoch=3)
+    obj = json.loads(line)
+    assert obj["seq"] == 5 and obj["epoch"] == 3 and "crc" in obj
+    ev, seq, epoch = decode_wal(line)
+    assert (seq, epoch) == (5, 3)
+    assert encode_event(ev) == encode_event(events[0])
+    # decode_record stays the 2-tuple compat wrapper
+    assert decode_record(line)[1] == 5
+    # the epoch is INSIDE the checksum: tampering it must not decode
+    tampered = line.replace('"epoch": 3', '"epoch": 9')
+    with pytest.raises(Exception, match="checksum"):
+        decode_wal(tampered)
+    # legacy (frameless) records still decode, with no seq and no epoch
+    legacy = encode_event(events[0])
+    assert decode_wal(legacy) == (events[0], None, None)
+    # epoch is only stamped on sequenced records
+    assert "epoch" not in json.loads(encode_event(events[0], epoch=3))
+
+
+def test_scan_wal_tracks_epoch_and_rejects_regression(tmp_path, churn):
+    _, events, _ = churn
+    log = str(tmp_path / "wal.jsonl")
+    with open(log, "w") as fh:
+        for i, epoch in enumerate((1, 1, 2)):
+            fh.write(encode_event(events[i], seq=i, epoch=epoch) + "\n")
+    info = scan_wal(log)
+    assert info.last_epoch == 2 and info.records == 3
+    with open(log, "a") as fh:  # a fenced leader kept writing
+        fh.write(encode_event(events[3], seq=3, epoch=1) + "\n")
+    with pytest.raises(ServeError, match="epoch regressed"):
+        scan_wal(log)
+
+
+def test_event_source_min_epoch_drops_fenced_records(tmp_path, churn):
+    _, events, _ = churn
+    log = str(tmp_path / "wal.jsonl")
+    with open(log, "w") as fh:
+        for i, epoch in enumerate((1, 1, 2, 2)):
+            fh.write(encode_event(events[i], seq=i, epoch=epoch) + "\n")
+    src = EventSource(log, min_epoch=2)
+    got = list(src.replay())
+    assert got == events[2:4]
+    assert src.fenced == 2 and src.last_epoch == 2
+
+
+def test_wal_writer_refuses_log_with_newer_epoch(tmp_path, churn):
+    _, events, _ = churn
+    log = str(tmp_path / "wal.jsonl")
+    w = WalWriter(log, epoch=2)
+    w.append(events[:2])
+    w.close()
+    with pytest.raises(FencedError):
+        WalWriter(log, epoch=1)
+
+
+# ------------------------------------------------------------- tail backoff
+def test_tail_backoff_doubles_and_caps(tmp_path, churn):
+    _, events, _ = churn
+    log = str(tmp_path / "wal.jsonl")
+    WalWriter(log).append(events[:3])
+    sleeps = []
+    src = EventSource(log)
+    batches = list(
+        src.tail(
+            poll_interval=0.01, max_poll_interval=0.05,
+            idle_timeout=0.25, batch_size=64, sleep=sleeps.append,
+        )
+    )
+    assert sum(len(b) for b in batches) == 3
+    # idle polls back off exponentially from the base interval to the cap
+    assert sleeps[:4] == [0.01, 0.02, 0.04, 0.05]
+    assert all(s <= 0.05 for s in sleeps)
+
+
+# -------------------------------------------------------------------- lease
+def test_lease_acquire_renew_fence_and_describe(tmp_path):
+    clock = Clock()
+    lf = LeaseFile(str(tmp_path), clock=clock)
+    assert lf.read() is None and lf.expired()
+    lease = lf.acquire("a", ttl=5.0)
+    assert lease.epoch == 1 and not lf.expired()
+    assert lf.acquire("b", ttl=5.0).epoch == 2  # monotonic reigns
+    with pytest.raises(FencedError):  # a deposed holder cannot renew
+        lf.renew("a", 1, 5.0)
+    clock.advance(6.0)
+    assert lf.expired()
+    d = lf.describe()
+    assert d["present"] and d["epoch"] == 2 and d["holder"] == "b"
+    assert d["expired"] and d["age_seconds"] >= 6.0
+    # atomic promotion: no tmp file survives a completed renew
+    assert not os.path.exists(lease_path(str(tmp_path)) + ".tmp")
+    with open(lease_path(str(tmp_path)), "w") as fh:
+        fh.write("{torn")
+    with pytest.raises(PersistError):
+        lf.read()
+
+
+# ---------------------------------------------------------------- bootstrap
+def test_follower_bootstraps_bit_for_bit_and_never_writes(tmp_path, churn):
+    log, ckdir, leader = _leader_dir(tmp_path, churn)
+    f = FollowerService(ckdir, log_path=log, replica="r1")
+    assert f.recovery.outcome == "newest"
+    assert f.recovery.duplicates_skipped == 0
+    f.catch_up()
+    assert f.lag().caught_up
+    np.testing.assert_array_equal(_reach(f.service), _reach(leader))
+    # read-only: the follower side can never produce durable artifacts
+    assert f.service.read_only
+    with pytest.raises(ServeError, match="read-only"):
+        f.service.snapshot(str(tmp_path / "snap"))
+    with pytest.raises(ServeError, match="read-only"):
+        f.service.start()
+
+
+def test_follower_queries_answer_through_guard(tmp_path, churn):
+    log, ckdir, leader = _leader_dir(tmp_path, churn)
+    f = FollowerService(ckdir, log_path=log, replica="r1")
+    pods = leader.engine.pods
+    a = f"{pods[0].namespace}/{pods[0].name}"
+    b = f"{pods[1].namespace}/{pods[1].name}"
+    want = bool(_reach(leader)[0, 1])
+    assert f.can_reach(a, b) == want
+    assert list(f.can_reach_batch([(a, b)])) == [want]
+
+
+# ------------------------------------------------------------- stale reads
+def test_stale_read_rejected_with_measured_lag(tmp_path, churn):
+    log, ckdir, leader = _leader_dir(tmp_path, churn)
+    f = FollowerService(
+        ckdir, log_path=log, replica="r1",
+        max_lag_seq=0, auto_catch_up=False,
+    )
+    f.catch_up()
+    w = WalWriter(log, epoch=1)  # the leader keeps writing
+    w.append([_relabel(leader, k) for k in range(5)])
+    w.close()
+    before = _counter("kvtpu_stale_reads_total", "outcome=rejected")
+    pods = leader.engine.pods
+    a = f"{pods[0].namespace}/{pods[0].name}"
+    with pytest.raises(StaleReadError) as ei:
+        f.can_reach(a, a)
+    assert ei.value.lag_seq == 5 and ei.value.bound_seq == 0
+    assert exit_code_for(ei.value) == 2  # ServeError family → input error
+    assert (
+        _counter("kvtpu_stale_reads_total", "outcome=rejected") == before + 1
+    )
+
+
+def test_stale_read_proxies_when_enabled(tmp_path, churn):
+    log, ckdir, leader = _leader_dir(tmp_path, churn)
+    f = FollowerService(
+        ckdir, log_path=log, replica="r1",
+        max_lag_seq=0, auto_catch_up=False, proxy_stale=True,
+    )
+    f.catch_up()
+    w = WalWriter(log, epoch=1)
+    w.append([_relabel(leader, k) for k in range(5)])
+    w.close()
+    before = _counter("kvtpu_stale_reads_total", "outcome=proxied")
+    pods = leader.engine.pods
+    a = f"{pods[0].namespace}/{pods[0].name}"
+    assert f.can_reach(a, a) is not None  # answered, not raised
+    assert (
+        _counter("kvtpu_stale_reads_total", "outcome=proxied") == before + 1
+    )
+    assert f.lag().caught_up  # the proxy forced a full catch-up
+
+
+# ----------------------------------------------------------------- failover
+def test_promotion_is_breaker_gated(tmp_path, churn):
+    clock = Clock()
+    log, ckdir, leader = _leader_dir(
+        tmp_path, churn, ttl=5.0, clock=clock
+    )
+    f = FollowerService(
+        ckdir, log_path=log, replica="r2",
+        breaker_threshold=2, lease_ttl=5.0, clock=clock,
+    )
+    # live lease: no promotion, breaker stays closed
+    assert f.heartbeat() and f.probe.state == CLOSED
+    assert not f.maybe_promote()
+    # lease expires, but ONE missed heartbeat is jitter, not death
+    clock.advance(6.0)
+    assert not f.heartbeat()
+    assert not f.maybe_promote()
+    # the second consecutive failure opens the breaker → promotion
+    assert not f.heartbeat()
+    assert f.probe.state == OPEN
+    before = _counter("kvtpu_promotions_total", "replica=r2")
+    assert f.maybe_promote()
+    assert f.promoted and f.epoch == 2
+    assert _counter("kvtpu_promotions_total", "replica=r2") == before + 1
+    assert f.lease.read().holder == "r2"
+    # the promoted follower owns a fenced writer at the new epoch
+    f.writer.append([_relabel(leader, 0)])
+    assert scan_wal(log).last_epoch == 2
+    # ... and the deposed leader is fenced on BOTH paths
+    with pytest.raises(FencedError):
+        old = WalWriter(log[:-6] + "other.jsonl", epoch=1, lease=f.lease)
+        old.append([_relabel(leader, 1)])
+    with pytest.raises(FencedError):
+        f.lease.renew("leader-0", 1, 5.0)
+
+
+def test_claim_arbitration_exactly_one_winner(tmp_path, churn):
+    clock = Clock()
+    log, ckdir, _ = _leader_dir(tmp_path, churn, ttl=1.0, clock=clock)
+    fa = FollowerService(ckdir, log_path=log, replica="ra", clock=clock)
+    fb = FollowerService(ckdir, log_path=log, replica="rb", clock=clock)
+    wins = [fa._claim(2), fb._claim(2)]
+    assert sorted(wins) == [False, True]
+    assert os.path.exists(os.path.join(ckdir, "promote-00000002.claim"))
+
+
+def test_loser_does_not_promote_after_winner_renews(tmp_path, churn):
+    clock = Clock()
+    log, ckdir, _ = _leader_dir(tmp_path, churn, ttl=1.0, clock=clock)
+    fa = FollowerService(
+        ckdir, log_path=log, replica="ra",
+        breaker_threshold=2, lease_ttl=1.0, clock=clock,
+    )
+    fb = FollowerService(
+        ckdir, log_path=log, replica="rb",
+        breaker_threshold=2, lease_ttl=1.0, clock=clock,
+    )
+    clock.advance(2.0)
+    for _ in range(2):
+        fa.heartbeat()
+        fb.heartbeat()
+    promoted = [f.maybe_promote() for f in (fa, fb)]
+    assert promoted == [True, False]  # winner renewed → loser sees a live lease
+    assert fa.epoch == 2 and not fb.promoted
+
+
+# ---------------------------------------------------------------- CLI surface
+def test_cli_serve_follow_answers_and_reports(tmp_path, churn, capsys):
+    log, ckdir, leader = _leader_dir(tmp_path, churn)
+    rc = main([
+        "serve", "--follow", ckdir, "--events", log,
+        "--idle-timeout", "0.2", "--tail-poll", "0.01", "--json",
+    ])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == EXIT_OK
+    assert out["replica"] == "follower" and out["outcome"] == "newest"
+    assert out["lag_seq"] == 0 and not out["promoted"]
+    assert out["reachable_pairs"] == int(_reach(leader).sum())
+
+
+def test_cli_serve_follow_promotes_on_lease_expiry(tmp_path, churn, capsys):
+    log, ckdir, _ = _leader_dir(tmp_path, churn, ttl=0.2)
+    time.sleep(0.3)
+    rc = main([
+        "serve", "--follow", ckdir, "--events", log,
+        "--promote-on-lease-expiry", "--lease-ttl", "0.2",
+        "--idle-timeout", "10", "--tail-poll", "0.01", "--json",
+    ])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == EXIT_OK
+    assert out["promoted"] and out["epoch"] == 2
+
+
+def test_cli_recover_json_reports_lease_and_epoch(tmp_path, churn, capsys):
+    log, ckdir, _ = _leader_dir(tmp_path, churn)
+    rc = main(["recover", ckdir, "--events", log, "--json"])
+    report = json.loads(capsys.readouterr().out.strip())
+    assert rc == EXIT_OK
+    assert report["wal"]["last_epoch"] == 1
+    lease = report["lease"]
+    assert lease["present"] and lease["epoch"] == 1
+    assert lease["holder"] == "leader-0" and "age_seconds" in lease
+    # text mode prints the lease line too
+    rc = main(["recover", ckdir, "--events", log])
+    text = capsys.readouterr().out
+    assert rc == EXIT_OK and "lease" in text and "epoch 1" in text
+
+
+# ------------------------------------------------- observability / gating
+def test_new_metric_families_registered():
+    for fam in (
+        "kvtpu_replica_lag_seconds",
+        "kvtpu_replica_lag_seq",
+        "kvtpu_promotions_total",
+        "kvtpu_stale_reads_total",
+    ):
+        assert fam in REQUIRED_FAMILIES
+
+
+def test_bench_gate_directions():
+    assert _direction("queries/s", "aggregate_queries_per_second") == "higher"
+    assert _direction(None, "aggregate_queries_per_second") == "higher"
+    assert _direction("s", "replica_lag_seconds") == "lower"
+    assert _direction(None, "replica_lag_seconds") == "lower"
+
+
+def test_new_kill_points_registered():
+    assert "before-lease-renew" in KILL_POINTS
+    assert "after-promote-epoch" in KILL_POINTS
+
+
+def test_exit_contract_covers_follow_and_promotion_paths():
+    """The interprocedural exit-contract rule must see straight through
+    ``cmd_serve → _run_serve → _run_follow → FollowerService`` — the new
+    StaleReadError/FencedError raise sites are KvTpuError subclasses
+    caught by cmd_serve's handler, so the whole CLI stays finding-free."""
+    from kubernetes_verification_tpu.analysis.core import run_package
+
+    result = run_package(rules=["exit-contract"])
+    assert result.findings == []
+
+
+# ------------------------------------------------------------ failover chaos
+def _run_child(workdir, kill, *, role="leader", promote=False, seed=3,
+               n_events=60, pods=24, batch=10, checkpoint_every=2,
+               lease_ttl=0.3):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    cmd = [
+        sys.executable, CHILD, "--workdir", str(workdir),
+        "--kill", kill, "--role", role, "--seed", str(seed),
+        "--n-events", str(n_events), "--pods", str(pods),
+        "--batch", str(batch), "--checkpoint-every", str(checkpoint_every),
+        "--lease-ttl", str(lease_ttl),
+    ]
+    if promote:
+        cmd.append("--promote")
+    return subprocess.run(
+        cmd, env=env, capture_output=True, text=True, timeout=300,
+    )
+
+
+def _attach_two_followers(ckdir, log, cluster, cfg, ttl, *, first=0):
+    """The failover dance the chaos runs share: two followers attach to a
+    dead leader's directory, both watch the lease die and the breaker
+    open, and EXACTLY one wins the promotion claim."""
+    time.sleep(ttl + 0.2)  # let the (real-clock) lease expire
+    mk = lambda name: FollowerService(
+        ckdir, log_path=log, replica=name,
+        initial_cluster=cluster, config=cfg,
+        breaker_threshold=2, lease_ttl=ttl,
+    )
+    followers = [mk("ra"), mk("rb")]
+    for f in followers:
+        assert f.recovery.duplicates_skipped == 0
+    for _ in range(2):
+        for f in followers:
+            f.heartbeat()
+    order = followers if first == 0 else followers[::-1]
+    promoted = [f for f in order if f.maybe_promote()]
+    assert len(promoted) == 1, "exactly one follower must win the epoch"
+    return followers, promoted[0]
+
+
+def _assert_failover_invariants(workdir, cluster, cfg, winner, followers,
+                                prior_epoch=1):
+    """Post-promotion invariants shared by every chaos run: the old epoch
+    is fenced on the write path, and the promoted follower answers
+    bit-for-bit with a from-scratch verification of the surviving log
+    prefix (continued through the new epoch's writes)."""
+    log = os.path.join(str(workdir), "events.jsonl")
+    assert winner.epoch == prior_epoch + 1
+    # fenced: the dead leader's epoch can no longer append to ANY log
+    # governed by this lease
+    stray = os.path.join(str(workdir), "stray.jsonl")
+    with pytest.raises(FencedError):
+        WalWriter(stray, epoch=prior_epoch, lease=winner.lease).append(
+            [_relabel(winner.service, 99)]
+        )
+    # the new reign writes through the promoted writer...
+    winner.writer.append(
+        [_relabel(winner.service, k) for k in range(3)]
+    )
+    info = scan_wal(log)
+    assert info.last_epoch == winner.epoch and not info.torn
+    # ...and every replica converges on the same answer as a from-scratch
+    # verification of the surviving prefix (zero duplicate applications:
+    # exactly-once resume is what makes these equal)
+    oracle = VerificationService(cluster, cfg)
+    survived = 0
+    for b in EventSource(log).batches(256):
+        oracle.apply(b)
+        survived += len(b)
+    assert survived == info.records
+    for f in followers:
+        f.catch_up()
+        np.testing.assert_array_equal(_reach(f.service), _reach(oracle))
+
+
+def _chaos_cluster(pods):
+    cluster = random_cluster(
+        GeneratorConfig(
+            n_pods=pods, n_policies=24, n_namespaces=6, seed=7,
+            p_ipblock_peer=0.0, min_selector_labels=1,
+        )
+    )
+    return cluster, kv.VerifyConfig(backend="cpu", compute_ports=False)
+
+
+def test_failover_chaos_lease_renew_kill(tmp_path):
+    """One fast end-to-end failover: SIGKILL the leader inside a lease
+    renewal mid-stream, attach two followers, and check the whole
+    protocol — single promotion, fencing, bit-for-bit convergence."""
+    clear_kill_points()
+    proc = _run_child(tmp_path, "before-lease-renew@4")
+    assert proc.returncode == 137, proc.stderr
+    cluster, cfg = _chaos_cluster(24)
+    log = str(tmp_path / "events.jsonl")
+    followers, winner = _attach_two_followers(
+        str(tmp_path / "ck"), log, cluster, cfg, 0.3
+    )
+    _assert_failover_invariants(tmp_path, cluster, cfg, winner, followers)
+
+
+@pytest.mark.slow
+def test_failover_chaos_every_kill_point(tmp_path):
+    """The acceptance chaos: a 500-event churn stream, the leader
+    SIGKILLed at EVERY named kill-point (the promotion-side point fires
+    inside a promoting follower — the new leader dying mid-handover),
+    two followers attached per run; every run must elect exactly one new
+    leader, fence the old epoch, and answer bit-for-bit with a
+    from-scratch verification of the surviving prefix."""
+    clear_kill_points()
+    n_events, pods, batch, ck_every = 500, 64, 25, 3
+    cluster, cfg = _chaos_cluster(pods)
+    kill_at = {
+        "mid-log-append": 137,   # record index
+        "after-tmp-write": 2,    # checkpoint-internal hits
+        "before-rename": 2,
+        "after-manifest": 2,
+        "before-lease-renew": 10,  # of ~21 renewals
+        "after-promote-epoch": 0,  # fires in the promoting follower
+    }
+    kills = 0
+    for i, point in enumerate(KILL_POINTS):
+        workdir = tmp_path / f"run-{i}-{point}"
+        workdir.mkdir()
+        spec = f"{point}@{kill_at[point]}"
+        log = str(workdir / "events.jsonl")
+        ckdir = str(workdir / "ck")
+        prior_epoch = 1
+        if point == "after-promote-epoch":
+            # clean leader run, then a promoting follower dies right
+            # after bumping the lease epoch — the half-handover state
+            proc = _run_child(
+                workdir, "", n_events=n_events, pods=pods, batch=batch,
+                checkpoint_every=ck_every,
+            )
+            assert proc.returncode == 0, proc.stderr
+            time.sleep(0.5)  # lease (ttl 0.3) dies with the leader
+            proc = _run_child(
+                workdir, spec, role="follower", promote=True,
+                n_events=n_events, pods=pods, batch=batch,
+                checkpoint_every=ck_every,
+            )
+            assert proc.returncode == 137, (spec, proc.stderr)
+            dead = LeaseFile(ckdir).read()
+            assert dead.epoch == 2  # bumped before the kill...
+            assert scan_wal(log).last_epoch == 1  # ...nothing written at it
+            prior_epoch = 2  # the survivors take over from the dead reign
+        else:
+            proc = _run_child(
+                workdir, spec, n_events=n_events, pods=pods, batch=batch,
+                checkpoint_every=ck_every,
+            )
+            assert proc.returncode == 137, (spec, proc.stderr)
+        kills += 1
+        followers, winner = _attach_two_followers(
+            ckdir, log, cluster, cfg, 0.3, first=i % 2
+        )
+        _assert_failover_invariants(
+            workdir, cluster, cfg, winner, followers,
+            prior_epoch=prior_epoch,
+        )
+    assert kills == len(KILL_POINTS)
