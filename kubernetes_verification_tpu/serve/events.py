@@ -38,9 +38,10 @@ reign counter from ``leader.lease`` (serve/replication.py). The epoch is
 covered by the record crc, so a fenced stray writer cannot forge a newer
 reign; :func:`scan_wal` rejects epoch *regressions* mid-log (a lower epoch
 after a higher one is a stale leader that kept writing past its fencing),
-and :class:`EventSource` can drop sub-``min_epoch`` records on the read
-side (counted in ``fenced``) as defence in depth. Records without an
-``epoch`` stay valid — pre-replication logs keep replaying.
+and :class:`EventSource` drops the same regressions while live-tailing —
+plus anything below an explicit ``min_epoch`` floor — on the read side
+(counted in ``fenced``) as defence in depth. Records without an ``epoch``
+stay valid — pre-replication logs keep replaying.
 """
 from __future__ import annotations
 
@@ -390,10 +391,16 @@ class EventSource:
     (sequenced) streams, ``start_after_seq`` skips records whose ``seq``
     is already applied — the zero-duplicate-application half of recovery —
     counting them in ``skipped``; ``last_seq`` tracks the highest applied
-    sequence number (-1 until one is seen). ``min_epoch`` is read-side
-    fencing: records stamped with a lower lease epoch (a superseded leader
-    that kept writing) are dropped and counted in ``fenced`` instead of
-    applied; ``last_epoch`` tracks the highest epoch seen.
+    sequence number (-1 until one is seen). Read-side fencing drops (and
+    counts in ``fenced``) any record whose lease epoch *regresses* — an
+    older reign's record appearing after a newer reign's is a superseded
+    leader that kept writing (:func:`scan_wal` raises on the same shape at
+    open; a live tail drops it and moves on) — as well as anything below
+    an explicit ``min_epoch`` floor; ``last_epoch`` tracks the highest
+    epoch seen. Raising ``min_epoch`` is only safe once every committed
+    record below it has already been consumed (see
+    ``FollowerService.heartbeat``), which is why regression fencing, not
+    the floor, is the primary guard.
     """
 
     def __init__(
@@ -443,7 +450,12 @@ class EventSource:
             self.offset += len(raw)
             self.lineno += 1
             if epoch is not None:
-                if self.min_epoch is not None and epoch < self.min_epoch:
+                if (self.min_epoch is not None and epoch < self.min_epoch) or (
+                    self.last_epoch is not None and epoch < self.last_epoch
+                ):
+                    # below the explicit floor, or an epoch regression — a
+                    # fenced leader's stray append landing after its
+                    # successor's records
                     self.fenced += 1
                     continue
                 if self.last_epoch is None or epoch > self.last_epoch:

@@ -33,18 +33,25 @@ the shared-filesystem substrate *is* the leader's committed state.
 counter. A follower promotes only when BOTH hold: the lease has expired
 *and* its leader-probe circuit breaker has opened (several consecutive
 failed probes — one missed renewal is jitter, not death). Promotion is
-arbitrated by an ``O_CREAT|O_EXCL`` claim file per target epoch, so
-exactly one follower wins; the winner bumps the lease epoch and stamps
-it into every WAL record it subsequently writes. The deposed leader is
-*fenced* twice: write-side (its :class:`WalWriter` re-reads the lease
-per append and raises :class:`~..resilience.errors.FencedError` on a
-newer epoch) and read-side (``scan_wal`` rejects epoch regressions;
-followers drop sub-``min_epoch`` records). Kill-points
-``before-lease-renew`` and ``after-promote-epoch`` let the fault
-harness SIGKILL either side of the handover.
+arbitrated in two layers: an ``O_CREAT|O_EXCL`` claim file per target
+epoch thins the field, and the lease itself is the final word —
+:meth:`LeaseFile.renew` runs its read-check-write under an exclusive
+``flock`` and refuses an equal-epoch renewal by a different holder, so
+even two claimants racing through a swept stale claim cannot both hold
+one epoch. The winner bumps the lease epoch and stamps it into every WAL
+record it subsequently writes. The deposed leader is *fenced* twice:
+write-side (its :class:`WalWriter` re-reads the lease per append and
+raises :class:`~..resilience.errors.FencedError` on a newer epoch) and
+read-side (``scan_wal`` rejects epoch regressions at open;
+:class:`~.events.EventSource` drops the same regressions while tailing,
+and a follower raises its ``min_epoch`` floor only after its applied
+stream has reached the new reign — never ahead of records it still owes
+itself). Kill-points ``before-lease-renew`` and ``after-promote-epoch``
+let the fault harness SIGKILL either side of the handover.
 """
 from __future__ import annotations
 
+import fcntl
 import json
 import os
 import time
@@ -158,30 +165,48 @@ class LeaseFile:
     def renew(self, holder: str, epoch: int, ttl: float) -> Lease:
         """Atomically (re-)write the lease for ``holder`` at ``epoch``.
 
-        Fencing lives here too: renewing below the on-disk epoch raises
-        :class:`FencedError` — the one thing a deposed leader's heartbeat
-        loop must never do is clobber its successor's lease."""
+        Fencing lives here too: renewing below the on-disk epoch — or at
+        the on-disk epoch as a *different* holder — raises
+        :class:`FencedError`. The read-check-write runs under an
+        exclusive ``flock`` on a sibling ``.lock`` file, making it a
+        compare-and-swap: two promoters racing one target epoch
+        serialise, the first wins the reign and the second is refused
+        instead of silently clobbering it. A bit-rotted (unreadable)
+        lease cannot fence anyone — its epoch is gone — so it is
+        rewritten whole."""
         kill_point("before-lease-renew")
-        cur = self.read()
-        if cur is not None and cur.epoch > epoch:
-            raise FencedError(
-                f"{self.path}: lease epoch {cur.epoch} (held by "
-                f"{cur.holder!r}) supersedes {epoch} — renewal refused",
-                epoch=epoch, lease_epoch=cur.epoch,
+        lock_fd = os.open(self.path + ".lock", os.O_CREAT | os.O_RDWR, 0o644)
+        try:
+            fcntl.flock(lock_fd, fcntl.LOCK_EX)
+            try:
+                cur = self.read()
+            except PersistError:
+                cur = None
+            if cur is not None and (
+                cur.epoch > epoch
+                or (cur.epoch == epoch and cur.holder != holder)
+            ):
+                raise FencedError(
+                    f"{self.path}: lease epoch {cur.epoch} (held by "
+                    f"{cur.holder!r}) supersedes {epoch} held by "
+                    f"{holder!r} — renewal refused",
+                    epoch=epoch, lease_epoch=cur.epoch,
+                )
+            lease = Lease(
+                epoch=int(epoch), holder=holder,
+                renewed_at=float(self._clock()), ttl=float(ttl),
             )
-        lease = Lease(
-            epoch=int(epoch), holder=holder,
-            renewed_at=float(self._clock()), ttl=float(ttl),
-        )
-        tmp = self.path + ".tmp"
-        with open(tmp, "w") as fh:
-            json.dump(lease.to_dict(), fh, sort_keys=True)
-            fh.write("\n")
-            fh.flush()
-            os.fsync(fh.fileno())
-        os.replace(tmp, self.path)
-        _fsync_dir(os.path.dirname(self.path) or ".")
-        return lease
+            tmp = self.path + ".tmp"
+            with open(tmp, "w") as fh:
+                json.dump(lease.to_dict(), fh, sort_keys=True)
+                fh.write("\n")
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, self.path)
+            _fsync_dir(os.path.dirname(self.path) or ".")
+            return lease
+        finally:
+            os.close(lock_fd)  # closing the fd releases the flock
 
     def acquire(self, holder: str, ttl: float) -> Lease:
         """Take the lease for a *new* reign: epoch = on-disk epoch + 1
@@ -191,9 +216,13 @@ class LeaseFile:
         return self.renew(holder, (cur.epoch if cur else 0) + 1, ttl)
 
     def expired(self, now: Optional[float] = None) -> bool:
-        """True when the lease is missing or past its ttl (both mean "no
-        live leader" to a follower)."""
-        cur = self.read()
+        """True when the lease is missing, unreadable (bit rot — a reign
+        nobody can prove is no reign) or past its ttl: all three mean "no
+        live leader" to a follower, matching ``heartbeat`` semantics."""
+        try:
+            cur = self.read()
+        except PersistError:
+            return True
         if cur is None:
             return True
         return cur.expired(self._clock() if now is None else now)
@@ -376,10 +405,21 @@ class FollowerService:
         return applied
 
     def catch_up(self) -> int:
-        """Drain to the current WAL tip (poll until nothing is pending)."""
+        """Drain to the current WAL tip (poll until nothing is pending).
+
+        Bounded: an undecodable newline-terminated tail (a dead leader's
+        torn buffered write) is left unconsumed by the source's
+        last-line retry but still counts as a pending newline, so a poll
+        that applies nothing without advancing the offset means the
+        remainder is not consumable right now — return instead of
+        spinning; a later catch-up (or the recovery ladder) retries it."""
         applied = self.poll()
         while self._pending_records() > 0:
-            applied += self.poll()
+            before = self.source.offset
+            got = self.poll()
+            applied += got
+            if got == 0 and self.source.offset == before:
+                break
         return applied
 
     # ----------------------------------------------------------- bounded reads
@@ -445,8 +485,8 @@ class FollowerService:
     # --------------------------------------------------------------- failover
     def heartbeat(self) -> bool:
         """One leader-liveness probe: feed the breaker, raise our fencing
-        floor to the observed epoch, and return True when the leader
-        looked alive."""
+        floor where that is safe, and return True when the leader looked
+        alive."""
         try:
             cur = self.lease.read()
         except PersistError:
@@ -454,9 +494,19 @@ class FollowerService:
         now = self._clock()
         alive = cur is not None and not cur.expired(now)
         if cur is not None:
-            # every record of the current reign carries epoch >= this, so
-            # raising the floor only drops a *deposed* writer's strays
-            if self.source.min_epoch is None or cur.epoch > self.source.min_epoch:
+            # Raise the read-side floor to the lease epoch ONLY once our
+            # applied stream has reached that reign: a follower still
+            # behind the promotion point owes itself the previous reign's
+            # committed records, and a floor above them would silently
+            # fence-drop committed state. Until then the EventSource's
+            # epoch-regression fencing alone drops a deposed writer's
+            # strays (an old epoch after a newer one).
+            if (
+                (self.source.min_epoch is None
+                 or cur.epoch > self.source.min_epoch)
+                and self.source.last_epoch is not None
+                and self.source.last_epoch >= cur.epoch
+            ):
                 self.source.min_epoch = cur.epoch
         if alive:
             self.probe.record_success()
@@ -476,11 +526,33 @@ class FollowerService:
             return False
         return self.promote() is not None
 
+    def _claim_age(self, claim: str) -> Optional[float]:
+        """A claim's age in the *injected* clock's time base: prefer the
+        ``claimed_at`` its creator stamped inside (written with the same
+        clock family), falling back to file mtime — comparable to the
+        clock only when the clock is real wall time — for a claimant that
+        died between creating the file and landing the stamp. None = the
+        claim vanished underneath us (someone else swept it)."""
+        try:
+            with open(claim) as fh:
+                stamped = json.load(fh)["claimed_at"]
+            return self._clock() - float(stamped)
+        except (OSError, ValueError, TypeError, KeyError):
+            pass
+        try:
+            return self._clock() - os.path.getmtime(claim)
+        except OSError:
+            return None
+
     def _claim(self, target_epoch: int) -> bool:
-        """Exactly-one-winner arbitration: an ``O_CREAT|O_EXCL`` claim
-        file per target epoch. A stale claim (older than the lease ttl
-        with the epoch still unbumped — its creator died mid-promotion)
-        is swept so the reign isn't deadlocked."""
+        """First-layer arbitration: an ``O_CREAT|O_EXCL`` claim file per
+        target epoch. A stale claim (older than the lease ttl with the
+        epoch still unbumped — its creator died mid-promotion) is swept
+        so the reign isn't deadlocked. The sweep's remove/recreate is
+        racy by construction (two sweepers can both end up holding a
+        claim); that is acceptable because the lease renewal, not the
+        claim, is the final arbiter — ``renew`` is a locked
+        compare-and-swap that refuses the second claimant."""
         claim = os.path.join(
             self.directory, f"promote-{target_epoch:08d}.claim"
         )
@@ -490,11 +562,13 @@ class FollowerService:
             except FileExistsError:
                 if attempt:
                     return False
-                try:
-                    age = time.time() - os.path.getmtime(claim)
-                except OSError:
+                age = self._claim_age(claim)
+                if age is None:
                     return False
-                cur = self.lease.read()
+                try:
+                    cur = self.lease.read()
+                except PersistError:
+                    cur = None
                 stale = age > self.lease_ttl and (
                     cur is None or cur.epoch < target_epoch
                 )
@@ -505,10 +579,16 @@ class FollowerService:
                 except OSError:
                     return False
                 continue
-            # the claim file IS the atomic primitive — O_EXCL creation
-            # decides the race; the content is advisory
+            # O_EXCL creation decides this layer's race; the content
+            # carries the holder and a claimed_at in the injected clock's
+            # time base so later sweepers judge staleness with the same
+            # clock that drives the rest of the protocol
             with os.fdopen(fd, "w") as fh:
-                fh.write(f"{self.replica}\n")
+                json.dump(
+                    {"holder": self.replica, "claimed_at": self._clock()},
+                    fh, sort_keys=True,
+                )
+                fh.write("\n")
             return True
         return False
 
@@ -520,7 +600,10 @@ class FollowerService:
         Callers that only need read-side promotion can drop the writer —
         holding the lease is what fences the old leader."""
         self.catch_up()
-        cur = self.lease.read()
+        try:
+            cur = self.lease.read()
+        except PersistError:
+            cur = None  # bit rot: fall back to the highest applied epoch
         prior = cur.epoch if cur is not None else (self.source.last_epoch or 0)
         target_epoch = prior + 1
         if not self._claim(target_epoch):
@@ -528,7 +611,16 @@ class FollowerService:
                 "promotion_lost", replica=self.replica, epoch=target_epoch
             )
             return None
-        self.lease.renew(self.replica, target_epoch, self.lease_ttl)
+        try:
+            self.lease.renew(self.replica, target_epoch, self.lease_ttl)
+        except FencedError:
+            # another promoter reached this epoch between our claim and
+            # our renewal (a swept-claim race): the lease CAS says it
+            # holds the reign, so we don't
+            log_event(
+                "promotion_lost", replica=self.replica, epoch=target_epoch
+            )
+            return None
         kill_point("after-promote-epoch")
         self.promoted = True
         self.epoch = target_epoch

@@ -176,6 +176,26 @@ def test_event_source_min_epoch_drops_fenced_records(tmp_path, churn):
     assert src.fenced == 2 and src.last_epoch == 2
 
 
+def test_event_source_drops_epoch_regression_while_tailing(tmp_path, churn):
+    """A fenced leader's stray append (old epoch after a newer reign's
+    records) is dropped by a live tail — the same shape scan_wal raises
+    on at open — while the new reign keeps applying."""
+    _, events, _ = churn
+    log = str(tmp_path / "wal.jsonl")
+    with open(log, "w") as fh:
+        for i, epoch in enumerate((1, 1, 2)):
+            fh.write(encode_event(events[i], seq=i, epoch=epoch) + "\n")
+    src = EventSource(log)
+    assert list(src.replay()) == events[:3]
+    with open(log, "a") as fh:  # the deposed leader kept writing
+        fh.write(encode_event(events[3], seq=3, epoch=1) + "\n")
+    assert list(src.replay()) == []
+    assert src.fenced == 1 and src.last_epoch == 2 and src.last_seq == 2
+    with open(log, "a") as fh:  # the new reign is unaffected
+        fh.write(encode_event(events[4], seq=3, epoch=2) + "\n")
+    assert list(src.replay()) == [events[4]]
+
+
 def test_wal_writer_refuses_log_with_newer_epoch(tmp_path, churn):
     _, events, _ = churn
     log = str(tmp_path / "wal.jsonl")
@@ -226,6 +246,38 @@ def test_lease_acquire_renew_fence_and_describe(tmp_path):
         fh.write("{torn")
     with pytest.raises(PersistError):
         lf.read()
+
+
+def test_renew_refuses_equal_epoch_different_holder(tmp_path):
+    """The lease renewal is the promotion protocol's final arbiter: two
+    claimants racing one target epoch must not both hold the reign."""
+    clock = Clock()
+    lf = LeaseFile(str(tmp_path), clock=clock)
+    lf.acquire("a", ttl=5.0)  # epoch 1
+    lf.renew("a", 1, 5.0)  # self-renewal at one's own epoch stays fine
+    with pytest.raises(FencedError):  # a rival cannot share the epoch
+        lf.renew("b", 1, 5.0)
+    assert lf.read().holder == "a"
+
+
+def test_corrupt_lease_counts_as_dead_leader(tmp_path, churn):
+    """A bit-rotted lease must feed the breaker toward failover, not
+    permanently block promotion with a PersistError."""
+    clock = Clock()
+    log, ckdir, _ = _leader_dir(tmp_path, churn, ttl=5.0, clock=clock)
+    f = FollowerService(
+        ckdir, log_path=log, replica="r1",
+        breaker_threshold=2, lease_ttl=5.0, clock=clock,
+    )
+    with open(lease_path(ckdir), "w") as fh:
+        fh.write("{bit rot")
+    assert f.lease.expired()  # unreadable == no live leader
+    assert not f.heartbeat()
+    assert not f.heartbeat()
+    assert f.probe.state == OPEN
+    assert f.maybe_promote()  # promotes through the rot, no PersistError
+    assert f.promoted and f.epoch == 2  # prior reign from the WAL's epochs
+    assert f.lease.read().holder == "r1"
 
 
 # ---------------------------------------------------------------- bootstrap
@@ -333,6 +385,79 @@ def test_promotion_is_breaker_gated(tmp_path, churn):
         old.append([_relabel(leader, 1)])
     with pytest.raises(FencedError):
         f.lease.renew("leader-0", 1, 5.0)
+
+
+def test_heartbeat_does_not_fence_unapplied_prior_reign(tmp_path, churn):
+    """A follower that observes a new lease epoch while still BEHIND the
+    promotion point must not raise its min_epoch floor yet: the previous
+    reign's committed records it has not applied would be silently
+    fence-dropped and its state would diverge from the leader's."""
+    clock = Clock()
+    log, ckdir, leader = _leader_dir(tmp_path, churn, ttl=1.0, clock=clock)
+    f = FollowerService(
+        ckdir, log_path=log, replica="r1",
+        auto_catch_up=False, lease_ttl=1.0, clock=clock,
+    )
+    f.catch_up()
+    # the old reign commits more records; r1 does NOT poll them
+    lease = LeaseFile(ckdir, clock=clock)
+    w = WalWriter(log, epoch=1, lease=lease)
+    w.append([_relabel(leader, k) for k in range(4)])
+    w.close()
+    # the leader dies; a sibling follower (already at the tip) promotes
+    clock.advance(2.0)
+    sib = FollowerService(
+        ckdir, log_path=log, replica="r2",
+        breaker_threshold=1, lease_ttl=1.0, clock=clock,
+    )
+    assert not sib.heartbeat()
+    assert sib.maybe_promote() and sib.epoch == 2
+    sib.writer.append([_relabel(sib.service, k) for k in range(90, 93)])
+    sib.catch_up()
+    # r1 heartbeats while still behind: it sees epoch 2 in the lease but
+    # must not fence the epoch-1 records it still owes itself
+    f.heartbeat()
+    assert f.source.min_epoch in (None, 1)
+    f.catch_up()
+    assert f.source.fenced == 0
+    np.testing.assert_array_equal(_reach(f.service), _reach(sib.service))
+    # once caught up past the transition, the floor may rise
+    f.heartbeat()
+    assert f.source.min_epoch == 2
+
+
+def test_catch_up_bounded_on_undecodable_tail(tmp_path, churn):
+    """An invalid newline-terminated WAL tail (a dead leader's torn
+    buffered write) is left unconsumed by the source but still counts as
+    a pending newline — catch_up must return, not spin forever."""
+    log, ckdir, _ = _leader_dir(tmp_path, churn)
+    f = FollowerService(
+        ckdir, log_path=log, replica="r1", auto_catch_up=False
+    )
+    f.catch_up()
+    with open(log, "a") as fh:
+        fh.write('{"event": "add_policy", "torn\n')
+    assert f.catch_up() == 0  # bounded: returns despite pending newline
+    assert f.lag().seq == 1  # the junk still measures as lag
+
+
+def test_claim_sweep_runs_on_the_injected_clock(tmp_path, churn):
+    """Claim staleness is judged in the injected clock's time base (via
+    the claimed_at stamped inside the claim), so a fake-clock harness can
+    exercise the dead-claimant sweep without real sleeps."""
+    clock = Clock()
+    log, ckdir, _ = _leader_dir(tmp_path, churn, ttl=1.0, clock=clock)
+    fa = FollowerService(
+        ckdir, log_path=log, replica="ra", lease_ttl=1.0, clock=clock
+    )
+    fb = FollowerService(
+        ckdir, log_path=log, replica="rb", lease_ttl=1.0, clock=clock
+    )
+    assert fa._claim(2) and not fb._claim(2)  # a fresh claim blocks
+    # ra dies mid-promotion (epoch never bumped); only the FAKE clock
+    # advances — the sweep must still see the claim as stale
+    clock.advance(5.0)
+    assert fb._claim(2)
 
 
 def test_claim_arbitration_exactly_one_winner(tmp_path, churn):
