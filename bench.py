@@ -1132,6 +1132,170 @@ def _replicate_worker(ck_dir, log_path, idx, n_batches, barrier, out_q):
     )
 
 
+def _replicate_net_worker(url, base_dir, idx, n_batches, barrier, out_q):
+    """Subprocess body for ``--mode replicate --net`` (module-level for
+    spawn): a networked follower — checkpoint shipped over HTTP, WAL
+    tailed into a local byte mirror — answering batched queries while the
+    leader keeps appending churn through the timed window. Each batch is
+    preceded by a poll(), so the measured queries/s pays for tailing, and
+    the lag reported is the end-of-window lag *under* churn, not after a
+    final quiesced catch-up."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import numpy as np
+
+    from kubernetes_verification_tpu.serve import FollowerService
+
+    f = FollowerService(
+        os.path.join(base_dir, f"net-replica-{idx}"),
+        replica=f"net-replica-{idx}",
+        leader_url=url,
+        auto_catch_up=False,
+    )
+    f.catch_up()
+    f.service.reach(trigger="query")
+    n = f.service.n_pods
+    pods = f.service.engine.pods
+    ref = lambda i: f"{pods[i % n].namespace}/{pods[i % n].name}"
+    rs = np.random.default_rng(9500 + idx)
+    sub = 512
+    batches = [
+        [
+            (ref(int(a)), ref(int(b)))
+            for a, b in rs.integers(0, n, (sub, 2))
+        ]
+        for _ in range(n_batches)
+    ]
+    f.can_reach_batch(batches[0])  # compile + generation-keyed cache fill
+    barrier.wait(timeout=300)
+    s = time.perf_counter()
+    for b in batches:
+        f.poll()  # keep tailing the churn the leader is appending
+        f.can_reach_batch(b)
+    elapsed = time.perf_counter() - s
+    lag = f.lag()
+    out_q.put(
+        {
+            "replica": f.replica,
+            "queries": n_batches * sub,
+            "elapsed_s": elapsed,
+            "qps": (n_batches * sub) / elapsed,
+            "lag_seconds": lag.seconds,
+            "lag_seq": lag.seq,
+            "applied": f.applied,
+            "outcome": f.recovery.outcome,
+        }
+    )
+
+
+def _bench_replicate_net(args, svc, writer, workdir, ck_dir, log_path, n_batches):
+    """The ``--net`` leg of replicate mode: one in-process
+    :class:`ReplicationServer` over the leader's checkpoint directory and
+    WAL, four spawn-process followers bootstrapping over HTTP, and the
+    leader appending relabel churn from a thread for as long as the
+    followers' timed windows run."""
+    import multiprocessing as mp
+    import threading
+
+    from kubernetes_verification_tpu.serve import (
+        ReplicationServer,
+        UpdatePodLabels,
+    )
+
+    replicas = 4
+    ctx = mp.get_context("spawn")
+    pods = svc.engine.pods
+    n_now = svc.n_pods
+
+    def _relabel(k):
+        p = pods[k % n_now]
+        labels = dict(p.labels)
+        labels["bench-net-churn"] = str(k)
+        return UpdatePodLabels(
+            namespace=p.namespace, pod=p.name, labels=labels
+        )
+
+    with ReplicationServer(ck_dir, log_path) as server:
+        log(f"replication server: {server.url}; {replicas} networked followers")
+        barrier = ctx.Barrier(replicas + 1)
+        out_q = ctx.Queue()
+        procs = [
+            ctx.Process(
+                target=_replicate_net_worker,
+                args=(server.url, workdir, idx, n_batches, barrier, out_q),
+            )
+            for idx in range(replicas)
+        ]
+        for p in procs:
+            p.start()
+        barrier.wait(timeout=300)  # every follower bootstrapped and warm
+        stop = threading.Event()
+
+        def _churn():
+            k = 0
+            while not stop.is_set():
+                writer.append([_relabel(k)])
+                k += 1
+                time.sleep(0.005)
+
+        churner = threading.Thread(target=_churn, daemon=True)
+        churner.start()
+        results = [out_q.get(timeout=300) for _ in procs]
+        stop.set()
+        churner.join(timeout=30)
+        for p in procs:
+            p.join(timeout=60)
+    writer.close()
+    agg = sum(r["qps"] for r in results)
+    lags = [r["lag_seconds"] for r in results]
+    spread = max(lags) - min(lags)
+    per = ", ".join(f"{r['qps']:,.0f}" for r in results)
+    log(
+        f"{replicas} networked follower(s) under sustained churn: aggregate "
+        f"{agg:,.0f} queries/s ({per}); lag max {max(lags):.3f}s "
+        f"spread {spread:.3f}s"
+    )
+    _emit(
+        {
+            "metric": (
+                f"networked replicated serving: {replicas} HTTP followers "
+                f"under sustained leader churn, {args.pods} pods / "
+                f"{args.policies} policies, batch 512, cpu"
+            ),
+            "value": round(agg, 1),
+            "unit": "queries/s",
+            "replicas": results,
+        }
+    )
+    # explicit-direction series for the history gate: throughput gates
+    # higher by its rate-shaped name/unit, the lag series lower by unit,
+    # the spread lower by NAME (observe/history.py)
+    _emit(
+        {
+            "metric": "net_aggregate_queries_per_second",
+            "value": round(agg, 1),
+            "unit": "queries/s",
+            "replicas": replicas,
+        }
+    )
+    _emit(
+        {
+            "metric": "net_replica_lag_seconds",
+            "value": round(max(lags), 4),
+            "unit": "s",
+            "replicas": replicas,
+        }
+    )
+    _emit(
+        {
+            "metric": "replica_lag_spread_seconds",
+            "value": round(spread, 4),
+            "unit": "s",
+            "replicas": replicas,
+            "net": True,
+        }
+    )
+
+
 def bench_replicate(args) -> None:
     """Replicated-serving read scaling: one leader writes the WAL (epoch-
     stamped, lease-renewed, checkpointed mid-stream), then 1 -> 2 -> 4
@@ -1196,12 +1360,19 @@ def bench_replicate(args) -> None:
                 svc.engine, log_path=log_path,
                 log_offset=writer.offset, last_seq=writer.next_seq - 1,
             )
-    writer.close()
     t1 = time.perf_counter()
     log(
         f"leader: {len(events)} events appended at epoch 1, checkpoint at "
         f"seq {mid} in {t1 - t0:.1f}s -> {workdir}"
     )
+    if getattr(args, "net", False):
+        # networked leg: the writer stays open — the leader keeps churning
+        # through the followers' timed windows
+        n_batches = max(2, args.n_queries // 512)
+        return _bench_replicate_net(
+            args, svc, writer, workdir, ck_dir, log_path, n_batches
+        )
+    writer.close()
 
     ctx = mp.get_context("spawn")
     n_batches = max(2, args.n_queries // 512)
@@ -1269,9 +1440,16 @@ def bench_replicate(args) -> None:
         for g in groups.values()
         for r in g["replicas"]
     )
+    # per-follower lag spread over the 4-replica group: a fleet whose
+    # slowest member lags its fastest signals skewed bootstrap/tailing
+    # even when the max lag alone looks fine
+    quad_lags = [
+        r["bootstrap_lag_seconds"] for r in groups[4]["replicas"]
+    ]
+    lag_spread = max(quad_lags) - min(quad_lags)
     log(
         f"4-replica aggregate vs single read/write service: {scaling:.2f}x "
-        f"(max bootstrap lag {max_lag:.3f}s)"
+        f"(max bootstrap lag {max_lag:.3f}s, spread {lag_spread:.3f}s)"
     )
     _emit(
         {
@@ -1304,6 +1482,14 @@ def bench_replicate(args) -> None:
         {
             "metric": "replica_lag_seconds",
             "value": round(max_lag, 4),
+            "unit": "s",
+            "replicas": 4,
+        }
+    )
+    _emit(
+        {
+            "metric": "replica_lag_spread_seconds",
+            "value": round(lag_spread, 4),
             "unit": "s",
             "replicas": 4,
         }
@@ -1378,6 +1564,13 @@ def main() -> None:
         "--n-queries", type=int, default=8_192,
         help="query mode: total probes in the timed steady-state workload "
         "(answered in sub-batches of 512)",
+    )
+    ap.add_argument(
+        "--net", action="store_true",
+        help="replicate mode: networked fleet — 4 followers bootstrap over "
+        "HTTP from a ReplicationServer and tail its WAL into local byte "
+        "mirrors while the leader keeps appending churn through the timed "
+        "window (aggregate queries/s + lag under sustained churn)",
     )
     ap.add_argument(
         "--introspect",

@@ -20,6 +20,9 @@ only, SURVEY.md §1); this exposes the full pipeline:
   assertions (violations exit 1 with pod-pair witnesses);
 * ``kv-tpu query``         — can-reach / who-can-reach / blast-radius /
   what-if admission checks against manifests or a serve snapshot;
+* ``kv-tpu lb``            — spread query batches across follower replicas
+  by staleness-weighted routing (stale reads retry on the leader,
+  unreachable replicas are breaker-ejected);
 * ``kv-tpu recover``       — read-only triage of a serve checkpoint
   directory (generation health, WAL valid prefix);
 * ``kv-tpu backends``      — list available execution backends.
@@ -1006,7 +1009,9 @@ def _run_follow(args) -> int:
     in ``--follow DIR``, tail the leader's WAL under the ``--staleness``
     bound, and (with ``--promote-on-lease-expiry``) take over when the
     lease expires and the leader-probe breaker opens."""
+    import random as _random
     import time as _time
+    import zlib as _zlib
 
     from .resilience.errors import EXIT_OK, EXIT_VIOLATIONS
     from .serve import FollowerService, load_assertions
@@ -1019,6 +1024,7 @@ def _run_follow(args) -> int:
         proxy_stale=args.proxy_stale,
         lease_ttl=args.lease_ttl,
         batch_size=args.batch_size,
+        leader_url=getattr(args, "leader", None),
     )
     svc = follower.service
     if getattr(args, "assert_file", None):
@@ -1028,6 +1034,9 @@ def _run_follow(args) -> int:
     # between drains
     interval = args.tail_poll
     max_interval = max(args.tail_poll, min(1.0, args.tail_poll * 32))
+    # per-replica jitter stream (same law as EventSource.tail): a fleet
+    # of followers started together must not probe the leader in phase
+    rng = _random.Random(_zlib.crc32(args.replica.encode()))
     idle_since = _time.monotonic()
     while True:
         applied = follower.poll()
@@ -1041,7 +1050,9 @@ def _run_follow(args) -> int:
             continue
         if now - idle_since >= args.idle_timeout:
             break
-        _time.sleep(min(interval, args.idle_timeout))
+        _time.sleep(
+            min(interval, args.idle_timeout) * (1.0 + rng.random() * 0.1)
+        )
         interval = min(interval * 2, max_interval)
     # the final answer rides the same staleness gate as any client read:
     # over-bound exits 2 with the measured lag (or proxies under
@@ -1147,6 +1158,57 @@ def _run_recover(args) -> int:
     return EXIT_OK
 
 
+def _parse_probe_batch(path: str):
+    """Parse a ``--batch`` JSONL probe file into ``(src, dst, port,
+    protocol)`` tuples — shared by ``kv-tpu query`` and ``kv-tpu lb``."""
+    from .resilience.errors import IngestError
+
+    probes = []
+    try:
+        with open(path) as fh:
+            lines = fh.read().splitlines()
+    except OSError as e:
+        raise IngestError(f"cannot read query batch {path}: {e}") from e
+    for ln_no, line in enumerate(lines, 1):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            obj = json.loads(line)
+        except ValueError as e:
+            raise IngestError(
+                f"{path}:{ln_no}: not valid JSON: {e}"
+            ) from e
+        if not isinstance(obj, dict) or "src" not in obj or "dst" not in obj:
+            raise IngestError(
+                f"{path}:{ln_no}: each probe needs 'src' and "
+                "'dst' (optional: 'port', 'protocol')"
+            )
+        unknown = set(obj) - {"src", "dst", "port", "protocol"}
+        if unknown:
+            raise IngestError(
+                f"{path}:{ln_no}: unknown field(s) {sorted(unknown)}"
+            )
+        port = obj.get("port")
+        if port is not None:
+            try:
+                port = int(port)
+            except (TypeError, ValueError):
+                raise IngestError(
+                    f"{path}:{ln_no}: port must be an integer, "
+                    f"got {obj['port']!r}"
+                ) from None
+        probes.append(
+            (
+                str(obj["src"]),
+                str(obj["dst"]),
+                port,
+                str(obj.get("protocol", "TCP")),
+            )
+        )
+    return probes
+
+
 def cmd_query(args) -> int:
     from .resilience.errors import KvTpuError
 
@@ -1158,7 +1220,7 @@ def cmd_query(args) -> int:
 
 
 def _run_query(args) -> int:
-    from .resilience.errors import EXIT_OK, EXIT_VIOLATIONS, IngestError
+    from .resilience.errors import EXIT_OK, EXIT_VIOLATIONS
     from .serve import (
         AddPolicy,
         QueryEngine,
@@ -1184,52 +1246,7 @@ def _run_query(args) -> int:
             "allowed": ok,
         }
     if getattr(args, "batch", None):
-        probes = []
-        try:
-            with open(args.batch) as fh:
-                lines = fh.read().splitlines()
-        except OSError as e:
-            raise IngestError(
-                f"cannot read query batch {args.batch}: {e}"
-            ) from e
-        for ln_no, line in enumerate(lines, 1):
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                obj = json.loads(line)
-            except ValueError as e:
-                raise IngestError(
-                    f"{args.batch}:{ln_no}: not valid JSON: {e}"
-                ) from e
-            if not isinstance(obj, dict) or "src" not in obj or "dst" not in obj:
-                raise IngestError(
-                    f"{args.batch}:{ln_no}: each probe needs 'src' and "
-                    "'dst' (optional: 'port', 'protocol')"
-                )
-            unknown = set(obj) - {"src", "dst", "port", "protocol"}
-            if unknown:
-                raise IngestError(
-                    f"{args.batch}:{ln_no}: unknown field(s) "
-                    f"{sorted(unknown)}"
-                )
-            port = obj.get("port")
-            if port is not None:
-                try:
-                    port = int(port)
-                except (TypeError, ValueError):
-                    raise IngestError(
-                        f"{args.batch}:{ln_no}: port must be an integer, "
-                        f"got {obj['port']!r}"
-                    ) from None
-            probes.append(
-                (
-                    str(obj["src"]),
-                    str(obj["dst"]),
-                    port,
-                    str(obj.get("protocol", "TCP")),
-                )
-            )
+        probes = _parse_probe_batch(args.batch)
         answers = q.can_reach_batch(probes)
         out["batch"] = {
             "file": args.batch,
@@ -1371,6 +1388,81 @@ def _run_query(args) -> int:
             for line in a["violations"]:
                 print(f"  VIOLATION: {line}")
     return exit_code
+
+
+def cmd_lb(args) -> int:
+    from .resilience.errors import KvTpuError
+
+    try:
+        with _observed(args):
+            return _run_lb(args)
+    except KvTpuError as e:
+        return _diagnose(args, e)
+
+
+def _run_lb(args) -> int:
+    """``kv-tpu lb``: answer ``--batch`` probe files through a
+    staleness-weighted load balancer over follower replicas. Each
+    ``--replica`` is a checkpoint directory (shared-fs follower) or
+    ``DIR=URL`` (networked follower bootstrapped over HTTP from the
+    replication server at URL into DIR). ``--leader DIR`` wires the
+    stale-read retry / last-resort fallback."""
+    from .resilience.errors import EXIT_OK, EXIT_VIOLATIONS
+    from .serve import FollowerService, QueryLoadBalancer
+
+    replicas = []
+    for i, spec in enumerate(args.replica):
+        directory, sep, url = spec.partition("=")
+        replicas.append(
+            FollowerService(
+                directory,
+                log_path=args.events,
+                replica=f"replica-{i}",
+                max_lag_seconds=args.staleness,
+                leader_url=url if sep else None,
+            )
+        )
+    leader = None
+    if args.leader:
+        # no staleness bound: the leader's directory IS the fresh state
+        leader = FollowerService(
+            args.leader, log_path=args.events, replica="leader"
+        )
+    lb = QueryLoadBalancer(replicas, leader=leader, seed=args.seed)
+    batches = []
+    denied = 0
+    for path in args.batch:
+        probes = _parse_probe_batch(path)
+        answers, who = lb.can_reach_batch(probes)
+        allowed = int(answers.sum())
+        denied += len(probes) - allowed
+        batches.append(
+            {
+                "file": path,
+                "n": len(probes),
+                "allowed": allowed,
+                "replica": who,
+            }
+        )
+    out = {"batches": batches, "lb": lb.describe()}
+    if args.json:
+        print(json.dumps(out, sort_keys=True))
+    else:
+        for b in batches:
+            print(
+                f"{b['file']}: {b['allowed']}/{b['n']} allowed "
+                f"(answered by {b['replica']})"
+            )
+        routed = ", ".join(
+            f"{who}={n}" for who, n in sorted(lb.routed.items())
+        )
+        print(
+            f"routed: {routed or 'nothing'}  "
+            f"stale_retries: {lb.stale_retries}  ejections: {lb.ejections}"
+        )
+    if args.check_denied and denied:
+        return EXIT_VIOLATIONS
+    return EXIT_OK
 
 
 def cmd_backends(_args) -> int:
@@ -1599,6 +1691,14 @@ def main(argv: Optional[list] = None) -> int:
         "holder on promotion)",
     )
     p.add_argument(
+        "--leader", metavar="URL",
+        help="with --follow: the leader lives on another host — "
+        "bootstrap its checkpoint over HTTP from the replication "
+        "server at URL into the --follow directory and tail its WAL "
+        "into a local byte mirror (--events then names the mirror "
+        "file; default wal-mirror.jsonl inside the directory)",
+    )
+    p.add_argument(
         "--proxy-stale", action="store_true",
         help="with --follow: answer over-bound reads with leader-fresh "
         "state instead of raising StaleReadError",
@@ -1741,6 +1841,48 @@ def main(argv: Optional[list] = None) -> int:
     p.add_argument("--json", action="store_true")
     _add_obs_flags(p)
     p.set_defaults(fn=cmd_query)
+
+    p = sub.add_parser(
+        "lb",
+        help="spread --batch probe files across follower replicas by "
+        "staleness-weighted routing: stale reads retry on the leader, "
+        "unreachable replicas are breaker-ejected",
+    )
+    p.add_argument(
+        "--replica", action="append", default=[], metavar="DIR[=URL]",
+        help="a follower's checkpoint directory (repeatable); DIR=URL "
+        "bootstraps a networked follower over HTTP from the replication "
+        "server at URL into DIR",
+    )
+    p.add_argument(
+        "--leader", metavar="DIR",
+        help="the leader's checkpoint directory — stale-read retry and "
+        "last-resort fallback (without it, an over-bound replica's "
+        "StaleReadError propagates and a fully-ejected fleet exits 4)",
+    )
+    p.add_argument(
+        "--batch", action="append", default=[], required=True,
+        metavar="FILE.jsonl",
+        help="probe batch to route (repeatable; one batch = one routing "
+        "decision); same JSONL schema as kv-tpu query --batch",
+    )
+    p.add_argument(
+        "--events", metavar="FILE",
+        help="override the WAL the replicas tail (default: the path the "
+        "checkpoint manifest records)",
+    )
+    p.add_argument(
+        "--staleness", type=float, default=None, metavar="SECONDS",
+        help="per-replica staleness bound (default: unbounded)",
+    )
+    p.add_argument("--seed", type=int, default=0, help="routing-draw seed")
+    p.add_argument(
+        "--check-denied", action="store_true",
+        help="exit 1 when any probe is denied",
+    )
+    p.add_argument("--json", action="store_true")
+    _add_obs_flags(p)
+    p.set_defaults(fn=cmd_lb)
 
     p = sub.add_parser("backends", help="list available backends")
     p.set_defaults(fn=cmd_backends)

@@ -67,7 +67,9 @@ _HIGHER_IS_BETTER_METRICS = frozenset(
 )
 #: and the replica-lag series gates lower-is-better by NAME — a follower
 #: falling further behind the leader is a regression whatever the unit
-_LOWER_IS_BETTER_METRICS = frozenset({"replica_lag_seconds"})
+_LOWER_IS_BETTER_METRICS = frozenset(
+    {"replica_lag_seconds", "replica_lag_spread_seconds"}
+)
 
 
 def append_run(record: dict, path: str = DEFAULT_HISTORY) -> dict:

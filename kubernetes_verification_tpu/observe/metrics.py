@@ -59,6 +59,13 @@ __all__ = [
     "REPLICA_LAG_SEQ",
     "PROMOTIONS_TOTAL",
     "STALE_READS_TOTAL",
+    "NET_REQUESTS_TOTAL",
+    "NET_REQUEST_FAILURES_TOTAL",
+    "NET_BYTES_TOTAL",
+    "NET_FAULTS_INJECTED_TOTAL",
+    "LB_REQUESTS_TOTAL",
+    "LB_STALE_RETRIES_TOTAL",
+    "LB_EJECTIONS_TOTAL",
     "LINT_FINDINGS_TOTAL",
     "REQUIRED_FAMILIES",
 ]
@@ -387,6 +394,63 @@ STALE_READS_TOTAL = Counter(
     ("outcome",),
 )
 
+NET_REQUESTS_TOTAL = Counter(
+    "kvtpu_net_requests_total",
+    "Replication-transport requests issued by followers and the query "
+    "load balancer, by wire operation (tip / wal / manifest / file) — "
+    "the denominator for the failure ratio on the networked read plane.",
+    ("op",),
+)
+
+NET_REQUEST_FAILURES_TOTAL = Counter(
+    "kvtpu_net_request_failures_total",
+    "Replication-transport requests that failed after exhausting their "
+    "bounded retry budget (connection refused/reset, timeout, checksum "
+    "mismatch, injected network fault), by wire operation — each one "
+    "feeds the caller's leader-probe or per-replica breaker.",
+    ("op",),
+)
+
+NET_BYTES_TOTAL = Counter(
+    "kvtpu_net_bytes_total",
+    "Payload bytes shipped over the replication transport, by wire "
+    "operation — WAL range bytes under 'wal', checkpoint chunk bytes "
+    "under 'file'; snapshot-shipping bootstrap cost is visible here.",
+    ("op",),
+)
+
+NET_FAULTS_INJECTED_TOTAL = Counter(
+    "kvtpu_net_faults_injected_total",
+    "Network faults fired at the transport seam by the injection harness "
+    "(net-drop / net-delay / net-partition), by kind and wire operation — "
+    "the chaos suite's ground truth for what each run actually injected.",
+    ("kind", "op"),
+)
+
+LB_REQUESTS_TOTAL = Counter(
+    "kvtpu_lb_requests_total",
+    "Query batches the load balancer routed, by destination replica "
+    "(the leader counts under its own name when a stale read was "
+    "retried against it) — staleness-weighted routing skew is read "
+    "straight off this family.",
+    ("replica",),
+)
+
+LB_STALE_RETRIES_TOTAL = Counter(
+    "kvtpu_lb_stale_retries_total",
+    "Batches a replica rejected with StaleReadError that the load "
+    "balancer retried against the leader — sustained growth means the "
+    "staleness bound is tighter than the followers can tail.",
+)
+
+LB_EJECTIONS_TOTAL = Counter(
+    "kvtpu_lb_ejections_total",
+    "Replicas the load balancer ejected from rotation (their per-replica "
+    "breaker opened after consecutive transport failures), by replica — "
+    "they re-enter through the breaker's half-open probe.",
+    ("replica",),
+)
+
 LINT_FINDINGS_TOTAL = Counter(
     "kvtpu_lint_findings_total",
     "Non-grandfathered findings reported by `kv-tpu lint` runs in this "
@@ -475,6 +539,14 @@ REQUIRED_FAMILIES = frozenset(
         "kvtpu_replica_lag_seq",
         "kvtpu_promotions_total",
         "kvtpu_stale_reads_total",
+        # networked replication (serve/transport.py + serve/lb.py)
+        "kvtpu_net_requests_total",
+        "kvtpu_net_request_failures_total",
+        "kvtpu_net_bytes_total",
+        "kvtpu_net_faults_injected_total",
+        "kvtpu_lb_requests_total",
+        "kvtpu_lb_stale_retries_total",
+        "kvtpu_lb_ejections_total",
         # static analysis (analysis/)
         "kvtpu_lint_findings_total",
         # interprocedural engine (analysis/callgraph.py + summaries.py)

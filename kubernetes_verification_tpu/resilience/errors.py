@@ -37,6 +37,7 @@ __all__ = [
     "ServeError",
     "StaleReadError",
     "FencedError",
+    "ReplicationError",
     "BackendError",
     "BackendOOM",
     "BackendTimeout",
@@ -146,6 +147,28 @@ class FencedError(ServeError):
         super().__init__(message)
         self.epoch = epoch
         self.lease_epoch = lease_epoch
+
+
+class ReplicationError(ServeError):
+    """A replication-transport operation failed: the connection was refused
+    or reset, a request timed out, a chunk arrived checksum-mismatched, or
+    an injected network fault (``net-drop`` / ``net-partition``) fired at
+    the transport seam. ``op`` names the wire operation (``tip`` / ``wal``
+    / ``manifest`` / ``file``) and ``url`` the endpoint. Transient by
+    construction — callers retry with capped jittered backoff and feed
+    per-replica breakers; a follower that cannot reach its leader keeps
+    serving (increasingly stale) reads from its local mirror."""
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        op: Optional[str] = None,
+        url: Optional[str] = None,
+    ) -> None:
+        super().__init__(message)
+        self.op = op
+        self.url = url
 
 
 class BackendError(KvTpuError, RuntimeError):

@@ -46,26 +46,33 @@ import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence
 
-from ..observe.metrics import FAULTS_INJECTED_TOTAL
+from ..observe.metrics import FAULTS_INJECTED_TOTAL, NET_FAULTS_INJECTED_TOTAL
 from .errors import (
     BackendError,
     BackendOOM,
     ConfigError,
     DeviceLost,
+    ReplicationError,
 )
 
 __all__ = [
     "FAULT_KINDS",
     "KILL_POINTS",
+    "NET_FAULT_KINDS",
     "FaultRule",
     "FaultInjector",
     "FaultyBackend",
     "KillPointInjector",
+    "NetFaultInjector",
     "parse_fault_spec",
     "register_faulty",
     "install_kill_points",
     "clear_kill_points",
     "kill_point",
+    "install_net_faults",
+    "clear_net_faults",
+    "heal_net_partition",
+    "net_fault",
 ]
 
 #: named crash points in the durability write path (serve/durability.py,
@@ -81,7 +88,16 @@ KILL_POINTS = (
     "after-promote-epoch",
 )
 
-FAULT_KINDS = ("oom", "timeout", "device_loss", "flaky") + KILL_POINTS
+#: network fault kinds injected at the replication-transport seam
+#: (serve/transport.py calls :func:`net_fault` before every wire request):
+#: ``net-drop`` fails one request, ``net-delay`` adds latency to one,
+#: ``net-partition`` latches — every request fails until
+#: :func:`heal_net_partition` (or :func:`clear_net_faults`)
+NET_FAULT_KINDS = ("net-drop", "net-delay", "net-partition")
+
+FAULT_KINDS = (
+    ("oom", "timeout", "device_loss", "flaky") + KILL_POINTS + NET_FAULT_KINDS
+)
 
 #: tile assumed when an ``oom>T`` rule fires against a config carrying no
 #: explicit ``tile`` option — matches ResilienceConfig.initial_tile
@@ -236,6 +252,12 @@ def register_faulty(
                 f"kill-point {rule.kind!r} is a process crash, not a "
                 "backend fault — arm it with install_kill_points()"
             )
+        if rule.kind in NET_FAULT_KINDS:
+            raise ConfigError(
+                f"network fault {rule.kind!r} fires at the replication-"
+                "transport seam, not in a backend — arm it with "
+                "install_net_faults()"
+            )
     injector = FaultInjector(rules, seed=seed)
     name = f"faulty:{inner_name}"
     register_backend(
@@ -327,3 +349,123 @@ def kill_point(name: str, flush=None) -> None:
         if flush is not None:
             flush.flush()
         os._exit(inj.exit_code)
+
+
+# ---------------------------------------------------------- network faults
+class NetFaultInjector:
+    """Seeded, request-counting network fault schedule for the transport
+    seam. One counter spans every wire operation (``tip``/``wal``/
+    ``manifest``/``file``) so ``net-drop@3`` means "the 4th request this
+    process makes fails", whatever it was for. ``net-partition`` *latches*:
+    once its rule fires, every subsequent request fails until
+    :meth:`heal` — the two-sided silence of a real partition, not a
+    one-shot error."""
+
+    def __init__(
+        self,
+        rules: Sequence[FaultRule],
+        *,
+        seed: int = 0,
+        delay_seconds: float = 0.05,
+        sleep=time.sleep,
+    ) -> None:
+        self.rules = [r for r in rules if r.kind in NET_FAULT_KINDS]
+        if not self.rules:
+            raise ConfigError(
+                f"no network fault rules in {list(rules)!r}; known kinds: "
+                f"{NET_FAULT_KINDS}"
+            )
+        self.delay_seconds = delay_seconds
+        self._sleep = sleep
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self.calls = 0
+        self.partitioned = False
+        self.injected: Dict[str, int] = {}
+
+    def next_fault(self) -> Optional[str]:
+        """Advance the request counter and return the fault kind to inject
+        on this request, or None."""
+        with self._lock:
+            idx = self.calls
+            self.calls += 1
+            if self.partitioned:
+                self.injected["net-partition"] = (
+                    self.injected.get("net-partition", 0) + 1
+                )
+                return "net-partition"
+            for rule in self.rules:
+                if rule.at_call is not None:
+                    fired = rule.at_call == idx
+                elif rule.prob is not None:
+                    fired = self._rng.random() < rule.prob
+                else:
+                    fired = True
+                if fired:
+                    if rule.kind == "net-partition":
+                        self.partitioned = True
+                    self.injected[rule.kind] = (
+                        self.injected.get(rule.kind, 0) + 1
+                    )
+                    return rule.kind
+        return None
+
+    def heal(self) -> None:
+        """End a latched partition; other rules keep their schedule."""
+        with self._lock:
+            self.partitioned = False
+
+
+#: the process-wide armed schedule (None = every net_fault() is a no-op)
+_NET_INJECTOR: Optional[NetFaultInjector] = None
+
+
+def install_net_faults(
+    rules: Sequence[FaultRule],
+    *,
+    seed: int = 0,
+    delay_seconds: float = 0.05,
+    sleep=time.sleep,
+) -> NetFaultInjector:
+    """Arm the transport-seam network faults process-wide (rules typically
+    come from ``parse_fault_spec("net-drop@2,net-delay%0.1")``); returns
+    the injector so a harness can inspect counters and heal partitions."""
+    global _NET_INJECTOR
+    # kvtpu: ignore[concurrency-hygiene] armed by the chaos harness before any transport client issues requests; arm/disarm is single-threaded
+    _NET_INJECTOR = NetFaultInjector(
+        rules, seed=seed, delay_seconds=delay_seconds, sleep=sleep
+    )
+    return _NET_INJECTOR
+
+
+def clear_net_faults() -> None:
+    """Disarm every network fault (tests; also ends a latched partition)."""
+    global _NET_INJECTOR
+    _NET_INJECTOR = None  # kvtpu: ignore[concurrency-hygiene] disarm happens on the harness thread after the scenario finishes
+
+
+def heal_net_partition() -> None:
+    """Heal the armed injector's latched partition, keeping its other
+    rules scheduled — the partition-then-heal chaos move."""
+    inj = _NET_INJECTOR
+    if inj is not None:
+        inj.heal()
+
+
+def net_fault(op: str) -> None:
+    """The transport seam. :class:`~.serve.transport.ReplicationClient`
+    calls this before every wire request; a firing ``net-delay`` sleeps
+    ``delay_seconds`` and lets the request proceed, ``net-drop`` and
+    ``net-partition`` raise :class:`ReplicationError` as if the connection
+    died. No-op unless armed via :func:`install_net_faults`."""
+    inj = _NET_INJECTOR
+    if inj is None:
+        return
+    kind = inj.next_fault()
+    if kind is None:
+        return
+    NET_FAULTS_INJECTED_TOTAL.labels(kind=kind, op=op).inc()
+    if kind == "net-delay":
+        inj._sleep(inj.delay_seconds)
+        return
+    raise ReplicationError(f"injected {kind} on {op!r} request", op=op)

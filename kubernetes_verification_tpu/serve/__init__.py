@@ -24,16 +24,28 @@ incremental engines were built for — BASELINE config 5):
   checkpoint substrate: :class:`FollowerService` (checkpoint bootstrap,
   exactly-once WAL tailing, staleness-bounded reads) and
   :class:`LeaseFile` (the atomic heartbeat whose monotonic epoch fences
-  a deposed leader after a breaker-gated promotion).
+  a deposed leader after a breaker-gated promotion);
+* ``transport`` — the same replication over the network (stdlib HTTP):
+  :class:`ReplicationServer` serves WAL ranges + checkpoint chunks,
+  :class:`ReplicationClient` fetches them with timeouts / bounded
+  jittered retries / checksums through the ``net_fault`` chaos seam, and
+  :class:`RemoteEventSource` keeps a byte-replica WAL mirror so every
+  read-side fencing guarantee holds verbatim off-host
+  (:func:`bootstrap_from_leader` is the snapshot-shipping bootstrap);
+* ``lb`` — :class:`QueryLoadBalancer`: staleness-weighted routing of
+  query batches across replicas, ``StaleReadError`` retried against the
+  leader, unreachable replicas ejected via per-replica breakers.
 
-CLI: ``kv-tpu serve`` (``--follow DIR`` for a replica) / ``kv-tpu query``
-(``--batch FILE.jsonl`` for the vectorized path) / ``kv-tpu recover``;
-benchmarks: ``bench.py --mode serve`` / ``--mode query`` / ``--mode
-replicate``; metric families: ``kvtpu_serve_*``, ``kvtpu_query_cache_*``,
-``kvtpu_query_batch_size``, ``kvtpu_checkpoints_total``,
-``kvtpu_recoveries_total``, ``kvtpu_wal_truncations_total``,
-``kvtpu_replica_lag_seconds``/``_seq``, ``kvtpu_promotions_total``,
-``kvtpu_stale_reads_total``.
+CLI: ``kv-tpu serve`` (``--follow DIR`` for a replica, ``--leader URL``
+for a networked one) / ``kv-tpu query`` (``--batch FILE.jsonl`` for the
+vectorized path) / ``kv-tpu lb`` / ``kv-tpu recover``; benchmarks:
+``bench.py --mode serve`` / ``--mode query`` / ``--mode replicate``
+(``--net`` for the networked fleet); metric families: ``kvtpu_serve_*``,
+``kvtpu_query_cache_*``, ``kvtpu_query_batch_size``,
+``kvtpu_checkpoints_total``, ``kvtpu_recoveries_total``,
+``kvtpu_wal_truncations_total``, ``kvtpu_replica_lag_seconds``/``_seq``,
+``kvtpu_promotions_total``, ``kvtpu_stale_reads_total``,
+``kvtpu_net_*``, ``kvtpu_lb_*``.
 """
 from .durability import (
     CheckpointInfo,
@@ -61,12 +73,19 @@ from .events import (
     scan_wal,
     write_events,
 )
+from .lb import QueryLoadBalancer
 from .replication import (
     FollowerService,
     Lease,
     LeaseFile,
     ReplicaLag,
     lease_path,
+)
+from .transport import (
+    RemoteEventSource,
+    ReplicationClient,
+    ReplicationServer,
+    bootstrap_from_leader,
 )
 from .queries import (
     Assertion,
@@ -111,6 +130,11 @@ __all__ = [
     "LeaseFile",
     "ReplicaLag",
     "lease_path",
+    "ReplicationServer",
+    "ReplicationClient",
+    "RemoteEventSource",
+    "bootstrap_from_leader",
+    "QueryLoadBalancer",
     "QueryCache",
     "QueryEngine",
     "PodSelector",

@@ -47,6 +47,7 @@ from __future__ import annotations
 
 import json
 import os
+import random
 import time
 import zlib
 from dataclasses import dataclass, field
@@ -488,6 +489,8 @@ class EventSource:
         batch_size: int = 256,
         sleep: Callable[[float], None] = time.sleep,
         max_poll_interval: Optional[float] = None,
+        jitter: float = 0.1,
+        seed: Optional[int] = None,
     ) -> Iterator[List[Event]]:
         """Yield batches of newly appended events until the stream goes
         quiet for ``idle_timeout`` seconds (None = tail forever).
@@ -497,12 +500,20 @@ class EventSource:
         (default ``32 × poll_interval``, capped at 1s and never below
         ``poll_interval``) — and snaps back to ``poll_interval`` the
         moment a drain yields events, so a quiet cluster stops burning CPU
-        without slowing catch-up on a busy one. ``idle_timeout`` (when
-        set) also caps a single sleep, so the timeout is still honoured
-        promptly."""
+        without slowing catch-up on a busy one. Each sleep is stretched by
+        ``U[0, jitter)`` (same law as ``RetryPolicy``): co-started
+        followers tailing one leader would otherwise double in lockstep
+        and poll it in synchronized bursts. The draw comes from a PRNG
+        seeded by ``seed`` when given (tests), else decorrelated per
+        process and path. ``idle_timeout`` (when set) also caps a single
+        sleep, so the timeout is still honoured promptly."""
         if max_poll_interval is None:
             max_poll_interval = max(poll_interval, min(1.0, poll_interval * 32))
         max_poll_interval = max(max_poll_interval, poll_interval)
+        rng = random.Random(
+            seed if seed is not None
+            else (os.getpid() << 16) ^ zlib.crc32(self.path.encode())
+        )
         interval = poll_interval
         last_growth = time.monotonic()
         while True:
@@ -518,7 +529,7 @@ class EventSource:
                 and time.monotonic() - last_growth >= idle_timeout
             ):
                 return
-            delay = interval
+            delay = interval * (1.0 + rng.random() * jitter)
             if idle_timeout is not None:
                 delay = min(delay, idle_timeout)
             sleep(delay)

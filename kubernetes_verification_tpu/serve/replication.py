@@ -69,6 +69,7 @@ from ..resilience.breaker import OPEN, CircuitBreaker
 from ..resilience.errors import (
     FencedError,
     PersistError,
+    ReplicationError,
     ServeError,
     StaleReadError,
 )
@@ -301,6 +302,8 @@ class FollowerService:
         breaker_cooldown: float = 30.0,
         batch_size: int = 256,
         clock: Callable[[], float] = time.time,
+        leader_url: Optional[str] = None,
+        transport_timeout: float = 2.0,
     ) -> None:
         self.directory = directory
         self.replica = replica
@@ -318,6 +321,24 @@ class FollowerService:
         #: the fenced WalWriter a successful :meth:`promote` leaves behind
         self.writer: Optional[WalWriter] = None
         self.applied = 0
+        #: networked mode (``leader_url``): the leader is on another host,
+        #: reached over serve.transport; the local ``directory`` holds the
+        #: shipped checkpoint mirror, the WAL byte-mirror, and the standby
+        #: lease/claim files this replica arbitrates promotion with
+        self.leader_url = leader_url
+        self.client = None
+        self._last_remote_lease: Optional[dict] = None
+        if leader_url is not None:
+            # deferred import: transport imports this module at top level
+            from .transport import ReplicationClient, bootstrap_from_leader
+
+            os.makedirs(directory, exist_ok=True)
+            self.client = ReplicationClient(
+                leader_url, timeout=transport_timeout
+            )
+            bootstrap_from_leader(self.client, directory)
+            if log_path is None:
+                log_path = os.path.join(directory, "wal-mirror.jsonl")
 
         recovery = RecoveryManager(directory).recover(
             log_path=log_path,
@@ -344,6 +365,15 @@ class FollowerService:
             self.log_path = log_path
             self.source = EventSource(
                 log_path, start_after_seq=recovery.last_seq
+            )
+        if self.client is not None:
+            from .transport import RemoteEventSource
+
+            # wrap the positioned source: the mirror file grows by
+            # fetching the leader's raw WAL bytes, every read-side
+            # guarantee stays with the inner EventSource
+            self.source = RemoteEventSource(
+                self.client, self.log_path, inner=self.source, clock=clock
             )
         #: leader-probe breaker: consecutive expired-lease observations
         #: must exceed the threshold before failover even becomes
@@ -379,15 +409,38 @@ class FollowerService:
         return chunk.count(b"\n")
 
     def lag(self) -> ReplicaLag:
-        """Measure (don't repair) how far we trail the leader's tip."""
+        """Measure (don't repair) how far we trail the leader's tip.
+
+        A networked follower's mirror stops growing the moment the wire
+        does, so "nothing pending locally" is only freshness while the
+        leader is in contact: past a grace of ``lease_ttl`` since the
+        last successful fetch, staleness accrues from the last moment we
+        were both caught up *and* in contact — a partitioned replica's
+        lag grows instead of lying at zero."""
         pending = self._pending_records()
         now = self._clock()
         if pending == 0:
-            self._caught_up_at = now
-            return ReplicaLag(seconds=0.0, seq=0)
+            if self._remote_contact_fresh(now):
+                self._caught_up_at = now
+                return ReplicaLag(seconds=0.0, seq=0)
+            return ReplicaLag(
+                seconds=max(0.0, now - self._caught_up_at), seq=0
+            )
         return ReplicaLag(
             seconds=max(0.0, now - self._caught_up_at), seq=pending
         )
+
+    def _remote_contact_fresh(self, now: float) -> bool:
+        """Shared-filesystem followers read the leader's WAL directly —
+        always "in contact". A networked one is fresh only within
+        ``lease_ttl`` of its last successful fetch (or while it *is* the
+        leader, having promoted)."""
+        if self.client is None or self.promoted:
+            return True
+        last = getattr(self.source, "last_contact", None)
+        if last is None:
+            return False
+        return now - last <= self.lease_ttl
 
     def _set_lag_gauges(self, lag: ReplicaLag) -> None:
         REPLICA_LAG_SECONDS.labels(replica=self.replica).set(lag.seconds)
@@ -439,13 +492,18 @@ class FollowerService:
         if not over:
             return self.query
         if self.proxy_stale:
-            STALE_READS_TOTAL.labels(outcome="proxied").inc()
             if self.leader_proxy is not None:
+                STALE_READS_TOTAL.labels(outcome="proxied").inc()
                 return self.leader_proxy
-            # shared-filesystem substrate: the WAL tip *is* the leader's
-            # committed state — forcing a full catch-up is the proxy
+            # the WAL tip *is* the leader's committed state — on the
+            # shared filesystem directly, over the network only when the
+            # fetch actually reached the leader — so a full catch-up is
+            # the proxy; a partitioned networked follower falls through
+            # to the typed rejection instead of serving stale as fresh
             self.catch_up()
-            return self.query
+            if self._remote_contact_fresh(self._clock()):
+                STALE_READS_TOTAL.labels(outcome="proxied").inc()
+                return self.query
         STALE_READS_TOTAL.labels(outcome="rejected").inc()
         raise StaleReadError(
             f"replica {self.replica!r} is {lag.seconds:.3f}s / {lag.seq} "
@@ -486,7 +544,17 @@ class FollowerService:
     def heartbeat(self) -> bool:
         """One leader-liveness probe: feed the breaker, raise our fencing
         floor where that is safe, and return True when the leader looked
-        alive."""
+        alive.
+
+        A shared-filesystem follower reads ``leader.lease`` directly. A
+        networked one probes the leader's ``/v1/tip`` — liveness is
+        "reachable AND its served lease is unexpired by its own clock"
+        (wall clocks don't compare across hosts, so the leader judges its
+        own expiry) — and *also* honours the local standby lease: a
+        co-located peer that promoted is a live leader too, so the
+        breaker must not open against a healthy new reign."""
+        if self.client is not None:
+            return self._heartbeat_remote()
         try:
             cur = self.lease.read()
         except PersistError:
@@ -494,20 +562,58 @@ class FollowerService:
         now = self._clock()
         alive = cur is not None and not cur.expired(now)
         if cur is not None:
-            # Raise the read-side floor to the lease epoch ONLY once our
-            # applied stream has reached that reign: a follower still
-            # behind the promotion point owes itself the previous reign's
-            # committed records, and a floor above them would silently
-            # fence-drop committed state. Until then the EventSource's
-            # epoch-regression fencing alone drops a deposed writer's
-            # strays (an old epoch after a newer one).
-            if (
-                (self.source.min_epoch is None
-                 or cur.epoch > self.source.min_epoch)
-                and self.source.last_epoch is not None
-                and self.source.last_epoch >= cur.epoch
-            ):
-                self.source.min_epoch = cur.epoch
+            self._raise_epoch_floor(cur.epoch)
+        if alive:
+            self.probe.record_success()
+        else:
+            self.probe.record_failure()
+        return alive
+
+    def _raise_epoch_floor(self, epoch: int) -> None:
+        """Raise the read-side floor to the lease epoch ONLY once our
+        applied stream has reached that reign: a follower still behind
+        the promotion point owes itself the previous reign's committed
+        records, and a floor above them would silently fence-drop
+        committed state. Until then the EventSource's epoch-regression
+        fencing alone drops a deposed writer's strays (an old epoch after
+        a newer one)."""
+        if (
+            (self.source.min_epoch is None or epoch > self.source.min_epoch)
+            and self.source.last_epoch is not None
+            and self.source.last_epoch >= epoch
+        ):
+            self.source.min_epoch = epoch
+
+    def _heartbeat_remote(self) -> bool:
+        lease_d = None
+        try:
+            tip = self.client.tip()
+        except ReplicationError:
+            reachable = False
+        else:
+            reachable = True
+            lease_d = tip.get("lease")
+            self._last_remote_lease = lease_d
+        alive = bool(
+            reachable
+            and lease_d
+            and lease_d.get("present")
+            and not lease_d.get("expired")
+        )
+        epoch: Optional[int] = None
+        if lease_d and lease_d.get("present") and "epoch" in lease_d:
+            epoch = int(lease_d["epoch"])
+        # the local standby lease: a promoted peer's reign counts too
+        try:
+            local = self.lease.read()
+        except PersistError:
+            local = None
+        if local is not None:
+            if not local.expired(self._clock()):
+                alive = True
+            epoch = local.epoch if epoch is None else max(epoch, local.epoch)
+        if epoch is not None:
+            self._raise_epoch_floor(epoch)
         if alive:
             self.probe.record_success()
         else:
@@ -605,6 +711,14 @@ class FollowerService:
         except PersistError:
             cur = None  # bit rot: fall back to the highest applied epoch
         prior = cur.epoch if cur is not None else (self.source.last_epoch or 0)
+        if self.client is not None:
+            # a networked follower's local standby lease starts empty: the
+            # reign to supersede is whatever the remote leader last served
+            # us (or stamped into records we applied), never below it
+            prior = max(prior, self.source.last_epoch or 0)
+            remote = self._last_remote_lease
+            if remote and remote.get("present") and "epoch" in remote:
+                prior = max(prior, int(remote["epoch"]))
         target_epoch = prior + 1
         if not self._claim(target_epoch):
             log_event(
@@ -625,6 +739,11 @@ class FollowerService:
         self.promoted = True
         self.epoch = target_epoch
         self.source.min_epoch = target_epoch
+        if self.client is not None and hasattr(self.source, "detach"):
+            # our mirror is the WAL of record now: appending a deposed
+            # leader's bytes after our own higher-epoch records would
+            # hand scan_wal an epoch regression on the next open
+            self.source.detach()
         self.service.read_only = False
         PROMOTIONS_TOTAL.labels(replica=self.replica).inc()
         log_event(
@@ -636,6 +755,36 @@ class FollowerService:
         )
         return self.writer
 
+    def repoint(self, leader_url: str, *, timeout: Optional[float] = None):
+        """Follow a *new* leader after a failover: drop mirror bytes past
+        our consumed prefix (unapplied bytes fetched from the old leader
+        may not exist on the new one) and resume fetching from there.
+
+        Only sound when our applied prefix is a prefix of the new
+        leader's log — a replica that applied records the new leader
+        never saw must re-bootstrap instead (the transport raises
+        :class:`ReplicationError` on the shrunken-log shape it can
+        detect; the README failure matrix covers the rest)."""
+        if self.client is None:
+            raise ServeError(
+                f"replica {self.replica!r} is not networked — repoint() "
+                "needs a follower constructed with leader_url="
+            )
+        from .transport import ReplicationClient
+
+        client = ReplicationClient(
+            leader_url,
+            timeout=timeout if timeout is not None else self.client.timeout,
+        )
+        self.source.truncate_unconsumed()
+        self.source.set_client(client)
+        self.client = client
+        self.leader_url = leader_url
+        self._last_remote_lease = None
+        log_event(
+            "follower_repoint", replica=self.replica, leader_url=leader_url
+        )
+
     # ------------------------------------------------------------------ misc
     @property
     def generation(self) -> int:
@@ -644,7 +793,7 @@ class FollowerService:
     def describe(self) -> dict:
         """One status dict (CLI summaries, tests)."""
         lag = self.lag()
-        return {
+        out = {
             "replica": self.replica,
             "directory": self.directory,
             "log_path": self.log_path,
@@ -657,3 +806,11 @@ class FollowerService:
             "breaker": self.probe.state,
             "outcome": self.recovery.outcome,
         }
+        if self.client is not None:
+            err = getattr(self.source, "last_error", None)
+            out.update(
+                leader_url=self.leader_url,
+                last_contact=getattr(self.source, "last_contact", None),
+                transport_error=str(err) if err is not None else None,
+            )
+        return out

@@ -217,12 +217,41 @@ def test_tail_backoff_doubles_and_caps(tmp_path, churn):
         src.tail(
             poll_interval=0.01, max_poll_interval=0.05,
             idle_timeout=0.25, batch_size=64, sleep=sleeps.append,
+            jitter=0.0,
         )
     )
     assert sum(len(b) for b in batches) == 3
-    # idle polls back off exponentially from the base interval to the cap
+    # jitter off: idle polls back off exponentially from the base
+    # interval to the cap, exactly
     assert sleeps[:4] == [0.01, 0.02, 0.04, 0.05]
     assert all(s <= 0.05 for s in sleeps)
+
+
+def test_tail_backoff_jitter_bounded_and_decorrelated(tmp_path, churn):
+    """Default jitter stretches each idle sleep by U[0, 10%) — bounded
+    within [base, base*1.1) at every step, still capped, and two
+    followers seeded differently don't poll in phase."""
+    _, events, _ = churn
+    log = str(tmp_path / "wal.jsonl")
+    WalWriter(log).append(events[:3])
+
+    def _sleeps(seed):
+        sleeps = []
+        src = EventSource(log)
+        list(
+            src.tail(
+                poll_interval=0.01, max_poll_interval=0.05,
+                idle_timeout=0.25, batch_size=64, sleep=sleeps.append,
+                seed=seed,
+            )
+        )
+        return sleeps
+
+    a = _sleeps(1)
+    expected = [0.01, 0.02, 0.04, 0.05]
+    for s, base in zip(a, expected + [0.05] * len(a)):
+        assert base <= s < base * 1.1 + 1e-12
+    assert a != _sleeps(2)  # different seeds, different phase
 
 
 # -------------------------------------------------------------------- lease

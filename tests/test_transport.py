@@ -1,0 +1,996 @@
+"""Networked replication: the WAL/snapshot wire protocol
+(:class:`ReplicationServer` / :class:`ReplicationClient`), snapshot-
+shipping bootstrap with its commit-point discipline, the byte-replica
+:class:`RemoteEventSource` mirror (crc/epoch/seq fencing unchanged over
+the wire), networked :class:`FollowerService` staleness + failover, the
+``net-drop``/``net-delay``/``net-partition`` fault seam, the
+staleness-weighted :class:`QueryLoadBalancer`, the ``kv-tpu lb`` /
+``serve --leader`` / ``recover`` CLI surface, the bench-gate entries for
+the networked series, and the two-host-simulated SIGKILL chaos run."""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import kubernetes_verification_tpu as kv
+from kubernetes_verification_tpu.cli import main
+from kubernetes_verification_tpu.harness.generate import (
+    GeneratorConfig,
+    random_cluster,
+    random_event_stream,
+)
+from kubernetes_verification_tpu.observe import REGISTRY
+from kubernetes_verification_tpu.observe.history import _direction
+from kubernetes_verification_tpu.observe.metrics import REQUIRED_FAMILIES
+from kubernetes_verification_tpu.resilience import (
+    EXIT_OK,
+    EXIT_VIOLATIONS,
+    ConfigError,
+    StaleReadError,
+)
+from kubernetes_verification_tpu.resilience.breaker import CLOSED, OPEN
+from kubernetes_verification_tpu.resilience.errors import ReplicationError
+from kubernetes_verification_tpu.resilience.faults import (
+    clear_net_faults,
+    heal_net_partition,
+    install_net_faults,
+    net_fault,
+    parse_fault_spec,
+    register_faulty,
+)
+from kubernetes_verification_tpu.serve import (
+    CheckpointManager,
+    EventSource,
+    FollowerService,
+    LeaseFile,
+    QueryLoadBalancer,
+    RemoteEventSource,
+    ReplicationClient,
+    ReplicationServer,
+    UpdatePodLabels,
+    VerificationService,
+    WalWriter,
+    bootstrap_from_leader,
+    encode_event,
+    scan_wal,
+)
+from kubernetes_verification_tpu.serve.durability import (
+    _tree_digest,
+    load_manifest,
+)
+from kubernetes_verification_tpu.serve.transport import wal_offset_after_seq
+
+CHILD = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "transport_child.py"
+)
+
+_NOSLEEP = lambda _s: None  # noqa: E731 — retry backoff off in error-path tests
+
+
+def _counter(name, key):
+    return REGISTRY.dump()["counters"].get(name, {}).get(key, 0.0)
+
+
+class Clock:
+    """Injectable wall clock. Starts at the REAL time.time() — Lease
+    timestamps are wall-clock, so a fake below real time never expires
+    anything written with the real clock."""
+
+    def __init__(self):
+        self.t = time.time()
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+@pytest.fixture(autouse=True)
+def _no_net_faults():
+    """Every test starts and ends with the process-global net-fault
+    injector disarmed (it is shared by every client in the process)."""
+    clear_net_faults()
+    yield
+    clear_net_faults()
+
+
+@pytest.fixture(scope="module")
+def churn():
+    cluster = random_cluster(
+        GeneratorConfig(
+            n_pods=24, n_policies=10, n_namespaces=3, seed=7,
+            p_ipblock_peer=0.0, min_selector_labels=1,
+        )
+    )
+    events = random_event_stream(cluster, n_events=120, seed=3)
+    cfg = kv.VerifyConfig(backend="cpu", compute_ports=False)
+    return cluster, events, cfg
+
+
+def _reach(svc):
+    return np.asarray(svc.reach())
+
+
+def _leader_dir(tmp_path, churn, *, ttl=60.0, ck_at=60, clock=time.time):
+    """Write a leader's on-disk footprint: epoch-1 WAL, one mid-stream
+    checkpoint, and a renewed lease. Returns (log, ckdir, leader svc)."""
+    cluster, events, cfg = churn
+    log = str(tmp_path / "events.jsonl")
+    ckdir = str(tmp_path / "ck")
+    os.makedirs(ckdir, exist_ok=True)
+    lease = LeaseFile(ckdir, clock=clock)
+    lease.acquire("leader-0", ttl=ttl)
+    svc = VerificationService(cluster, cfg)
+    cm = CheckpointManager(ckdir)
+    writer = WalWriter(log, epoch=1, lease=lease)
+    src = EventSource(log)
+    writer.append(events[:ck_at])
+    for b in src.batches(64):
+        svc.apply(b)
+    cm.checkpoint(
+        svc.engine, log_path=log, log_offset=src.offset, last_seq=src.last_seq
+    )
+    writer.append(events[ck_at:])
+    for b in src.batches(64):
+        svc.apply(b)
+    writer.close()
+    lease.renew("leader-0", 1, ttl)
+    return log, ckdir, svc
+
+
+def _relabel(svc, k):
+    """An idempotent-safe churn event: flip one label on an existing pod."""
+    pods = svc.engine.pods
+    p = pods[k % len(pods)]
+    labels = dict(p.labels)
+    labels["churn"] = str(k)
+    return UpdatePodLabels(namespace=p.namespace, pod=p.name, labels=labels)
+
+
+# ------------------------------------------------------------ wire protocol
+def test_wal_offset_after_seq_semantics(tmp_path, churn):
+    _, events, _ = churn
+    log = str(tmp_path / "wal.jsonl")
+    recs = [encode_event(events[i], seq=i, epoch=1) + "\n" for i in range(3)]
+    with open(log, "w") as fh:
+        fh.writelines(recs)
+    assert wal_offset_after_seq(log, -1) == 0
+    assert wal_offset_after_seq(log, 1) == len(recs[0]) + len(recs[1])
+    full = sum(len(r) for r in recs)
+    assert wal_offset_after_seq(log, 2) == full
+    assert wal_offset_after_seq(log, 99) == full  # past the tip: resume at end
+    assert wal_offset_after_seq(str(tmp_path / "absent.jsonl"), 0) == 0
+    # a legacy (unsequenced) record has no identity to dedup by: the scan
+    # stops BEFORE it so the record is resent rather than silently skipped
+    with open(log, "a") as fh:
+        fh.write(encode_event(events[3]) + "\n")
+        fh.write(encode_event(events[4], seq=3, epoch=1) + "\n")
+    assert wal_offset_after_seq(log, 99) == full
+    # an incomplete (unterminated) tail is a writer mid-flush: excluded
+    with open(log, "a") as fh:
+        fh.write('{"torn')
+    assert wal_offset_after_seq(log, 99) == full
+
+
+def test_server_tip_and_wal_ranges_round_trip(tmp_path, churn):
+    log, ckdir, _ = _leader_dir(tmp_path, churn)
+    with open(log, "rb") as fh:
+        raw = fh.read()
+    info = scan_wal(log)
+    with ReplicationServer(ckdir, log) as server:
+        client = ReplicationClient(server.url, sleep=_NOSLEEP)
+        tip = client.tip()
+        assert tip["size"] == len(raw)
+        assert tip["last_seq"] == info.last_seq
+        assert tip["last_epoch"] == 1
+        assert tip["lease"]["present"] and tip["lease"]["epoch"] == 1
+        assert isinstance(tip["server_time"], float)
+        # full range at offset 0 is the leader's bytes, verbatim
+        payload, meta = client.wal(offset=0)
+        assert payload == raw and meta == {"offset": 0, "size": len(raw)}
+        # a bounded range honours the limit; resuming at its end rejoins
+        head, _ = client.wal(offset=0, limit=100)
+        tail, _ = client.wal(offset=100)
+        assert head + tail == raw and len(head) == 100
+        # start_after_seq resume lands exactly where the offset scan says
+        cut = wal_offset_after_seq(log, 60)
+        payload, meta = client.wal(start_after_seq=60)
+        assert meta["offset"] == cut and payload == raw[cut:]
+        with pytest.raises(ReplicationError, match="exactly one"):
+            client.wal(offset=0, start_after_seq=0)
+    with pytest.raises(ReplicationError, match="http"):
+        ReplicationClient("https://sealed.example:9")
+
+
+def test_wal_crc_mismatch_is_a_typed_failure(tmp_path, churn):
+    log, ckdir, _ = _leader_dir(tmp_path, churn)
+    with ReplicationServer(ckdir, log) as server:
+        client = ReplicationClient(server.url, sleep=_NOSLEEP)
+        before = _counter("kvtpu_net_request_failures_total", "op=wal")
+        client._request = lambda op, path: (
+            b"corrupted-in-flight",
+            {"X-KVTPU-Offset": "0", "X-KVTPU-Size": "19",
+             "X-KVTPU-Crc32": "00000000"},
+        )
+        with pytest.raises(ReplicationError, match="corrupted"):
+            client.wal(offset=0)
+        assert (
+            _counter("kvtpu_net_request_failures_total", "op=wal")
+            == before + 1
+        )
+
+
+def test_manifest_and_chunked_fetch_file(tmp_path, churn):
+    log, ckdir, _ = _leader_dir(tmp_path, churn)
+    with ReplicationServer(ckdir, log) as server:
+        client = ReplicationClient(server.url, sleep=_NOSLEEP)
+        info = client.manifest()
+        gen = info["generation"]
+        assert gen is not None and info["manifest"]["generation"] == gen
+        assert info["files"], "a snapshot generation ships at least one file"
+        paths = [f["path"] for f in info["files"]]
+        assert paths == sorted(paths)
+        entry = info["files"][0]
+        src = os.path.join(
+            CheckpointManager(ckdir).snapshot_dir(gen), entry["path"]
+        )
+        with open(src, "rb") as fh:
+            want = fh.read()
+        dest = str(tmp_path / "fetched.bin")
+        # a 64-byte chunk size forces the multi-round-trip loop
+        got = client.fetch_file(
+            gen, entry["path"], dest,
+            expected_sha256=entry["sha256"], chunk_bytes=64,
+        )
+        assert got == entry["size"] == len(want)
+        with open(dest, "rb") as fh:
+            assert fh.read() == want
+        # a manifest-checksum mismatch refuses the file and leaves nothing
+        bad = str(tmp_path / "bad.bin")
+        with pytest.raises(ReplicationError, match="manifest checksum"):
+            client.fetch_file(
+                gen, entry["path"], bad, expected_sha256="0" * 64
+            )
+        assert not os.path.exists(bad) and not os.path.exists(bad + ".fetch")
+
+
+def test_checkpoint_chunk_traversal_is_refused(tmp_path, churn):
+    log, ckdir, _ = _leader_dir(tmp_path, churn)
+    with ReplicationServer(ckdir, log) as server:
+        client = ReplicationClient(server.url, sleep=_NOSLEEP)
+        gen = client.manifest()["generation"]
+        for rel in ("../leader.lease", "/etc/passwd", ""):
+            with pytest.raises(ReplicationError, match="HTTP 404"):
+                client._request(
+                    "file",
+                    f"/v1/checkpoint/file?generation={gen}&path={rel}",
+                )
+
+
+def test_client_retries_through_a_transient_drop(tmp_path, churn):
+    log, ckdir, _ = _leader_dir(tmp_path, churn)
+    sleeps = []
+    with ReplicationServer(ckdir, log) as server:
+        client = ReplicationClient(server.url, sleep=sleeps.append)
+        install_net_faults(parse_fault_spec("net-drop@0"))
+        before = _counter("kvtpu_net_request_failures_total", "op=tip")
+        tip = client.tip()  # first attempt dropped, the retry answers
+        assert tip["last_epoch"] == 1
+        assert (
+            _counter("kvtpu_net_request_failures_total", "op=tip")
+            == before + 1
+        )
+        # one backoff sleep, in the policy's jittered first-delay band
+        assert len(sleeps) == 1 and 0.05 <= sleeps[0] <= 0.055
+
+
+# ---------------------------------------------------------------- bootstrap
+def test_bootstrap_fetches_then_is_idempotent(tmp_path, churn):
+    log, ckdir, _ = _leader_dir(tmp_path, churn)
+    dest = str(tmp_path / "follower")
+    os.makedirs(dest)
+    with ReplicationServer(ckdir, log) as server:
+        client = ReplicationClient(server.url, sleep=_NOSLEEP)
+        out = bootstrap_from_leader(client, dest)
+        assert out["outcome"] == "fetched" and out["bytes"] > 0
+        gen = out["generation"]
+        cm = CheckpointManager(dest)
+        manifest = load_manifest(cm.manifest_path(gen))
+        assert _tree_digest(cm.snapshot_dir(gen)) == manifest["snapshot_digest"]
+        # the same generation again is a no-op: manifest presence commits
+        assert bootstrap_from_leader(client, dest)["outcome"] == "already-local"
+    # a leader with no checkpoint yet has nothing to ship
+    empty = str(tmp_path / "empty-ck")
+    os.makedirs(empty)
+    with ReplicationServer(empty, log) as server:
+        client = ReplicationClient(server.url, sleep=_NOSLEEP)
+        out = bootstrap_from_leader(client, str(tmp_path / "f2"))
+        assert out == {"outcome": "no-checkpoint", "generation": None}
+
+
+def test_bootstrap_partial_transfer_commits_nothing(tmp_path, churn):
+    """A partition mid-shipping (latched: the client's retries cannot
+    outrun it) must leave NO committed generation — the manifest is
+    written last, so the next attempt starts clean and succeeds."""
+    log, ckdir, _ = _leader_dir(tmp_path, churn)
+    dest = str(tmp_path / "follower")
+    os.makedirs(dest)
+    with ReplicationServer(ckdir, log) as server:
+        client = ReplicationClient(server.url, sleep=_NOSLEEP)
+        # request 0 is the manifest; the first file chunk (and every
+        # retry after it) dies mid-transfer
+        install_net_faults(parse_fault_spec("net-partition@1"))
+        with pytest.raises(ReplicationError):
+            bootstrap_from_leader(client, dest)
+        assert CheckpointManager(dest).generations() == []
+        heal_net_partition()
+        out = bootstrap_from_leader(client, dest)
+        assert out["outcome"] == "fetched"
+        gen = out["generation"]
+        cm = CheckpointManager(dest)
+        manifest = load_manifest(cm.manifest_path(gen))
+        assert _tree_digest(cm.snapshot_dir(gen)) == manifest["snapshot_digest"]
+
+
+# -------------------------------------------------------- remote event source
+def test_remote_event_source_mirrors_bit_for_bit(tmp_path, churn):
+    log, ckdir, _ = _leader_dir(tmp_path, churn)
+    mirror = str(tmp_path / "mirror.jsonl")
+    with ReplicationServer(ckdir, log) as server:
+        client = ReplicationClient(server.url, sleep=_NOSLEEP)
+        # a small fetch window forces multiple wire rounds per sync
+        src = RemoteEventSource(client, mirror, limit_bytes=512)
+        got = list(src.replay())
+    want = list(EventSource(log).replay())
+    assert got == want
+    with open(log, "rb") as a, open(mirror, "rb") as b:
+        assert a.read() == b.read()
+    info = scan_wal(log)
+    assert src.offset == os.path.getsize(log)  # mirror offsets ARE leader offsets
+    assert src.last_seq == info.last_seq and src.last_epoch == 1
+    assert src.fetched_bytes == os.path.getsize(log)
+    assert src.last_error is None and src.last_contact is not None
+
+
+def test_remote_event_source_enforces_epoch_floor_over_the_wire(tmp_path, churn):
+    _, events, _ = churn
+    log = str(tmp_path / "wal.jsonl")
+    with open(log, "w") as fh:
+        for i, epoch in enumerate((1, 1, 2, 2)):
+            fh.write(encode_event(events[i], seq=i, epoch=epoch) + "\n")
+    ckdir = str(tmp_path / "ck")
+    os.makedirs(ckdir)
+    with ReplicationServer(ckdir, log) as server:
+        client = ReplicationClient(server.url, sleep=_NOSLEEP)
+        src = RemoteEventSource(
+            client, str(tmp_path / "mirror.jsonl"), min_epoch=2
+        )
+        assert list(src.replay()) == events[2:4]
+    assert src.fenced == 2 and src.last_epoch == 2
+
+
+def test_remote_event_source_handles_leader_log_shrink(tmp_path, churn):
+    _, events, _ = churn
+    log = str(tmp_path / "wal.jsonl")
+    w = WalWriter(log, epoch=1)
+    w.append(events[:8])
+    w.close()
+    keep = wal_offset_after_seq(log, 3)  # first four records survive
+    ckdir = str(tmp_path / "ck")
+    os.makedirs(ckdir)
+    with ReplicationServer(ckdir, log) as server:
+        client = ReplicationClient(server.url, sleep=_NOSLEEP)
+        # (a) shrink ABOVE our applied prefix: fetched-but-unapplied
+        # surplus is dropped and the tail resumes — no divergence
+        src = RemoteEventSource(client, str(tmp_path / "m1.jsonl"))
+        src._sync()  # mirror holds all 8 records; none applied yet
+        assert os.path.getsize(src.mirror_path) == os.path.getsize(log)
+        with open(log, "rb+") as fh:
+            fh.truncate(keep)
+        # the sync that notices the shrink drops the surplus; the next
+        # one refetches the surviving bytes and the tail resumes
+        assert list(src.replay()) == []
+        assert os.path.getsize(src.mirror_path) == 0
+        assert list(src.replay()) == events[:4]
+        assert os.path.getsize(src.mirror_path) == keep
+        assert src.last_error is None
+        # (b) shrink BELOW an applied prefix is divergent history: the
+        # error is recorded (stale serving continues), telling the
+        # operator this follower must re-bootstrap
+        src2 = RemoteEventSource(client, str(tmp_path / "m2.jsonl"))
+        assert list(src2.replay()) == events[:4]
+        with open(log, "rb+") as fh:
+            fh.truncate(wal_offset_after_seq(log, 1))
+        assert list(src2.replay()) == []
+        assert src2.last_error is not None
+        assert "re-bootstrap" in str(src2.last_error)
+
+
+def test_remote_event_source_swallows_wire_failures(tmp_path, churn):
+    log, ckdir, _ = _leader_dir(tmp_path, churn)
+    with ReplicationServer(ckdir, log) as server:
+        client = ReplicationClient(server.url, sleep=_NOSLEEP)
+        src = RemoteEventSource(client, str(tmp_path / "mirror.jsonl"))
+        install_net_faults(parse_fault_spec("net-partition@0"))
+        assert list(src.replay()) == []  # partitioned: stale, not dead
+        assert isinstance(src.last_error, ReplicationError)
+        clear_net_faults()
+        assert list(src.replay()) == list(EventSource(log).replay())
+        assert src.last_error is None
+
+
+# ------------------------------------------------------- networked follower
+def test_networked_follower_bootstraps_and_converges(tmp_path, churn):
+    log, ckdir, leader = _leader_dir(tmp_path, churn)
+    fdir = str(tmp_path / "net-follower")
+    with ReplicationServer(ckdir, log) as server:
+        f = FollowerService(fdir, leader_url=server.url, replica="net-0")
+        assert f.recovery.outcome == "newest"
+        assert f.log_path == os.path.join(fdir, "wal-mirror.jsonl")
+        f.catch_up()
+        assert f.lag().caught_up
+        np.testing.assert_array_equal(_reach(f.service), _reach(leader))
+        assert f.service.read_only
+        d = f.describe()
+        assert d["leader_url"] == server.url
+        assert d["last_contact"] is not None and d["transport_error"] is None
+        # the mirror is a byte replica of the leader's WAL
+        with open(log, "rb") as a, open(f.log_path, "rb") as b:
+            assert a.read() == b.read()
+
+
+def test_networked_follower_staleness_grows_under_partition(tmp_path, churn):
+    clock = Clock()
+    log, ckdir, leader = _leader_dir(tmp_path, churn, clock=clock)
+    fdir = str(tmp_path / "net-follower")
+    pods = leader.engine.pods
+    a = f"{pods[0].namespace}/{pods[0].name}"
+    with ReplicationServer(ckdir, log, clock=clock) as server:
+        f = FollowerService(
+            fdir, leader_url=server.url, replica="net-1",
+            max_lag_seconds=2.0, proxy_stale=True, lease_ttl=1.0, clock=clock,
+        )
+        f.catch_up()
+        assert f.lag().seconds == 0.0
+        # @0 so the latch does not re-arm after the heal below (a bare
+        # net-partition rule fires on every request)
+        install_net_faults(parse_fault_spec("net-partition@0"))
+        clock.advance(5.0)
+        f.poll()  # the fetch fails and is swallowed; the mirror is stale
+        lag = f.lag()
+        assert lag.seconds >= 4.0 and lag.seq == 0
+        before = _counter("kvtpu_stale_reads_total", "outcome=rejected")
+        # proxy_stale cannot proxy through a partition: the catch-up never
+        # reached the leader, so the read is REJECTED, not served as fresh
+        with pytest.raises(StaleReadError) as ei:
+            f.can_reach(a, a)
+        assert ei.value.lag_seconds >= 4.0
+        assert (
+            _counter("kvtpu_stale_reads_total", "outcome=rejected")
+            == before + 1
+        )
+        heal_net_partition()
+        f.poll()  # contact restored: freshness snaps back
+        assert f.lag().seconds == 0.0
+        assert f.can_reach(a, a) is not None
+        assert f.describe()["transport_error"] is None
+
+
+def test_networked_failover_elects_one_and_fences_strays(tmp_path, churn):
+    """Two networked followers share a standby directory (their election
+    medium) with separate mirrors. The leader dies; exactly one follower
+    wins the local claim + lease CAS; the loser repoints at the winner
+    and converges; a deposed epoch-1 stray record is fenced by every
+    surviving replica — the shared-fs fencing story, unchanged over the
+    wire."""
+    log, ckdir, _ = _leader_dir(tmp_path, churn, ttl=0.3)
+    standby = str(tmp_path / "standby")
+    server = ReplicationServer(ckdir, log)
+    server.start()
+    mk = lambda name, mirror: FollowerService(
+        standby, log_path=str(tmp_path / mirror), replica=name,
+        leader_url=server.url, breaker_threshold=2, lease_ttl=5.0,
+    )
+    fa, fb = mk("net-a", "mirror-a.jsonl"), mk("net-b", "mirror-b.jsonl")
+    for f in (fa, fb):
+        f.catch_up()
+        assert f.heartbeat()  # capture the remote reign while it lives
+    server.close()
+    time.sleep(0.4)  # the dead leader's (remote) lease ttl runs out
+    for _ in range(2):
+        for f in (fa, fb):
+            f.heartbeat()
+    assert fa.probe.state == OPEN and fb.probe.state == OPEN
+    promoted = [f for f in (fa, fb) if f.maybe_promote()]
+    assert len(promoted) == 1, "exactly one follower must win the epoch"
+    winner = promoted[0]
+    loser = fb if winner is fa else fa
+    assert winner.epoch == 2 and winner.source.detached
+    assert winner.lease.read().holder == winner.replica
+    # the new reign writes to its own mirror — the WAL of record now
+    winner.writer.append([_relabel(winner.service, k) for k in range(3)])
+    winner.poll()
+    info = scan_wal(winner.log_path)
+    assert info.last_epoch == 2 and not info.torn
+    # the loser repoints at the winner and converges bit-for-bit
+    with ReplicationServer(standby, winner.log_path) as srv2:
+        loser.repoint(srv2.url)
+        loser.catch_up()
+        np.testing.assert_array_equal(
+            _reach(loser.service), _reach(winner.service)
+        )
+        # a deposed leader's stray epoch-1 record arrives after the
+        # epoch-2 reign began: every surviving replica fences it
+        stray = encode_event(
+            _relabel(winner.service, 99), seq=winner.source.last_seq + 1,
+            epoch=1,
+        )
+        with open(winner.log_path, "a") as fh:
+            fh.write(stray + "\n")
+        fenced_w, fenced_l = winner.source.fenced, loser.source.fenced
+        assert winner.poll() == 0
+        assert winner.source.fenced == fenced_w + 1
+        assert loser.catch_up() == 0
+        assert loser.source.fenced == fenced_l + 1
+    oracle = VerificationService(churn[0], churn[2])
+    for b in EventSource(winner.log_path).batches(256):
+        oracle.apply(b)
+    np.testing.assert_array_equal(_reach(winner.service), _reach(oracle))
+    np.testing.assert_array_equal(_reach(loser.service), _reach(oracle))
+
+
+# ----------------------------------------------------------- net fault seam
+def test_net_fault_grammar_and_backend_rejection():
+    kinds = [r.kind for r in parse_fault_spec(
+        "net-drop@1,net-delay%0.5,net-partition"
+    )]
+    assert kinds == ["net-drop", "net-delay", "net-partition"]
+    with pytest.raises(ConfigError, match="transport seam"):
+        register_faulty("cpu", parse_fault_spec("net-drop"))
+    with pytest.raises(ConfigError, match="no network fault rules"):
+        install_net_faults(parse_fault_spec("flaky"))
+
+
+def test_net_partition_latches_until_healed():
+    inj = install_net_faults(parse_fault_spec("net-partition@2"))
+    before = _counter(
+        "kvtpu_net_faults_injected_total", "kind=net-partition,op=tip"
+    )
+    net_fault("tip")
+    net_fault("tip")  # requests 0 and 1 pass
+    for _ in range(2):  # request 2 fires and LATCHES; 3 stays dead
+        with pytest.raises(ReplicationError, match="net-partition"):
+            net_fault("tip")
+    assert inj.partitioned
+    heal_net_partition()
+    net_fault("tip")  # healed: traffic flows again
+    assert (
+        _counter(
+            "kvtpu_net_faults_injected_total", "kind=net-partition,op=tip"
+        )
+        == before + 2
+    )
+    assert inj.injected["net-partition"] == 2
+
+
+def test_net_delay_sleeps_and_proceeds():
+    sleeps = []
+    install_net_faults(
+        parse_fault_spec("net-delay"), delay_seconds=0.07, sleep=sleeps.append
+    )
+    before = _counter(
+        "kvtpu_net_faults_injected_total", "kind=net-delay,op=wal"
+    )
+    net_fault("wal")  # delayed, not failed
+    assert sleeps == [0.07]
+    assert (
+        _counter("kvtpu_net_faults_injected_total", "kind=net-delay,op=wal")
+        == before + 1
+    )
+
+
+# ------------------------------------------------------------ load balancer
+class _StubLag:
+    def __init__(self, seconds):
+        self.seconds = seconds
+        self.seq = 0
+
+
+class _StubReplica:
+    """A FollowerService-shaped stand-in: a name, a lag, and a scripted
+    can_reach_batch outcome."""
+
+    def __init__(self, name, lag_seconds=0.0, raises=None):
+        self.replica = name
+        self.lag_seconds = lag_seconds
+        self.raises = raises
+        self.calls = 0
+
+    def lag(self):
+        return _StubLag(self.lag_seconds)
+
+    def can_reach_batch(self, probes):
+        self.calls += 1
+        if self.raises is not None:
+            raise self.raises
+        return np.ones(len(probes), dtype=bool)
+
+
+def test_lb_routes_by_staleness_weight_deterministically():
+    def build():
+        fresh = _StubReplica("fresh", 0.0)
+        laggy = _StubReplica("laggy", 60.0)
+        lb = QueryLoadBalancer([fresh, laggy], seed=11)
+        lb.dispatch([[("a", "b")]] * 40)
+        return lb
+
+    lb = build()
+    # weight 1/(0.05+lag): the fresh replica absorbs most traffic but the
+    # laggy one tapers instead of cliff-dropping to zero
+    assert lb.routed.get("fresh", 0) > lb.routed.get("laggy", 0)
+    assert lb.routed.get("fresh", 0) + lb.routed.get("laggy", 0) == 40
+    weights = {
+        r["replica"]: r["weight"] for r in lb.describe()["replicas"]
+    }
+    assert weights["fresh"] == pytest.approx(1 / 0.05)
+    assert weights["laggy"] == pytest.approx(1 / 60.05)
+    # seeded draw: the same fleet state routes identically every run
+    assert build().routed == lb.routed
+
+
+def test_lb_stale_read_retries_against_leader():
+    stale = _StubReplica("stale", raises=StaleReadError("past the bound"))
+    leader = _StubReplica("leader-proxy")
+    before = _counter("kvtpu_lb_stale_retries_total", "")
+    lb = QueryLoadBalancer([stale], leader=leader, seed=0)
+    answers, who = lb.can_reach_batch([("a", "b")])
+    assert who == "leader" and bool(answers[0])
+    assert lb.stale_retries == 1 and lb.ejections == 0
+    assert _counter("kvtpu_lb_stale_retries_total", "") == before + 1
+    # staleness is NOT a failure: the replica's breaker stays closed
+    assert lb.breakers["stale"].state == CLOSED
+    # with no leader wired, the typed error propagates to the caller
+    lb2 = QueryLoadBalancer(
+        [_StubReplica("stale", raises=StaleReadError("past the bound"))],
+        seed=0,
+    )
+    with pytest.raises(StaleReadError):
+        lb2.can_reach_batch([("a", "b")])
+
+
+def test_lb_ejects_unreachable_replica_via_breaker():
+    dead = _StubReplica(
+        "dead", raises=ReplicationError("connection refused", op="wal")
+    )
+    leader = _StubReplica("leader-proxy")
+    before = _counter("kvtpu_lb_ejections_total", "replica=dead")
+    lb = QueryLoadBalancer(
+        [dead], leader=leader, seed=0, breaker_threshold=2
+    )
+    for _ in range(3):
+        _, who = lb.can_reach_batch([("a", "b")])
+        assert who == "leader"
+    # two failures opened the breaker (one ejection); the third batch
+    # never even tried the dead replica
+    assert dead.calls == 2 and lb.ejections == 1
+    assert lb.breakers["dead"].state == OPEN
+    assert lb.pick_order() == []
+    assert _counter("kvtpu_lb_ejections_total", "replica=dead") == before + 1
+
+
+def test_lb_exhaustion_without_leader_is_typed():
+    dead = _StubReplica("dead", raises=ConnectionRefusedError("nope"))
+    lb = QueryLoadBalancer([dead], seed=0)
+    with pytest.raises(ReplicationError, match="no leader fallback") as ei:
+        lb.can_reach_batch([("a", "b")])
+    assert ei.value.op == "lb"
+    with pytest.raises(ReplicationError, match="at least one replica"):
+        QueryLoadBalancer([])
+
+
+# -------------------------------------------------------------- CLI surface
+def test_cli_lb_routes_batches_and_gates_denials(tmp_path, churn, capsys):
+    log, ckdir, leader = _leader_dir(tmp_path, churn)
+    pods = leader.engine.pods
+    reach = _reach(leader)
+    probes = [
+        {"src": f"{pods[i].namespace}/{pods[i].name}",
+         "dst": f"{pods[j].namespace}/{pods[j].name}"}
+        for i in range(4) for j in range(4)
+    ]
+    batch = str(tmp_path / "probes.jsonl")
+    with open(batch, "w") as fh:
+        fh.writelines(json.dumps(p) + "\n" for p in probes)
+    netdir = str(tmp_path / "net-replica")
+    with ReplicationServer(ckdir, log) as server:
+        rc = main([
+            "lb", "--replica", ckdir, "--replica", f"{netdir}={server.url}",
+            "--leader", ckdir, "--batch", batch, "--seed", "0", "--json",
+        ])
+        out = json.loads(capsys.readouterr().out.strip())
+        assert rc == EXIT_OK
+        (b,) = out["batches"]
+        assert b["n"] == 16 and b["replica"] in ("replica-0", "replica-1")
+        assert b["allowed"] == int(reach[:4, :4].sum())
+        names = [r["replica"] for r in out["lb"]["replicas"]]
+        assert names == ["replica-0", "replica-1"]
+        assert sum(r["routed"] for r in out["lb"]["replicas"]) == 1
+        # --check-denied maps denials onto the violations exit code
+        rc = main([
+            "lb", "--replica", ckdir, "--batch", batch,
+            "--check-denied", "--json",
+        ])
+        capsys.readouterr()
+        denied = 16 - int(reach[:4, :4].sum())
+        assert rc == (EXIT_VIOLATIONS if denied else EXIT_OK)
+
+
+def test_cli_serve_follow_rides_a_leader_url(tmp_path, churn, capsys):
+    log, ckdir, leader = _leader_dir(tmp_path, churn)
+    fdir = str(tmp_path / "net-follower")
+    with ReplicationServer(ckdir, log) as server:
+        rc = main([
+            "serve", "--follow", fdir, "--leader", server.url,
+            "--idle-timeout", "0.2", "--tail-poll", "0.01", "--json",
+        ])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == EXIT_OK
+    assert out["leader_url"] == server.url and not out["promoted"]
+    assert out["lag_seq"] == 0 and out["transport_error"] is None
+    assert out["reachable_pairs"] == int(_reach(leader).sum())
+
+
+def test_cli_recover_json_is_read_only_against_live_tail(
+    tmp_path, churn, capsys
+):
+    """Satellite: ``kv-tpu recover --json`` against a follower directory
+    mid-tail — lease/epoch status correct, nothing written, the tail
+    unharmed — while the leader's writer is still live."""
+    log, ckdir, leader = _leader_dir(tmp_path, churn)
+    lease = LeaseFile(ckdir)
+    lease.renew("leader-0", 1, 60.0)
+    writer = WalWriter(log, epoch=1, lease=lease)  # live mid-reign writer
+    writer.append([_relabel(leader, k) for k in range(5)])
+    fdir = str(tmp_path / "net-follower")
+    with ReplicationServer(ckdir, log) as server:
+        f = FollowerService(fdir, leader_url=server.url, replica="mid")
+        f.catch_up()
+        with open(f.log_path, "rb") as fh:
+            mirror_before = fh.read()
+        rc = main(["recover", fdir, "--events", f.log_path, "--json"])
+        report = json.loads(capsys.readouterr().out.strip())
+        assert rc == EXIT_OK
+        assert report["usable"] and report["generations"][0]["valid"]
+        assert report["wal"]["last_epoch"] == 1 and not report["wal"]["torn"]
+        assert report["wal"]["records"] == scan_wal(f.log_path).records
+        # a standby directory has no reign yet: no lease block to report
+        assert "lease" not in report
+        # the leader's own directory reports the live reign
+        rc = main(["recover", ckdir, "--events", log, "--json"])
+        report = json.loads(capsys.readouterr().out.strip())
+        assert rc == EXIT_OK
+        assert report["lease"]["present"] and report["lease"]["epoch"] == 1
+        assert report["lease"]["holder"] == "leader-0"
+        assert not report["lease"]["expired"]
+        # read-only: the mirror is untouched and the tail keeps working
+        with open(f.log_path, "rb") as fh:
+            assert fh.read() == mirror_before
+        writer.append([_relabel(leader, k) for k in range(5, 8)])
+        writer.close()
+        f.catch_up()
+    oracle = VerificationService(churn[0], churn[2])
+    for b in EventSource(log).batches(256):
+        oracle.apply(b)
+    np.testing.assert_array_equal(_reach(f.service), _reach(oracle))
+
+
+# ------------------------------------------------- observability / gating
+def test_net_metric_families_registered():
+    for fam in (
+        "kvtpu_net_requests_total",
+        "kvtpu_net_request_failures_total",
+        "kvtpu_net_bytes_total",
+        "kvtpu_net_faults_injected_total",
+        "kvtpu_lb_requests_total",
+        "kvtpu_lb_stale_retries_total",
+        "kvtpu_lb_ejections_total",
+    ):
+        assert fam in REQUIRED_FAMILIES
+
+
+def test_bench_gate_directions_for_net_series():
+    assert _direction("queries/s", "net_aggregate_queries_per_second") == "higher"
+    assert _direction(None, "net_aggregate_queries_per_second") == "higher"
+    assert _direction("s", "net_replica_lag_seconds") == "lower"
+    assert _direction("s", "replica_lag_spread_seconds") == "lower"
+    assert _direction(None, "replica_lag_spread_seconds") == "lower"
+
+
+def test_transport_and_lb_are_lint_clean_without_baseline():
+    """The new modules must satisfy the error-taxonomy and lease-atomic
+    rules outright — no new LINT_BASELINE.json entries ride this PR."""
+    from kubernetes_verification_tpu.analysis.baseline import (
+        default_baseline_path,
+        load_baseline,
+    )
+    from kubernetes_verification_tpu.analysis.core import run_package
+
+    new_files = ["serve/transport.py", "serve/lb.py"]
+    result = run_package(
+        rules=["error-taxonomy", "lease-atomic"], only=new_files
+    )
+    assert result.findings == []
+    assert result.grandfathered == []
+    baseline = load_baseline(default_baseline_path())
+    for rule, by_path in baseline.items():
+        for path in new_files:
+            assert path not in by_path, (rule, path)
+
+
+# ------------------------------------------------------------ chaos (slow)
+def _chaos_cluster(pods=24):
+    """MUST mirror transport_child.py's generator knobs exactly: the
+    from-scratch oracle replays the child's WAL against this cluster."""
+    cluster = random_cluster(
+        GeneratorConfig(
+            n_pods=pods, n_policies=24, n_namespaces=6, seed=7,
+            p_ipblock_peer=0.0, min_selector_labels=1,
+        )
+    )
+    return cluster, kv.VerifyConfig(backend="cpu", compute_ports=False)
+
+
+def _spawn_net_leader(workdir, kill, *, n_events=160):
+    """Start the networked leader child and wait for its published URL.
+    Returns (proc, url, ack_file) — create ack_file to arm the kill."""
+    url_file = os.path.join(str(workdir), "url.txt")
+    ack_file = os.path.join(str(workdir), "ack")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [
+            sys.executable, CHILD, "--workdir", str(workdir),
+            "--url-file", url_file, "--ack-file", ack_file,
+            "--kill", kill, "--n-events", str(n_events),
+        ],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    deadline = time.time() + 120
+    while not os.path.exists(url_file):
+        assert proc.poll() is None, proc.communicate()[1]
+        assert time.time() < deadline, "leader never published its URL"
+        time.sleep(0.02)
+    with open(url_file) as fh:
+        return proc, fh.read().strip(), ack_file
+
+
+@pytest.mark.slow
+def test_networked_failover_chaos_sigkill(tmp_path):
+    """The acceptance chaos, two-host-simulated: a leader process on its
+    own 'host' serves checkpoint + WAL over HTTP and is SIGKILLed inside
+    a lease renewal mid-stream; two networked followers (shared standby
+    directory, separate mirrors) detect the death through the wire,
+    elect EXACTLY one new leader (the most-caught-up replica first), the
+    loser repoints and converges bit-for-bit with a from-scratch
+    verification of the elected history."""
+    proc, url, ack_file = _spawn_net_leader(
+        tmp_path, "before-lease-renew@2", n_events=160
+    )
+    standby = str(tmp_path / "standby")
+    mk = lambda name, mirror: FollowerService(
+        standby, log_path=str(tmp_path / mirror), replica=name,
+        leader_url=url, breaker_threshold=2, lease_ttl=2.0,
+    )
+    followers = [mk("net-a", "mirror-a.jsonl"), mk("net-b", "mirror-b.jsonl")]
+    for f in followers:
+        f.catch_up()
+        assert f.heartbeat()  # the reign is live and observed
+        assert f.recovery.duplicates_skipped == 0
+    open(ack_file, "w").close()  # arm the kill; keep tailing until death
+    while proc.poll() is None:
+        for f in followers:
+            f.poll()
+        time.sleep(0.01)
+    assert proc.returncode == 137, proc.communicate()[1]
+    for _ in range(2):
+        for f in followers:
+            f.heartbeat()
+    assert all(f.probe.state == OPEN for f in followers)
+    # elect the most-caught-up replica: the loser's mirror is then a
+    # prefix of the winner's, so its repoint is sound by construction
+    order = sorted(
+        followers, key=lambda f: os.path.getsize(f.log_path), reverse=True
+    )
+    promoted = [f for f in order if f.maybe_promote()]
+    assert len(promoted) == 1, "exactly one promotion per incident"
+    winner = promoted[0]
+    loser = [f for f in followers if f is not winner][0]
+    assert winner.epoch == 2 and not loser.promoted
+    winner.writer.append([_relabel(winner.service, k) for k in range(3)])
+    winner.poll()
+    info = scan_wal(winner.log_path)
+    assert info.last_epoch == 2 and not info.torn
+    with ReplicationServer(standby, winner.log_path) as srv2:
+        loser.repoint(srv2.url)
+        loser.catch_up()
+    cluster, cfg = _chaos_cluster()
+    oracle = VerificationService(cluster, cfg)
+    survived = 0
+    for b in EventSource(winner.log_path).batches(256):
+        oracle.apply(b)
+        survived += len(b)
+    assert survived == info.records
+    np.testing.assert_array_equal(_reach(winner.service), _reach(oracle))
+    np.testing.assert_array_equal(_reach(loser.service), _reach(oracle))
+
+
+@pytest.mark.slow
+def test_partition_then_heal_converges_without_false_failover(
+    tmp_path, churn
+):
+    """A transient partition with the leader STILL ALIVE: the follower's
+    lag grows, the breaker gate keeps one missed heartbeat from turning
+    into a premature promotion, and healing converges bit-for-bit."""
+    log, ckdir, leader = _leader_dir(tmp_path, churn)
+    lease = LeaseFile(ckdir)
+    lease.renew("leader-0", 1, 60.0)
+    writer = WalWriter(log, epoch=1, lease=lease)
+    with ReplicationServer(ckdir, log) as server:
+        f = FollowerService(
+            fdir := str(tmp_path / "net-follower"), leader_url=server.url,
+            replica="part-0", breaker_threshold=2, lease_ttl=0.2,
+        )
+        f.catch_up()
+        install_net_faults(parse_fault_spec("net-partition@0"))
+        # the leader keeps committing on the far side of the partition
+        writer.append([_relabel(leader, k) for k in range(30)])
+        time.sleep(0.3)
+        f.poll()
+        assert f.lag().seconds > 0.0  # staleness accrues, it never lies at 0
+        # ONE failed heartbeat is jitter, not death: no promotion
+        assert not f.heartbeat()
+        assert f.probe.state == CLOSED and not f.maybe_promote()
+        heal_net_partition()
+        f.catch_up()
+        assert not f.promoted and f.lag().caught_up
+    writer.close()
+    oracle = VerificationService(churn[0], churn[2])
+    for b in EventSource(log).batches(256):
+        oracle.apply(b)
+    np.testing.assert_array_equal(_reach(f.service), _reach(oracle))
+    assert os.path.isdir(fdir)
+
+
+@pytest.mark.slow
+def test_slow_link_still_converges_bit_for_bit(tmp_path, churn):
+    """Every wire request delayed (net-delay%1.0) over a small fetch
+    window — many slow round trips — must still converge bit-for-bit."""
+    log, ckdir, leader = _leader_dir(tmp_path, churn)
+    sleeps = []
+    with ReplicationServer(ckdir, log) as server:
+        f = FollowerService(
+            str(tmp_path / "net-follower"), leader_url=server.url,
+            replica="slow-0",
+        )
+        f.source.limit_bytes = 512
+        install_net_faults(
+            parse_fault_spec("net-delay%1.0"),
+            delay_seconds=0.01, sleep=sleeps.append,
+        )
+        before = _counter(
+            "kvtpu_net_faults_injected_total", "kind=net-delay,op=wal"
+        )
+        f.catch_up()
+        assert f.lag().caught_up
+    assert len(sleeps) > 10, "the small window must force many slow rounds"
+    assert (
+        _counter("kvtpu_net_faults_injected_total", "kind=net-delay,op=wal")
+        > before
+    )
+    np.testing.assert_array_equal(_reach(f.service), _reach(leader))
+    with open(log, "rb") as a, open(f.log_path, "rb") as b:
+        assert a.read() == b.read()

@@ -1,0 +1,146 @@
+"""Subprocess body for the networked failover chaos test
+(tests/test_transport.py).
+
+A leader on its own simulated host: it writes an epoch-1 WAL with lease
+renewals and periodic checkpoints, serves the checkpoint directory and
+WAL over HTTP (:class:`ReplicationServer`), and — with ``--kill`` — dies
+by SIGKILL (``os._exit(137)`` inside the armed kill-point) mid-write,
+taking the HTTP endpoint down with it, exactly like a machine loss.
+
+Handshake: after the first checkpoint generation exists the child starts
+the server and publishes its URL to ``--url-file`` (tmp + ``os.replace``
+so the parent never reads a half-written line). With ``--ack-file`` it
+then keeps renewing the lease until the parent creates that file
+(followers attached and bootstrapped) before arming the kill and
+appending the second half — the parent never races the kill window.
+
+Deliberately never solves reach: the child's job is to die while
+writing, not to derive answers nobody will read.
+"""
+import argparse
+import os
+import sys
+import time
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--workdir", required=True)
+    ap.add_argument("--url-file", required=True)
+    ap.add_argument(
+        "--ack-file", default="",
+        help="block after publishing the URL until this file exists "
+        "(the parent's 'followers attached' signal)",
+    )
+    ap.add_argument(
+        "--kill", default="",
+        help="fault spec armed via install_kill_points AFTER the ack, "
+        "e.g. 'before-lease-renew@2' (empty = run to completion)",
+    )
+    ap.add_argument("--seed", type=int, default=3)
+    ap.add_argument("--n-events", type=int, default=200)
+    ap.add_argument("--pods", type=int, default=24)
+    ap.add_argument("--batch", type=int, default=10)
+    ap.add_argument("--checkpoint-every", type=int, default=2)
+    ap.add_argument("--lease-ttl", type=float, default=0.3)
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+    import kubernetes_verification_tpu as kv
+    from kubernetes_verification_tpu.harness.generate import (
+        GeneratorConfig,
+        random_cluster,
+        random_event_stream,
+    )
+    from kubernetes_verification_tpu.resilience.faults import (
+        install_kill_points,
+        parse_fault_spec,
+    )
+    from kubernetes_verification_tpu.serve import (
+        CheckpointManager,
+        EventSource,
+        LeaseFile,
+        ReplicationServer,
+        VerificationService,
+        WalWriter,
+    )
+
+    # MUST mirror the parent test's generator knobs exactly: the parent
+    # rebuilds this cluster for the from-scratch oracle
+    cluster = random_cluster(
+        GeneratorConfig(
+            n_pods=args.pods, n_policies=24, n_namespaces=6, seed=7,
+            p_ipblock_peer=0.0, min_selector_labels=1,
+        )
+    )
+    cfg = kv.VerifyConfig(backend="cpu", compute_ports=False)
+    log = os.path.join(args.workdir, "events.jsonl")
+    ck = os.path.join(args.workdir, "ck")
+    events = random_event_stream(
+        cluster, n_events=args.n_events, seed=args.seed
+    )
+
+    svc = VerificationService(cluster, cfg)
+    os.makedirs(ck, exist_ok=True)
+    cm = CheckpointManager(ck, retain=3)
+    lease = LeaseFile(ck)
+    lease.acquire("net-leader", ttl=args.lease_ttl)  # epoch 1
+    writer = WalWriter(log, epoch=1, lease=lease)
+    source = EventSource(log)
+
+    def _append(chunk) -> None:
+        lease.renew("net-leader", 1, args.lease_ttl)
+        writer.append(chunk)
+        for batch in source.batches(args.batch):
+            svc.apply(batch)
+
+    def _checkpoint() -> None:
+        cm.checkpoint(
+            svc.engine, log_path=log,
+            log_offset=source.offset, last_seq=source.last_seq,
+        )
+
+    mid = len(events) // 2
+    batches_since = 0
+    for i in range(0, mid, args.batch):
+        _append(events[i:i + args.batch])
+        batches_since += 1
+        if batches_since >= args.checkpoint_every:
+            _checkpoint()
+            batches_since = 0
+    _checkpoint()
+
+    server = ReplicationServer(ck, log)
+    url = server.start()
+    tmp = args.url_file + ".tmp"
+    with open(tmp, "w") as fh:
+        fh.write(url)
+    os.replace(tmp, args.url_file)
+
+    if args.ack_file:
+        deadline = time.time() + 60.0
+        while not os.path.exists(args.ack_file):
+            if time.time() > deadline:
+                print("parent never acked", file=sys.stderr)
+                return 1
+            lease.renew("net-leader", 1, args.lease_ttl)
+            time.sleep(args.lease_ttl / 4)
+
+    # armed only now: the parent-visible phase-1 renewals never count
+    # toward the kill index
+    if args.kill:
+        install_kill_points(parse_fault_spec(args.kill), seed=args.seed)
+    for i in range(mid, len(events), args.batch):
+        _append(events[i:i + args.batch])
+    _checkpoint()
+    writer.close()
+    server.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
