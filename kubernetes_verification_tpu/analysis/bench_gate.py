@@ -16,6 +16,15 @@ query`` emits rides the gate with no further configuration). Latency-like
 series gate lower-is-better, by unit or by explicit name
 (``replica_lag_seconds``).
 
+By default (``--deflated``) the gate expands each record into its derived
+series first: a ``"<metric> compile_s"`` series (lower-is-better — the
+14.3s→59.8s compile walk slipped through ungated) and, for records carrying
+a perf-sentinel calibration block, the dispatch-deflated ``<metric>_deflated``
+twin. Wherever a twin has ≥ 2 entries it carries the verdict and the raw
+headline is reported as an ungated context row — the gate stops failing on
+tunnel dispatch noise while raw numbers stay visible side by side.
+``--raw`` restores the pre-sentinel behaviour (no expansion, raw gates).
+
 ``--dry-run`` exercises the full parse-and-compare path but always exits 0:
 tier-1 runs it on every PR so a malformed history entry (or a gate-logic
 regression) fails fast, without making perf noise a test failure.
@@ -55,19 +64,33 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         help="parse and report but always exit 0 (the tier-1 CI mode)",
     )
     ap.add_argument("--json", action="store_true")
+    deflation = ap.add_mutually_exclusive_group()
+    deflation.add_argument(
+        "--deflated", dest="deflated", action="store_true", default=True,
+        help="expand derived series (compile_s, dispatch-deflated twins) "
+        "and let a twin with enough history carry the verdict (default)",
+    )
+    deflation.add_argument(
+        "--raw", dest="deflated", action="store_false",
+        help="gate raw series only; no derived-series expansion",
+    )
     args = ap.parse_args(argv)
 
     from ..observe.history import (
         check_regression,
         default_paths,
+        expand_derived,
         format_findings,
         load_runs,
     )
 
     paths = args.paths or default_paths(repo_root())
     runs = load_runs(paths)
+    if args.deflated:
+        runs = expand_derived(runs)
     ok, findings = check_regression(
-        runs, tolerance=args.tolerance, window=args.window
+        runs, tolerance=args.tolerance, window=args.window,
+        prefer_deflated=args.deflated,
     )
     if args.json:
         print(json.dumps({"ok": ok, "findings": findings}, sort_keys=True))

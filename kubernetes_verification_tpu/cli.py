@@ -616,9 +616,12 @@ def _apply_diffs(args, inc, ops, skipped_docs) -> None:
 
 
 def cmd_explain(args) -> int:
-    # two modes share the verb: per-kernel cost/memory introspection when a
-    # cluster size or backend is given, the legacy encoding+Datalog export
-    # when only a manifest PATH is
+    # three modes share the verb: the roofline report over the recorded
+    # bench history (--roofline), per-kernel cost/memory introspection
+    # when a cluster size or backend is given, and the legacy
+    # encoding+Datalog export when only a manifest PATH is
+    if getattr(args, "roofline", False):
+        return _explain_roofline(args)
     if args.pods is not None or args.backend is not None:
         return _explain_cost(args)
     if not args.path:
@@ -706,12 +709,41 @@ def _explain_cost(args) -> int:
     return 0
 
 
+def _explain_roofline(args) -> int:
+    """``kv-tpu explain --roofline``: achieved MACs/s as %% of device peak
+    per recorded bench mode — published v5e/v5p/v4/v6e table when the
+    record names a known device model, the record's own
+    sentinel-calibrated matmul peak otherwise, analytic host estimate as
+    the last resort."""
+    from .observe.history import default_paths, load_runs
+    from .observe.introspect import format_roofline_table, roofline_rows
+
+    paths = [args.path] if args.path else default_paths()
+    runs = load_runs(paths)
+    rows = roofline_rows(runs)
+    if args.json:
+        print(json.dumps({"rows": rows}, sort_keys=True))
+        return 0
+    if not rows:
+        print(
+            "no history record carries MAC accounting yet — run bench.py "
+            "(modes tiled/k8s/closure/stripe stamp `macs` + `steady_s`)"
+        )
+        return 0
+    print(format_roofline_table(rows))
+    return 0
+
+
 def cmd_history(args) -> int:
-    """``kv-tpu history``: show the bench-history trajectory and the
-    regression gate's verdict over it."""
+    """``kv-tpu history``: show the bench-history trajectory — raw and
+    dispatch-deflated values side by side, with each round's sentinel
+    noise figure — and the regression gate's verdict over the expanded
+    (deflation-aware) series."""
     from .observe.history import (
         check_regression,
+        deflate_record,
         default_paths,
+        expand_derived,
         format_findings,
         load_runs,
     )
@@ -720,7 +752,8 @@ def cmd_history(args) -> int:
     runs = load_runs(paths)
     if args.json:
         ok, findings = check_regression(
-            runs, tolerance=args.tolerance, window=args.window
+            expand_derived(runs), tolerance=args.tolerance,
+            window=args.window, prefer_deflated=True,
         )
         print(
             json.dumps(
@@ -740,9 +773,22 @@ def cmd_history(args) -> int:
             for k in ("compile_s", "steady_s", "round")
             if r.get(k) is not None
         )
-        print(f"{r['metric']}: {r['value']:.6g} {r.get('unit', '')}{extras}")
+        twin = deflate_record(r)
+        deflated = f"  deflated={twin['value']:.6g}" if twin else ""
+        sentinel = r.get("sentinel")
+        noise = (
+            f"  sentinel_spread={sentinel['spread_pct']:g}%"
+            if isinstance(sentinel, dict)
+            and sentinel.get("spread_pct") is not None
+            else ""
+        )
+        print(
+            f"{r['metric']}: {r['value']:.6g} {r.get('unit', '')}"
+            f"{deflated}{noise}{extras}"
+        )
     ok, findings = check_regression(
-        runs, tolerance=args.tolerance, window=args.window
+        expand_derived(runs), tolerance=args.tolerance, window=args.window,
+        prefer_deflated=True,
     )
     print()
     print(format_findings(findings))
@@ -1600,6 +1646,13 @@ def main(argv: Optional[list] = None) -> int:
     p.add_argument(
         "--backend", default=None,
         help="cost mode: backend to introspect (default cpu)",
+    )
+    p.add_argument(
+        "--roofline", action="store_true",
+        help="print achieved MACs/s as %% of device peak per recorded "
+        "bench mode (published v5e/v5p/v4/v6e peak table; "
+        "sentinel-calibrated or analytic fallback on hosts); reads the "
+        "bench history (PATH overrides the default file)",
     )
     p.add_argument("--json", action="store_true")
     p.set_defaults(fn=cmd_explain)

@@ -18,24 +18,43 @@ One import surface for the whole stack:
   cost/memory analysis of compiled dispatch sites (``introspect``).
 * ``memory_snapshot`` / ``start_sampler`` — live device-memory telemetry
   with host-RSS fallback (``telemetry``).
-* ``append_run`` / ``check_regression`` — the bench-history store and
-  regression gate (``history``).
+* ``append_run`` / ``check_regression`` / ``expand_derived`` — the
+  bench-history store and (dispatch-deflation-aware) regression gate
+  (``history``).
+* ``run_calibration`` / ``SentinelSuite`` — fixed-shape compute-bound
+  calibration kernels + the dispatch-latency probe; the noise context
+  every bench record carries (``sentinel``).
 
 ``utils.observe`` re-exports the seed-era names from here for backward
 compatibility.
 """
 from __future__ import annotations
 
-from . import history, introspect, metrics, telemetry
+from . import history, introspect, metrics, sentinel, telemetry
 from .events import configure_logging, log_event, logger
 from .export import dump_registry, to_prometheus, write_metrics
-from .history import append_run, check_regression, load_runs
+from .history import (
+    append_run,
+    check_regression,
+    deflate_record,
+    expand_derived,
+    load_runs,
+)
 from .introspect import (
     KernelCostReport,
+    device_peak_macs_per_s,
     format_cost_table,
+    format_roofline_table,
     maybe_publish,
     publish_host_estimate,
+    roofline_rows,
     set_introspection,
+)
+from .sentinel import (
+    SentinelCalibrationError,
+    SentinelSuite,
+    run_calibration,
+    slim_context,
 )
 from .jit import DispatchTracker, abstract_signature, tree_nbytes
 from .registry import (
@@ -85,7 +104,17 @@ __all__ = [
     "stop_sampler",
     "append_run",
     "check_regression",
+    "deflate_record",
+    "expand_derived",
     "load_runs",
+    "sentinel",
+    "SentinelCalibrationError",
+    "SentinelSuite",
+    "run_calibration",
+    "slim_context",
+    "device_peak_macs_per_s",
+    "format_roofline_table",
+    "roofline_rows",
     "set_memory_hook",
     "trace_to_dir",
     "configure_logging",

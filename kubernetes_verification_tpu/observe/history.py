@@ -24,7 +24,33 @@ recognised
 structurally as a fallback — a ``*_per_second`` metric name or a
 ``.../s`` unit gates higher-is-better (so the ``queries_per_second``
 series from BENCH rounds is gated even where its unit string predates the
-list above).
+list above). Further structural suffix rules (the perf-sentinel layer,
+``observe/sentinel.py``):
+
+* ``*_deflated`` inherits the direction of the base series it was derived
+  from (strip the suffix, infer again) — the dispatch-deflated twin of a
+  throughput gates higher, of a latency lower;
+* ``compile_s`` (bare or as a ``... compile_s`` derived-series suffix)
+  gates lower-is-better — the 14.3s→59.8s compile-time walk slipped
+  through precisely because no series watched it;
+* ``pct_of_peak`` / ``*_pct_of_peak`` gates higher-is-better (roofline
+  utilisation);
+* the sentinel *context* series (``sentinel_dispatch_s``,
+  ``sentinel_spread_pct``) are explicitly UNGATED: they measure the
+  environment's noise, and gating them would re-admit exactly the noise
+  the deflated series exist to remove. The per-kernel ``sentinel_<k>_s``
+  series DO gate (lower-is-better by unit): a calibrated compute-bound
+  kernel slowing down is a real toolchain/code signal, not tunnel noise.
+
+Dispatch-deflated twins: every record whose calibration block
+(``sentinel.dispatch_s``, attached by ``bench.py``) and timing shape allow
+it grows a ``<metric>_deflated`` sibling series via :func:`deflate_record`
+— the measured per-dispatch overhead is removed from the steady figure, so
+the twin tracks device compute while the raw series keeps tracking what a
+user experiences. ``expand_derived`` materialises those twins (plus the
+``... compile_s`` series) and ``check_regression(prefer_deflated=True)``
+gates the twin INSTEAD of the raw series wherever the twin has enough
+history — raw stays visible as an ungated context row.
 """
 from __future__ import annotations
 
@@ -36,8 +62,11 @@ from typing import Dict, Iterable, List, Optional, Tuple
 
 __all__ = [
     "DEFAULT_HISTORY",
+    "DEFLATED_SUFFIX",
     "append_run",
     "load_runs",
+    "deflate_record",
+    "expand_derived",
     "check_regression",
     "format_findings",
 ]
@@ -70,6 +99,20 @@ _HIGHER_IS_BETTER_METRICS = frozenset(
 _LOWER_IS_BETTER_METRICS = frozenset(
     {"replica_lag_seconds", "replica_lag_spread_seconds"}
 )
+#: sentinel context series: the round's NOISE measurements. Never gated —
+#: a slower tunnel or a noisier host is environment, not regression; the
+#: deflated series exist so these numbers stop leaking into verdicts.
+_UNGATED_METRICS = frozenset(
+    {"sentinel_dispatch_s", "sentinel_spread_pct"}
+)
+
+#: suffix of the dispatch-deflated twin series ``deflate_record`` derives
+DEFLATED_SUFFIX = "_deflated"
+#: suffix of the derived compile-time series (``"<metric> compile_s"``)
+_COMPILE_SUFFIX = "compile_s"
+
+#: latency units deflation understands, as seconds-per-unit
+_SECONDS_PER_UNIT = {"s": 1.0, "seconds": 1.0, "ms": 1e-3, "us": 1e-6}
 
 
 def append_run(record: dict, path: str = DEFAULT_HISTORY) -> dict:
@@ -146,6 +189,10 @@ def default_paths(root: str = ".") -> List[str]:
 
 
 def _direction(unit: Optional[str], metric: Optional[str] = None) -> str:
+    # the sentinel context series are never gated: they ARE the noise
+    # measurement the deflated series subtract out
+    if metric in _UNGATED_METRICS:
+        return "unknown"
     if metric in _HIGHER_IS_BETTER_METRICS:
         return "higher"
     if metric in _LOWER_IS_BETTER_METRICS:
@@ -162,16 +209,146 @@ def _direction(unit: Optional[str], metric: Optional[str] = None) -> str:
         return "higher"
     if unit is not None and unit.endswith("/s"):
         return "higher"
+    # structural suffix rules (perf-sentinel layer):
+    if metric is not None:
+        # the dispatch-deflated twin inherits its base series' direction
+        if metric.endswith(DEFLATED_SUFFIX):
+            return _direction(unit, metric[: -len(DEFLATED_SUFFIX)])
+        # compile time gates lower-is-better whether emitted bare or as
+        # the derived "<metric> compile_s" series
+        if metric == _COMPILE_SUFFIX or metric.endswith(" " + _COMPILE_SUFFIX):
+            return "lower"
+        # roofline utilisation gates higher-is-better
+        if metric == "pct_of_peak" or metric.endswith("_pct_of_peak"):
+            return "higher"
     return "unknown"
 
 
+def _sentinel_dispatch_s(rec: dict) -> Optional[float]:
+    """The per-dispatch overhead from a record's calibration block, when
+    present and usable."""
+    sentinel = rec.get("sentinel")
+    if not isinstance(sentinel, dict):
+        return None
+    try:
+        dispatch_s = float(sentinel["dispatch_s"])
+    except (KeyError, TypeError, ValueError):
+        return None
+    if dispatch_s <= 0.0:
+        return None
+    return dispatch_s
+
+
+def deflate_record(rec: dict) -> Optional[dict]:
+    """Derive the dispatch-deflated twin of one history record, or ``None``
+    when the record carries no usable calibration block or its shape does
+    not support deflation.
+
+    Throughput records (direction "higher") additionally need a numeric
+    ``steady_s``: the model is wall = compute + dispatch, so the deflated
+    throughput is ``value * steady_s / (steady_s - dispatch_s)``. Latency
+    records in a seconds-family unit subtract the dispatch overhead
+    directly. Both clamp the compute term to 10% of the measured figure
+    (flagged ``deflation_clamped``) so a probe misread can never produce a
+    negative or absurd twin.
+    """
+    dispatch_s = _sentinel_dispatch_s(rec)
+    if dispatch_s is None:
+        return None
+    metric = rec.get("metric")
+    if not isinstance(metric, str) or metric.endswith(DEFLATED_SUFFIX):
+        return None
+    unit = rec.get("unit")
+    direction = _direction(unit, metric)
+    try:
+        value = float(rec["value"])
+    except (KeyError, TypeError, ValueError):
+        return None
+    twin = {
+        "metric": metric + DEFLATED_SUFFIX,
+        "unit": unit,
+        "derived_from": metric,
+        "dispatch_s": dispatch_s,
+        "deflation_clamped": False,
+    }
+    for key in ("ts", "round", "mode", "origin"):
+        if key in rec:
+            twin[key] = rec[key]
+    if direction == "higher":
+        try:
+            steady_s = float(rec["steady_s"])
+        except (KeyError, TypeError, ValueError):
+            return None
+        if steady_s <= 0.0:
+            return None
+        compute_s = steady_s - dispatch_s
+        floor = 0.1 * steady_s
+        if compute_s < floor:
+            compute_s = floor
+            twin["deflation_clamped"] = True
+        twin["value"] = value * steady_s / compute_s
+        return twin
+    if direction == "lower" and unit in _SECONDS_PER_UNIT:
+        scale = _SECONDS_PER_UNIT[unit]
+        value_s = value * scale
+        compute_s = value_s - dispatch_s
+        floor = 0.1 * value_s
+        if compute_s < floor:
+            compute_s = floor
+            twin["deflation_clamped"] = True
+        twin["value"] = compute_s / scale
+        return twin
+    return None
+
+
+def expand_derived(runs: List[dict], deflate: bool = True) -> List[dict]:
+    """Materialise the derived series alongside their sources, preserving
+    within-series order:
+
+    * a ``"<metric> compile_s"`` series (unit "s") from every record with
+      a numeric ``compile_s`` field — so compile-time walks gate
+      lower-is-better per headline series;
+    * the ``<metric>_deflated`` twin (:func:`deflate_record`) from every
+      record carrying a usable sentinel calibration block.
+    """
+    out: List[dict] = []
+    for rec in runs:
+        out.append(rec)
+        compile_s = rec.get("compile_s")
+        metric = rec.get("metric")
+        if isinstance(metric, str) and isinstance(compile_s, (int, float)):
+            derived = {
+                "metric": f"{metric} {_COMPILE_SUFFIX}",
+                "unit": "s",
+                "value": float(compile_s),
+                "derived_from": metric,
+            }
+            for key in ("ts", "round", "mode", "origin"):
+                if key in rec:
+                    derived[key] = rec[key]
+            out.append(derived)
+        if deflate:
+            twin = deflate_record(rec)
+            if twin is not None:
+                out.append(twin)
+    return out
+
+
 def check_regression(
-    runs: List[dict], tolerance: float = 0.25, window: int = 5
+    runs: List[dict],
+    tolerance: float = 0.25,
+    window: int = 5,
+    prefer_deflated: bool = False,
 ) -> Tuple[bool, List[dict]]:
     """Group runs by (metric, unit) series; within each series with ≥ 2
     entries, compare the newest value against the median of up to
     ``window`` preceding runs. A drop (throughput) or rise (latency) beyond
-    ``tolerance`` (relative) regresses. Returns (ok, findings)."""
+    ``tolerance`` (relative) regresses. Returns (ok, findings).
+
+    With ``prefer_deflated=True``, any raw series whose
+    ``<metric>_deflated`` twin also has ≥ 2 entries is demoted to an
+    ungated context row (``gated_via`` names the twin): the twin carries
+    the verdict, the raw headline stays visible."""
     series: Dict[Tuple[str, Optional[str]], List[dict]] = {}
     for r in runs:
         series.setdefault((r["metric"], r.get("unit")), []).append(r)
@@ -193,13 +370,20 @@ def check_regression(
             "n_previous": len(prev),
             "regressed": False,
         }
+        gated_via = None
+        if prefer_deflated and not metric.endswith(DEFLATED_SUFFIX):
+            twin = metric + DEFLATED_SUFFIX
+            if len(series.get((twin, unit), [])) >= 2:
+                gated_via = twin
+                finding["gated_via"] = twin
         if median > 0 and direction != "unknown":
             ratio = newest["value"] / median
             finding["ratio"] = round(ratio, 4)
-            if direction == "higher":
-                finding["regressed"] = ratio < 1.0 - tolerance
-            else:
-                finding["regressed"] = ratio > 1.0 + tolerance
+            if gated_via is None:
+                if direction == "higher":
+                    finding["regressed"] = ratio < 1.0 - tolerance
+                else:
+                    finding["regressed"] = ratio > 1.0 + tolerance
         findings.append(finding)
     ok = not any(f["regressed"] for f in findings)
     return ok, findings
@@ -211,9 +395,14 @@ def format_findings(findings: List[dict]) -> str:
     lines = []
     for f in findings:
         ratio = f.get("ratio")
-        verdict = "REGRESSED" if f["regressed"] else (
-            "ok" if f["direction"] != "unknown" else "ungated"
-        )
+        if f["regressed"]:
+            verdict = "REGRESSED"
+        elif f.get("gated_via"):
+            verdict = "context"  # verdict carried by the deflated twin
+        elif f["direction"] != "unknown":
+            verdict = "ok"
+        else:
+            verdict = "ungated"
         lines.append(
             f"[{verdict:>9}] {f['metric']} ({f['unit']}, {f['direction']}"
             f"-is-better): newest={f['newest']:.6g} vs median({f['n_previous']}"

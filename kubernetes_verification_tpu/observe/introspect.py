@@ -35,6 +35,8 @@ from .metrics import (
     KERNEL_BYTES_ACCESSED,
     KERNEL_FLOPS,
     KERNEL_PEAK_BYTES,
+    ROOFLINE_ACHIEVED_MACS_PER_SECOND,
+    ROOFLINE_PCT_OF_PEAK,
 )
 
 __all__ = [
@@ -49,6 +51,9 @@ __all__ = [
     "clear_reports",
     "format_cost_table",
     "roofline_ridge",
+    "device_peak_macs_per_s",
+    "roofline_rows",
+    "format_roofline_table",
 ]
 
 #: Machine-balance ridge points (FLOP/byte at which a kernel flips from
@@ -108,6 +113,144 @@ class KernelCostReport:
 
 def roofline_ridge(platform: str) -> float:
     return _RIDGE_FLOPS_PER_BYTE.get(platform, _RIDGE_FLOPS_PER_BYTE["host"])
+
+
+#: Published MXU peak, in MACs/s (= published TOPS / 2: one MAC is a
+#: multiply + an add), keyed by ``device_kind`` prefix (longest prefix
+#: wins; a v5e reports "TPU v5 lite"). Sources: the public TPU spec
+#: sheets — v5e 394.2 int8 TOPS / 197.1 bf16 TFLOP/s; v5p 918 int8 TOPS;
+#: v4 has no int8 MXU mode (275 bf16 TFLOP/s for both rows); v6e (Trillium)
+#: 1836.7 int8 TOPS.
+_PEAK_MACS_PER_S = {
+    "TPU v5 lite": {"int8": 197.1e12, "bf16": 98.55e12},
+    "TPU v5e": {"int8": 197.1e12, "bf16": 98.55e12},
+    "TPU v5p": {"int8": 459.0e12, "bf16": 229.5e12},
+    "TPU v5": {"int8": 459.0e12, "bf16": 229.5e12},
+    "TPU v4": {"int8": 137.5e12, "bf16": 137.5e12},
+    "TPU v6 lite": {"int8": 918.35e12, "bf16": 459.2e12},
+    "TPU v6e": {"int8": 918.35e12, "bf16": 459.2e12},
+}
+
+
+def device_peak_macs_per_s(
+    device_kind: Optional[str], dtype: str = "int8"
+) -> Optional[float]:
+    """Published MXU peak for a device model string (longest-prefix match
+    over the table above), or ``None`` for unknown devices — callers fall
+    back to the sentinel-calibrated or analytic host peak."""
+    if not device_kind:
+        return None
+    best = None
+    for prefix, peaks in _PEAK_MACS_PER_S.items():
+        if device_kind.startswith(prefix) and dtype in peaks:
+            if best is None or len(prefix) > len(best[0]):
+                best = (prefix, peaks[dtype])
+    return best[1] if best else None
+
+
+def _analytic_host_peak() -> float:
+    """Order-of-magnitude host MAC peak: cores × ~2.5 GHz × 16 int8
+    MACs/cycle (one 128-bit FMA pipe's worth). Deliberately coarse — it
+    exists so a ``pct_of_peak`` on an unknown host is a bounded estimate
+    instead of a division by zero."""
+    cores = os.cpu_count() or 1
+    return float(cores) * 2.5e9 * 16.0
+
+
+def _roofline_peak(rec: dict) -> Tuple[float, str]:
+    """(peak MACs/s, source) for one history record: the published device
+    table when the model is known, else the record's own
+    sentinel-calibrated matmul peak, else the analytic host estimate."""
+    peak = device_peak_macs_per_s(rec.get("device"))
+    if peak:
+        return peak, f"peak-table[{rec.get('device')}]"
+    sentinel = rec.get("sentinel")
+    if isinstance(sentinel, dict):
+        try:
+            cal = float(sentinel.get("calibrated_peak_macs_per_s", 0.0))
+        except (TypeError, ValueError):
+            cal = 0.0
+        if cal > 0.0:
+            return cal, "sentinel-calibrated"
+    return _analytic_host_peak(), "analytic-host"
+
+
+def roofline_rows(runs: List[dict]) -> List[dict]:
+    """Achieved-vs-peak accounting over a bench history: for the newest
+    record of every mode that carries a MAC count (``macs``, stamped by
+    ``bench.py``) and a steady-state seconds figure, convert measured
+    throughput into achieved MACs/s and position it against the device
+    peak (published table → sentinel-calibrated → analytic host). Updates
+    the ``kvtpu_roofline_*`` gauges as a side effect."""
+    newest: Dict[str, dict] = {}
+    for rec in runs:
+        try:
+            macs = float(rec["macs"])
+            steady = float(rec["steady_s"])
+        except (KeyError, TypeError, ValueError):
+            continue
+        if macs <= 0.0 or steady <= 0.0:
+            continue
+        mode = rec.get("mode") or str(rec.get("metric", "?"))
+        newest[mode] = rec  # later records win: history order is oldest-first
+    rows = []
+    for mode, rec in sorted(newest.items()):
+        macs = float(rec["macs"])
+        steady = float(rec["steady_s"])
+        achieved = macs / steady
+        peak, source = _roofline_peak(rec)
+        pct = 100.0 * achieved / peak if peak else 0.0
+        ROOFLINE_ACHIEVED_MACS_PER_SECOND.labels(mode=mode).set(achieved)
+        ROOFLINE_PCT_OF_PEAK.labels(mode=mode).set(pct)
+        rows.append(
+            {
+                "mode": mode,
+                "metric": rec.get("metric"),
+                "device": rec.get("device"),
+                "platform": rec.get("platform"),
+                "macs": macs,
+                "steady_s": steady,
+                "achieved_macs_per_s": achieved,
+                "peak_macs_per_s": peak,
+                "peak_source": source,
+                "pct_of_peak": round(pct, 2),
+                "macs_basis": rec.get("macs_basis"),
+            }
+        )
+    return rows
+
+
+def format_roofline_table(rows: List[dict]) -> str:
+    """Fixed-width roofline table (the ``kv-tpu explain --roofline``
+    body). Empty string when no record carries MAC accounting."""
+    if not rows:
+        return ""
+    header = (
+        "mode", "device", "achieved MACs/s", "peak MACs/s", "% peak",
+        "peak source", "basis",
+    )
+    out = [header]
+    for r in rows:
+        out.append(
+            (
+                str(r["mode"]),
+                str(r.get("device") or "?"),
+                _fmt_count(r["achieved_macs_per_s"]),
+                _fmt_count(r["peak_macs_per_s"]),
+                f"{r['pct_of_peak']:.1f}%",
+                str(r["peak_source"]),
+                str(r.get("macs_basis") or ""),
+            )
+        )
+    widths = [max(len(row[i]) for row in out) for i in range(len(header))]
+    lines = []
+    for ri, row in enumerate(out):
+        lines.append(
+            "  ".join(cell.ljust(widths[i]) for i, cell in enumerate(row)).rstrip()
+        )
+        if ri == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    return "\n".join(lines)
 
 
 # ------------------------------------------------------------------ gating

@@ -67,6 +67,12 @@ __all__ = [
     "LB_STALE_RETRIES_TOTAL",
     "LB_EJECTIONS_TOTAL",
     "LINT_FINDINGS_TOTAL",
+    "SENTINEL_KERNEL_SECONDS",
+    "SENTINEL_SPREAD_PCT",
+    "SENTINEL_DISPATCH_SECONDS",
+    "SENTINEL_CALIBRATION_FAILURES_TOTAL",
+    "ROOFLINE_ACHIEVED_MACS_PER_SECOND",
+    "ROOFLINE_PCT_OF_PEAK",
     "REQUIRED_FAMILIES",
 ]
 
@@ -482,6 +488,58 @@ LINT_CACHE_HITS_TOTAL = Counter(
     "against.",
 )
 
+SENTINEL_KERNEL_SECONDS = Gauge(
+    "kvtpu_sentinel_kernel_seconds",
+    "Median wall-clock of one fixed-shape calibration-kernel run "
+    "(observe/sentinel.py), by kernel — the compute-bound reference every "
+    "bench round records so headline drift can be attributed to code vs "
+    "the host↔device path.",
+    ("kernel",),
+)
+
+SENTINEL_SPREAD_PCT = Gauge(
+    "kvtpu_sentinel_spread_pct",
+    "Measured run-to-run spread ((max-min)/median, percent) of each "
+    "calibration kernel on its last measurement — the round's noise "
+    "figure; a calibrated sentinel repeats within its registration bound "
+    "(<1% on a real chip).",
+    ("kernel",),
+)
+
+SENTINEL_DISPATCH_SECONDS = Gauge(
+    "kvtpu_sentinel_dispatch_seconds",
+    "Median round-trip of the near-empty dispatch probe (dispatch + "
+    "scalar read-back) — the per-dispatch overhead the tunnel adds to "
+    "every timed solve, and the quantity dispatch-deflation removes from "
+    "bench headlines.",
+)
+
+SENTINEL_CALIBRATION_FAILURES_TOTAL = Counter(
+    "kvtpu_sentinel_calibration_failures_total",
+    "Sentinel kernels whose measured spread exceeded the registration "
+    "bound, by kernel — the instrument itself was too noisy to calibrate "
+    "with (the bench record carries calibrated=false instead of a "
+    "verdict).",
+    ("kernel",),
+)
+
+ROOFLINE_ACHIEVED_MACS_PER_SECOND = Gauge(
+    "kvtpu_roofline_achieved_macs_per_second",
+    "Achieved multiply-accumulates per steady-state second for the newest "
+    "bench record of each mode that carries MAC accounting "
+    "(observe/introspect.py roofline report), by mode.",
+    ("mode",),
+)
+
+ROOFLINE_PCT_OF_PEAK = Gauge(
+    "kvtpu_roofline_pct_of_peak",
+    "Achieved MACs/s as percent of the device peak (published v5e-class "
+    "table, else the sentinel-calibrated or analytic host fallback), by "
+    "mode — the number that calibrates every 'practical XLA optimum' "
+    "claim and locates remaining headroom.",
+    ("mode",),
+)
+
 #: The frozen dashboard contract: families that must exist in every build.
 #: New families are appended here by the PR that introduces them; the
 #: `metrics-names` lint rule and `scripts/check_metrics_names.py` both fail
@@ -547,6 +605,14 @@ REQUIRED_FAMILIES = frozenset(
         "kvtpu_lb_requests_total",
         "kvtpu_lb_stale_retries_total",
         "kvtpu_lb_ejections_total",
+        # perf sentinel + roofline accounting (observe/sentinel.py +
+        # observe/introspect.py)
+        "kvtpu_sentinel_kernel_seconds",
+        "kvtpu_sentinel_spread_pct",
+        "kvtpu_sentinel_dispatch_seconds",
+        "kvtpu_sentinel_calibration_failures_total",
+        "kvtpu_roofline_achieved_macs_per_second",
+        "kvtpu_roofline_pct_of_peak",
         # static analysis (analysis/)
         "kvtpu_lint_findings_total",
         # interprocedural engine (analysis/callgraph.py + summaries.py)

@@ -1,0 +1,487 @@
+"""Perf sentinel: fixed-shape calibration kernels + a dispatch-latency probe.
+
+The bench trajectory's open wound (ROADMAP "Perf sentinel + roofline
+accounting", VERDICT §3) is that a headline walking 2.76 → 2.41 G pairs/s
+across rounds is indistinguishable from the tunnel's documented ±30%
+dispatch noise: rounds are different sessions, and nothing in the recorded
+rounds separates "the code got slower" from "the host↔device path got
+slower".  This module is that missing instrument:
+
+* **Calibration kernels** — 2–3 *fixed-shape, compute-bound* kernels
+  (a chained int8 MXU matmul, a chained f32 matmul, a VPU bitwise-rotate
+  loop over packed words) whose run-to-run spread is verified against a
+  bound **at registration** (`SentinelSuite.register` measures the kernel
+  and refuses — or records ``calibrated=False`` — when the spread exceeds
+  it).  A calibrated kernel repeating within its bound means the *device
+  compute* path is stable; if the headline moved anyway, the cause is
+  dispatch, config, or code — not silicon.
+* **Dispatch probe** — a near-empty kernel timed round-trip (dispatch +
+  scalar read-back), whose median *is* the per-dispatch overhead the
+  tunnel adds to every timed solve.  Headlines are re-expressed
+  "dispatch-deflated" by removing it (``observe/history.py:
+  deflate_record``), which is what the regression gate evaluates.
+
+``bench.py --mode sentinel`` runs the suite standalone; every other bench
+mode prepends it as a calibration block so each ``bench_history.jsonl``
+record carries its own noise context (``sentinel`` field).  The measured
+MACs/s of the matmul sentinels doubles as the *practical peak* reference
+for roofline accounting on hosts with no published peak table
+(``observe/introspect.py: device_peak_macs_per_s`` fallback).
+
+The kernels are chained (iteration *k+1* consumes iteration *k*'s output)
+so XLA can neither CSE the loop body into one matmul nor dead-code any
+iteration, and each is sized per platform so compute dominates the
+dispatch overhead it is calibrating against.  Shapes are fixed per
+platform — cross-round comparability on the same device class is the
+whole point — and recorded in the context so a config change is visible
+as such.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..resilience.errors import ConfigError
+from .events import log_event
+from .metrics import (
+    SENTINEL_CALIBRATION_FAILURES_TOTAL,
+    SENTINEL_DISPATCH_SECONDS,
+    SENTINEL_KERNEL_SECONDS,
+    SENTINEL_SPREAD_PCT,
+)
+
+__all__ = [
+    "SentinelCalibrationError",
+    "SentinelKernel",
+    "SentinelSuite",
+    "default_suite",
+    "run_calibration",
+    "slim_context",
+    "DEFAULT_MAX_SPREAD_PCT",
+]
+
+#: Registration-time spread bound (max−min over median, percent), per
+#: platform.  On a real chip the compute-bound kernels repeat within 1%
+#: (the r05 closure evidence: 0.5% while dispatch-bound ops read 1.5–2×
+#: slow); shared CI hosts juggle noisy neighbours, so the host bound is
+#: loose — the *measured* spread is recorded either way, and that number,
+#: not the bound, is what rides every bench record.
+DEFAULT_MAX_SPREAD_PCT = {"tpu": 1.0, "gpu": 5.0}
+_HOST_MAX_SPREAD_PCT = 40.0
+
+_ENV_MAX_SPREAD = "KVTPU_SENTINEL_MAX_SPREAD_PCT"
+
+
+class SentinelCalibrationError(ConfigError):
+    """A sentinel kernel's measured spread exceeded the registration bound
+    (strict mode): the instrument itself is too noisy to calibrate with."""
+
+
+@dataclasses.dataclass(frozen=True)
+class SentinelKernel:
+    """One fixed-shape calibration kernel.
+
+    ``build(device)`` returns a zero-arg runner that executes ONE chained
+    iteration block and forces completion (scalar read-back — under the
+    remote-TPU tunnel ``block_until_ready`` returns at dispatch).
+    ``macs_per_run`` is the exact multiply-accumulate count of one run for
+    the matmul sentinels (0 for non-MXU kernels); ``kind`` tags which unit
+    the kernel saturates.
+    """
+
+    name: str
+    build: Callable[[object, Dict[str, int]], Callable[[], float]]
+    macs_per_run: int
+    kind: str  # "mxu" | "vpu"
+    dtype: str
+    config: Dict[str, int] = dataclasses.field(default_factory=dict)
+
+
+def _read_scalar(out) -> float:
+    """Force one element back to the host — completion under the tunnel."""
+    import numpy as np
+
+    return float(np.asarray(out.ravel()[0]))
+
+
+def _platform() -> str:
+    import jax
+
+    return jax.default_backend()
+
+
+# --------------------------------------------------------------- kernels
+def _matmul_sizes(platform: str) -> Dict[str, int]:
+    """Fixed per-platform chain sizes: on TPU the chain must dominate the
+    ~80 ms tunnel dispatch it calibrates against (~100+ ms of MXU work);
+    on hosts it must stay sub-second under pytest."""
+    if platform == "tpu":
+        return {"n": 8192, "loops": 64}
+    return {"n": 256, "loops": 4}
+
+
+def _vpu_sizes(platform: str) -> Dict[str, int]:
+    if platform == "tpu":
+        return {"words": 1 << 24, "loops": 256}
+    return {"words": 1 << 18, "loops": 16}
+
+
+def _build_matmul_int8(device, cfg: Dict[str, int]) -> Callable[[], float]:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    n, loops = cfg["n"], cfg["loops"]
+    rng = np.random.default_rng(0)
+    x0 = jax.device_put(
+        rng.integers(-64, 64, (n, n), dtype=np.int8), device
+    )
+    w = jax.device_put(rng.integers(-64, 64, (n, n), dtype=np.int8), device)
+
+    @jax.jit
+    def chain(x, w):
+        def body(_, x):
+            y = jnp.dot(x, w, preferred_element_type=jnp.int32)
+            # re-quantize so the chain stays int8 and no iteration folds
+            return (y & 0x3F).astype(jnp.int8)
+
+        return jax.lax.fori_loop(0, loops, body, x)
+
+    def run() -> float:
+        return _read_scalar(chain(x0, w))
+
+    return run
+
+
+def _build_matmul_f32(device, cfg: Dict[str, int]) -> Callable[[], float]:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    n, loops = cfg["n"], cfg["loops"]
+    rng = np.random.default_rng(1)
+    x0 = jax.device_put(
+        rng.standard_normal((n, n), dtype=np.float32), device
+    )
+    w = jax.device_put(
+        (rng.standard_normal((n, n), dtype=np.float32) / np.sqrt(n)).astype(
+            np.float32
+        ),
+        device,
+    )
+
+    @jax.jit
+    def chain(x, w):
+        def body(_, x):
+            return jnp.dot(x, w)  # ||w|| ≈ 1 keeps the chain finite
+
+        return jax.lax.fori_loop(0, loops, body, x)
+
+    def run() -> float:
+        return _read_scalar(chain(x0, w))
+
+    return run
+
+
+def _build_vpu_bitops(device, cfg: Dict[str, int]) -> Callable[[], float]:
+    """Packed-word bitwise chain — the VPU analogue of the closure kernels'
+    uint32 inner loop (rotate-xor keeps every lane live)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    words, loops = cfg["words"], cfg["loops"]
+    rng = np.random.default_rng(2)
+    w0 = jax.device_put(
+        rng.integers(0, 2**32, words, dtype=np.uint32), device
+    )
+
+    @jax.jit
+    def chain(w):
+        def body(_, w):
+            rot = (w << jnp.uint32(1)) | (w >> jnp.uint32(31))
+            return rot ^ jnp.uint32(0x9E3779B9)
+
+        return jax.lax.fori_loop(0, loops, body, w)
+
+    def run() -> float:
+        return _read_scalar(chain(w0))
+
+    return run
+
+
+def _default_kernels(platform: str) -> List[SentinelKernel]:
+    mm = _matmul_sizes(platform)
+    # the MXU runs f32 dots far below its int8 rate — a shorter chain keeps
+    # the f32 sentinel's wall time in the same band as the int8 one
+    f32 = dict(mm, loops=max(1, mm["loops"] // (4 if platform == "tpu" else 1)))
+    vp = _vpu_sizes(platform)
+    return [
+        SentinelKernel(
+            name="mxu_int8",
+            build=_build_matmul_int8,
+            macs_per_run=mm["loops"] * mm["n"] ** 3,
+            kind="mxu",
+            dtype="int8",
+            config=dict(mm),
+        ),
+        SentinelKernel(
+            name="mxu_f32",
+            build=_build_matmul_f32,
+            macs_per_run=f32["loops"] * f32["n"] ** 3,
+            kind="mxu",
+            dtype="f32",
+            config=f32,
+        ),
+        SentinelKernel(
+            name="vpu_bitops",
+            build=_build_vpu_bitops,
+            macs_per_run=0,
+            kind="vpu",
+            dtype="uint32",
+            config=dict(vp),
+        ),
+    ]
+
+
+# ----------------------------------------------------------------- suite
+def _band(times: List[float]) -> Dict[str, float]:
+    ts = sorted(float(t) for t in times)
+    med = ts[len(ts) // 2]
+    return {
+        "n": len(ts),
+        "min_s": ts[0],
+        "median_s": med,
+        "max_s": ts[-1],
+        "spread_pct": 100.0 * (ts[-1] - ts[0]) / med if med else 0.0,
+    }
+
+
+def default_max_spread_pct(platform: str) -> float:
+    env = os.environ.get(_ENV_MAX_SPREAD)
+    if env:
+        try:
+            return float(env)
+        except ValueError:
+            pass  # a malformed override falls back to the platform bound
+    return DEFAULT_MAX_SPREAD_PCT.get(platform, _HOST_MAX_SPREAD_PCT)
+
+
+class SentinelSuite:
+    """Registered sentinels plus the measurements taken at registration.
+
+    ``register`` runs the kernel (warmup + ``reps`` timed runs, up to
+    ``retries`` re-measurements keeping the tightest band) and verifies the
+    measured spread against ``max_spread_pct``:
+
+    * strict (default off): a persistent violation raises
+      :class:`SentinelCalibrationError` — the caller refuses to calibrate
+      with a noisy instrument;
+    * non-strict: the kernel is registered with ``calibrated=False`` and
+      ``kvtpu_sentinel_calibration_failures_total`` counts it — a bench
+      must still run, carrying the honesty marker instead of a verdict.
+
+    ``timer`` is injectable so tests exercise the verification logic with
+    deterministic fake clocks.
+    """
+
+    def __init__(
+        self,
+        device=None,
+        *,
+        reps: int = 5,
+        retries: int = 2,
+        max_spread_pct: Optional[float] = None,
+        timer: Callable[[], float] = time.perf_counter,
+    ) -> None:
+        import jax
+
+        self.device = device if device is not None else jax.devices()[0]
+        self.platform = _platform()
+        self.reps = max(3, int(reps))
+        self.retries = max(1, int(retries))
+        self.max_spread_pct = (
+            default_max_spread_pct(self.platform)
+            if max_spread_pct is None
+            else float(max_spread_pct)
+        )
+        self.timer = timer
+        self.results: Dict[str, dict] = {}
+        self._order: List[str] = []
+
+    def _measure(self, run: Callable[[], float]) -> Dict[str, float]:
+        for _ in range(2):  # compile + cache warm
+            run()
+        times = []
+        for _ in range(self.reps):
+            s = self.timer()
+            run()
+            times.append(self.timer() - s)
+        return _band(times)
+
+    def register(self, kernel: SentinelKernel, *, strict: bool = False) -> dict:
+        """Measure ``kernel`` and admit it to the suite, verifying its
+        spread against the bound (see class docstring)."""
+        run = kernel.build(self.device, dict(kernel.config))
+        band = self._measure(run)
+        for _ in range(self.retries - 1):
+            if band["spread_pct"] <= self.max_spread_pct:
+                break
+            again = self._measure(run)
+            if again["spread_pct"] < band["spread_pct"]:
+                band = again
+        calibrated = band["spread_pct"] <= self.max_spread_pct
+        if not calibrated:
+            SENTINEL_CALIBRATION_FAILURES_TOTAL.labels(
+                kernel=kernel.name
+            ).inc()
+            log_event(
+                "sentinel_calibration_failed",
+                kernel=kernel.name,
+                spread_pct=round(band["spread_pct"], 3),
+                bound_pct=self.max_spread_pct,
+            )
+            if strict:
+                raise SentinelCalibrationError(
+                    f"sentinel {kernel.name!r}: measured spread "
+                    f"{band['spread_pct']:.2f}% exceeds the "
+                    f"{self.max_spread_pct:g}% calibration bound after "
+                    f"{self.retries} measurement(s)"
+                )
+        med = band["median_s"]
+        res = {
+            "kind": kernel.kind,
+            "dtype": kernel.dtype,
+            "config": dict(kernel.config),
+            "median_s": med,
+            "min_s": band["min_s"],
+            "max_s": band["max_s"],
+            "spread_pct": band["spread_pct"],
+            "reps": band["n"],
+            "calibrated": calibrated,
+            "macs_per_run": kernel.macs_per_run,
+            "macs_per_s": (kernel.macs_per_run / med) if med else 0.0,
+        }
+        self.results[kernel.name] = res
+        self._order.append(kernel.name)
+        SENTINEL_KERNEL_SECONDS.labels(kernel=kernel.name).set(med)
+        SENTINEL_SPREAD_PCT.labels(kernel=kernel.name).set(
+            res["spread_pct"]
+        )
+        return res
+
+    # ------------------------------------------------------ dispatch probe
+    def probe_dispatch(self, reps: int = 16) -> Dict[str, float]:
+        """Median round-trip of a near-empty kernel: dispatch + scalar
+        read-back.  This is the additive overhead every timed solve pays
+        per dispatch — the quantity deflation removes."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        x = jax.device_put(np.arange(8, dtype=np.int32), self.device)
+        tick = jax.jit(lambda v: v + jnp.int32(1))
+        for _ in range(3):  # compile + warm the transfer path
+            _read_scalar(tick(x))
+        times = []
+        for _ in range(max(4, reps)):
+            s = self.timer()
+            _read_scalar(tick(x))
+            times.append(self.timer() - s)
+        band = _band(times)
+        self.results["_dispatch"] = band
+        SENTINEL_DISPATCH_SECONDS.set(band["median_s"])
+        return band
+
+    # ------------------------------------------------------------ context
+    def context(self) -> dict:
+        """The calibration block a bench record carries: per-kernel bands,
+        the worst calibrated-kernel spread (``spread_pct`` — the round's
+        noise figure), the dispatch probe, and the measured practical peak
+        (max MACs/s over the matmul sentinels — the roofline fallback
+        reference on hosts with no published peak)."""
+        kernels = {
+            name: dict(self.results[name])
+            for name in self._order
+            if name in self.results
+        }
+        spreads = [k["spread_pct"] for k in kernels.values()]
+        peaks = [
+            k["macs_per_s"] for k in kernels.values() if k["macs_per_run"]
+        ]
+        dispatch = self.results.get("_dispatch") or {}
+        import jax
+
+        dev = self.device
+        return {
+            "platform": self.platform,
+            "device": getattr(dev, "device_kind", str(dev)),
+            "jax_version": jax.__version__,
+            "max_spread_pct_bound": self.max_spread_pct,
+            "spread_pct": max(spreads) if spreads else 0.0,
+            "calibrated": all(k["calibrated"] for k in kernels.values()),
+            "calibrated_peak_macs_per_s": max(peaks) if peaks else 0.0,
+            "dispatch_s": dispatch.get("median_s", 0.0),
+            "dispatch_min_s": dispatch.get("min_s", 0.0),
+            "dispatch_band": dispatch,
+            "kernels": kernels,
+        }
+
+
+def default_suite(
+    device=None,
+    *,
+    reps: int = 5,
+    max_spread_pct: Optional[float] = None,
+    strict: bool = False,
+) -> SentinelSuite:
+    """Build the default 3-kernel suite, registering (and thereby
+    measuring + verifying) every kernel, then run the dispatch probe."""
+    suite = SentinelSuite(device, reps=reps, max_spread_pct=max_spread_pct)
+    for k in _default_kernels(suite.platform):
+        suite.register(k, strict=strict)
+    suite.probe_dispatch()
+    return suite
+
+
+def run_calibration(
+    device=None,
+    *,
+    reps: int = 5,
+    max_spread_pct: Optional[float] = None,
+    strict: bool = False,
+) -> dict:
+    """One-call calibration: build + measure the default suite and return
+    its context block (what ``bench.py`` prepends to every record)."""
+    return default_suite(
+        device, reps=reps, max_spread_pct=max_spread_pct, strict=strict
+    ).context()
+
+
+def slim_context(ctx: dict) -> dict:
+    """The compact calibration block stored on every bench record: enough
+    to deflate (``dispatch_s``), to judge the round's noise
+    (``spread_pct`` + per-kernel medians/spreads), and to anchor the
+    roofline fallback (``calibrated_peak_macs_per_s``) — without the
+    per-kernel config/band bulk."""
+    return {
+        "platform": ctx.get("platform"),
+        "device": ctx.get("device"),
+        "dispatch_s": round(float(ctx.get("dispatch_s", 0.0)), 6),
+        "dispatch_min_s": round(float(ctx.get("dispatch_min_s", 0.0)), 6),
+        "spread_pct": round(float(ctx.get("spread_pct", 0.0)), 3),
+        "calibrated": bool(ctx.get("calibrated", False)),
+        "calibrated_peak_macs_per_s": round(
+            float(ctx.get("calibrated_peak_macs_per_s", 0.0)), 1
+        ),
+        "kernels": {
+            name: {
+                "median_s": round(float(k.get("median_s", 0.0)), 6),
+                "spread_pct": round(float(k.get("spread_pct", 0.0)), 3),
+                "calibrated": bool(k.get("calibrated", False)),
+            }
+            for name, k in (ctx.get("kernels") or {}).items()
+        },
+    }

@@ -5,6 +5,9 @@ The gate itself moved into the package
 (``kubernetes_verification_tpu/analysis/bench_gate.py``) so every repo
 gate lives under ``analysis/``; this script keeps the historical entry
 point, flags and exit codes byte-for-byte (tier-1 invokes ``main`` here).
+All flags pass straight through, including ``--deflated`` (default: gate
+dispatch-deflated twin series where they have history) and ``--raw``
+(pre-sentinel behaviour).
 """
 from __future__ import annotations
 

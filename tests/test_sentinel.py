@@ -1,0 +1,479 @@
+"""The perf-sentinel layer: calibration kernels + the dispatch probe
+(``observe/sentinel.py``), dispatch-deflated twin series and derived-series
+gating (``observe/history.py`` + ``analysis/bench_gate.py``), roofline
+accounting (``observe/introspect.py``), and the ``bench.py --mode
+sentinel`` / ``kv-tpu explain --roofline`` / ``kv-tpu history`` surfaces."""
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from kubernetes_verification_tpu.observe import REGISTRY
+from kubernetes_verification_tpu.observe.history import (
+    _direction,
+    append_run,
+    check_regression,
+    deflate_record,
+    expand_derived,
+    format_findings,
+    load_runs,
+)
+from kubernetes_verification_tpu.observe.introspect import (
+    device_peak_macs_per_s,
+    format_roofline_table,
+    roofline_rows,
+)
+from kubernetes_verification_tpu.observe.sentinel import (
+    SentinelCalibrationError,
+    SentinelKernel,
+    SentinelSuite,
+    run_calibration,
+    slim_context,
+)
+from kubernetes_verification_tpu.resilience.errors import ConfigError
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        name, REPO / "scripts" / f"{name}.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# -------------------------------------------------------- _direction rules
+def test_direction_sentinel_context_series_are_ungated():
+    # the context series ARE the noise measurement: gating them would gate
+    # on the noise itself, whatever their unit says
+    assert _direction("pct", "sentinel_spread_pct") == "unknown"
+    assert _direction("s", "sentinel_dispatch_s") == "unknown"
+    # but the per-kernel series gate lower-is-better by unit: a calibrated
+    # compute-bound kernel slowing down is real signal
+    assert _direction("s", "sentinel_mxu_int8_s") == "lower"
+
+
+def test_direction_compile_s_gates_lower():
+    assert _direction("s", "compile_s") == "lower"
+    assert _direction("s", "queries_per_second compile_s") == "lower"
+    # no suffix match without the separating space
+    assert _direction("weird", "precompile_s_thing") == "unknown"
+
+
+def test_direction_pct_of_peak_gates_higher():
+    assert _direction("pct", "pct_of_peak") == "higher"
+    assert _direction("pct", "tiled_pct_of_peak") == "higher"
+
+
+def test_direction_deflated_inherits_base_direction():
+    assert _direction("pairs/s", "m_deflated") == "higher"
+    assert _direction("queries/s", "aggregate_queries_per_second_deflated") == "higher"
+    assert _direction("ms", "latency_deflated") == "lower"
+    assert _direction("weird_pct", "mystery_deflated") == "unknown"
+
+
+# ----------------------------------------------------------- deflation math
+def _sentinel_runs(computes, dispatches, work=1e6, metric="m"):
+    """Fake throughput history where wall = compute + dispatch per solve."""
+    runs = []
+    for c, d in zip(computes, dispatches):
+        steady = c + d
+        runs.append(
+            {
+                "metric": metric,
+                "unit": "pairs/s",
+                "value": work / steady,
+                "steady_s": steady,
+                "sentinel": {"dispatch_s": d},
+            }
+        )
+    return runs
+
+
+def test_deflate_record_throughput():
+    (rec,) = _sentinel_runs([0.010], [0.001], work=1000.0)
+    twin = deflate_record(rec)
+    assert twin["metric"] == "m_deflated" and twin["unit"] == "pairs/s"
+    # value * steady / (steady - dispatch): the dispatch-free throughput
+    assert twin["value"] == pytest.approx(1000.0 / 0.010)
+    assert twin["derived_from"] == "m" and not twin["deflation_clamped"]
+
+
+def test_deflate_record_latency_units():
+    rec = {
+        "metric": "lat",
+        "unit": "ms",
+        "value": 11.0,
+        "sentinel": {"dispatch_s": 0.001},
+    }
+    twin = deflate_record(rec)
+    assert twin["value"] == pytest.approx(10.0)
+    assert twin["metric"] == "lat_deflated" and twin["unit"] == "ms"
+
+
+def test_deflate_record_clamps_probe_misreads():
+    # dispatch >= steady: the compute term floors at 10% of the measured
+    # figure instead of going negative, and the twin says so
+    rec = _sentinel_runs([0.001], [0.020], work=1000.0)[0]
+    twin = deflate_record(rec)
+    assert twin["deflation_clamped"]
+    assert twin["value"] == pytest.approx(rec["value"] * 10.0)
+
+
+def test_deflate_record_refuses_unusable_shapes():
+    assert deflate_record({"metric": "m", "unit": "pairs/s", "value": 1.0}) is None
+    assert (
+        deflate_record(
+            {
+                "metric": "m_deflated",
+                "unit": "pairs/s",
+                "value": 1.0,
+                "steady_s": 1.0,
+                "sentinel": {"dispatch_s": 0.1},
+            }
+        )
+        is None  # never deflate a twin again
+    )
+    assert (
+        deflate_record(
+            {
+                "metric": "m",
+                "unit": "bytes",  # lower-is-better but not a time unit
+                "value": 10.0,
+                "sentinel": {"dispatch_s": 0.1},
+            }
+        )
+        is None
+    )
+    # throughput without steady_s has nothing to deflate against
+    assert (
+        deflate_record(
+            {
+                "metric": "m",
+                "unit": "pairs/s",
+                "value": 10.0,
+                "sentinel": {"dispatch_s": 0.1},
+            }
+        )
+        is None
+    )
+
+
+def test_expand_derived_compile_s_and_twins():
+    runs = _sentinel_runs([0.01, 0.01], [0.001, 0.001])
+    runs[0]["compile_s"] = 14.3
+    expanded = expand_derived(runs)
+    metrics = [r["metric"] for r in expanded]
+    assert metrics == ["m", "m compile_s", "m_deflated", "m", "m_deflated"]
+    comp = expanded[1]
+    assert comp["unit"] == "s" and comp["value"] == pytest.approx(14.3)
+    # headtohead emits compile_s as a per-variant dict: not a series
+    only = expand_derived(
+        [{"metric": "ab", "unit": "pct", "value": 1.0, "compile_s": {"xla": 3.0}}]
+    )
+    assert len(only) == 1
+    # deflate=False keeps the compile series but skips the twins
+    assert [r["metric"] for r in expand_derived(runs, deflate=False)] == [
+        "m", "m compile_s", "m",
+    ]
+
+
+# ------------------------------------------------- the two gate fixtures
+def test_gate_stays_green_when_only_dispatch_noise_regresses():
+    # tunnel noise round: dispatch jumps 0.001 -> 0.011 while device
+    # compute holds at 0.010 — raw drops ~48%, deflated is flat
+    runs = _sentinel_runs([0.010] * 6, [0.001] * 5 + [0.011])
+    ok_raw, _ = check_regression(runs)
+    assert not ok_raw  # the pre-sentinel gate would fail on noise
+    ok, findings = check_regression(expand_derived(runs), prefer_deflated=True)
+    assert ok, format_findings(findings)
+    raw = next(f for f in findings if f["metric"] == "m")
+    assert raw["gated_via"] == "m_deflated" and not raw["regressed"]
+    assert "context" in format_findings(findings)
+
+
+def test_gate_fails_when_deflated_series_regresses():
+    # real regression round: dispatch flat, device compute doubles — the
+    # deflated twin carries the verdict and fails
+    runs = _sentinel_runs([0.010] * 5 + [0.020], [0.001] * 6)
+    ok, findings = check_regression(expand_derived(runs), prefer_deflated=True)
+    assert not ok
+    defl = next(f for f in findings if f["metric"] == "m_deflated")
+    assert defl["regressed"] and defl["ratio"] == pytest.approx(0.5, abs=0.03)
+
+
+def test_gate_compile_time_walk_is_gated():
+    # the 14.3s -> 59.8s walk that motivated the satellite: the derived
+    # compile series gates lower-is-better even though raw stays flat
+    runs = [
+        {"metric": "m", "unit": "pairs/s", "value": 100.0, "compile_s": c}
+        for c in [14.3, 15.0, 14.8, 20.4, 59.8]
+    ]
+    ok, findings = check_regression(expand_derived(runs))
+    assert not ok
+    f = next(x for x in findings if x["metric"] == "m compile_s")
+    assert f["regressed"] and f["direction"] == "lower"
+
+
+def test_bench_gate_shim_deflated_and_raw_flags(tmp_path, capsys):
+    mod = _load_script("check_bench_regression")
+    noise = str(tmp_path / "noise.jsonl")
+    for r in _sentinel_runs([0.010] * 6, [0.001] * 5 + [0.011]):
+        append_run(r, noise)
+    # default (--deflated): noise-only raw regression passes
+    assert mod.main([noise]) == 0
+    assert mod.main([noise, "--deflated"]) == 0
+    # --raw restores the pre-sentinel behaviour byte-compatibly
+    assert mod.main([noise, "--raw"]) == 1
+    real = str(tmp_path / "real.jsonl")
+    for r in _sentinel_runs([0.010] * 5 + [0.020], [0.001] * 6):
+        append_run(r, real)
+    assert mod.main([real]) == 1  # a real deflated regression still fails
+    out = mod.main([real, "--json"])
+    assert out == 1
+    payload = json.loads(capsys.readouterr().out.splitlines()[-1])
+    assert any(
+        f["metric"] == "m_deflated" and f["regressed"]
+        for f in payload["findings"]
+    )
+
+
+# ------------------------------------------------------ the sentinel suite
+def _scripted_timer(durations, repeats=40):
+    """Deterministic clock: each timed run reads the next duration."""
+    seq, t = [], 0.0
+    for d in list(durations) * repeats:
+        seq.append(t)
+        t += d
+        seq.append(t)
+    it = iter(seq)
+    return lambda: next(it)
+
+
+def _dummy_kernel():
+    return SentinelKernel(
+        name="dummy",
+        build=lambda dev, cfg: (lambda: 0.0),
+        macs_per_run=1000,
+        kind="mxu",
+        dtype="int8",
+        config={"n": 1},
+    )
+
+
+def test_register_verifies_spread_and_records_macs():
+    suite = SentinelSuite(
+        reps=3, max_spread_pct=5.0,
+        timer=_scripted_timer([0.100, 0.101, 0.100]),
+    )
+    res = suite.register(_dummy_kernel())
+    assert res["calibrated"] and res["spread_pct"] <= 5.0
+    assert res["macs_per_s"] == pytest.approx(1000 / 0.100, rel=0.05)
+    assert suite.results["dummy"]["median_s"] == pytest.approx(0.100, rel=0.05)
+
+
+def test_register_strict_raises_on_noisy_instrument():
+    suite = SentinelSuite(
+        reps=3, max_spread_pct=1.0,
+        timer=_scripted_timer([0.10, 0.20, 0.10]),
+    )
+    with pytest.raises(SentinelCalibrationError):
+        suite.register(_dummy_kernel(), strict=True)
+    # the taxonomy contract: a calibration failure is a ConfigError
+    assert issubclass(SentinelCalibrationError, ConfigError)
+
+
+def test_register_non_strict_marks_uncalibrated_and_counts():
+    before = (
+        REGISTRY.dump()["counters"]
+        .get("kvtpu_sentinel_calibration_failures_total", {})
+        .get("kernel=dummy", 0.0)
+    )
+    suite = SentinelSuite(
+        reps=3, max_spread_pct=1.0,
+        timer=_scripted_timer([0.10, 0.20, 0.10]),
+    )
+    res = suite.register(_dummy_kernel())
+    assert not res["calibrated"]
+    after = REGISTRY.dump()["counters"][
+        "kvtpu_sentinel_calibration_failures_total"
+    ]["kernel=dummy"]
+    assert after >= before + 1
+
+
+def test_run_calibration_cpu_end_to_end():
+    # real kernels on the host backend; the spread bound is opened wide so
+    # a noisy CI neighbour can never flake this test — what it asserts is
+    # the SHAPE of the context, not this host's noise
+    ctx = run_calibration(reps=3, max_spread_pct=1e9)
+    assert set(ctx["kernels"]) == {"mxu_int8", "mxu_f32", "vpu_bitops"}
+    assert ctx["dispatch_s"] > 0 and ctx["calibrated"]
+    assert ctx["calibrated_peak_macs_per_s"] > 0
+    slim = slim_context(ctx)
+    assert slim["dispatch_s"] == pytest.approx(ctx["dispatch_s"], abs=1e-6)
+    assert set(slim["kernels"]) == set(ctx["kernels"])
+    json.dumps(slim)  # must be history-record safe as-is
+
+
+# ------------------------------------------------------------- roofline
+def test_device_peak_longest_prefix_match():
+    assert device_peak_macs_per_s("TPU v5 lite") == pytest.approx(197.1e12)
+    # "TPU v5p" must beat the shorter "TPU v5" prefix
+    assert device_peak_macs_per_s("TPU v5p") == pytest.approx(459.0e12)
+    assert device_peak_macs_per_s("TPU v4 (something)") == pytest.approx(137.5e12)
+    assert device_peak_macs_per_s("Quantum9000") is None
+    assert device_peak_macs_per_s(None) is None
+    assert device_peak_macs_per_s("TPU v5 lite", dtype="bf16") == pytest.approx(
+        98.55e12
+    )
+
+
+def _roofline_fixture():
+    return [
+        # the VERDICT flagship figure: 2.9e14 MACs in 4.14s on a v5e
+        {
+            "metric": "all-pairs", "unit": "pairs/s", "value": 2.4e9,
+            "mode": "tiled", "device": "TPU v5 lite", "platform": "tpu",
+            "macs": 2.9e14, "steady_s": 4.14,
+            "macs_basis": "n_pods^2 * (ingress_grants + egress_grants)",
+        },
+        {
+            "metric": "closure_pairs_per_second", "unit": "pairs/s",
+            "value": 1e9, "mode": "closure", "device": "cpu",
+            "platform": "cpu",
+            "sentinel": {"dispatch_s": 1e-4,
+                         "calibrated_peak_macs_per_s": 6.0e10},
+            "macs": 1.0e12, "steady_s": 10.0,
+        },
+        {
+            "metric": "x", "unit": "pairs/s", "value": 1.0, "mode": "k8s",
+            "device": "Quantum9000", "platform": "cpu",
+            "macs": 5.0e11, "steady_s": 2.0,
+        },
+    ]
+
+
+def test_roofline_rows_sources_and_pct():
+    rows = roofline_rows(_roofline_fixture())
+    by = {r["mode"]: r for r in rows}
+    assert by["tiled"]["peak_source"] == "peak-table[TPU v5 lite]"
+    # ~36% of v5e int8 peak — the VERDICT ported estimate
+    assert by["tiled"]["pct_of_peak"] == pytest.approx(35.5, abs=1.0)
+    assert by["closure"]["peak_source"] == "sentinel-calibrated"
+    assert by["closure"]["pct_of_peak"] == pytest.approx(166.7, abs=1.0)
+    assert by["k8s"]["peak_source"] == "analytic-host"
+    assert by["k8s"]["peak_macs_per_s"] > 0
+    gauges = REGISTRY.dump()["gauges"]
+    assert gauges["kvtpu_roofline_pct_of_peak"]["mode=tiled"] == pytest.approx(
+        35.5, abs=1.0
+    )
+    assert gauges["kvtpu_roofline_achieved_macs_per_second"][
+        "mode=tiled"
+    ] == pytest.approx(2.9e14 / 4.14, rel=1e-6)
+
+
+def test_roofline_rows_newest_record_wins_and_skips_unusable():
+    old = dict(_roofline_fixture()[0], steady_s=8.28)
+    new = _roofline_fixture()[0]
+    rows = roofline_rows(
+        [old, new, {"metric": "no-macs", "unit": "s", "value": 1.0}]
+    )
+    assert len(rows) == 1 and rows[0]["steady_s"] == pytest.approx(4.14)
+
+
+def test_format_roofline_table():
+    rows = roofline_rows(_roofline_fixture())
+    table = format_roofline_table(rows)
+    lines = table.splitlines()
+    assert "% peak" in lines[0] and "peak source" in lines[0]
+    assert any("peak-table[TPU v5 lite]" in ln for ln in lines)
+    assert any("sentinel-calibrated" in ln for ln in lines)
+    assert any("analytic-host" in ln for ln in lines)
+    assert format_roofline_table([]) == ""
+
+
+# ------------------------------------------------------------------ CLI
+def test_cli_history_renders_deflated_and_spread(tmp_path, capsys):
+    from kubernetes_verification_tpu.cli import main
+
+    p = str(tmp_path / "h.jsonl")
+    for r in _sentinel_runs([0.010] * 3, [0.001] * 3):
+        r["sentinel"]["spread_pct"] = 2.5
+        append_run(r, p)
+    rc = main(["history", p])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "deflated=" in out and "sentinel_spread=2.5%" in out
+    # the raw series is context (the twin carries the verdict), visible
+    assert "context" in out
+
+
+def test_cli_history_gates_the_deflated_series(tmp_path, capsys):
+    from kubernetes_verification_tpu.cli import main
+
+    p = str(tmp_path / "h.jsonl")
+    for r in _sentinel_runs([0.010] * 5 + [0.020], [0.001] * 6):
+        append_run(r, p)
+    rc = main(["history", p])
+    out = capsys.readouterr().out
+    assert rc == 1 and "REGRESSED" in out and "m_deflated" in out
+
+
+def test_cli_explain_roofline(tmp_path, capsys):
+    from kubernetes_verification_tpu.cli import main
+
+    p = tmp_path / "h.jsonl"
+    with open(p, "w") as fh:
+        for rec in _roofline_fixture():
+            fh.write(json.dumps(rec) + "\n")
+    assert main(["explain", "--roofline", str(p)]) == 0
+    out = capsys.readouterr().out
+    assert "% peak" in out and "peak-table[TPU v5 lite]" in out
+    assert main(["explain", "--roofline", "--json", str(p)]) == 0
+    rows = json.loads(capsys.readouterr().out)["rows"]
+    assert any(r["mode"] == "tiled" and r["pct_of_peak"] > 30 for r in rows)
+
+
+def test_cli_explain_roofline_empty_history(tmp_path, capsys):
+    from kubernetes_verification_tpu.cli import main
+
+    p = tmp_path / "empty.jsonl"
+    p.write_text("")
+    assert main(["explain", "--roofline", str(p)]) == 0
+    assert "no history record carries MAC accounting" in capsys.readouterr().out
+
+
+# ------------------------------------------------- bench.py --mode sentinel
+def test_bench_mode_sentinel_records_history(tmp_path):
+    hist = tmp_path / "h.jsonl"
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        KVTPU_BENCH_HISTORY=str(hist),
+        # the test asserts record SHAPE; a noisy CI host must not flake it
+        KVTPU_SENTINEL_MAX_SPREAD_PCT="100000",
+    )
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--mode", "sentinel"],
+        env=env, capture_output=True, text=True, timeout=240,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    runs = load_runs([str(hist)])
+    metrics = {r["metric"] for r in runs}
+    assert {
+        "sentinel_mxu_int8_s", "sentinel_mxu_f32_s", "sentinel_vpu_bitops_s",
+        "sentinel_dispatch_s", "sentinel_spread_pct",
+    } <= metrics
+    rec = next(r for r in runs if r["metric"] == "sentinel_mxu_int8_s")
+    # the structured context fields every record now carries
+    assert rec["mode"] == "sentinel" and rec["platform"] == "cpu"
+    assert "device" in rec and rec["sentinel"]["dispatch_s"] > 0
+    # a sentinel-only history gates green (single-entry + ungated series)
+    ok, findings = check_regression(expand_derived(runs), prefer_deflated=True)
+    assert ok, format_findings(findings)
