@@ -1070,18 +1070,28 @@ def bench_serve(args) -> None:
     )
 
 
+#: above this the dense [N,N] int32 count matrices stop being a sane
+#: single-chip comparator (2 × 4 GB at 32k pods); --mode query drops to
+#: packed-only with a log line instead of silently OOMing
+_DENSE_QUERY_LIMIT = 32_768
+
+
 def bench_query(args) -> None:
     """Batched query engine throughput: answer a mixed probe workload (95%%
     any-port with an 80/20 hot-source skew, 5%% port-refined on a
     hot-pair set) through
     ``QueryEngine.can_reach_batch`` — one jitted device dispatch per batch,
     generation-keyed row/port caching — against a loop of scalar
-    ``can_reach`` calls over the same distribution. Headline value is
-    steady-state queries/s on a dirty engine (the serving regime: churn has
-    invalidated the reach derivation and the batch answers from gathered
-    rows without paying a full solve); per-batch p50/p99 latency, the
-    cold-cache and post-churn figures, and the measured scalar comparison
-    ride along."""
+    ``can_reach`` calls over the same distribution. Runs the workload on
+    the requested engines (``--engine dense|packed|both``): the packed run
+    serves straight from device-resident uint32 word rows (matrix-free —
+    the regime that scales to the 100k-pod config
+    ``--pods 100000 --engine packed``) and the two blended figures are
+    compared head to head. Headline value per engine is steady-state
+    queries/s on a dirty engine; per-batch p50/p99 latency, cold-cache and
+    post-churn figures, the measured scalar comparison, and the
+    steady-window host-to-device byte delta (``query_h2d_bytes`` — flat at
+    0 when engine state is device-resident) ride along."""
     import jax
     import numpy as np
 
@@ -1089,6 +1099,10 @@ def bench_query(args) -> None:
         GeneratorConfig,
         random_cluster,
         random_event_stream,
+    )
+    from kubernetes_verification_tpu.observe.metrics import (
+        QUERY_CACHE_MISSES_TOTAL,
+        QUERY_H2D_BYTES_TOTAL,
     )
     from kubernetes_verification_tpu.serve import (
         QueryEngine,
@@ -1098,6 +1112,18 @@ def bench_query(args) -> None:
     dev = jax.devices()[0]
     log(f"device: {dev} ({jax.default_backend()})")
     n = args.pods
+    engines = (
+        ["dense", "packed"] if args.engine == "both" else [args.engine]
+    )
+    if "dense" in engines and n > _DENSE_QUERY_LIMIT:
+        gb = 2 * n * n * 4 / 1e9
+        log(
+            f"dense engine skipped at {n} pods (the two [N,N] int32 count "
+            f"matrices alone are {gb:.0f} GB); running packed only"
+        )
+        engines = [e for e in engines if e != "dense"]
+        if not engines:
+            engines = ["packed"]
     t0 = time.perf_counter()
     cluster = random_cluster(
         GeneratorConfig(
@@ -1107,13 +1133,9 @@ def bench_query(args) -> None:
     )
     events = random_event_stream(cluster, n_events=128, seed=5)
     t1 = time.perf_counter()
-    svc = VerificationService(cluster)
-    svc.reach()  # engine init + first derive: compiles out of steady figures
-    q = QueryEngine(svc)
-    pods = svc.engine.pods
+    pods = cluster.pods
     ref = lambda i: f"{pods[i % n].namespace}/{pods[i % n].name}"
-    t2 = time.perf_counter()
-    log(f"generate {t1 - t0:.1f}s  service init+first solve {t2 - t1:.1f}s")
+    log(f"generate {t1 - t0:.1f}s")
 
     # mixed workload, the admission-control shape: 95% any-port probes
     # whose sources follow an 80/20 hot-set skew (service traffic
@@ -1145,76 +1167,118 @@ def bench_query(args) -> None:
         return out
 
     batches = [make_batch(k) for k in range(n_batches)]
-    svc.apply(events[:64])  # dirty the engine: the serving-regime state
-    q.can_reach_batch(batches[0])  # kernel compiles + cache fill
-    # cold figure: a fresh engine's first batch on the warm jit caches —
-    # all rows miss, one device dispatch, port groups solved once
-    qc = QueryEngine(svc)
-    s = time.perf_counter()
-    qc.can_reach_batch(batches[0])
-    cold_s = time.perf_counter() - s
-    # steady state: warm generation-keyed cache, engine still dirty
-    lat = []
-    s_all = time.perf_counter()
-    for b in batches:
-        s = time.perf_counter()
-        q.can_reach_batch(b)
-        lat.append(time.perf_counter() - s)
-    wall = time.perf_counter() - s_all
-    n_timed = n_batches * sub
-    value = n_timed / wall
-    lat_sorted = sorted(lat)
-    p50 = lat_sorted[len(lat_sorted) // 2]
-    p99 = lat_sorted[min(len(lat_sorted) - 1, int(len(lat_sorted) * 0.99))]
-    batch_band = _band(lat)
-    log(
-        f"{n_timed} mixed queries in {wall * 1e3:.1f}ms = {value:,.0f} "
-        f"queries/s (batch={sub}: p50 {p50 * 1e3:.2f}ms p99 "
-        f"{p99 * 1e3:.2f}ms; cold batch {cold_s * 1e3:.1f}ms)"
-    )
+    blended: dict = {}
+    for kind in engines:
+        t2 = time.perf_counter()
+        if kind == "packed":
+            from kubernetes_verification_tpu.packed_incremental import (
+                PackedIncrementalVerifier,
+            )
 
-    # scalar comparator on the SAME distribution, measured per call. The
-    # scalar loop is given its best case: the first can_reach pays the
-    # full lazy solve (excluded), every later any-port call reads the
-    # clean matrix. Blend per the 95/5 workload mix.
-    q.can_reach(ref(0), ref(1))  # pays the solve; now clean
-    sc_any = []
-    rs = np.random.default_rng(2)
-    for _ in range(512):
-        a, b = rs.integers(0, n, 2)
-        s = time.perf_counter()
-        q.can_reach(ref(int(a)), ref(int(b)))
-        sc_any.append(time.perf_counter() - s)
-    sc_port = []
-    for k in range(4):
-        hs, hd = hot[k]
-        s = time.perf_counter()
-        q.can_reach(ref(hs), ref(hd), port=hot_ports[k % 3])
-        sc_port.append(time.perf_counter() - s)
-    any_med = sorted(sc_any)[len(sc_any) // 2]
-    port_med = sorted(sc_port)[len(sc_port) // 2]
-    scalar_per_query = 0.95 * any_med + 0.05 * port_med
-    scalar_qps = 1.0 / scalar_per_query
-    speedup = value / scalar_qps
-    speedup_any = value * any_med
-    log(
-        f"scalar loop: any-port {any_med * 1e6:.1f}us/query, ported "
-        f"{port_med * 1e3:.1f}ms/query -> blended {scalar_qps:,.0f} "
-        f"queries/s; batched speedup {speedup:.0f}x "
-        f"(vs pure any-port loop {speedup_any:.0f}x)"
-    )
+            from kubernetes_verification_tpu import VerifyConfig
 
-    # post-churn rider: another applied batch bumps the generation, the
-    # cache drops, and the next batch re-gathers rows on the dirty engine
-    svc.apply(events[64:])
-    s = time.perf_counter()
-    q.can_reach_batch(batches[0])
-    churn_s = time.perf_counter() - s
-    log(f"first batch after churn (cache invalidated): {churn_s * 1e3:.1f}ms")
-    _emit(
-        {
+            svc = VerificationService(
+                engine=PackedIncrementalVerifier(
+                    cluster,
+                    VerifyConfig(compute_ports=False),
+                    keep_matrix=False,
+                )
+            )
+        else:
+            svc = VerificationService(cluster)
+            svc.reach()  # first derive: compiles out of steady figures
+        q = QueryEngine(svc)
+        t3 = time.perf_counter()
+        log(f"[{kind}] service init+first solve {t3 - t2:.1f}s")
+        svc.apply(events[:64])  # dirty the engine: the serving regime
+        q.can_reach_batch(batches[0])  # kernel compiles + cache fill
+        # cold figure: a fresh engine's first batch on the warm jit
+        # caches — all rows miss, one dispatch, port groups solved once
+        qc = QueryEngine(svc)
+        s = time.perf_counter()
+        qc.can_reach_batch(batches[0])
+        cold_s = time.perf_counter() - s
+        # steady state: warm generation-keyed cache, engine still dirty;
+        # the H2D counter delta across this window is the residency
+        # claim — engine state already lives on device, so warm batches
+        # must transfer nothing
+        h2d_before = QUERY_H2D_BYTES_TOTAL.labels(kind=kind).value
+        miss_before = QUERY_CACHE_MISSES_TOTAL.labels(kind="rows").value
+        lat = []
+        s_all = time.perf_counter()
+        for b in batches:
+            s = time.perf_counter()
+            q.can_reach_batch(b)
+            lat.append(time.perf_counter() - s)
+        wall = time.perf_counter() - s_all
+        h2d_steady = (
+            QUERY_H2D_BYTES_TOTAL.labels(kind=kind).value - h2d_before
+        )
+        rows_steady = (
+            QUERY_CACHE_MISSES_TOTAL.labels(kind="rows").value
+            - miss_before
+        )
+        n_timed = n_batches * sub
+        value = n_timed / wall
+        lat_sorted = sorted(lat)
+        p50 = lat_sorted[len(lat_sorted) // 2]
+        p99 = lat_sorted[
+            min(len(lat_sorted) - 1, int(len(lat_sorted) * 0.99))
+        ]
+        batch_band = _band(lat)
+        log(
+            f"[{kind}] {n_timed} mixed queries in {wall * 1e3:.1f}ms = "
+            f"{value:,.0f} queries/s (batch={sub}: p50 {p50 * 1e3:.2f}ms "
+            f"p99 {p99 * 1e3:.2f}ms; cold batch {cold_s * 1e3:.1f}ms; "
+            f"steady-window H2D {h2d_steady:,.0f} bytes)"
+        )
+
+        # scalar comparator on the SAME distribution, measured per call.
+        # The scalar loop is given its best case: the first can_reach pays
+        # the full lazy solve / row gather (excluded), later any-port
+        # calls read the clean matrix (dense) or cached word rows
+        # (packed). Blend per the 95/5 workload mix.
+        q.can_reach(ref(0), ref(1))  # pays the solve; now warm
+        sc_any = []
+        rs = np.random.default_rng(2)
+        for _ in range(512):
+            a, b = rs.integers(0, n, 2)
+            s = time.perf_counter()
+            q.can_reach(ref(int(a)), ref(int(b)))
+            sc_any.append(time.perf_counter() - s)
+        sc_port = []
+        for k in range(4):
+            hs, hd = hot[k]
+            s = time.perf_counter()
+            q.can_reach(ref(hs), ref(hd), port=hot_ports[k % 3])
+            sc_port.append(time.perf_counter() - s)
+        any_med = sorted(sc_any)[len(sc_any) // 2]
+        port_med = sorted(sc_port)[len(sc_port) // 2]
+        scalar_per_query = 0.95 * any_med + 0.05 * port_med
+        scalar_qps = 1.0 / scalar_per_query
+        speedup = value / scalar_qps
+        speedup_any = value * any_med
+        log(
+            f"[{kind}] scalar loop: any-port {any_med * 1e6:.1f}us/query, "
+            f"ported {port_med * 1e3:.1f}ms/query -> blended "
+            f"{scalar_qps:,.0f} queries/s; batched speedup {speedup:.0f}x "
+            f"(vs pure any-port loop {speedup_any:.0f}x)"
+        )
+
+        # post-churn rider: another applied batch bumps the generation,
+        # the cache drops, and the next batch re-gathers rows
+        svc.apply(events[64:])
+        s = time.perf_counter()
+        q.can_reach_batch(batches[0])
+        churn_s = time.perf_counter() - s
+        log(
+            f"[{kind}] first batch after churn (cache invalidated): "
+            f"{churn_s * 1e3:.1f}ms"
+        )
+        tag = "packed batched" if kind == "packed" else "batched"
+        record = {
             "metric": (
-                f"batched queries_per_second: mixed 95/5 any-port/ported "
+                f"{tag} queries_per_second: mixed 95/5 any-port/ported "
                 f"can_reach_batch, {n} pods / {args.policies} policies, "
                 f"batch {sub}, 1 chip"
             ),
@@ -1232,10 +1296,31 @@ def bench_query(args) -> None:
             "scalar_queries_per_s": round(scalar_qps, 1),
             "speedup_vs_scalar": round(speedup, 1),
             "speedup_vs_scalar_any_port": round(speedup_any, 1),
-            "compile_s": round(t2 - t1, 2),
+            "query_h2d_bytes": float(h2d_steady),
+            "compile_s": round(t3 - t2, 2),
             "steady_s": round(batch_band["median_s"], 4),
         }
-    )
+        if kind == "packed":
+            # roofline accounting: a packed row gather contracts every
+            # missed source row against the per-policy int8 maps (ingress
+            # + egress blocks) over the padded pod axis; a near-zero MAC
+            # count is the point — warm batches answer from cached rows
+            npad = int(svc.engine._n_padded)
+            record["macs"] = rows_steady * float(npad) * 2.0 * float(
+                args.policies
+            )
+            record["macs_basis"] = (
+                "rows_missed_steady * n_padded * 2 * n_policies "
+                "(packed per-policy int8 contractions)"
+            )
+        _emit(record)
+        blended[kind] = (value, scalar_qps)
+    if len(blended) == 2:
+        dv, pv = blended["dense"][0], blended["packed"][0]
+        log(
+            f"packed vs dense blended QPS: {pv:,.0f} vs {dv:,.0f} "
+            f"({pv / dv:.2f}x) at {n} pods"
+        )
 
 
 def _replicate_worker(ck_dir, log_path, idx, n_batches, barrier, out_q):
@@ -1676,8 +1761,9 @@ def main() -> None:
         "serve = churn event stream through the coalescing verification "
         "service with interleaved queries (events/s + query latency); "
         "query = mixed any-port/ported probe batches through "
-        "QueryEngine.can_reach_batch vs a scalar can_reach loop "
-        "(queries/s + per-batch p50/p99); "
+        "QueryEngine.can_reach_batch vs a scalar can_reach loop, on the "
+        "dense and/or packed device-resident engine (--engine; queries/s "
+        "+ per-batch p50/p99 + steady-window H2D bytes); "
         "replicate = leader writes the WAL, 1/2/4 follower processes "
         "bootstrap + tail + answer batched queries concurrently "
         "(aggregate queries/s read scaling); "
@@ -1724,6 +1810,14 @@ def main() -> None:
         "--n-queries", type=int, default=8_192,
         help="query mode: total probes in the timed steady-state workload "
         "(answered in sub-batches of 512)",
+    )
+    ap.add_argument(
+        "--engine", choices=("dense", "packed", "both"), default="both",
+        help="query mode: which serving engine(s) run the workload — "
+        "packed answers from device-resident uint32 word rows without a "
+        "dense [N,N] matrix (the only choice above 32k pods; the 100k-pod "
+        "config is --pods 100000 --engine packed); both adds the "
+        "packed-vs-dense blended-QPS comparison line",
     )
     ap.add_argument(
         "--net", action="store_true",

@@ -1708,7 +1708,8 @@ def main(argv: Optional[list] = None) -> int:
     p.add_argument("path", nargs="?", help="manifest file/dir (cold start)")
     p.add_argument(
         "--from-snapshot", metavar="DIR",
-        help="warm restart from a serve snapshot instead of manifests",
+        help="warm restart from a serve snapshot instead of manifests "
+        "(dense or packed — detected from the snapshot contents)",
     )
     p.add_argument(
         "--events", metavar="FILE",
@@ -1841,7 +1842,10 @@ def main(argv: Optional[list] = None) -> int:
     p.add_argument("path", nargs="?", help="manifest file/dir")
     p.add_argument(
         "--from-snapshot", metavar="DIR",
-        help="query a serve snapshot instead of manifests",
+        help="query a serve snapshot instead of manifests; the engine "
+        "kind is auto-detected, and a packed (bitmap-state) snapshot "
+        "answers --batch from device-resident uint32 word rows without "
+        "materialising the dense reach matrix",
     )
     p.add_argument(
         "--can-reach", nargs=2, metavar=("SRC", "DST"),

@@ -221,6 +221,10 @@ def _direction(unit: Optional[str], metric: Optional[str] = None) -> str:
         # roofline utilisation gates higher-is-better
         if metric == "pct_of_peak" or metric.endswith("_pct_of_peak"):
             return "higher"
+        # byte counters (h2d traffic, transfer volumes) gate
+        # lower-is-better: growth means a residency or caching regression
+        if metric.endswith("_bytes"):
+            return "lower"
     return "unknown"
 
 

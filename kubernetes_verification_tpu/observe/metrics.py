@@ -51,6 +51,9 @@ __all__ = [
     "QUERY_CACHE_HITS_TOTAL",
     "QUERY_CACHE_MISSES_TOTAL",
     "QUERY_BATCH_SIZE",
+    "QUERY_H2D_BYTES_TOTAL",
+    "QUERY_PACKED_DISPATCHES_TOTAL",
+    "DEVICE_STATE_FLIPS_TOTAL",
     "CHECKPOINTS_TOTAL",
     "RECOVERIES_TOTAL",
     "WAL_TRUNCATIONS_TOTAL",
@@ -330,6 +333,34 @@ QUERY_BATCH_SIZE = Histogram(
     buckets=(1.0, 8.0, 64.0, 512.0, 4096.0, 32768.0),
 )
 
+QUERY_H2D_BYTES_TOTAL = Counter(
+    "kvtpu_query_h2d_bytes_total",
+    "Host→device bytes uploaded to build query-plane device state, by "
+    "engine kind ('dense' uploads its isolation vectors once per "
+    "generation; 'packed' aliases already-resident state and charges "
+    "nothing) — flat across warm batches means steady-state queries "
+    "moved zero engine bytes over the tunnel.",
+    ("kind",),
+)
+
+QUERY_PACKED_DISPATCHES_TOTAL = Counter(
+    "kvtpu_query_packed_dispatches_total",
+    "Batched query dispatches answered from the packed uint32 bitmap "
+    "state (no dense [N, N] operand in the program), by kernel kind: "
+    "'rows' (word-row gather), 'cols' (who-can-reach columns) or 'probe' "
+    "(fused rows + verdict-bit extraction).",
+    ("kind",),
+)
+
+DEVICE_STATE_FLIPS_TOTAL = Counter(
+    "kvtpu_device_state_flips_total",
+    "Generation flips published by the query plane's double-buffered "
+    "device-state cache, by engine kind — each one is a shadow state "
+    "built off to the side and swapped in atomically, never a stall of "
+    "in-flight query reads.",
+    ("kind",),
+)
+
 SERVE_STALENESS_SECONDS = Gauge(
     "kvtpu_serve_staleness_seconds",
     "Age of the oldest applied-but-unsolved mutation at the most recent "
@@ -587,6 +618,10 @@ REQUIRED_FAMILIES = frozenset(
         "kvtpu_query_cache_hits_total",
         "kvtpu_query_cache_misses_total",
         "kvtpu_query_batch_size",
+        # device-resident query plane (ops/device_state.py + packed twins)
+        "kvtpu_query_h2d_bytes_total",
+        "kvtpu_query_packed_dispatches_total",
+        "kvtpu_device_state_flips_total",
         # durability layer (WAL / checkpoints / recovery / breaker)
         "kvtpu_checkpoints_total",
         "kvtpu_recoveries_total",

@@ -19,6 +19,18 @@ Dynamic batch dimensions are padded to the next power of two before entering
 jit so the number of compiled signatures stays logarithmic in batch size
 (the recompile-hazard rule's concern); padding rows reuse a valid source
 index and are sliced off on the host.
+
+The ``packed_*`` twins answer the same probes straight from the
+`PackedIncrementalVerifier`'s uint32 bitmap state — per-policy int8 maps
+contracted by `_reach_block` (via the engine's own `_rows_step` row
+oracle), word-packed on device, with only the final verdict *bits*
+extracted per probe. No [N, N] operand of any dtype appears in the
+program, so the path works unchanged in matrix-free mode at 100k–1M pods
+and moves ~32× fewer result bytes than the int32 row gather.
+
+Isolation vectors may be passed as pre-uploaded device arrays (see
+`ops/device_state.py`); host arrays are converted as before, so callers
+that have not adopted the residency layer keep working.
 """
 from __future__ import annotations
 
@@ -29,9 +41,42 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["batched_reach_rows", "batched_reach_cols", "batched_any_port"]
+__all__ = [
+    "batched_reach_rows",
+    "batched_reach_cols",
+    "batched_any_port",
+    "packed_reach_rows",
+    "packed_reach_cols",
+    "packed_any_port",
+]
 
 _I32 = jnp.int32
+_U32 = jnp.uint32
+
+_ROWS_STEP = None
+_REACH_BLOCK = None
+
+
+def _packed_ops():
+    """Lazy accessor for the packed engine's shared kernels — imported on
+    first packed dispatch, not at module import (`packed_incremental`
+    itself imports through the `ops` package)."""
+    global _ROWS_STEP, _REACH_BLOCK
+    if _ROWS_STEP is None:
+        from ..packed_incremental import _reach_block, _rows_step
+
+        _ROWS_STEP, _REACH_BLOCK = _rows_step, _reach_block
+    return _ROWS_STEP, _REACH_BLOCK
+
+
+def _as_iso(vec) -> jnp.ndarray:
+    """Isolation vector → int32 device operand. A pre-uploaded device
+    array (the generation-keyed cache in `ops/device_state.py`) passes
+    through untouched — the host→device copy this used to pay per
+    dispatch only happens for host arrays."""
+    if isinstance(vec, jax.Array) and vec.dtype == _I32:
+        return vec
+    return jnp.asarray(vec, dtype=_I32)
 
 
 def _pow2(n: int) -> int:
@@ -165,8 +210,8 @@ def batched_reach_rows(
     rows = _reach_rows_kernel(
         ing_count,
         eg_count,
-        jnp.asarray(ing_iso, dtype=_I32),
-        jnp.asarray(eg_iso, dtype=_I32),
+        _as_iso(ing_iso),
+        _as_iso(eg_iso),
         padded,
         self_traffic=self_traffic,
         default_allow_unselected=default_allow_unselected,
@@ -196,8 +241,8 @@ def batched_reach_cols(
     cols = _reach_cols_kernel(
         ing_count,
         eg_count,
-        jnp.asarray(ing_iso, dtype=_I32),
-        jnp.asarray(eg_iso, dtype=_I32),
+        _as_iso(ing_iso),
+        _as_iso(eg_iso),
         padded,
         self_traffic=self_traffic,
         default_allow_unselected=default_allow_unselected,
@@ -233,8 +278,8 @@ def batched_any_port(
     rows, ans = _probe_rows_kernel(
         ing_count,
         eg_count,
-        jnp.asarray(ing_iso, dtype=_I32),
-        jnp.asarray(eg_iso, dtype=_I32),
+        _as_iso(ing_iso),
+        _as_iso(eg_iso),
         _pad_idx(src_idx, _pow2(src_idx.size)),
         _pad_idx(q_row, _pow2(q_row.size)),
         _pad_idx(q_dst, _pow2(q_dst.size)),
@@ -243,5 +288,191 @@ def batched_any_port(
     )
     return (
         np.asarray(rows)[: src_idx.size],
+        np.asarray(ans)[: q_row.size],
+    )
+
+
+@partial(jax.jit, static_argnames=("self_traffic", "default_allow"))
+def _packed_probe_kernel(
+    sel_ing8,
+    sel_eg8,
+    ing_by_pol,
+    eg_by_pol,
+    ing_cnt,
+    eg_cnt,
+    col_mask,
+    row_valid,
+    src_idx,
+    q_row,
+    q_dst,
+    *,
+    self_traffic: bool,
+    default_allow: bool,
+):
+    """Packed word-rows for ``src_idx`` plus per-probe verdict bits, one
+    dispatch. The row oracle is the engine's own ``_rows_step`` (jit-in-jit
+    inlines it here), so the words are bit-identical to the mutation path's
+    by construction; the answer extraction reads exactly one bit per probe
+    instead of unpacking anything to int32."""
+    rows_step, _ = _packed_ops()
+    words = rows_step(
+        sel_ing8, sel_eg8, ing_by_pol, eg_by_pol, ing_cnt, eg_cnt,
+        col_mask, row_valid, src_idx,
+        self_traffic=self_traffic, default_allow=default_allow,
+    )  # uint32 [K, Np/32]
+    shift = (q_dst % 32).astype(_U32)
+    bits = (words[q_row, q_dst // 32] >> shift) & _U32(1)
+    return words, bits > 0
+
+
+@partial(jax.jit, static_argnames=("self_traffic", "default_allow"))
+def _packed_cols_kernel(
+    sel_ing8,
+    sel_eg8,
+    ing_by_pol,
+    eg_by_pol,
+    ing_cnt,
+    eg_cnt,
+    col_mask,
+    row_valid,
+    dst_idx,
+    *,
+    self_traffic: bool,
+    default_allow: bool,
+):
+    """Reach COLUMNS from the per-policy maps: ``_reach_block`` over
+    (every source × the gathered destinations), masked by row validity on
+    the source axis and the packed column mask on the destination axis —
+    the transpose twin of ``_rows_step`` as a skinny [Np, U] block."""
+    _, reach_block = _packed_ops()
+    C, Np = sel_ing8.shape
+    r = reach_block(
+        ing_by_pol,
+        jnp.take(sel_ing8, dst_idx, axis=1),
+        sel_eg8,
+        jnp.take(eg_by_pol, dst_idx, axis=1),
+        jnp.take(ing_cnt, dst_idx),
+        eg_cnt,
+        jnp.arange(Np, dtype=_I32),
+        dst_idx,
+        self_traffic,
+        default_allow,
+    )
+    r &= row_valid[:, None] > 0
+    dst_ok = (col_mask[dst_idx // 32] >> (dst_idx % 32).astype(_U32)) & _U32(1)
+    return r & (dst_ok > 0)[None, :]
+
+
+def packed_reach_rows(
+    sel_ing8,
+    sel_eg8,
+    ing_by_pol,
+    eg_by_pol,
+    ing_cnt,
+    eg_cnt,
+    col_mask,
+    row_valid,
+    src_idx,
+    *,
+    self_traffic: bool,
+    default_allow: bool,
+) -> np.ndarray:
+    """Packed twin of :func:`batched_reach_rows`: word-rows for ``src_idx``
+    gathered straight from the packed engine's resident maps; returns host
+    uint32 [U, Np/32] (bits past the real pod count are already masked off
+    by ``col_mask``, so ``unpack_cols(words, n_padded)[:, :n]`` is
+    bit-identical to the dense rows at every N including ragged tails)."""
+    from ..observe.metrics import QUERY_PACKED_DISPATCHES_TOTAL
+
+    src_idx = np.asarray(src_idx, dtype=np.int64)
+    n_padded = int(row_valid.shape[0])
+    if src_idx.size == 0:
+        return np.zeros((0, n_padded // 32), dtype=np.uint32)
+    rows_step, _ = _packed_ops()
+    words = rows_step(
+        sel_ing8, sel_eg8, ing_by_pol, eg_by_pol, ing_cnt, eg_cnt,
+        col_mask, row_valid,
+        _pad_idx(src_idx, _pow2(src_idx.size)),
+        self_traffic=self_traffic, default_allow=default_allow,
+    )
+    QUERY_PACKED_DISPATCHES_TOTAL.labels(kind="rows").inc()
+    return np.asarray(words)[: src_idx.size]
+
+
+def packed_reach_cols(
+    sel_ing8,
+    sel_eg8,
+    ing_by_pol,
+    eg_by_pol,
+    ing_cnt,
+    eg_cnt,
+    col_mask,
+    row_valid,
+    dst_idx,
+    *,
+    n: int,
+    self_traffic: bool,
+    default_allow: bool,
+) -> np.ndarray:
+    """Packed twin of :func:`batched_reach_cols`; returns bool [n, U] —
+    column ``k`` lists every source that reaches ``dst_idx[k]``, computed
+    from the per-policy maps without any [N, N] operand."""
+    from ..observe.metrics import QUERY_PACKED_DISPATCHES_TOTAL
+
+    dst_idx = np.asarray(dst_idx, dtype=np.int64)
+    if dst_idx.size == 0:
+        return np.zeros((n, 0), dtype=bool)
+    cols = _packed_cols_kernel(
+        sel_ing8, sel_eg8, ing_by_pol, eg_by_pol, ing_cnt, eg_cnt,
+        col_mask, row_valid,
+        _pad_idx(dst_idx, _pow2(dst_idx.size)),
+        self_traffic=self_traffic, default_allow=default_allow,
+    )
+    QUERY_PACKED_DISPATCHES_TOTAL.labels(kind="cols").inc()
+    return np.asarray(cols)[:n, : dst_idx.size]
+
+
+def packed_any_port(
+    sel_ing8,
+    sel_eg8,
+    ing_by_pol,
+    eg_by_pol,
+    ing_cnt,
+    eg_cnt,
+    col_mask,
+    row_valid,
+    src_idx,
+    q_row,
+    q_dst,
+    *,
+    self_traffic: bool,
+    default_allow: bool,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Packed twin of :func:`batched_any_port`: one fused dispatch returns
+    ``(word rows [U, Np/32], answers [Q])`` — the rows for the caller's
+    generation-keyed memo, the answers as the single extracted verdict bit
+    per probe."""
+    from ..observe.metrics import QUERY_PACKED_DISPATCHES_TOTAL
+
+    src_idx = np.asarray(src_idx, dtype=np.int64)
+    q_row = np.asarray(q_row, dtype=np.int64)
+    q_dst = np.asarray(q_dst, dtype=np.int64)
+    n_padded = int(row_valid.shape[0])
+    if q_row.size == 0:
+        return (
+            np.zeros((0, n_padded // 32), dtype=np.uint32),
+            np.zeros(0, dtype=bool),
+        )
+    words, ans = _packed_probe_kernel(
+        sel_ing8, sel_eg8, ing_by_pol, eg_by_pol, ing_cnt, eg_cnt,
+        col_mask, row_valid,
+        _pad_idx(src_idx, _pow2(src_idx.size)),
+        _pad_idx(q_row, _pow2(q_row.size)),
+        _pad_idx(q_dst, _pow2(q_dst.size)),
+        self_traffic=self_traffic, default_allow=default_allow,
+    )
+    QUERY_PACKED_DISPATCHES_TOTAL.labels(kind="probe").inc()
+    return (
+        np.asarray(words)[: src_idx.size],
         np.asarray(ans)[: q_row.size],
     )

@@ -228,18 +228,23 @@ class CheckpointManager:
         last_seq: int = -1,
     ) -> CheckpointInfo:
         """Commit one atomic checkpoint generation of ``engine`` (an
-        :class:`~..incremental.IncrementalVerifier`), binding it to the
-        event-log position (``log_offset`` bytes consumed, ``last_seq``
-        the highest applied WAL sequence number, -1 for unsequenced
-        streams)."""
-        from ..utils.persist import save_incremental
+        :class:`~..incremental.IncrementalVerifier` or a
+        :class:`~..packed_incremental.PackedIncrementalVerifier` — the
+        snapshot format records which, and recovery re-detects it),
+        binding it to the event-log position (``log_offset`` bytes
+        consumed, ``last_seq`` the highest applied WAL sequence number,
+        -1 for unsequenced streams)."""
+        from ..utils.persist import save_incremental, save_packed_incremental
 
         gen = self._next_generation()
         snap_dir = self.snapshot_dir(gen)
         tmp_dir = os.path.join(self.directory, f".tmp-gen-{gen:08d}")
         if os.path.exists(tmp_dir):
             shutil.rmtree(tmp_dir)
-        save_incremental(engine, tmp_dir)
+        if getattr(engine, "metrics_engine", "dense") == "packed":
+            save_packed_incremental(engine, tmp_dir)
+        else:
+            save_incremental(engine, tmp_dir)
         digest = _tree_digest(tmp_dir)
         kill_point("after-tmp-write")
         if self.fsync:
@@ -403,6 +408,7 @@ class RecoveryManager:
         device=None,
         strict_wal: bool = False,
         batch_size: int = 256,
+        engine_factory=None,
     ) -> "RecoveryResult":
         """Load the newest valid checkpoint (falling back down the ladder
         on damage), scan-and-repair the WAL, replay the log from the
@@ -413,7 +419,11 @@ class RecoveryManager:
         use the manifest's; rebuilds need it explicitly or there is
         nothing to replay). ``initial_cluster`` enables the from-scratch
         rebuild rung; without it, an all-corrupt ladder raises
-        :class:`PersistError`.
+        :class:`PersistError`. ``engine_factory`` — an optional
+        ``(cluster, config, device) -> engine`` hook applied on the
+        rebuild rung, so a follower can rebuild onto a packed
+        (matrix-free) engine instead of the dense default; checkpoint
+        rungs pick the engine kind from the snapshot itself.
         """
         from .service import VerificationService
 
@@ -464,9 +474,15 @@ class RecoveryManager:
                     "rebuild from",
                     path=self.directory,
                 )
-            service = VerificationService(
-                initial_cluster, config, serve_config, device=device
-            )
+            if engine_factory is not None:
+                service = VerificationService(
+                    engine=engine_factory(initial_cluster, config, device),
+                    serve_config=serve_config,
+                )
+            else:
+                service = VerificationService(
+                    initial_cluster, config, serve_config, device=device
+                )
             outcome = "rebuild"
             offset, after_seq, generation = 0, -1, -1
             replay_path = log_path

@@ -59,7 +59,12 @@ class QueryLoadBalancer:
     any object with the same query methods (a
     :class:`~.queries.QueryEngine`, or a FollowerService wired straight
     at the leader's directory). ``clock`` only feeds the breakers, so
-    tests drive cooldowns without sleeping."""
+    tests drive cooldowns without sleeping.
+
+    Replicas are duck-typed, so a fleet may mix dense and packed
+    (device-resident word-row) followers freely — the answers are
+    bit-identical by construction and :meth:`describe` reports each
+    replica's engine kind so a skewed mix is visible to operators."""
 
     def __init__(
         self,
@@ -186,11 +191,17 @@ class QueryLoadBalancer:
         return [self.can_reach_batch(batch) for batch in batches]
 
     # ------------------------------------------------------------- status
+    @staticmethod
+    def _engine_kind(replica) -> str:
+        svc = getattr(replica, "service", replica)
+        return "packed" if getattr(svc, "packed", False) else "dense"
+
     def describe(self) -> dict:
         return {
             "replicas": [
                 {
                     "replica": r.replica,
+                    "engine": self._engine_kind(r),
                     "breaker": self.breakers[r.replica].state,
                     "weight": self._weight(r),
                     "routed": self.routed.get(r.replica, 0),

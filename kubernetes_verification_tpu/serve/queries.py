@@ -63,7 +63,11 @@ from ..ops.batched import (
     batched_any_port,
     batched_reach_cols,
     batched_reach_rows,
+    packed_any_port,
+    packed_reach_cols,
+    packed_reach_rows,
 )
+from ..ops.tiled import unpack_cols
 from ..resilience.breaker import CLOSED
 from ..resilience.errors import BackendError, IngestError, ServeError
 from .events import AddPolicy, Event, RemovePolicy, UpdatePolicy
@@ -80,6 +84,23 @@ __all__ = [
 ]
 
 _I32 = jnp.int32
+
+
+def _packed_operands(state):
+    """Kernel operand tuple from a packed :class:`DeviceQueryState` —
+    positional order matches the ``packed_*`` twins in ``ops/batched.py``."""
+    a = state.arrays
+    return (
+        a["sel_ing8"], a["sel_eg8"], a["ing_by_pol"], a["eg_by_pol"],
+        a["ing_cnt"], a["eg_cnt"], a["col_mask"], a["row_valid"],
+    )
+
+
+def _word_bits(words: np.ndarray, dst: np.ndarray) -> np.ndarray:
+    """Per-probe verdict bits from host uint32 word rows: ``words`` is
+    [Q, W] (row ``k`` already gathered for probe ``k``), ``dst`` [Q]."""
+    w = words[np.arange(dst.size), dst // 32]
+    return ((w >> (dst % 32).astype(np.uint32)) & np.uint32(1)).astype(bool)
 
 
 @jax.jit
@@ -299,28 +320,45 @@ def check_assertions(service, assertions: Sequence[Assertion]) -> List[Violation
 
 def _assertion_submatrices(service, plan):
     """A ``(src_idx, dst_idx) -> reach submatrix`` provider for assertion
-    checks: full matrix when it is free (clean engine, standing fallback)
-    or forced (breaker not closed); batched source-row gather otherwise."""
+    checks: full matrix when it is free (clean dense engine, standing
+    fallback) or forced (breaker not closed); batched source-row gather
+    otherwise. On a packed engine the row gather is always the cheap path
+    — the word kernels recompute from the resident maps, so there is no
+    'clean matrix for free' rung."""
     eng = service.engine
     br = service._breaker
+    packed = getattr(service, "packed", False)
+    clean_dense = (
+        not packed and eng._reach is not None and not eng._reach_dirty
+    )
     rows_path = (
         service._fallback_reach is None
-        and (eng._reach is None or eng._reach_dirty)
+        and not clean_dense
         and (br is None or br.state == CLOSED)
     )
     if rows_path:
         uniq = np.unique(np.concatenate([p[1] for p in plan]))
         cfg = eng.config
         try:
-            rows = batched_reach_rows(
-                eng._ing_count,
-                eng._eg_count,
-                eng._ing_iso,
-                eng._eg_iso,
-                uniq,
-                self_traffic=cfg.self_traffic,
-                default_allow_unselected=cfg.default_allow_unselected,
-            )
+            state = service._query_state()
+            if packed:
+                words = packed_reach_rows(
+                    *_packed_operands(state), uniq, **state.meta["flags"]
+                )
+                rows = unpack_cols(
+                    words, state.meta["n_padded"]
+                )[:, : state.n]
+            else:
+                a = state.arrays
+                rows = batched_reach_rows(
+                    a["ing_count"],
+                    a["eg_count"],
+                    a["ing_iso"],
+                    a["eg_iso"],
+                    uniq,
+                    self_traffic=cfg.self_traffic,
+                    default_allow_unselected=cfg.default_allow_unselected,
+                )
         except BackendError:
             rows = None  # engine state unusable: the solve ladder owns it
         if rows is not None:
@@ -419,8 +457,10 @@ class QueryCache:
     ``full_resync``). What-if overlays never touch this: they derive on
     copy-on-write buffers and answer from their own matrices.
 
-    * ``row_pos``/``row_mat`` — packed any-port reach rows by source pod
-      index, stored as one [capacity, N] matrix (geometric growth, so a
+    * ``row_pos``/``row_mat`` — any-port reach rows by source pod index
+      (bool [*, N] on a dense engine; uint32 word rows [*, Np/32] on a
+      packed engine), stored as one [capacity, ·] matrix (geometric
+      growth, so a
       long probe stream costs amortized O(1) copies per cached row — a
       per-batch concatenate would re-copy the whole cache every miss
       batch and dominate steady-state latency) answered with a single
@@ -434,7 +474,7 @@ class QueryCache:
 
     generation: int = -1
     row_pos: Dict[int, int] = field(default_factory=dict)
-    row_mat: Optional[np.ndarray] = None  # bool [cached, N]
+    row_mat: Optional[np.ndarray] = None  # bool or uint32 [cached, ·]
     ports: Dict[Tuple[int, int], Tuple[tuple, bool]] = field(
         default_factory=dict
     )
@@ -458,7 +498,7 @@ class QueryCache:
         need = base + rows.shape[0]
         if self.row_mat is None or self.row_mat.shape[0] < need:
             cap = max(need, 2 * base, 64)
-            grown = np.empty((cap, rows.shape[1]), dtype=bool)
+            grown = np.empty((cap, rows.shape[1]), dtype=rows.dtype)
             if base:
                 grown[:base] = self.row_mat[:base]
             self.row_mat = grown
@@ -505,7 +545,20 @@ class QueryEngine:
         self._count("can_reach")
         si, di = self._idx(src), self._idx(dst)
         if port is None:
-            return bool(self.service.reach()[si, di])
+            svc = self.service
+            if getattr(svc, "packed", False):
+                # matrix-free scalar answer: one word-row probe through
+                # the packed batch path instead of a full [N,N] solve
+                svc.flush()
+                with svc._lock:
+                    self._cache.sync(svc)
+                    return bool(
+                        self._any_port_batch(
+                            np.asarray([si], dtype=np.int64),
+                            np.asarray([di], dtype=np.int64),
+                        )[0]
+                    )
+            return bool(svc.reach()[si, di])
         return self._can_reach_port(si, di, port, protocol)
 
     def _can_reach_port(
@@ -513,10 +566,11 @@ class QueryEngine:
     ) -> bool:
         self.service.flush()
         eng = self.service.engine
-        cluster = eng.as_cluster()
-        pair = [cluster.pods[si]] + (
-            [cluster.pods[di]] if di != si else []
-        )
+        # engine row indices, NOT as_cluster() positions — the packed
+        # engine's as_cluster() compacts tombstoned rows away, so the two
+        # numberings disagree after any pod removal
+        pods = eng.pods
+        pair = [pods[si]] + ([pods[di]] if di != si else [])
         # a NetworkPolicy only ever selects pods in its own namespace, so
         # only the pair's namespaces can contribute grants or isolation —
         # the rest of the policy list is dead weight for the 2-pod oracle
@@ -525,9 +579,11 @@ class QueryEngine:
         res = kv.verify(
             Cluster(
                 pods=pair,
-                namespaces=list(cluster.namespaces),
+                namespaces=list(eng.namespaces),
                 policies=[
-                    p for p in cluster.policies if p.namespace in pair_ns
+                    p
+                    for p in eng.policies.values()
+                    if p.namespace in pair_ns
                 ],
             ),
             VerifyConfig(
@@ -651,6 +707,8 @@ class QueryEngine:
         svc = self.service
         if svc._fallback_reach is not None:
             return svc._fallback_reach[s, d]
+        if getattr(svc, "packed", False):
+            return self._any_port_batch_packed(s, d)
         eng = svc.engine
         if eng._reach is not None and not eng._reach_dirty:
             return np.asarray(eng.reach)[s, d]
@@ -676,13 +734,15 @@ class QueryEngine:
             )
         cfg = eng.config
         try:
+            state = svc._query_state()
+            a = state.arrays
             if not row_pos:
                 # cold cache: rows + per-probe answers in one dispatch
                 rows, out = batched_any_port(
-                    eng._ing_count,
-                    eng._eg_count,
-                    eng._ing_iso,
-                    eng._eg_iso,
+                    a["ing_count"],
+                    a["eg_count"],
+                    a["ing_iso"],
+                    a["eg_iso"],
                     uniq,
                     inv,
                     d,
@@ -693,10 +753,10 @@ class QueryEngine:
                 return out
             if missing.size:
                 rows = batched_reach_rows(
-                    eng._ing_count,
-                    eng._eg_count,
-                    eng._ing_iso,
-                    eng._eg_iso,
+                    a["ing_count"],
+                    a["eg_count"],
+                    a["ing_iso"],
+                    a["eg_iso"],
                     missing,
                     self_traffic=cfg.self_traffic,
                     default_allow_unselected=cfg.default_allow_unselected,
@@ -710,6 +770,50 @@ class QueryEngine:
             (row_pos[int(u)] for u in uniq), np.int64, uniq.size
         )
         return cache.row_mat[pos[inv], d]
+
+    def _any_port_batch_packed(
+        self, s: np.ndarray, d: np.ndarray
+    ) -> np.ndarray:
+        """Packed-engine any-port answers (lock held): word rows gathered
+        straight from the resident per-policy maps, verdict bits extracted
+        on device, unpacked never. The maps are always current (mutations
+        rewrite them in place before ``apply`` returns), so there is no
+        clean-engine rung and no breaker rung — the only fallbacks are the
+        standing fallback matrix (checked by the caller) and the service
+        solve ladder on a backend fault."""
+        svc = self.service
+        cache = self._cache
+        uniq, inv = np.unique(s, return_inverse=True)
+        row_pos = cache.row_pos
+        hit = np.fromiter(
+            (int(u) in row_pos for u in uniq), bool, uniq.size
+        )
+        missing = uniq[~hit]
+        if hit.any():
+            QUERY_CACHE_HITS_TOTAL.labels(kind="rows").inc(int(hit.sum()))
+        if missing.size:
+            QUERY_CACHE_MISSES_TOTAL.labels(kind="rows").inc(
+                int(missing.size)
+            )
+        try:
+            state = svc._query_state()
+            fl = state.meta["flags"]
+            ops_ = _packed_operands(state)
+            if not row_pos:
+                # cold cache: word rows + per-probe bits in one dispatch
+                words, out = packed_any_port(*ops_, uniq, inv, d, **fl)
+                cache.add_rows(uniq, words)
+                return out
+            if missing.size:
+                cache.add_rows(
+                    missing, packed_reach_rows(*ops_, missing, **fl)
+                )
+        except BackendError:
+            return svc._solve("query")[s, d]
+        pos = np.fromiter(
+            (row_pos[int(u)] for u in uniq), np.int64, uniq.size
+        )
+        return _word_bits(cache.row_mat[pos[inv]], d)
 
     def _ported_batch(self, items) -> List[Tuple[int, bool]]:
         """Port-refined answers for ``(k, si, di, port, protocol)`` items
@@ -779,12 +883,14 @@ class QueryEngine:
         src_idx = np.asarray(src_idx, dtype=np.int64)
         if svc._fallback_reach is not None:
             return np.asarray(svc._fallback_reach)[src_idx, :]
+        packed = getattr(svc, "packed", False)
         eng = svc.engine
-        if eng._reach is not None and not eng._reach_dirty:
-            return np.asarray(eng.reach)[src_idx, :]
-        br = svc._breaker
-        if br is not None and br.state != CLOSED:
-            return svc._solve("query")[src_idx, :]
+        if not packed:
+            if eng._reach is not None and not eng._reach_dirty:
+                return np.asarray(eng.reach)[src_idx, :]
+            br = svc._breaker
+            if br is not None and br.state != CLOSED:
+                return svc._solve("query")[src_idx, :]
         cache = self._cache
         row_pos = cache.row_pos
         uniq, inv = np.unique(src_idx, return_inverse=True)
@@ -800,23 +906,39 @@ class QueryEngine:
             )
         cfg = eng.config
         try:
+            state = svc._query_state()
             if missing.size:
-                rows = batched_reach_rows(
-                    eng._ing_count,
-                    eng._eg_count,
-                    eng._ing_iso,
-                    eng._eg_iso,
-                    missing,
-                    self_traffic=cfg.self_traffic,
-                    default_allow_unselected=cfg.default_allow_unselected,
-                )
+                if packed:
+                    rows = packed_reach_rows(
+                        *_packed_operands(state),
+                        missing,
+                        **state.meta["flags"],
+                    )
+                else:
+                    a = state.arrays
+                    rows = batched_reach_rows(
+                        a["ing_count"],
+                        a["eg_count"],
+                        a["ing_iso"],
+                        a["eg_iso"],
+                        missing,
+                        self_traffic=cfg.self_traffic,
+                        default_allow_unselected=(
+                            cfg.default_allow_unselected
+                        ),
+                    )
                 cache.add_rows(missing, rows)
         except BackendError:
             return svc._solve("query")[src_idx, :]
         pos = np.fromiter(
             (row_pos[int(u)] for u in uniq), np.int64, uniq.size
         )
-        return cache.row_mat[pos[inv], :]
+        gathered = cache.row_mat[pos[inv], :]
+        if packed:
+            return unpack_cols(gathered, state.meta["n_padded"])[
+                :, : state.n
+            ]
+        return gathered
 
     def _reach_cols(self, dst_idx: np.ndarray) -> np.ndarray:
         """Reach COLUMNS bool [N, U] for index array ``dst_idx`` (lock
@@ -828,6 +950,17 @@ class QueryEngine:
         if svc._fallback_reach is not None:
             return np.asarray(svc._fallback_reach)[:, dst_idx]
         eng = svc.engine
+        if getattr(svc, "packed", False):
+            try:
+                state = svc._query_state()
+                return packed_reach_cols(
+                    *_packed_operands(state),
+                    dst_idx,
+                    n=state.n,
+                    **state.meta["flags"],
+                )
+            except BackendError:
+                return svc._solve("query")[:, dst_idx]
         if eng._reach is not None and not eng._reach_dirty:
             return np.asarray(eng.reach)[:, dst_idx]
         br = svc._breaker
@@ -835,11 +968,13 @@ class QueryEngine:
             return svc._solve("query")[:, dst_idx]
         cfg = eng.config
         try:
+            state = svc._query_state()
+            a = state.arrays
             return batched_reach_cols(
-                eng._ing_count,
-                eng._eg_count,
-                eng._ing_iso,
-                eng._eg_iso,
+                a["ing_count"],
+                a["eg_count"],
+                a["ing_iso"],
+                a["eg_iso"],
                 dst_idx,
                 self_traffic=cfg.self_traffic,
                 default_allow_unselected=cfg.default_allow_unselected,
@@ -970,6 +1105,12 @@ class QueryEngine:
         ``RemovePolicy``) — label churn is not an admission decision."""
         self._count("what_if")
         svc = self.service
+        if getattr(svc, "packed", False):
+            raise ServeError(
+                "what-if admission requires the dense serving engine: the "
+                "copy-on-write overlay rides the dense count matrices "
+                "(serve on an IncrementalVerifier to dry-run policy events)"
+            )
         svc.flush()
         with svc._lock:
             before = svc._solve("query")

@@ -275,6 +275,12 @@ class FollowerService:
     underlying service is marked ``read_only`` so nothing on this side
     can ever produce a durable artifact.
 
+    ``engine_factory`` (``(cluster, config, device) -> engine``) makes a
+    rebuilt follower serve from a packed matrix-free engine — combined
+    with a packed leader checkpoint (auto-detected by the recovery
+    ladder) a follower at 100k–1M pods answers batches from on-chip
+    uint32 word rows without ever materialising a dense [N, N] matrix.
+
     ``auto_catch_up`` (default True) drains the WAL before every guarded
     read; tests and the bench turn it off to control lag explicitly.
     ``clock`` must be wall-clock compatible with the leader's lease clock
@@ -304,6 +310,7 @@ class FollowerService:
         clock: Callable[[], float] = time.time,
         leader_url: Optional[str] = None,
         transport_timeout: float = 2.0,
+        engine_factory=None,
     ) -> None:
         self.directory = directory
         self.replica = replica
@@ -347,6 +354,7 @@ class FollowerService:
             serve_config=serve_config,
             device=device,
             batch_size=batch_size,
+            engine_factory=engine_factory,
         )
         self.recovery = recovery
         self.service = recovery.service

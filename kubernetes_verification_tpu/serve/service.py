@@ -47,6 +47,11 @@ from ..backends.base import VerifyConfig
 from ..incremental import IncrementalVerifier
 from ..models.core import Cluster, Namespace
 from ..observe import trace
+from ..ops.device_state import (
+    DeviceStateCache,
+    dense_query_state,
+    packed_query_state,
+)
 from ..observe.metrics import (
     FALLBACKS_TOTAL,
     SERVE_BATCHES_TOTAL,
@@ -165,6 +170,10 @@ class VerificationService:
             cfg = config or VerifyConfig(compute_ports=False)
             engine = IncrementalVerifier(cluster, cfg, device=device)
         self._engine = engine
+        #: True when the engine serves from packed uint32 bitmap state
+        #: (``PackedIncrementalVerifier``): queries ride the packed word
+        #: kernels and never materialise a dense [N, N] operand
+        self.packed = getattr(engine, "metrics_engine", "dense") == "packed"
         self.config = engine.config
         self.serve_config = serve_config or ServeConfig()
         #: follower mode (serve/replication.py): this replica applies the
@@ -194,6 +203,9 @@ class VerificationService:
         #: reach matrix from a from-scratch fallback solve; valid until the
         #: next mutation (the incremental counts may be what broke)
         self._fallback_reach: Optional[np.ndarray] = None
+        #: double-buffered device operands for the batched query plane,
+        #: keyed on :attr:`generation` — see ``ops/device_state.py``
+        self._device_states = DeviceStateCache()
         #: private breaker guarding the incremental derivation: while open,
         #: queries skip the doomed engine solve and go straight to the
         #: from-scratch CPU fallback until the cooldown admits a probe
@@ -218,11 +230,28 @@ class VerificationService:
         config: Optional[VerifyConfig] = None,
         device=None,
     ) -> "VerificationService":
-        """Warm restart: rebuild the engine from a
-        ``save_incremental`` checkpoint (crash recovery — no re-solve)."""
-        from ..utils.persist import load_incremental
+        """Warm restart: rebuild the engine from a ``save_incremental`` or
+        ``save_packed_incremental`` checkpoint (crash recovery — no
+        re-solve). The engine kind is probed from the checkpoint itself: a
+        packed state file carries its slot layout (``pod_active``), a
+        dense one its count matrices."""
+        import os
 
-        engine = load_incremental(directory, config=config, device=device)
+        from ..utils.persist import (
+            _load_npz,
+            load_incremental,
+            load_packed_incremental,
+        )
+
+        state_path = os.path.join(directory, "state.npz")
+        with _load_npz(state_path) as z:
+            is_packed = "pod_active" in z.files
+        if is_packed:
+            engine = load_packed_incremental(
+                directory, config=config, device=device
+            )
+        else:
+            engine = load_incremental(directory, config=config, device=device)
         return cls(engine=engine, serve_config=serve_config)
 
     def snapshot(self, directory: Optional[str] = None) -> str:
@@ -237,10 +266,13 @@ class VerificationService:
             raise ServeError(
                 "no snapshot directory configured (ServeConfig.snapshot_dir)"
             )
-        from ..utils.persist import save_incremental
+        from ..utils.persist import save_incremental, save_packed_incremental
 
         with self._lock:
-            save_incremental(self._engine, target)
+            if self.packed:
+                save_packed_incremental(self._engine, target)
+            else:
+                save_incremental(self._engine, target)
             self.stats.snapshots += 1
         return target
 
@@ -309,6 +341,7 @@ class VerificationService:
                 if kept:
                     self._generation += 1
                     self._fallback_reach = None
+                    self._refresh_device_state()
                     if self._dirty_since is None:
                         self._dirty_since = time.monotonic()
             if self.assertions:
@@ -349,15 +382,54 @@ class VerificationService:
         elif isinstance(ev, RemoveNamespace):
             eng.remove_namespace(ev.namespace)
         elif isinstance(ev, FullResync):
-            self._engine = IncrementalVerifier(
-                ev.cluster, self.config, device=eng.device
-            )
+            if self.packed:
+                # rebuild with the SAME engine kind (and matrix mode): a
+                # resync must not silently swap the query plane back to
+                # dense state the deployment may not have memory for
+                from ..packed_incremental import PackedIncrementalVerifier
+
+                self._engine = PackedIncrementalVerifier(
+                    ev.cluster,
+                    self.config,
+                    device=eng.device,
+                    keep_matrix=eng._packed is not None,
+                )
+            else:
+                self._engine = IncrementalVerifier(
+                    ev.cluster, self.config, device=eng.device
+                )
             self._pod_idx = {
                 (p.namespace, p.name): i
                 for i, p in enumerate(self._engine.pods)
             }
         else:
             raise ServeError(f"unhandled event kind {ev.kind!r}")
+
+    # ------------------------------------------------------- device residency
+    def _build_device_state(self):
+        return (
+            packed_query_state(self._engine, self._generation)
+            if self.packed
+            else dense_query_state(self._engine, self._generation)
+        )
+
+    def _query_state(self):
+        """Device operands for the current generation (lock held). Builds
+        and flips in the front state on first use of a generation; warm
+        batches reuse it with zero host→device transfers."""
+        state = self._device_states.get(self._generation)
+        if state is None:
+            state = self._device_states.publish(self._build_device_state())
+        return state
+
+    def _refresh_device_state(self) -> None:
+        """Write-path half of the double buffer (lock held, called once
+        per applied batch): if the query plane has device state resident,
+        commit the new generation's shadow state and flip it in — the old
+        front retires intact, so a reader that picked it up just before
+        the flip finishes its batch on stable buffers."""
+        if self._device_states.peek() is not None:
+            self._device_states.publish(self._build_device_state())
 
     # --------------------------------------------------------------- solving
     def reach(self, trigger: str = "query") -> np.ndarray:
@@ -372,6 +444,8 @@ class VerificationService:
             eng = self._engine
             if self._fallback_reach is not None:
                 return self._fallback_reach
+            if self.packed:
+                return self._solve_packed(trigger)
             if not eng._reach_dirty and eng._reach is not None:
                 return np.asarray(eng.reach)
             staleness = (
@@ -402,6 +476,33 @@ class VerificationService:
             SERVE_STALENESS_SECONDS.set(staleness)
             self._dirty_since = None
             return reach
+
+    def _solve_packed(self, trigger: str) -> np.ndarray:
+        """Full-matrix answers on a packed engine (lock held). Only legal
+        when the engine keeps its packed matrix — in matrix-free mode a
+        dense [N, N] must never exist, so anything that genuinely needs
+        the whole matrix is refused with guidance to the batched plane.
+        Transients retry inside the engine; there is no from-scratch CPU
+        fallback at packed scale."""
+        eng = self._engine
+        if eng._packed is None:
+            raise ServeError(
+                "matrix-free packed engine cannot materialise the dense "
+                "reach matrix — use the batched query plane "
+                "(can_reach_batch / who_can_reach / blast_radius) or "
+                "build the engine with keep_matrix=True"
+            )
+        staleness = (
+            time.monotonic() - self._dirty_since
+            if self._dirty_since is not None
+            else 0.0
+        )
+        reach = np.asarray(eng.reach)
+        SERVE_SOLVES_TOTAL.labels(trigger=trigger).inc()
+        self.stats.solves[trigger] = self.stats.solves.get(trigger, 0) + 1
+        SERVE_STALENESS_SECONDS.set(staleness)
+        self._dirty_since = None
+        return reach
 
     def _solve_fallback(self) -> np.ndarray:
         """Incremental derivation failed hard: answer from a from-scratch

@@ -381,6 +381,55 @@ def test_jit_host_sync_sharded_closure_loop_shape():
     assert ok == [], [f.render() for f in ok]
 
 
+def test_jit_host_sync_device_state_cache_shape():
+    """The device-state-cache pattern from the query plane: generation-
+    keyed device arrays live in a host-side cache and the batched wrapper
+    reads verdict bits back with ``np.asarray`` AFTER the dispatch — the
+    sanctioned host-side sync. The same readback moved INSIDE a jitted
+    helper that consumes the cached arrays is a sync-under-trace bug and
+    must still flag."""
+    bad = _lint(
+        """
+        import jax
+        import numpy as np
+
+        _STATES = {}
+
+        @jax.jit
+        def _probe(words, dst):
+            shift = (dst % 32).astype("uint32")
+            bits = (words[dst // 32] >> shift) & 1
+            # cached device array read back inside the traced body
+            return np.asarray(bits)
+        """,
+        ["jit-host-sync"],
+    )
+    assert bad and {f.rule for f in bad} == {"jit-host-sync"}
+    assert any("asarray" in f.message for f in bad)
+    ok = _lint(
+        """
+        import jax
+        import numpy as np
+
+        _STATES = {}
+
+        @jax.jit
+        def _probe(words, dst):
+            shift = (dst % 32).astype("uint32")
+            return (words[dst // 32] >> shift) & 1
+
+        def answer(generation, dst):
+            # host wrapper: dispatch on the cached device state, THEN
+            # read the final verdict bits back on the host side
+            words = _STATES[generation]
+            bits = _probe(words, dst)
+            return np.asarray(bits).astype(bool)
+        """,
+        ["jit-host-sync"],
+    )
+    assert ok == [], [f.render() for f in ok]
+
+
 def test_recompile_hazard_shape_string_key():
     bad = _lint(
         """
