@@ -163,6 +163,71 @@ def _band(times) -> dict:
     }
 
 
+def _warm_compile_split(cold_s: float, rerun, parity=None) -> dict:
+    """``compile_s``/``compile_cold_s``/``compile_warm_s`` fields for one
+    mode's emit: persist the AOT signatures recorded so far to a throwaway
+    pack, drop every in-process executable AND jax's own trace/compile
+    caches (simulating a fresh process in front of an on-disk pack),
+    install the pack and re-time the mode's compile-bearing phase.
+
+    ``cold_s`` is the mode's historic first-call time — compile plus one
+    run. To isolate the COMPILE share on both sides, ``rerun`` is timed
+    twice after the pack install: the first call pays warm dispatch (+ the
+    run), the second is pure steady-state run, and the steady time is
+    subtracted from both the warm first call and ``cold_s``. The
+    ``compile_s`` series keeps its historic compile+first-run meaning;
+    the gate watches ``compile_warm_s`` so a silent cold-start walk on
+    the warm path can never return. ``parity(out)`` — optional result
+    check of the warm rerun against the cold run."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    from kubernetes_verification_tpu.observe import aot
+
+    fields = {
+        "compile_s": round(cold_s, 2),
+        "compile_cold_s": round(cold_s, 2),
+    }
+    if not aot.aot_enabled():
+        return fields
+    d = tempfile.mkdtemp(prefix="kvtpu-aot-bench-")
+    try:
+        aot.save_pack(d)
+        aot.drop_executables()
+        jax.clear_caches()
+        loaded = aot.load_pack(d)
+        s = time.perf_counter()
+        out = rerun()
+        warm_total = time.perf_counter() - s
+        s = time.perf_counter()
+        rerun()
+        steady = time.perf_counter() - s
+        warm_s = max(0.0, warm_total - steady)
+        cold_compile = max(0.0, cold_s - steady)
+        fields["compile_cold_s"] = round(cold_compile, 2)
+        fields["compile_warm_s"] = round(warm_s, 2)
+        fields["aot_pack_entries"] = int(loaded.get("loaded", 0))
+        fields["aot_pack_bytes"] = int(loaded.get("bytes", 0))
+        if parity is not None:
+            ok = bool(parity(out))
+            fields["warm_parity"] = ok
+            if not ok:
+                log("WARM-PATH PARITY MISMATCH — inspect observe/aot.py")
+        log(
+            f"compile cold {cold_compile:.2f}s -> warm {warm_s:.2f}s "
+            f"(first call {cold_s:.2f}s -> {warm_total:.2f}s, steady "
+            f"{steady:.2f}s; {loaded.get('loaded', 0)} packed "
+            f"executables, {loaded.get('bytes', 0)} bytes)"
+        )
+    except Exception as e:  # noqa: BLE001 — a bench rider never kills the run
+        log(f"warm-compile measurement failed: {type(e).__name__}: {e}")
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+    return fields
+
+
 def bench_sentinel(args) -> None:
     """The perf-sentinel round: measure the fixed-shape compute-bound
     calibration kernels (mxu int8 / mxu f32 / vpu bitops — spread verified
@@ -296,6 +361,12 @@ def bench_tiled(args) -> None:
         f"{r.timings['reachable_pairs']} reachable pairs"
     )
     ports_tag = "port bitmaps" if compute_ports else "any-port"
+    cold_pairs = r.timings["reachable_pairs"]
+    warm_fields = _warm_compile_split(
+        t3 - t2,
+        rerun=run,
+        parity=lambda out: out.timings["reachable_pairs"] == cold_pairs,
+    )
     _emit(
         {
             "metric": (
@@ -306,7 +377,7 @@ def bench_tiled(args) -> None:
             "unit": "pairs/s",
             "vs_baseline": round(value / BASELINE_PAIRS_PER_SEC, 4),
             "band": band,
-            "compile_s": round(t3 - t2, 2),
+            **warm_fields,
             "steady_s": round(solve, 4),
             # roofline accounting (VERDICT.md methodology): the solve's
             # int8 dot work is N² pairs × one MAC per grant row
@@ -468,6 +539,15 @@ def bench_incremental(args) -> None:
         + "  ".join(f"{kk} {v * 1e3:.1f}ms" for kk, v in piped.items())
     )
     sync_band = _band([t for v in samples.values() for t in v])
+
+    def _warm_init():
+        if with_ports:
+            return PackedPortsIncrementalVerifier(
+                cluster, cfg, device=dev, headroom=16
+            )
+        return PackedIncrementalVerifier(cluster, cfg, device=dev)
+
+    warm_fields = _warm_compile_split(t2 - t1, rerun=_warm_init)
     _emit(
         {
             "metric": (
@@ -487,7 +567,7 @@ def bench_incremental(args) -> None:
             },
             # init = encode+maps+first solve (compiles); the warm diffs
             # above take per-kind compiles out of the steady figure
-            "compile_s": round(t2 - t1, 2),
+            **warm_fields,
             "steady_s": round(overall_piped, 4),
         }
     )
@@ -629,6 +709,14 @@ def bench_closure(args) -> None:
     mixed_s = time.perf_counter() - s
     log(f"closure after a mixed policy diff: {mixed_s:.2f}s "
         f"({full_s / mixed_s:.1f}x faster than full)")
+    ref_word = sync(packed_closure(inc._packed, tile=args.closure_tile))
+    warm_fields = _warm_compile_split(
+        full_first,
+        rerun=lambda: sync(
+            packed_closure(inc._packed, tile=args.closure_tile)
+        ),
+        parity=lambda out: out == ref_word,
+    )
     _emit(
         {
             "metric": (
@@ -645,7 +733,7 @@ def bench_closure(args) -> None:
             "adds_diff_real": adds_real,
             "iterations": iter_band,
             # first full closure includes compile; full_s is its steady median
-            "compile_s": round(full_first, 2),
+            **warm_fields,
             "steady_s": round(full_s, 4),
         }
     )
@@ -838,6 +926,10 @@ def bench_stripe(args) -> None:
     restripe_s = time.perf_counter() - s
     log(f"matrix-free diff {diff_s * 1e3:.1f}ms; "
         f"stripe re-verify ({tile} dsts) {restripe_s:.2f}s")
+    warm_fields = _warm_compile_split(
+        t2 - t1, rerun=run,
+        parity=lambda out: out.total_pairs == res.total_pairs,
+    )
     _emit(
         {
             "metric": (
@@ -852,7 +944,7 @@ def bench_stripe(args) -> None:
             "stripe_band": stripe_band,
             "mf_diff_ms": round(diff_s * 1e3, 2),
             "mf_restripe_s": round(restripe_s, 3),
-            "compile_s": round(t2 - t1, 2),
+            **warm_fields,
             "steady_s": round(stripe_s, 4),
             "macs": float(n_big) * float(width)
             * (enc_big.ingress.n + enc_big.egress.n),
@@ -1045,6 +1137,11 @@ def bench_serve(args) -> None:
         f"{ck_band['median_s'] * 1e3:.1f}ms "
         f"({ck_pct:.1f}% overhead at every-8-batches)"
     )
+    # the dense service engine keeps its kernels off the AOT manifest, so
+    # this split honestly reports warm ~= cold for the serve cold path
+    warm_fields = _warm_compile_split(
+        t2 - t1, rerun=lambda: VerificationService(cluster).reach()
+    )
     _emit(
         {
             "metric": (
@@ -1064,7 +1161,7 @@ def bench_serve(args) -> None:
             "events_per_solve": round(n_timed / max(1, n_solves), 2),
             "checkpoint_band": ck_band,
             "checkpoint_overhead_pct": round(ck_pct, 2),
-            "compile_s": round(t2 - t1, 2),
+            **warm_fields,
             "steady_s": round(apply_band["median_s"], 4),
         }
     )
@@ -1275,6 +1372,26 @@ def bench_query(args) -> None:
             f"[{kind}] first batch after churn (cache invalidated): "
             f"{churn_s * 1e3:.1f}ms"
         )
+        def _warm_init(kind=kind):
+            if kind == "packed":
+                from kubernetes_verification_tpu.packed_incremental import (
+                    PackedIncrementalVerifier,
+                )
+
+                from kubernetes_verification_tpu import VerifyConfig
+
+                return VerificationService(
+                    engine=PackedIncrementalVerifier(
+                        cluster,
+                        VerifyConfig(compute_ports=False),
+                        keep_matrix=False,
+                    )
+                )
+            s2 = VerificationService(cluster)
+            s2.reach()
+            return s2
+
+        warm_fields = _warm_compile_split(t3 - t2, rerun=_warm_init)
         tag = "packed batched" if kind == "packed" else "batched"
         record = {
             "metric": (
@@ -1297,7 +1414,7 @@ def bench_query(args) -> None:
             "speedup_vs_scalar": round(speedup, 1),
             "speedup_vs_scalar_any_port": round(speedup_any, 1),
             "query_h2d_bytes": float(h2d_steady),
-            "compile_s": round(t3 - t2, 2),
+            **warm_fields,
             "steady_s": round(batch_band["median_s"], 4),
         }
         if kind == "packed":
@@ -1614,6 +1731,7 @@ def bench_replicate(args) -> None:
         return _bench_replicate_net(
             args, svc, writer, workdir, ck_dir, log_path, n_batches
         )
+    tip_offset, tip_seq = writer.offset, writer.next_seq - 1
     writer.close()
 
     ctx = mp.get_context("spawn")
@@ -1734,6 +1852,76 @@ def bench_replicate(args) -> None:
             "value": round(lag_spread, 4),
             "unit": "s",
             "replicas": 4,
+        }
+    )
+
+    # warm-start SLO riders: a tip checkpoint ships the AOT pack (the
+    # leader's baseline loop compiled every batched-query kernel), then a
+    # FRESH follower — executables dropped, jit caches cleared — resumes
+    # from it and answers its first batch, promotes, and answers again.
+    # Both series gate lower-is-better by NAME (observe/history.py), and
+    # the dryrun asserts the warm path dispatches with zero aot misses.
+    from kubernetes_verification_tpu.observe import aot
+    from kubernetes_verification_tpu.serve import FollowerService
+
+    # rehearse the follower's exact sequence on the leader first: a fresh
+    # QueryEngine's first batch runs the fused cold-cache kernel, the
+    # second (same generation, rows partially cached) runs the row-gather
+    # kernel — both land in the pack with the follower's pow2-padded shapes
+    q2 = QueryEngine(svc)
+    q2.can_reach_batch(base_batches[0])
+    q2.can_reach_batch(base_batches[1 % len(base_batches)])
+    cm.checkpoint(
+        svc.engine, log_path=log_path, log_offset=tip_offset,
+        last_seq=tip_seq,
+    )
+    if aot.aot_enabled():
+        aot.drop_executables()
+        jax.clear_caches()  # the resumed follower starts from the pack alone
+    miss0 = aot.miss_total()
+    s = time.perf_counter()
+    f = FollowerService(
+        ck_dir, log_path=log_path, replica="slo-follower",
+        auto_catch_up=False,
+    )
+    f.catch_up()
+    f.can_reach_batch(base_batches[0])
+    resume_s = time.perf_counter() - s
+    resume_miss = int(aot.miss_total() - miss0)
+    miss0 = aot.miss_total()
+    s = time.perf_counter()
+    w2 = f.promote()
+    f.can_reach_batch(base_batches[1 % len(base_batches)])
+    promote_s = time.perf_counter() - s
+    promote_miss = int(aot.miss_total() - miss0)
+    if w2 is not None:
+        w2.close()
+    log(
+        f"warm-start SLO: resume->first answer {resume_s:.2f}s "
+        f"({resume_miss} aot misses), promote->first answer "
+        f"{promote_s:.2f}s ({promote_miss} aot misses)"
+    )
+    if aot.aot_enabled() and (resume_miss or promote_miss):
+        log(
+            "WARM-PATH AOT MISSES on resume/promote — the pack did not "
+            "cover the follower's kernels; inspect observe/aot.py"
+        )
+    _emit(
+        {
+            "metric": "resume_to_first_answer_s",
+            "value": round(resume_s, 3),
+            "unit": "s",
+            "aot_misses": resume_miss,
+            "aot_warm": bool(aot.aot_enabled()),
+        }
+    )
+    _emit(
+        {
+            "metric": "promote_to_first_answer_s",
+            "value": round(promote_s, 3),
+            "unit": "s",
+            "aot_misses": promote_miss,
+            "aot_warm": bool(aot.aot_enabled()),
         }
     )
 
@@ -1992,6 +2180,11 @@ def main() -> None:
             "macs": pairs * (enc.ingress.n + enc.egress.n),
             "macs_basis": "n_pods^2 * (ingress_grants + egress_grants)",
         }
+    # the dense research kernels stay off the AOT manifest, so this split
+    # honestly reports warm ~= cold for the k8s/kano modes
+    warm_fields = _warm_compile_split(
+        t4 - t3, rerun=lambda: drain(step(dev_args)[0])
+    )
     _emit(
         {
             "metric": (
@@ -2001,7 +2194,7 @@ def main() -> None:
             "value": round(value, 1),
             "unit": "pairs/s",
             "vs_baseline": round(value / BASELINE_PAIRS_PER_SEC, 4),
-            "compile_s": round(t4 - t3, 2),
+            **warm_fields,
             "steady_s": round(solve, 4),
             **macs_extra,
         }

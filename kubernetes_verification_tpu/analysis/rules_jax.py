@@ -469,6 +469,84 @@ class JitHostSyncRule(Rule):
                 )
 
 
+#: registrar call names from ``observe.aot`` (leading underscores of
+#: import aliases like ``_register_kernel`` are stripped before matching)
+_AOT_REGISTRARS = frozenset({"register_kernel", "transient_kernel"})
+
+
+@register
+class AotUnregisteredKernelRule(Rule):
+    id = "aot-unregistered-kernel"
+    rationale = (
+        "Warm start is a production SLO: every module-level jitted entry "
+        "point must be registered in the AOT kernel manifest "
+        "(`observe.aot.register_kernel` / `transient_kernel`) so its "
+        "compiled executable lands in the checkpoint-shipped warm pack "
+        "and `kvtpu_aot_cache_{hits,misses}_total` can account for it. "
+        "An unregistered jit silently recompiles on every cold start — "
+        "the recovery/promotion paths then miss their "
+        "resume_to_first_answer_s budget with nothing in the metrics to "
+        "say why. Registration is one line at module end: "
+        "`_kernel = register_kernel(\"engine\", \"_kernel\", _kernel, "
+        "static_argnames=(...))`. Kernels jitted per call inside a "
+        "function (transient shapes) use `transient_kernel` at the jit "
+        "site instead. Legacy modules predating the manifest are "
+        "grandfathered in `LINT_BASELINE.json`."
+    )
+    example = (
+        "@partial(jax.jit, static_argnames=(\"tile\",))\n"
+        "def _my_step(x, *, tile):  # never passed to register_kernel\n"
+        "    ...\n"
+    )
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        registered: Set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = (_last_name(node.func) or "").lstrip("_")
+            if name not in _AOT_REGISTRARS:
+                continue
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, ast.Name):
+                    registered.add(arg.id)
+        for stmt in ctx.tree.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if stmt.name in registered:
+                    continue
+                for dec in stmt.decorator_list:
+                    jitted = _last_name(dec) in _JIT_NAMES or (
+                        isinstance(dec, ast.Call)
+                        and _jit_call_info(dec) is not None
+                    )
+                    if jitted:
+                        yield Finding(
+                            self.id, ctx.rel, stmt.lineno,
+                            f"module-level jitted entry point "
+                            f"{stmt.name}() is not in the AOT kernel "
+                            "manifest — register it via observe.aot."
+                            "register_kernel so the warm pack covers it",
+                        )
+                        break
+            elif isinstance(stmt, ast.Assign) and isinstance(
+                stmt.value, ast.Call
+            ):
+                if _jit_call_info(stmt.value) is None:
+                    continue
+                for tgt in stmt.targets:
+                    if (
+                        isinstance(tgt, ast.Name)
+                        and tgt.id not in registered
+                    ):
+                        yield Finding(
+                            self.id, ctx.rel, stmt.lineno,
+                            f"module-level jitted binding {tgt.id} is "
+                            "not in the AOT kernel manifest — register "
+                            "it via observe.aot.register_kernel so the "
+                            "warm pack covers it",
+                        )
+
+
 _KEYISH = ("key", "sig", "cache", "memo")
 
 

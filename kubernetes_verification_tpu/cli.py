@@ -848,11 +848,38 @@ def cmd_serve(args) -> int:
         return _diagnose(args, e)
 
 
+def _maybe_ride_warm_pack(args) -> None:
+    """Install a warm executable pack before any engine is built: an
+    explicit ``--warm-pack``, else the ``aot-pack`` auto-detected next to
+    ``--from-snapshot`` (a checkpoint directory ships one beside its
+    ``gen-N/`` snapshots). Fail-open — a bad pack is counted misses and
+    warnings, never an error."""
+    import os
+
+    from .observe import aot
+
+    if not aot.aot_enabled():
+        return
+    candidates = []
+    if getattr(args, "warm_pack", None):
+        candidates.append(args.warm_pack)
+    snap = getattr(args, "from_snapshot", None)
+    if snap:
+        snap = os.path.abspath(snap)
+        candidates.append(aot.pack_dir(snap))
+        candidates.append(aot.pack_dir(os.path.dirname(snap)))
+    for cand in candidates:
+        if os.path.isdir(cand):
+            aot.load_pack(cand)
+            return
+
+
 def _load_serve_service(args, serve_config):
     """Build the service from manifests (``path``) or a warm-restart
     snapshot (``--from-snapshot``)."""
     from .serve import VerificationService
 
+    _maybe_ride_warm_pack(args)
     if getattr(args, "from_snapshot", None):
         return VerificationService.from_snapshot(
             args.from_snapshot, serve_config=serve_config
@@ -1199,8 +1226,65 @@ def _run_recover(args) -> int:
                     f"age {lease['age_seconds']:.1f}s / "
                     f"ttl {lease['ttl']:.1f}s)"
                 )
+        pack = report.get("aot_pack")
+        if pack and pack.get("present"):
+            env = "env-match" if pack.get("env_match") else "ENV MISMATCH"
+            print(
+                f"aot-pack {pack['directory']}: {pack['entries']} entries "
+                f"({pack['matching']} usable, {pack['mismatched']} "
+                f"mismatched, {pack['corrupt']} corrupt; {env}, "
+                f"{pack['bytes']} bytes)"
+            )
+        elif pack is not None:
+            print("aot-pack: none (cold start will recompile every kernel)")
     if report["generations"] and not report["usable"]:
         return EXIT_INPUT_ERROR
+    return EXIT_OK
+
+
+def cmd_warmup(args) -> int:
+    from .resilience.errors import KvTpuError
+
+    try:
+        with _observed(args):
+            return _run_warmup(args)
+    except KvTpuError as e:
+        return _diagnose(args, e)
+
+
+def _run_warmup(args) -> int:
+    """Pre-populate a warm executable pack for a config: build the engine
+    (construction prewarms the mutation/diff kernels through their real
+    call paths), drive the batched query plane, then AOT-compile every
+    recorded dispatch signature and persist the serialized executables
+    (``observe/aot.py``). ``kv-tpu serve``/``query --from-snapshot`` and
+    checkpoint recovery ride the resulting pack."""
+    from .observe import aot
+    from .resilience.errors import EXIT_OK
+    from .serve import QueryEngine, ServeConfig
+
+    svc, _skipped = _load_serve_service(args, ServeConfig())
+    q = QueryEngine(svc)
+    pods = svc.engine.pods
+    if len(pods) >= 2:
+        names = [f"{p.namespace}/{p.name}" for p in pods[:8]]
+        probes = [
+            (names[i], names[(i + 1) % len(names)], None, "TCP")
+            for i in range(len(names))
+        ]
+        q.can_reach_batch(probes)
+        q.who_can_reach(names[0])
+        q.blast_radius(names[0])
+    summary = aot.save_pack(args.out)
+    if args.json:
+        print(json.dumps(summary, sort_keys=True))
+    else:
+        print(
+            f"warmup: {summary['entries']} executables "
+            f"({summary['new']} newly compiled, {summary['skipped']} "
+            f"skipped) in {summary['directory']} "
+            f"[{summary['bytes']} bytes]"
+        )
     return EXIT_OK
 
 
@@ -1712,6 +1796,12 @@ def main(argv: Optional[list] = None) -> int:
         "(dense or packed — detected from the snapshot contents)",
     )
     p.add_argument(
+        "--warm-pack", metavar="DIR",
+        help="AOT executable pack to install before the engine is built "
+        "(default: the aot-pack directory auto-detected next to "
+        "--from-snapshot); see kv-tpu warmup",
+    )
+    p.add_argument(
         "--events", metavar="FILE",
         help="JSONL mutation-event stream to apply (see kv-tpu generate "
         "--events-out for the schema)",
@@ -1833,6 +1923,35 @@ def main(argv: Optional[list] = None) -> int:
     p.set_defaults(fn=cmd_recover)
 
     p = sub.add_parser(
+        "warmup",
+        help="pre-populate a warm executable pack (AOT kernel cache) for "
+        "a config: build the engine, drive the representative kernels, "
+        "and persist serialized executables for serve/query "
+        "--from-snapshot and checkpoint recovery to ride",
+    )
+    p.add_argument("path", nargs="?", help="manifest file/dir")
+    p.add_argument(
+        "--from-snapshot", metavar="DIR",
+        help="warm up against a serve snapshot instead of manifests "
+        "(records the exact shapes that snapshot serves)",
+    )
+    p.add_argument(
+        "--out", required=True, metavar="DIR",
+        help="pack directory to write — point it at "
+        "CHECKPOINT_DIR/aot-pack to pre-warm a checkpoint directory",
+    )
+    p.add_argument(
+        "--warm-pack", metavar="DIR",
+        help="existing pack to install first (the written pack then "
+        "extends it incrementally)",
+    )
+    p.add_argument("--no-self-traffic", dest="self_traffic", action="store_false")
+    p.add_argument("--no-default-allow", dest="default_allow", action="store_false")
+    p.add_argument("--json", action="store_true")
+    _add_obs_flags(p)
+    p.set_defaults(fn=cmd_warmup)
+
+    p = sub.add_parser(
         "query",
         help="one-shot queries against a cluster or serve snapshot: "
         "can-reach (scalar or --batch JSONL) / who-can-reach / "
@@ -1846,6 +1965,12 @@ def main(argv: Optional[list] = None) -> int:
         "kind is auto-detected, and a packed (bitmap-state) snapshot "
         "answers --batch from device-resident uint32 word rows without "
         "materialising the dense reach matrix",
+    )
+    p.add_argument(
+        "--warm-pack", metavar="DIR",
+        help="AOT executable pack to install before the engine is built "
+        "(default: the aot-pack directory auto-detected next to "
+        "--from-snapshot); see kv-tpu warmup",
     )
     p.add_argument(
         "--can-reach", nargs=2, metavar=("SRC", "DST"),

@@ -1,0 +1,736 @@
+"""AOT kernel pack: warm start as a production SLO.
+
+Compile+first-solve is the dominant term in failover (a promoted follower
+recompiles every kernel before its first answer) and in ``--resume``
+recovery. Long-running TPU systems amortize compilation by reusing
+precompiled executables across runs (PAPERS.md: *Large Scale Distributed
+Linear Algebra With Tensor Processing Units*); this module is that reuse,
+built on the same abstract-shape signatures the recompile tracker
+(``observe/jit.py``) and the cost introspector (``observe/introspect.py``)
+already key on.
+
+Three pieces:
+
+* **Kernel manifest** — every jitted entry point registers once at module
+  import (``register_kernel``; per-call jits like the sharded closure's
+  shard_map use ``transient_kernel``) and is rebound to a
+  :class:`WarmKernel` wrapper. Call sites are unchanged: the wrapper
+  delegates to the jitted function whenever the warm path cannot apply
+  (tracer operands from jit-in-jit calls, unbindable signatures, AOT
+  disabled) and otherwise looks its cache key up first.
+
+* **Content-addressed cache** — the key is the canonical repr of (engine,
+  kernel, static arguments, operand pytree structure, per-leaf
+  shape/dtype/weak-type, platform, device kind, device count, jax/jaxlib
+  versions, XLA flags); the pack entry's filename is the key's sha256.
+  A key mismatch of *any* component is a counted miss
+  (``kvtpu_aot_cache_misses_total``) that falls back to a fresh compile —
+  a serialized executable is never loaded under a non-matching key, so a
+  stale pack can cost time but never correctness.
+
+* **Warm executable pack** — ``save_pack`` AOT-compiles every recorded
+  dispatch signature via ``jitted.lower(...).compile()``, serializes the
+  executables (``jax.experimental.serialize_executable``) and writes them
+  next to a checksummed ``PACK_MANIFEST.json``; ``load_pack`` verifies
+  environment + per-entry payload digests and installs matching
+  executables for the wrappers to serve. Corrupt or truncated entries
+  degrade to a recompile with a warning — the pack path never raises into
+  a solve.
+
+``CheckpointManager`` ships the pack alongside its ``gen-N/`` snapshots
+(``serve/durability.py``), so ``recover()``, follower bootstrap and
+breaker-gated promotion restore *compiled* state; ``kv-tpu warmup``
+pre-populates a pack for a config, and ``bench.py`` gates the warm-path
+compile time so the cold-start walk can never silently return.
+
+Everything here is fail-open: any error on the warm path is a warning, a
+counted miss and a delegation to the ordinary jit dispatch.
+"""
+from __future__ import annotations
+
+import hashlib
+import inspect
+import json
+import os
+import pickle
+import threading
+import warnings
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+from .events import log_event
+from .metrics import (
+    AOT_CACHE_HITS_TOTAL,
+    AOT_CACHE_MISSES_TOTAL,
+    AOT_PACK_BYTES,
+)
+
+__all__ = [
+    "PACK_DIRNAME",
+    "PACK_MANIFEST_NAME",
+    "WarmKernel",
+    "aot_enabled",
+    "set_aot",
+    "register_kernel",
+    "transient_kernel",
+    "manifest",
+    "current_env",
+    "save_pack",
+    "load_pack",
+    "pack_status",
+    "pack_dir",
+    "drop_executables",
+    "hit_total",
+    "miss_total",
+]
+
+PACK_FORMAT = 1
+PACK_DIRNAME = "aot-pack"
+PACK_MANIFEST_NAME = "PACK_MANIFEST.json"
+
+_ENV_FLAG = "KVTPU_AOT"
+
+_lock = threading.RLock()
+_enabled: Optional[bool] = None  # None = defer to the env var
+#: every registered kernel, keyed by (engine, fn) — the kernel manifest
+_MANIFEST: Dict[Tuple[str, str], "_KernelBase"] = {}
+#: pack-loaded executables keyed by full cache key (exact-match only)
+_LOADED: Dict[str, Any] = {}
+#: serialized payload cache keyed by full cache key — lets repeated
+#: checkpoints reship the pack without re-running ``.lower().compile()``
+_PAYLOADS: Dict[str, bytes] = {}
+
+
+# ---------------------------------------------------------------- gating
+def aot_enabled() -> bool:
+    if _enabled is not None:
+        return _enabled
+    return os.environ.get(_ENV_FLAG, "").lower() not in ("0", "false")
+
+
+def set_aot(on: Optional[bool]) -> None:
+    """Force the warm path on/off for this process (None = defer to the
+    KVTPU_AOT env var again)."""
+    global _enabled
+    with _lock:
+        _enabled = on if on is None else bool(on)
+
+
+# ------------------------------------------------------- environment key
+def current_env() -> Dict[str, Any]:
+    """The environment fingerprint baked into every cache key: anything
+    that can invalidate a serialized executable. Tests monkeypatch this to
+    exercise the key-mismatch paths."""
+    import jax
+    import jaxlib
+
+    try:
+        dev = jax.devices()[0]
+        platform, kind = dev.platform, dev.device_kind
+    except Exception:  # uninitialisable backend — still key deterministically
+        platform, kind = "unknown", "unknown"
+    return {
+        "platform": platform,
+        "device_kind": kind,
+        "num_devices": int(jax.device_count()),
+        "jax": jax.__version__,
+        "jaxlib": getattr(jaxlib, "__version__", "unknown"),
+        "xla_flags": os.environ.get("XLA_FLAGS", ""),
+    }
+
+
+class _TracerSeen(Exception):
+    """An operand is a tracer: the wrapper is being called inside another
+    trace (jit-in-jit) — delegate straight to the jitted function."""
+
+
+def _leaf_sig(x) -> Tuple:
+    """Hashable, process-stable description of one operand leaf."""
+    import jax
+
+    if isinstance(x, jax.core.Tracer):
+        raise _TracerSeen
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is not None and dtype is not None:
+        return (
+            "a",
+            tuple(int(d) for d in shape),
+            str(dtype),
+            bool(getattr(x, "weak_type", False)),
+        )
+    if isinstance(x, (bool, int, float, complex)):
+        return ("s", type(x).__name__)
+    return ("o", repr(x))
+
+
+def _leaf_skel(x):
+    """Operand leaf → lowering skeleton: arrays become ShapeDtypeStructs
+    (no device buffers kept alive), scalars pass through verbatim."""
+    import jax
+
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is not None and dtype is not None:
+        return jax.ShapeDtypeStruct(
+            tuple(int(d) for d in shape),
+            dtype,
+            weak_type=bool(getattr(x, "weak_type", False)),
+        )
+    return x
+
+
+def _key_repr(
+    engine: str, fn: str, statics: str, treedef: str, sig: Tuple
+) -> str:
+    env = tuple(sorted((k, str(v)) for k, v in current_env().items()))
+    return repr((engine, fn, statics, treedef, sig, env))
+
+
+def _key_id(key: str) -> str:
+    return hashlib.sha256(key.encode()).hexdigest()
+
+
+#: per-kernel table sentinel: this key was seen and must compile fresh
+_FRESH = object()
+
+
+class _KernelBase:
+    """Shared warm-dispatch state for one manifest entry."""
+
+    def __init__(self, engine: str, name: str) -> None:
+        self.engine = engine
+        self.name = name
+        self._exes: Dict[str, Any] = {}  # key -> executable | _FRESH
+        self._recorded: Dict[str, Tuple] = {}  # key -> lowering recipe
+
+    # ------------------------------------------------------------ lookup
+    def _serve(self, key: str) -> Any:
+        """Executable for ``key`` or ``_FRESH``/None; installs (and counts
+        a hit for) a pack-loaded executable on first use."""
+        exe = self._exes.get(key)
+        if exe is None:
+            loaded = _LOADED.get(key)
+            if loaded is not None:
+                self._exes[key] = loaded
+                AOT_CACHE_HITS_TOTAL.labels(
+                    engine=self.engine, fn=self.name
+                ).inc()
+                return loaded
+        return exe
+
+    def _miss(self, reason: str) -> None:
+        AOT_CACHE_MISSES_TOTAL.labels(
+            engine=self.engine, fn=self.name, reason=reason
+        ).inc()
+
+    def _poison(self, key: str, err: Exception) -> None:
+        """A served executable failed to run: warn, count, and pin the key
+        to the fresh-compile path — degrade, never raise."""
+        self._exes[key] = _FRESH
+        self._miss("exec-error")
+        warnings.warn(
+            f"aot: packed executable for {self.engine}/{self.name} failed "
+            f"({type(err).__name__}: {err}); recompiling fresh",
+            RuntimeWarning,
+            stacklevel=3,
+        )
+        log_event(
+            "aot_exec_fallback",
+            engine=self.engine,
+            fn=self.name,
+            error=f"{type(err).__name__}: {err}",
+        )
+
+    # ------------------------------------------------------------ packing
+    def recorded_keys(self) -> List[str]:
+        return list(self._recorded)
+
+    def compile_recorded(self, key: str):
+        """AOT-compile the recorded signature for ``key`` (the save_pack
+        path; also caches the executable for this process)."""
+        raise NotImplementedError
+
+    def drop_executables(self) -> None:
+        self._exes.clear()
+
+
+class WarmKernel(_KernelBase):
+    """Wrapper around one module-level jitted function.
+
+    Canonical calling convention for the AOT artifacts: dynamic operands
+    positional in signature order with statics keyword-bound whenever
+    every dynamic parameter precedes every static one (the repo-wide
+    kernel shape — and the form under which ``donate_argnums`` keeps its
+    input/output aliasing through ``.lower()``); all-keyword otherwise.
+    Statics are *stripped* when invoking a compiled executable — a
+    ``Compiled`` rejects its static arguments outright.
+    """
+
+    def __init__(
+        self,
+        engine: str,
+        name: str,
+        jitted,
+        static_argnames: Iterable[str] = (),
+    ) -> None:
+        super().__init__(engine, name)
+        self.jitted = jitted
+        self.static_argnames = frozenset(static_argnames)
+        try:
+            self._sig = inspect.signature(jitted)
+        except (TypeError, ValueError):  # C-level callable, no signature
+            self._sig = None
+        self._bindable = self._sig is not None and not any(
+            p.kind
+            in (inspect.Parameter.VAR_POSITIONAL, inspect.Parameter.VAR_KEYWORD)
+            for p in self._sig.parameters.values()
+        )
+        self._positional = False
+        if self._bindable:
+            params = list(self._sig.parameters.values())
+            dyn_idx = [
+                i for i, p in enumerate(params)
+                if p.name not in self.static_argnames
+            ]
+            static_idx = [
+                i for i, p in enumerate(params)
+                if p.name in self.static_argnames
+            ]
+            kwonly_dyn = any(
+                params[i].kind == inspect.Parameter.KEYWORD_ONLY
+                for i in dyn_idx
+            )
+            self._positional = not kwonly_dyn and (
+                not static_idx
+                or not dyn_idx
+                or max(dyn_idx) < min(static_idx)
+            )
+
+    def lower(self, *args, **kwargs):
+        return self.jitted.lower(*args, **kwargs)
+
+    def _plan(self, args, kwargs):
+        """(key, dynamic kwargs, statics, skeleton) for this call, or None
+        when the warm path cannot apply."""
+        import jax
+
+        if not self._bindable:
+            return None
+        try:
+            bound = self._sig.bind(*args, **kwargs)
+            bound.apply_defaults()
+        except TypeError:
+            return None
+        statics: Dict[str, Any] = {}
+        dyn_kw: Dict[str, Any] = {}
+        for pname, val in bound.arguments.items():
+            (statics if pname in self.static_argnames else dyn_kw)[pname] = val
+        # bound.arguments preserves signature order, so under the
+        # positional convention the values line up with the parameters
+        dyn: Any = list(dyn_kw.values()) if self._positional else dyn_kw
+        try:
+            statics_key = repr(tuple(sorted(statics.items())))
+        except Exception:
+            return None
+        try:
+            leaves, treedef = jax.tree_util.tree_flatten(dyn)
+            sig = tuple(_leaf_sig(x) for x in leaves)
+        except _TracerSeen:
+            return None
+        except Exception:
+            return None
+        key = _key_repr(self.engine, self.name, statics_key, str(treedef), sig)
+        return key, dyn, statics, (leaves, treedef)
+
+    def __call__(self, *args, **kwargs):
+        if not aot_enabled():
+            return self.jitted(*args, **kwargs)
+        plan = self._plan(args, kwargs)
+        if plan is None:
+            return self.jitted(*args, **kwargs)
+        key, dyn, statics, (leaves, treedef) = plan
+        exe = self._serve(key)
+        if exe is not None and exe is not _FRESH:
+            try:
+                return exe(*dyn) if self._positional else exe(**dyn)
+            except Exception as e:  # shape drift, corrupt program, ...
+                self._poison(key, e)
+                return self.jitted(*args, **kwargs)
+        if exe is None:
+            import jax
+
+            self._miss("cold")
+            self._exes[key] = _FRESH
+            skel = jax.tree_util.tree_unflatten(
+                treedef, [_leaf_skel(x) for x in leaves]
+            )
+            self._recorded[key] = (skel, dict(statics))
+        return self.jitted(*args, **kwargs)
+
+    def compile_recorded(self, key: str):
+        skel, statics = self._recorded[key]
+        if self._positional:
+            lowered = self.jitted.lower(*skel, **statics)
+        else:
+            lowered = self.jitted.lower(**skel, **statics)
+        compiled = lowered.compile()
+        self._exes[key] = compiled
+        return compiled
+
+
+class TransientKernel(_KernelBase):
+    """Manifest entry for jits constructed per call (the sharded closure
+    jits a fresh ``shard_map`` closure per geometry). ``bind`` wraps one
+    such jitted object; the cache key carries the construction parameters
+    (``key_extras``) the closure baked in. Positional-only convention —
+    these callables take operand pytrees positionally and have no static
+    arguments of their own."""
+
+    def bind(self, jitted, key_extras: Tuple = ()) -> Callable:
+        import jax
+
+        engine, name = self.engine, self.name
+
+        def call(*args):
+            if not aot_enabled():
+                return jitted(*args)
+            try:
+                extras = repr(tuple(key_extras))
+                leaves, treedef = jax.tree_util.tree_flatten(args)
+                sig = tuple(_leaf_sig(x) for x in leaves)
+            except Exception:
+                return jitted(*args)
+            key = _key_repr(engine, name, extras, str(treedef), sig)
+            exe = self._serve(key)
+            if exe is not None and exe is not _FRESH:
+                try:
+                    return exe(*args)
+                except Exception as e:
+                    self._poison(key, e)
+                    return jitted(*args)
+            if exe is None:
+                self._miss("cold")
+                self._exes[key] = _FRESH
+                skel = jax.tree_util.tree_unflatten(
+                    treedef, [_leaf_skel(x) for x in leaves]
+                )
+                self._recorded[key] = (jitted, skel)
+            return jitted(*args)
+
+        call.jitted = jitted
+        return call
+
+    def compile_recorded(self, key: str):
+        jitted, skel = self._recorded[key]
+        compiled = jitted.lower(*skel).compile()
+        self._exes[key] = compiled
+        return compiled
+
+
+# ---------------------------------------------------------- registration
+def register_kernel(
+    engine: str,
+    name: str,
+    jitted,
+    *,
+    static_argnames: Iterable[str] = (),
+) -> WarmKernel:
+    """Register a module-level jitted entry point with the kernel manifest
+    and return its :class:`WarmKernel` (rebind the module name to it:
+    ``_f = register_kernel("eng", "_f", _f, ...)``). ``static_argnames``
+    must mirror the jit decorator's — jax exposes no introspection for
+    them on this version."""
+    kernel = WarmKernel(engine, name, jitted, static_argnames)
+    with _lock:
+        _MANIFEST[(engine, name)] = kernel
+    return kernel
+
+
+def transient_kernel(
+    engine: str, name: str, jitted, *, key_extras: Tuple = ()
+) -> Callable:
+    """Register (or reuse) a manifest entry for a per-call jit and return
+    the warm-dispatch wrapper for this particular jitted object."""
+    with _lock:
+        entry = _MANIFEST.get((engine, name))
+        if not isinstance(entry, TransientKernel):
+            entry = TransientKernel(engine, name)
+            _MANIFEST[(engine, name)] = entry
+    return entry.bind(jitted, key_extras)
+
+
+def manifest() -> Dict[Tuple[str, str], _KernelBase]:
+    """The live kernel manifest (read-only view)."""
+    with _lock:
+        return dict(_MANIFEST)
+
+
+def drop_executables() -> None:
+    """Forget every in-process executable (per-kernel tables and the
+    pack-loaded set). Recorded signatures and serialized payload caches
+    survive — this is the bench/test hook that simulates a fresh process
+    in front of an on-disk pack."""
+    with _lock:
+        _LOADED.clear()
+        for kernel in _MANIFEST.values():
+            kernel.drop_executables()
+
+
+def hit_total() -> float:
+    return sum(c.value for c in AOT_CACHE_HITS_TOTAL.children().values())
+
+
+def miss_total() -> float:
+    return sum(c.value for c in AOT_CACHE_MISSES_TOTAL.children().values())
+
+
+# ------------------------------------------------------------- the pack
+def pack_dir(checkpoint_dir: str) -> str:
+    """Where the warm pack lives relative to a checkpoint directory."""
+    return os.path.join(checkpoint_dir, PACK_DIRNAME)
+
+
+def _atomic_write(path: str, data: bytes) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as fh:
+        fh.write(data)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+
+
+def _read_manifest(directory: str) -> Optional[dict]:
+    path = os.path.join(directory, PACK_MANIFEST_NAME)
+    try:
+        with open(path) as fh:
+            man = json.load(fh)
+    except FileNotFoundError:
+        return None
+    except (OSError, json.JSONDecodeError, UnicodeDecodeError) as e:
+        warnings.warn(
+            f"aot: unreadable pack manifest {path} ({e}); ignoring pack",
+            RuntimeWarning,
+        )
+        return None
+    if not isinstance(man, dict) or not isinstance(man.get("entries"), list):
+        warnings.warn(
+            f"aot: malformed pack manifest {path}; ignoring pack",
+            RuntimeWarning,
+        )
+        return None
+    return man
+
+
+def save_pack(directory: str) -> dict:
+    """AOT-compile every recorded dispatch signature and persist the
+    serialized executables into ``directory`` (incremental: entries whose
+    key is already packed are reused, serialized payloads are cached
+    in-process so repeated checkpoints don't recompile). Per-entry
+    failures are warnings, never raises. Returns a summary dict."""
+    from jax.experimental import serialize_executable
+
+    os.makedirs(directory, exist_ok=True)
+    existing = _read_manifest(directory)
+    entries: Dict[str, dict] = {}
+    if existing is not None:
+        for ent in existing.get("entries", []):
+            if isinstance(ent, dict) and "id" in ent:
+                path = os.path.join(directory, f"{ent['id']}.kexe")
+                if os.path.exists(path):
+                    entries[ent["id"]] = ent
+    env = current_env()
+    compiled_n, skipped_n = 0, 0
+    with _lock:
+        kernels = list(_MANIFEST.values())
+    for kernel in kernels:
+        for key in kernel.recorded_keys():
+            kid = _key_id(key)
+            if kid in entries:
+                continue
+            blob = _PAYLOADS.get(key)
+            if blob is None:
+                try:
+                    compiled = kernel.compile_recorded(key)
+                    payload, in_tree, out_tree = serialize_executable.serialize(
+                        compiled
+                    )
+                    blob = pickle.dumps((payload, in_tree, out_tree))
+                except Exception as e:  # unserializable kernel — skip it
+                    skipped_n += 1
+                    log_event(
+                        "aot_pack_skip",
+                        engine=kernel.engine,
+                        fn=kernel.name,
+                        error=f"{type(e).__name__}: {e}",
+                    )
+                    continue
+                _PAYLOADS[key] = blob
+            try:
+                _atomic_write(os.path.join(directory, f"{kid}.kexe"), blob)
+            except OSError as e:
+                skipped_n += 1
+                warnings.warn(
+                    f"aot: could not write pack entry for {kernel.engine}/"
+                    f"{kernel.name}: {e}",
+                    RuntimeWarning,
+                )
+                continue
+            entries[kid] = {
+                "id": kid,
+                "engine": kernel.engine,
+                "fn": kernel.name,
+                "key": key,
+                "payload_sha256": hashlib.sha256(blob).hexdigest(),
+                "bytes": len(blob),
+            }
+            compiled_n += 1
+    manifest_obj = {
+        "format": PACK_FORMAT,
+        "env": env,
+        "entries": sorted(entries.values(), key=lambda e: e["id"]),
+    }
+    _atomic_write(
+        os.path.join(directory, PACK_MANIFEST_NAME),
+        (json.dumps(manifest_obj, sort_keys=True, indent=2) + "\n").encode(),
+    )
+    total_bytes = sum(int(e.get("bytes", 0)) for e in entries.values())
+    AOT_PACK_BYTES.set(total_bytes)
+    summary = {
+        "directory": directory,
+        "entries": len(entries),
+        "new": compiled_n,
+        "skipped": skipped_n,
+        "bytes": total_bytes,
+    }
+    log_event("aot_pack_save", **summary)
+    return summary
+
+
+def load_pack(directory: str) -> dict:
+    """Verify and install a warm pack: entries whose environment matches
+    the current fingerprint *and* whose payload digest checks out are
+    deserialized into the loaded-executable set (served by exact cache-key
+    match only); anything else is a counted miss — environment drift under
+    ``key-mismatch``, damage under ``corrupt`` — and a warning, never an
+    error. Returns a summary dict."""
+    from jax.experimental import serialize_executable
+
+    summary = {
+        "directory": directory,
+        "present": False,
+        "loaded": 0,
+        "mismatched": 0,
+        "corrupt": 0,
+        "bytes": 0,
+    }
+    man = _read_manifest(directory)
+    if man is None:
+        return summary
+    summary["present"] = True
+    env = current_env()
+    pack_env = man.get("env") or {}
+    for ent in man.get("entries", []):
+        if not isinstance(ent, dict) or "key" not in ent or "id" not in ent:
+            summary["corrupt"] += 1
+            continue
+        engine = str(ent.get("engine", "?"))
+        fn = str(ent.get("fn", "?"))
+        if pack_env != env:
+            # the executable was built for a different world — counted
+            # miss, never loaded
+            summary["mismatched"] += 1
+            AOT_CACHE_MISSES_TOTAL.labels(
+                engine=engine, fn=fn, reason="key-mismatch"
+            ).inc()
+            continue
+        key = ent["key"]
+        if key in _LOADED:
+            summary["loaded"] += 1
+            summary["bytes"] += int(ent.get("bytes", 0))
+            continue
+        path = os.path.join(directory, f"{ent['id']}.kexe")
+        try:
+            with open(path, "rb") as fh:
+                blob = fh.read()
+            if hashlib.sha256(blob).hexdigest() != ent.get("payload_sha256"):
+                raise PersistenceDamage("payload digest mismatch")
+            payload, in_tree, out_tree = pickle.loads(blob)
+            exe = serialize_executable.deserialize_and_load(
+                payload, in_tree, out_tree
+            )
+        except Exception as e:
+            summary["corrupt"] += 1
+            AOT_CACHE_MISSES_TOTAL.labels(
+                engine=engine, fn=fn, reason="corrupt"
+            ).inc()
+            warnings.warn(
+                f"aot: pack entry {ent['id'][:12]}… ({engine}/{fn}) is "
+                f"unusable ({type(e).__name__}: {e}); will recompile fresh",
+                RuntimeWarning,
+            )
+            log_event(
+                "aot_pack_corrupt",
+                entry=ent["id"],
+                engine=engine,
+                fn=fn,
+                error=f"{type(e).__name__}: {e}",
+            )
+            continue
+        with _lock:
+            _LOADED[key] = exe
+            _PAYLOADS.setdefault(key, blob)
+        summary["loaded"] += 1
+        summary["bytes"] += len(blob)
+    if summary["bytes"]:
+        AOT_PACK_BYTES.set(summary["bytes"])
+    log_event("aot_pack_load", **summary)
+    return summary
+
+
+class PersistenceDamage(Exception):
+    """Internal marker for a pack entry that failed its digest check."""
+
+
+def pack_status(directory: str) -> dict:
+    """Read-only validity report for ``kv-tpu recover --json``: entry
+    count, how many keys match the current environment, and per-entry
+    damage — nothing is deserialized and no metrics move."""
+    status: Dict[str, Any] = {
+        "directory": directory,
+        "present": False,
+        "entries": 0,
+        "env_match": False,
+        "matching": 0,
+        "mismatched": 0,
+        "corrupt": 0,
+        "bytes": 0,
+    }
+    man = _read_manifest(directory)
+    if man is None:
+        return status
+    status["present"] = True
+    env = current_env()
+    pack_env = man.get("env") or {}
+    status["env_match"] = pack_env == env
+    status["pack_env"] = pack_env
+    for ent in man.get("entries", []):
+        if not isinstance(ent, dict) or "id" not in ent:
+            status["corrupt"] += 1
+            continue
+        status["entries"] += 1
+        path = os.path.join(directory, f"{ent['id']}.kexe")
+        try:
+            with open(path, "rb") as fh:
+                blob = fh.read()
+        except OSError:
+            status["corrupt"] += 1
+            continue
+        if hashlib.sha256(blob).hexdigest() != ent.get("payload_sha256"):
+            status["corrupt"] += 1
+            continue
+        status["bytes"] += len(blob)
+        if status["env_match"]:
+            status["matching"] += 1
+        else:
+            status["mismatched"] += 1
+    return status

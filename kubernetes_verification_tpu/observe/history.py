@@ -95,9 +95,16 @@ _HIGHER_IS_BETTER_METRICS = frozenset(
     {"closure_pairs_per_second", "aggregate_queries_per_second"}
 )
 #: and the replica-lag series gates lower-is-better by NAME — a follower
-#: falling further behind the leader is a regression whatever the unit
+#: falling further behind the leader is a regression whatever the unit;
+#: the failover SLO series (promotion/resume to first answered batch)
+#: gate the same way: the whole point of the warm pack is keeping them low
 _LOWER_IS_BETTER_METRICS = frozenset(
-    {"replica_lag_seconds", "replica_lag_spread_seconds"}
+    {
+        "replica_lag_seconds",
+        "replica_lag_spread_seconds",
+        "promote_to_first_answer_s",
+        "resume_to_first_answer_s",
+    }
 )
 #: sentinel context series: the round's NOISE measurements. Never gated —
 #: a slower tunnel or a noisier host is environment, not regression; the
@@ -108,8 +115,11 @@ _UNGATED_METRICS = frozenset(
 
 #: suffix of the dispatch-deflated twin series ``deflate_record`` derives
 DEFLATED_SUFFIX = "_deflated"
-#: suffix of the derived compile-time series (``"<metric> compile_s"``)
+#: suffixes of the derived compile-time series (``"<metric> compile_s"``;
+#: the AOT warm-start split emits cold/warm twins of the same shape —
+#: ``compile_warm_s`` is the one the pack must keep near zero)
 _COMPILE_SUFFIX = "compile_s"
+_COMPILE_FIELDS = ("compile_s", "compile_cold_s", "compile_warm_s")
 
 #: latency units deflation understands, as seconds-per-unit
 _SECONDS_PER_UNIT = {"s": 1.0, "seconds": 1.0, "ms": 1e-3, "us": 1e-6}
@@ -215,8 +225,10 @@ def _direction(unit: Optional[str], metric: Optional[str] = None) -> str:
         if metric.endswith(DEFLATED_SUFFIX):
             return _direction(unit, metric[: -len(DEFLATED_SUFFIX)])
         # compile time gates lower-is-better whether emitted bare or as
-        # the derived "<metric> compile_s" series
-        if metric == _COMPILE_SUFFIX or metric.endswith(" " + _COMPILE_SUFFIX):
+        # a derived "<metric> compile[_cold|_warm]_s" series
+        if metric in _COMPILE_FIELDS or any(
+            metric.endswith(" " + f) for f in _COMPILE_FIELDS
+        ):
             return "lower"
         # roofline utilisation gates higher-is-better
         if metric == "pct_of_peak" or metric.endswith("_pct_of_peak"):
@@ -311,26 +323,29 @@ def expand_derived(runs: List[dict], deflate: bool = True) -> List[dict]:
 
     * a ``"<metric> compile_s"`` series (unit "s") from every record with
       a numeric ``compile_s`` field — so compile-time walks gate
-      lower-is-better per headline series;
+      lower-is-better per headline series — and the same for the AOT
+      split's ``compile_cold_s`` / ``compile_warm_s`` fields (the warm
+      series is how a silent cold-start walk would resurface);
     * the ``<metric>_deflated`` twin (:func:`deflate_record`) from every
       record carrying a usable sentinel calibration block.
     """
     out: List[dict] = []
     for rec in runs:
         out.append(rec)
-        compile_s = rec.get("compile_s")
         metric = rec.get("metric")
-        if isinstance(metric, str) and isinstance(compile_s, (int, float)):
-            derived = {
-                "metric": f"{metric} {_COMPILE_SUFFIX}",
-                "unit": "s",
-                "value": float(compile_s),
-                "derived_from": metric,
-            }
-            for key in ("ts", "round", "mode", "origin"):
-                if key in rec:
-                    derived[key] = rec[key]
-            out.append(derived)
+        for field in _COMPILE_FIELDS:
+            v = rec.get(field)
+            if isinstance(metric, str) and isinstance(v, (int, float)):
+                derived = {
+                    "metric": f"{metric} {field}",
+                    "unit": "s",
+                    "value": float(v),
+                    "derived_from": metric,
+                }
+                for key in ("ts", "round", "mode", "origin"):
+                    if key in rec:
+                        derived[key] = rec[key]
+                out.append(derived)
         if deflate:
             twin = deflate_record(rec)
             if twin is not None:

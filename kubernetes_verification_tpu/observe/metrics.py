@@ -70,6 +70,9 @@ __all__ = [
     "LB_STALE_RETRIES_TOTAL",
     "LB_EJECTIONS_TOTAL",
     "LINT_FINDINGS_TOTAL",
+    "AOT_CACHE_HITS_TOTAL",
+    "AOT_CACHE_MISSES_TOTAL",
+    "AOT_PACK_BYTES",
     "SENTINEL_KERNEL_SECONDS",
     "SENTINEL_SPREAD_PCT",
     "SENTINEL_DISPATCH_SECONDS",
@@ -554,6 +557,34 @@ SENTINEL_CALIBRATION_FAILURES_TOTAL = Counter(
     ("kernel",),
 )
 
+AOT_CACHE_HITS_TOTAL = Counter(
+    "kvtpu_aot_cache_hits_total",
+    "Kernel dispatches first served by a pack-loaded AOT executable "
+    "(observe/aot.py), by engine and function — one per cache key, so a "
+    "fully warm start counts every manifest kernel here and nothing under "
+    "the miss family.",
+    ("engine", "fn"),
+)
+
+AOT_CACHE_MISSES_TOTAL = Counter(
+    "kvtpu_aot_cache_misses_total",
+    "AOT warm-start cache misses, by engine, function and reason: 'cold' "
+    "(signature never packed — a fresh trace+compile), 'key-mismatch' "
+    "(pack entry built under a different platform/device/jax version/XLA "
+    "flags, never loaded), 'corrupt' (truncated or digest-failing pack "
+    "entry, degraded to recompile with a warning), 'exec-error' (a loaded "
+    "executable failed at dispatch and was poisoned back to the jit "
+    "path). Zero on the warm path is the failover SLO bench asserts.",
+    ("engine", "fn", "reason"),
+)
+
+AOT_PACK_BYTES = Gauge(
+    "kvtpu_aot_pack_bytes",
+    "Serialized bytes of the warm executable pack most recently saved or "
+    "loaded by this process — the on-disk cost of second-scale warm "
+    "starts, shipped by CheckpointManager next to its gen-N/ snapshots.",
+)
+
 ROOFLINE_ACHIEVED_MACS_PER_SECOND = Gauge(
     "kvtpu_roofline_achieved_macs_per_second",
     "Achieved multiply-accumulates per steady-state second for the newest "
@@ -654,5 +685,9 @@ REQUIRED_FAMILIES = frozenset(
         "kvtpu_lint_callgraph_nodes",
         "kvtpu_lint_callgraph_edges",
         "kvtpu_lint_cache_hits_total",
+        # AOT warm-start subsystem (observe/aot.py)
+        "kvtpu_aot_cache_hits_total",
+        "kvtpu_aot_cache_misses_total",
+        "kvtpu_aot_pack_bytes",
     }
 )

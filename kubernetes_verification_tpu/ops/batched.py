@@ -476,3 +476,30 @@ def packed_any_port(
         np.asarray(words)[: src_idx.size],
         np.asarray(ans)[: q_row.size],
     )
+
+
+# Kernel-manifest registration (observe/aot.py): rebinding each jitted
+# entry point to its WarmKernel keeps every call site above unchanged
+# (late binding) while the warm-start pack can serve packed executables.
+from ..observe.aot import register_kernel as _register_kernel  # noqa: E402
+
+_reach_rows_kernel = _register_kernel(
+    "query", "_reach_rows_kernel", _reach_rows_kernel,
+    static_argnames=("self_traffic", "default_allow_unselected"),
+)
+_probe_rows_kernel = _register_kernel(
+    "query", "_probe_rows_kernel", _probe_rows_kernel,
+    static_argnames=("self_traffic", "default_allow_unselected"),
+)
+_reach_cols_kernel = _register_kernel(
+    "query", "_reach_cols_kernel", _reach_cols_kernel,
+    static_argnames=("self_traffic", "default_allow_unselected"),
+)
+_packed_probe_kernel = _register_kernel(
+    "query", "_packed_probe_kernel", _packed_probe_kernel,
+    static_argnames=("self_traffic", "default_allow"),
+)
+_packed_cols_kernel = _register_kernel(
+    "query", "_packed_cols_kernel", _packed_cols_kernel,
+    static_argnames=("self_traffic", "default_allow"),
+)

@@ -643,3 +643,34 @@ def path_upto(reach, hops: int):
         pack_bool_cols(padded), np.arange(n), hops=hops, want_hops=False
     )
     return unpack_words_i8(acc, n + pad)[:, :n].astype(bool)
+
+
+# Kernel-manifest registration (observe/aot.py): rebind the jitted entry
+# points so the warm-start pack can serve packed executables; call sites
+# above are unchanged (late binding).
+from ..observe.aot import register_kernel as _register_kernel  # noqa: E402
+
+_packed_square_step = _register_kernel(
+    "closure", "_packed_square_step", _packed_square_step,
+    static_argnames=("row_tile", "dst_tile"),
+)
+_packed_row_counts = _register_kernel(
+    "closure", "_packed_row_counts", _packed_row_counts
+)
+_closure_rows_step = _register_kernel(
+    "closure", "_closure_rows_step", _closure_rows_step,
+    static_argnames=("tile",),
+)
+_rows_touching = _register_kernel("closure", "_rows_touching", _rows_touching)
+_rows_differ = _register_kernel("closure", "_rows_differ", _rows_differ)
+_delta_seed = _register_kernel("closure", "_delta_seed", _delta_seed)
+_any_removed = _register_kernel("closure", "_any_removed", _any_removed)
+_add_edges_round = _register_kernel(
+    "closure", "_add_edges_round", _add_edges_round, static_argnames=("tile",)
+)
+_rows_any = _register_kernel("closure", "_rows_any", _rows_any)
+_bounded_frontier_step = _register_kernel(
+    "closure", "_bounded_frontier_step", _bounded_frontier_step,
+    static_argnames=("tile",),
+)
+_any_bits = _register_kernel("closure", "_any_bits", _any_bits)

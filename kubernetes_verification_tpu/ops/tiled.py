@@ -1584,3 +1584,49 @@ def tiled_k8s_reach(
     return out
 
 
+
+
+# Kernel-manifest registration (observe/aot.py): rebind the jitted entry
+# points so the warm-start pack can serve packed executables; call sites
+# above are unchanged (late binding).
+from ..observe.aot import register_kernel as _register_kernel  # noqa: E402
+
+_tiled_step = _register_kernel(
+    "tiled", "_tiled_step", _tiled_step,
+    static_argnames=(
+        "tile", "chunk", "self_traffic", "default_allow_unselected",
+        "direction_aware_isolation", "use_pallas",
+    ),
+)
+_tiled_ports_step = _register_kernel(
+    "tiled", "_tiled_ports_step", _tiled_ports_step,
+    static_argnames=(
+        "layout", "tile", "chunk", "self_traffic",
+        "default_allow_unselected", "direction_aware_isolation",
+    ),
+)
+_tiled_ports_fused_step = _register_kernel(
+    "tiled", "_tiled_ports_fused_step", _tiled_ports_fused_step,
+    static_argnames=(
+        "layout", "stripe", "chunk", "tm", "tk", "self_traffic",
+        "default_allow_unselected", "direction_aware_isolation", "interp",
+    ),
+)
+_device_word_reduce = _register_kernel(
+    "tiled", "_device_word_reduce", _device_word_reduce,
+    static_argnames=("op",),
+)
+_device_out_degree = _register_kernel(
+    "tiled", "_device_out_degree", _device_out_degree
+)
+_device_group_or = _register_kernel(
+    "tiled", "_device_group_or", _device_group_or,
+    static_argnames=("n_groups",),
+)
+_policy_sets_step = _register_kernel(
+    "tiled", "_policy_sets_step", _policy_sets_step,
+    static_argnames=("chunk",),
+)
+_policy_sets = _register_kernel(
+    "tiled", "_policy_sets", _policy_sets, static_argnames=("chunk",)
+)

@@ -2120,3 +2120,50 @@ class PackedIncrementalVerifier:
         self.init_time = 0.0
         self._prewarm()
         return self
+
+
+# Kernel-manifest registration (observe/aot.py): rebind the jitted entry
+# points so the warm-start pack can serve packed executables; call sites
+# above are unchanged (late binding). Donation aliasing is preserved —
+# the wrapper lowers/dispatches dynamics positionally for these kernels.
+from .observe.aot import register_kernel as _register_kernel  # noqa: E402
+
+_slot_write = _register_kernel("packed", "_slot_write", _slot_write)
+_stripe_step = _register_kernel(
+    "packed", "_stripe_step", _stripe_step,
+    static_argnames=("width", "self_traffic", "default_allow"),
+)
+_rows_step = _register_kernel(
+    "packed", "_rows_step", _rows_step,
+    static_argnames=("self_traffic", "default_allow"),
+)
+_apply_pod_col = _register_kernel("packed", "_apply_pod_col", _apply_pod_col)
+_apply_pod_cols_group = _register_kernel(
+    "packed", "_apply_pod_cols_group", _apply_pod_cols_group
+)
+_pod_step = _register_kernel(
+    "packed", "_pod_step", _pod_step,
+    static_argnames=("self_traffic", "default_allow"),
+)
+_pod_step_mf = _register_kernel("packed", "_pod_step_mf", _pod_step_mf)
+_patch_rows = _register_kernel(
+    "packed", "_patch_rows", _patch_rows,
+    static_argnames=("self_traffic", "default_allow"),
+)
+_patch_cols = _register_kernel(
+    "packed", "_patch_cols", _patch_cols,
+    static_argnames=("self_traffic", "default_allow"),
+)
+_diff_step = _register_kernel(
+    "packed", "_diff_step", _diff_step,
+    static_argnames=("self_traffic", "default_allow", "has_rows", "has_cols"),
+)
+_build_maps = _register_kernel(
+    "packed", "_build_maps", _build_maps,
+    static_argnames=("chunk", "direction_aware"),
+)
+_sweep_jit = _register_kernel(
+    "packed", "_sweep_packed", _sweep_jit,
+    static_argnames=("tile", "self_traffic", "default_allow_unselected"),
+)
+_mask_rows = _register_kernel("packed", "_mask_rows", _mask_rows)

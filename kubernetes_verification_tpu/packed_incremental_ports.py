@@ -1876,3 +1876,35 @@ class PackedPortsIncrementalVerifier:
         self.init_time = 0.0
         self._prewarm()
         return self
+
+
+# Kernel-manifest registration (observe/aot.py): rebind the jitted entry
+# points so the warm-start pack can serve packed executables; call sites
+# above are unchanged (late binding). Donation aliasing is preserved —
+# the wrapper lowers/dispatches dynamics positionally for these kernels.
+from .observe.aot import register_kernel as _register_kernel  # noqa: E402
+
+_build_vp_operands = _register_kernel(
+    "packed-ports", "_build_vp_operands", _build_vp_operands,
+    static_argnames=("chunk", "direction_aware"),
+)
+_ports_patch_rows = _register_kernel(
+    "packed-ports", "_ports_patch_rows", _ports_patch_rows,
+    static_argnames=("layout", "self_traffic", "default_allow"),
+)
+_ports_patch_cols = _register_kernel(
+    "packed-ports", "_ports_patch_cols", _ports_patch_cols,
+    static_argnames=("layout", "self_traffic", "default_allow"),
+)
+_ports_sweep = _register_kernel(
+    "packed-ports", "_ports_sweep", _ports_sweep,
+    static_argnames=("layout", "tile", "self_traffic", "default_allow"),
+)
+_vp_write = _register_kernel("packed-ports", "_vp_write", _vp_write)
+_ports_pod_step = _register_kernel(
+    "packed-ports", "_ports_pod_step", _ports_pod_step,
+    static_argnames=("layout", "self_traffic", "default_allow"),
+)
+_ports_apply_pod_cols_group = _register_kernel(
+    "packed-ports", "_ports_apply_pod_cols_group", _ports_apply_pod_cols_group
+)

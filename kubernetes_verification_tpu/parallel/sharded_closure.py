@@ -333,6 +333,16 @@ def sharded_packed_closure(
             check_vma=False,
         )
     )
+    # per-call jit: the manifest entry is shared, the cache key carries the
+    # geometry this closure baked in (observe/aot.py warm-start pack)
+    from ..observe.aot import transient_kernel
+
+    fn = transient_kernel(
+        "sharded",
+        "_sharded_square_local",
+        fn,
+        key_extras=(Np, t, dt, dp, mp),
+    )
     cur = jnp.asarray(padded)
     for _ in range(max_iter):
         CLOSURE_ITERATIONS.inc()

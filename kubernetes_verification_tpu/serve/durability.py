@@ -273,6 +273,7 @@ class CheckpointManager:
             log_offset=int(log_offset), last_seq=int(last_seq),
         )
         self._rotate()
+        self._ship_pack()
         return CheckpointInfo(
             generation=gen,
             manifest_path=self.manifest_path(gen),
@@ -282,6 +283,23 @@ class CheckpointManager:
             log_offset=int(log_offset),
             last_seq=int(last_seq),
         )
+
+    def _ship_pack(self) -> None:
+        """Ship the warm executable pack alongside the ``gen-N/``
+        snapshots (``aot-pack/`` is invisible to :meth:`_rotate` — it is
+        not a generation). Incremental and fail-open: a pack failure can
+        cost a warm start, never a checkpoint."""
+        try:
+            from ..observe import aot
+
+            if aot.aot_enabled():
+                aot.save_pack(aot.pack_dir(self.directory))
+        except Exception as e:  # noqa: BLE001 — durability never rides on AOT
+            log_event(
+                "aot_pack_ship_failed",
+                directory=self.directory,
+                error=f"{type(e).__name__}: {e}",
+            )
 
     def _rotate(self) -> None:
         """Keep the newest ``retain`` committed generations; delete the
@@ -396,6 +414,13 @@ class RecoveryManager:
         lp = lease_path(self.directory)
         if os.path.exists(lp):
             report["lease"] = LeaseFile(lp).describe()
+        # warm-pack validity rides the same report (read-only, no loads)
+        try:
+            from ..observe import aot
+
+            report["aot_pack"] = aot.pack_status(aot.pack_dir(self.directory))
+        except Exception as e:  # noqa: BLE001 — report, don't die
+            report["aot_pack"] = {"present": False, "error": str(e)}
         return report
 
     def recover(
@@ -426,6 +451,21 @@ class RecoveryManager:
         rungs pick the engine kind from the snapshot itself.
         """
         from .service import VerificationService
+
+        # install the warm executable pack before any engine is built, so
+        # the snapshot load / replay / first answer all dispatch against
+        # packed executables (fail-open: a bad pack is misses + warnings)
+        try:
+            from ..observe import aot
+
+            if aot.aot_enabled():
+                aot.load_pack(aot.pack_dir(self.directory))
+        except Exception as e:  # noqa: BLE001 — recovery never rides on AOT
+            log_event(
+                "aot_pack_load_failed",
+                directory=self.directory,
+                error=f"{type(e).__name__}: {e}",
+            )
 
         errors: List[Tuple[int, str]] = []
         chosen: Optional[dict] = None

@@ -1,0 +1,308 @@
+"""The AOT warm-start subsystem (``observe/aot.py``): pack round-trips are
+zero-miss and bit-identical, every key-mismatch flavour (platform drift,
+jax version bump, changed abstract-shape signature) is a counted miss that
+falls back to a fresh compile — never a stale executable — corrupt or
+truncated pack entries degrade to a recompile with a warning, checkpoints
+ship the pack and recovery reloads it, and the ``aot-unregistered-kernel``
+lint rule keeps the kernel manifest honest."""
+import json
+import os
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import kubernetes_verification_tpu as kv
+from kubernetes_verification_tpu.analysis import lint_source
+from kubernetes_verification_tpu.cli import main
+from kubernetes_verification_tpu.harness.generate import (
+    GeneratorConfig,
+    random_cluster,
+)
+from kubernetes_verification_tpu.observe import aot
+from kubernetes_verification_tpu.resilience import EXIT_OK
+from kubernetes_verification_tpu.serve import (
+    CheckpointManager,
+    RecoveryManager,
+    VerificationService,
+)
+
+import textwrap
+
+
+@pytest.fixture
+def fresh_aot(monkeypatch):
+    """Private manifest/loaded/payload tables so pack round-trips see only
+    this test's kernels (the real ops kernels registered at import keep
+    working — they just run cold against the empty tables)."""
+    monkeypatch.setattr(aot, "_MANIFEST", {})
+    monkeypatch.setattr(aot, "_LOADED", {})
+    monkeypatch.setattr(aot, "_PAYLOADS", {})
+    aot.set_aot(True)
+    yield
+    aot.set_aot(None)
+
+
+def _register(name):
+    @jax.jit
+    def _fn(x):
+        return x * 2 + 1
+
+    return aot.register_kernel("aot-test", name, _fn)
+
+
+def _register_static(name):
+    @partial(jax.jit, static_argnames=("k",))
+    def _fn(x, *, k):
+        return x * k + jnp.sum(x)
+
+    return aot.register_kernel("aot-test", name, _fn, static_argnames=("k",))
+
+
+def _miss(fn, reason):
+    return aot.AOT_CACHE_MISSES_TOTAL.labels(
+        engine="aot-test", fn=fn, reason=reason
+    ).value
+
+
+def _same(a, b):
+    a, b = np.asarray(a), np.asarray(b)
+    assert a.dtype == b.dtype and a.shape == b.shape
+    np.testing.assert_array_equal(a, b)
+
+
+# ----------------------------------------------------- warm-path round trip
+def test_warm_roundtrip_is_zero_miss_and_bit_identical(fresh_aot, tmp_path):
+    k = _register("rt")
+    x = jnp.arange(12, dtype=jnp.float32).reshape(3, 4)
+    cold = k(x)  # records the signature (counted cold miss)
+    assert _miss("rt", "cold") >= 1
+    saved = aot.save_pack(str(tmp_path))
+    assert saved["entries"] == 1 and saved["bytes"] > 0
+    aot.drop_executables()
+    jax.clear_caches()  # the warm call must come from the pack alone
+    loaded = aot.load_pack(str(tmp_path))
+    assert loaded["present"] and loaded["loaded"] == 1
+    assert loaded["mismatched"] == 0 and loaded["corrupt"] == 0
+    m0, h0 = aot.miss_total(), aot.hit_total()
+    warm = k(x)
+    assert aot.miss_total() == m0  # zero misses on the warm path
+    assert aot.hit_total() == h0 + 1
+    _same(warm, cold)
+
+
+def test_static_args_roundtrip_keeps_key_per_static(fresh_aot, tmp_path):
+    k = _register_static("st")
+    x = jnp.arange(8, dtype=jnp.float32)
+    cold3, cold5 = k(x, k=3), k(x, k=5)
+    assert aot.save_pack(str(tmp_path))["entries"] == 2
+    aot.drop_executables()
+    assert aot.load_pack(str(tmp_path))["loaded"] == 2
+    m0 = aot.miss_total()
+    _same(k(x, k=3), cold3)
+    _same(k(x, k=5), cold5)
+    assert aot.miss_total() == m0
+
+
+# ------------------------------------------------------- key-mismatch walk
+@pytest.mark.parametrize("drift", [
+    {"platform": "tpu-imaginary"},
+    {"jax": "99.0.0"},
+])
+def test_env_drift_is_counted_miss_and_fresh_compile(
+    fresh_aot, tmp_path, monkeypatch, drift
+):
+    k = _register("env")
+    x = jnp.arange(6, dtype=jnp.float32)
+    cold = k(x)
+    aot.save_pack(str(tmp_path))
+    aot.drop_executables()
+    drifted = dict(aot.current_env(), **drift)
+    monkeypatch.setattr(aot, "current_env", lambda: drifted)
+    mm0 = _miss("env", "key-mismatch")
+    loaded = aot.load_pack(str(tmp_path))
+    # the executable was built for a different world: counted, never loaded
+    assert loaded["loaded"] == 0 and loaded["mismatched"] == 1
+    assert _miss("env", "key-mismatch") == mm0 + 1
+    assert aot._LOADED == {}
+    c0 = _miss("env", "cold")
+    fresh = k(x)  # fresh compile under the drifted key
+    assert _miss("env", "cold") == c0 + 1
+    _same(fresh, cold)
+
+
+def test_changed_shape_signature_is_cold_miss_not_stale_hit(
+    fresh_aot, tmp_path
+):
+    k = _register("shape")
+    x = jnp.arange(6, dtype=jnp.float32)
+    k(x)
+    aot.save_pack(str(tmp_path))
+    aot.drop_executables()
+    assert aot.load_pack(str(tmp_path))["loaded"] == 1
+    y = jnp.arange(10, dtype=jnp.float32)  # different abstract signature
+    c0, h0 = _miss("shape", "cold"), aot.hit_total()
+    out = k(y)
+    assert _miss("shape", "cold") == c0 + 1
+    assert aot.hit_total() == h0  # the packed executable was never served
+    _same(out, y * 2 + 1)
+
+
+# ----------------------------------------------------------- damaged packs
+def test_corrupt_pack_entry_degrades_to_recompile(fresh_aot, tmp_path):
+    k = _register("bad")
+    x = jnp.arange(4, dtype=jnp.int32)
+    cold = k(x)
+    aot.save_pack(str(tmp_path))
+    [kexe] = [n for n in os.listdir(str(tmp_path)) if n.endswith(".kexe")]
+    path = os.path.join(str(tmp_path), kexe)
+    with open(path, "rb") as fh:
+        blob = fh.read()
+    with open(path, "wb") as fh:  # flip bytes: digest check must catch it
+        fh.write(blob[:-8] + b"XXXXXXXX")
+    aot.drop_executables()
+    cr0 = _miss("bad", "corrupt")
+    with pytest.warns(RuntimeWarning, match="unusable"):
+        loaded = aot.load_pack(str(tmp_path))
+    assert loaded["loaded"] == 0 and loaded["corrupt"] == 1
+    assert _miss("bad", "corrupt") == cr0 + 1
+    _same(k(x), cold)  # fresh compile, bit-identical
+
+
+def test_truncated_pack_entry_and_manifest_never_raise(fresh_aot, tmp_path):
+    k = _register("tr")
+    x = jnp.arange(5, dtype=jnp.float32)
+    cold = k(x)
+    aot.save_pack(str(tmp_path))
+    [kexe] = [n for n in os.listdir(str(tmp_path)) if n.endswith(".kexe")]
+    path = os.path.join(str(tmp_path), kexe)
+    with open(path, "rb") as fh:
+        blob = fh.read()
+    with open(path, "wb") as fh:
+        fh.write(blob[: len(blob) // 2])  # truncated entry
+    aot.drop_executables()
+    with pytest.warns(RuntimeWarning):
+        assert aot.load_pack(str(tmp_path))["corrupt"] == 1
+    _same(k(x), cold)
+    # a garbage pack manifest is "no pack", not an exception
+    with open(os.path.join(str(tmp_path), aot.PACK_MANIFEST_NAME), "w") as fh:
+        fh.write("not json{{")
+    with pytest.warns(RuntimeWarning):
+        assert aot.load_pack(str(tmp_path))["present"] is False
+    assert aot.pack_status(str(tmp_path))["present"] is False
+
+
+# ------------------------------------------------------- randomized parity
+def test_randomized_warm_cold_parity(fresh_aot, tmp_path):
+    k = _register("fuzz")
+    rng = np.random.default_rng(0)
+    operands = [
+        jnp.asarray(rng.standard_normal((8,)).astype(np.float32)),
+        jnp.asarray(rng.standard_normal((4, 4)).astype(np.float32)),
+        jnp.asarray(rng.integers(-50, 50, size=(16,), dtype=np.int32)),
+        jnp.asarray(rng.standard_normal((2, 3, 5)).astype(np.float32)),
+    ]
+    cold = [k(x) for x in operands]
+    aot.save_pack(str(tmp_path))
+    aot.drop_executables()
+    jax.clear_caches()
+    assert aot.load_pack(str(tmp_path))["loaded"] == len(operands)
+    m0 = aot.miss_total()
+    for x, ref in zip(operands, cold):
+        _same(k(x), ref)
+    assert aot.miss_total() == m0
+
+
+def test_disabled_flag_delegates_without_metrics(fresh_aot):
+    k = _register("off")
+    aot.set_aot(False)
+    m0, h0 = aot.miss_total(), aot.hit_total()
+    x = jnp.arange(3, dtype=jnp.float32)
+    _same(k(x), x * 2 + 1)
+    assert aot.miss_total() == m0 and aot.hit_total() == h0
+    assert k.recorded_keys() == []  # nothing recorded, nothing to pack
+
+
+# ------------------------------------------- checkpoint / recover shipping
+def test_checkpoint_ships_pack_and_recover_reloads_it(
+    fresh_aot, tmp_path, capsys
+):
+    k = _register("ship")
+    x = jnp.arange(7, dtype=jnp.float32)
+    cold = k(x)
+    cluster = random_cluster(
+        GeneratorConfig(
+            n_pods=16, n_policies=6, n_namespaces=2, seed=11,
+            p_ipblock_peer=0.0, min_selector_labels=1,
+        )
+    )
+    cfg = kv.VerifyConfig(backend="cpu", compute_ports=False)
+    svc = VerificationService(cluster, cfg)
+    ckdir = str(tmp_path / "ck")
+    CheckpointManager(ckdir).checkpoint(svc.engine)
+    pack = aot.pack_dir(ckdir)
+    assert os.path.isdir(pack)
+    assert os.path.exists(os.path.join(pack, aot.PACK_MANIFEST_NAME))
+    aot.drop_executables()
+    rm = RecoveryManager(ckdir)
+    report = rm.inspect()
+    assert report["aot_pack"]["present"] and report["aot_pack"]["env_match"]
+    assert report["aot_pack"]["entries"] >= 1
+    assert report["aot_pack"]["corrupt"] == 0
+    res = rm.recover(config=cfg)  # recover() installs the pack itself
+    assert res.service is not None
+    m0 = aot.miss_total()
+    _same(k(x), cold)  # restored *compiled* state: warm, zero misses
+    assert aot.miss_total() == m0
+    # kv-tpu recover --json surfaces the same validity report
+    assert main(["recover", ckdir, "--json"]) == EXIT_OK
+    out = json.loads(capsys.readouterr().out)
+    assert out["aot_pack"]["present"] is True
+    assert out["aot_pack"]["env_match"] is True
+    assert out["aot_pack"]["entries"] == report["aot_pack"]["entries"]
+
+
+# ------------------------------------------------------------ the lint rule
+def test_aot_lint_rule_positive_and_negative():
+    bad = lint_source(
+        textwrap.dedent(
+            """
+            from functools import partial
+
+            import jax
+
+            @partial(jax.jit, static_argnames=("tile",))
+            def _step(x, *, tile):
+                return x
+
+            _probe = jax.jit(lambda x: x + 1)
+            """
+        ),
+        rules=["aot-unregistered-kernel"],
+    )
+    assert [f.rule for f in bad] == ["aot-unregistered-kernel"] * 2
+    assert "_step" in bad[0].message and "_probe" in bad[1].message
+    ok = lint_source(
+        textwrap.dedent(
+            """
+            import jax
+            from kubernetes_verification_tpu.observe.aot import register_kernel
+
+            @jax.jit
+            def _step(x):
+                return x
+
+            _step = register_kernel("eng", "_step", _step)
+
+            def _factory():
+                @jax.jit  # per-call jit inside a function: not module-level
+                def inner(x):
+                    return x
+                return inner
+            """
+        ),
+        rules=["aot-unregistered-kernel"],
+    )
+    assert ok == []

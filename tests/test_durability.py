@@ -263,6 +263,7 @@ def test_checkpoint_rotation_keeps_newest_generations(tmp_path, churn):
     assert cm.generations() == [4, 3]
     names = sorted(os.listdir(str(tmp_path)))
     assert names == [
+        "aot-pack",  # the warm executable pack survives rotation
         "gen-00000003", "gen-00000004",
         "manifest-00000003.json", "manifest-00000004.json",
     ]
