@@ -1504,6 +1504,8 @@ def _replicate_net_worker(url, base_dir, idx, n_batches, barrier, out_q):
 
     from kubernetes_verification_tpu.serve import FollowerService
 
+    from kubernetes_verification_tpu.observe.spans import add_span_sink
+
     f = FollowerService(
         os.path.join(base_dir, f"net-replica-{idx}"),
         replica=f"net-replica-{idx}",
@@ -1517,31 +1519,52 @@ def _replicate_net_worker(url, base_dir, idx, n_batches, barrier, out_q):
     ref = lambda i: f"{pods[i % n].namespace}/{pods[i % n].name}"
     rs = np.random.default_rng(9500 + idx)
     sub = 512
+    half = max(1, n_batches // 2)
     batches = [
         [
             (ref(int(a)), ref(int(b)))
             for a, b in rs.integers(0, n, (sub, 2))
         ]
-        for _ in range(n_batches)
+        for _ in range(2 * half)
     ]
     f.can_reach_batch(batches[0])  # compile + generation-keyed cache fill
+
+    # per-stage latency collection: the query pipeline's queue/dispatch/
+    # solve/d2h spans carry a `stage` attr; a span sink is cheaper and
+    # exacter than re-parsing the registry's histogram buckets
+    stage_seconds = {}
+
+    def _stage_sink(span):
+        stage = span.attrs.get("stage")
+        if stage and span.seconds is not None:
+            stage_seconds.setdefault(stage, []).append(span.seconds)
+
+    add_span_sink(_stage_sink)
+
+    def _window(window_batches):
+        s = time.perf_counter()
+        for b in window_batches:
+            f.poll()  # keep tailing the churn the leader is appending
+            f.can_reach_batch(b)
+        return time.perf_counter() - s
+
     barrier.wait(timeout=300)
-    s = time.perf_counter()
-    for b in batches:
-        f.poll()  # keep tailing the churn the leader is appending
-        f.can_reach_batch(b)
-    elapsed = time.perf_counter() - s
+    elapsed = _window(batches[:half])  # window A: unpolled
+    barrier.wait(timeout=300)  # parent arms the 1 Hz /metrics poller here
+    elapsed_polled = _window(batches[half:])  # window B: scraped at 1 Hz
     lag = f.lag()
     out_q.put(
         {
             "replica": f.replica,
-            "queries": n_batches * sub,
+            "queries": half * sub,
             "elapsed_s": elapsed,
-            "qps": (n_batches * sub) / elapsed,
+            "qps": (half * sub) / elapsed,
+            "qps_polled": (half * sub) / elapsed_polled,
             "lag_seconds": lag.seconds,
             "lag_seq": lag.seq,
             "applied": f.applied,
             "outcome": f.recovery.outcome,
+            "stage_seconds": stage_seconds,
         }
     )
 
@@ -1554,6 +1577,8 @@ def _bench_replicate_net(args, svc, writer, workdir, ck_dir, log_path, n_batches
     followers' timed windows run."""
     import multiprocessing as mp
     import threading
+
+    import numpy as np
 
     from kubernetes_verification_tpu.serve import (
         ReplicationServer,
@@ -1598,9 +1623,34 @@ def _bench_replicate_net(args, svc, writer, workdir, ck_dir, log_path, n_batches
 
         churner = threading.Thread(target=_churn, daemon=True)
         churner.start()
+
+        # window B's observability tax: a 1 Hz /metrics poller against the
+        # leader's scrape surface, armed between the followers' two timed
+        # windows (the second barrier), so qps vs qps_polled isolates the
+        # scrape-path overhead under otherwise identical load
+        from kubernetes_verification_tpu.serve import ReplicationClient
+
+        scrape_stop = threading.Event()
+        scrapes = [0]
+
+        def _scrape():
+            client = ReplicationClient(server.url)
+            while not scrape_stop.is_set():
+                try:
+                    client.metrics_text()
+                    scrapes[0] += 1
+                except Exception:
+                    pass  # an overloaded scrape is itself the datum
+                scrape_stop.wait(1.0)
+
+        scraper = threading.Thread(target=_scrape, daemon=True)
+        barrier.wait(timeout=300)  # release the followers into window B
+        scraper.start()
         results = [out_q.get(timeout=300) for _ in procs]
         stop.set()
+        scrape_stop.set()
         churner.join(timeout=30)
+        scraper.join(timeout=30)
         for p in procs:
             p.join(timeout=60)
     writer.close()
@@ -1651,6 +1701,49 @@ def _bench_replicate_net(args, svc, writer, workdir, ck_dir, log_path, n_batches
             "unit": "s",
             "replicas": replicas,
             "net": True,
+        }
+    )
+    # per-stage latency percentiles: the queue/dispatch/solve/d2h spans
+    # inside every batched query, pooled across followers and windows
+    stages = {}
+    for r in results:
+        for stage, samples in r.pop("stage_seconds", {}).items():
+            stages.setdefault(stage, []).extend(samples)
+    for stage in sorted(stages):
+        samples = np.asarray(stages[stage])
+        p50, p99 = np.percentile(samples, [50, 99])
+        log(
+            f"stage {stage}: p50 {p50 * 1e3:.3f}ms p99 {p99 * 1e3:.3f}ms "
+            f"({samples.size} samples)"
+        )
+        for q, v in (("p50", p50), ("p99", p99)):
+            _emit(
+                {
+                    "metric": f"net_stage_latency_{stage}_{q}_s",
+                    "value": round(float(v), 6),
+                    "unit": "s",
+                    "samples": int(samples.size),
+                    "replicas": replicas,
+                }
+            )
+    # the observability tax: same load, window B scraped at 1 Hz — gated
+    # lower-is-better by name (observe/history.py); budget is <2%
+    agg_polled = sum(r["qps_polled"] for r in results)
+    overhead_pct = max(0.0, (agg - agg_polled) / agg * 100.0)
+    log(
+        f"scrape overhead: {overhead_pct:.2f}% "
+        f"({agg:,.0f} -> {agg_polled:,.0f} queries/s with {scrapes[0]} "
+        f"/metrics scrapes at 1 Hz)"
+    )
+    _emit(
+        {
+            "metric": "net_scrape_overhead_pct",
+            "value": round(overhead_pct, 3),
+            "unit": "pct",
+            "scrapes": scrapes[0],
+            "qps_unpolled": round(agg, 1),
+            "qps_polled": round(agg_polled, 1),
+            "replicas": replicas,
         }
     )
 

@@ -170,3 +170,66 @@ class MetricDisciplineRule(Rule):
                 "site declares it — dead contract entry or a renamed "
                 "family",
             )
+
+
+#: names whose appearance inside a ``do_GET`` body proves the handler
+#: adopts the incoming trace context (observe/spans.py wire contract)
+_TRACE_PARSE_NAMES = frozenset({"parse_trace_header", "TRACE_HEADER"})
+
+
+@register
+class TraceContextRule(Rule):
+    id = "trace-context"
+    rationale = (
+        "Distributed traces only join up when every HTTP hop carries the "
+        "`X-Kvtpu-Trace` header: an outgoing `conn.request(...)` that "
+        "passes no `headers` drops the caller's trace context on the "
+        "floor, and a `do_GET` handler that never parses the header "
+        "(`parse_trace_header` / `TRACE_HEADER`) orphans every "
+        "server-side span into a fresh trace. Either break silently turns "
+        "`kv-tpu trace <id>` into a single-process view — the cross-"
+        "process timeline still renders, it just lies by omission."
+    )
+    example = 'conn.request("GET", "/v1/tip")  # headers= missing'
+
+    @staticmethod
+    def _has_headers(call: ast.Call) -> bool:
+        # http.client's signature is request(method, url, body, headers):
+        # a 4th positional, an explicit headers=, or an opaque ** splat
+        # (can't see inside statically) all count as propagating
+        if len(call.args) >= 4:
+            return True
+        return any(kw.arg in ("headers", None) for kw in call.keywords)
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "request"
+                and not self._has_headers(node)
+            ):
+                yield Finding(
+                    self.id, ctx.rel, node.lineno,
+                    "outgoing HTTP request without headers= — pass "
+                    "headers=trace_headers() so the X-Kvtpu-Trace context "
+                    "survives the hop",
+                )
+            if isinstance(node, ast.FunctionDef) and node.name == "do_GET":
+                refs = {
+                    n.id
+                    for n in ast.walk(node)
+                    if isinstance(n, ast.Name)
+                } | {
+                    n.attr
+                    for n in ast.walk(node)
+                    if isinstance(n, ast.Attribute)
+                }
+                if not (refs & _TRACE_PARSE_NAMES):
+                    yield Finding(
+                        self.id, ctx.rel, node.lineno,
+                        "do_GET never parses the incoming trace header "
+                        "(parse_trace_header/TRACE_HEADER) — server-side "
+                        "spans orphan into fresh traces instead of "
+                        "parenting under the caller's span",
+                    )

@@ -24,7 +24,11 @@ only, SURVEY.md §1); this exposes the full pipeline:
   by staleness-weighted routing (stale reads retry on the leader,
   unreachable replicas are breaker-ejected);
 * ``kv-tpu recover``       — read-only triage of a serve checkpoint
-  directory (generation health, WAL valid prefix);
+  directory (generation health, WAL valid prefix, flight-recorder dumps);
+* ``kv-tpu trace ID``      — reassemble one trace's cross-process timeline
+  from per-replica JSON event logs (span tree + query stage breakdown);
+* ``kv-tpu fleet``         — scrape every replica's ``/healthz`` +
+  ``/metrics``, render the fleet table, evaluate SLO burn rates;
 * ``kv-tpu backends``      — list available execution backends.
 """
 from __future__ import annotations
@@ -32,6 +36,7 @@ from __future__ import annotations
 import argparse
 import contextlib
 import json
+import os
 import sys
 from typing import Optional
 
@@ -51,15 +56,28 @@ def _add_obs_flags(p: argparse.ArgumentParser) -> None:
         "--log-json", action="store_true",
         help="emit one JSON event line per span/phase on stderr",
     )
+    p.add_argument(
+        "--flight", metavar="DIR",
+        help="arm the flight recorder: keep a bounded in-memory ring of "
+        "recent spans/events/metric deltas and dump it to "
+        "DIR/flight-<ts>.json on error escalation, breaker-open, "
+        "kill-points and SIGUSR2 (render dumps with `kv-tpu recover DIR`)",
+    )
 
 
 @contextlib.contextmanager
 def _observed(args):
     """Honour the shared observability flags around a command body."""
     from .observe import configure_logging, profile_to, write_metrics
+    from .observe import flight as _flight
 
     if getattr(args, "log_json", False):
         configure_logging()
+    flight_dir = getattr(args, "flight", None)
+    if flight_dir:
+        _flight.install(flight_dir)
+    else:
+        _flight.install_from_env()
     profile_dir = getattr(args, "profile", None)
     ctx = profile_to(profile_dir) if profile_dir else contextlib.nullcontext()
     try:
@@ -153,8 +171,14 @@ def _parse_opt(kv_str: str):
 def _diagnose(args, e: Exception) -> int:
     """The ``KvTpuError`` → exit-code contract: one line on stderr (the
     operator path) unless ``--log-json`` asked for the debugging traceback."""
+    from .observe.flight import trigger_dump
     from .resilience.errors import exit_code_for
 
+    # a typed error escalating out of a command is a flight-recorder
+    # trigger: the ring holds the spans/events that led here
+    path = trigger_dump("error", error=f"{type(e).__name__}: {e}")
+    if path:
+        print(f"kv-tpu: flight recorder dumped to {path}", file=sys.stderr)
     if getattr(args, "log_json", False):
         raise e
     print(f"kv-tpu: {type(e).__name__}: {e}", file=sys.stderr)
@@ -1182,6 +1206,7 @@ def _run_recover(args) -> int:
         print(f"recover: {args.dir} is not a directory", file=sys.stderr)
         return EXIT_INPUT_ERROR
     report = RecoveryManager(args.dir).inspect(log_path=args.events)
+    report["flight_dumps"] = _flight_dumps(args.dir)
     if args.json:
         print(json.dumps(report, sort_keys=True))
     else:
@@ -1237,9 +1262,52 @@ def _run_recover(args) -> int:
             )
         elif pack is not None:
             print("aot-pack: none (cold start will recompile every kernel)")
+        for f in report["flight_dumps"]:
+            if "error" in f:
+                print(f"flight {f['path']}: ERROR {f['error']}")
+                continue
+            print(
+                f"flight {f['path']}: trigger={f['trigger']} "
+                f"pid={f['pid']} entries={f['entries']}"
+            )
+            for line in f["tail"]:
+                print(line)
     if report["generations"] and not report["usable"]:
         return EXIT_INPUT_ERROR
     return EXIT_OK
+
+
+def _flight_dumps(directory: str, tail: int = 8) -> list:
+    """Flight-recorder dumps found in a serve directory, each summarized
+    for the recover report: trigger, pid, entry count, and the rendered
+    tail (the newest ``tail`` ring entries — the moments before the
+    trigger)."""
+    import glob
+    import os
+
+    from .observe.flight import load_dump, render_dump
+
+    out = []
+    for path in sorted(glob.glob(os.path.join(directory, "flight-*.json"))):
+        name = os.path.basename(path)
+        try:
+            payload = load_dump(path)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            out.append({"path": name, "error": f"{type(e).__name__}: {e}"})
+            continue
+        lines = render_dump(payload)
+        out.append(
+            {
+                "path": name,
+                "trigger": payload.get("trigger"),
+                "info": payload.get("info"),
+                "pid": payload.get("pid"),
+                "ts": payload.get("ts"),
+                "entries": len(payload.get("entries", [])),
+                "tail": lines[-tail:] if len(lines) > 1 else [],
+            }
+        )
+    return out
 
 
 def cmd_warmup(args) -> int:
@@ -1591,6 +1659,234 @@ def _run_lb(args) -> int:
             f"stale_retries: {lb.stale_retries}  ejections: {lb.ejections}"
         )
     if args.check_denied and denied:
+        return EXIT_VIOLATIONS
+    return EXIT_OK
+
+
+def cmd_trace(args) -> int:
+    from .resilience.errors import KvTpuError
+
+    try:
+        with _observed(args):
+            return _run_trace(args)
+    except KvTpuError as e:
+        return _diagnose(args, e)
+
+
+def _run_trace(args) -> int:
+    """``kv-tpu trace``: reassemble one trace's cross-process timeline.
+
+    Every span close and event line carries ``trace_id`` (propagated over
+    HTTP via the ``X-Kvtpu-Trace`` header), a wall-clock ``ts``/``start_ts``
+    and span/parent ids — so scanning each replica's JSON event log for one
+    trace id and sorting by wall time rebuilds the span tree across
+    processes, plus the query stage breakdown (queue/dispatch/solve/d2h)."""
+    from .resilience.errors import EXIT_OK, EXIT_VIOLATIONS
+
+    spans: dict = {}  # span_id -> span-close line (+ source log)
+    events = []  # non-span lines in the trace
+    for path in args.log:
+        try:
+            fh = open(path)
+        except OSError as e:
+            raise SystemExit(f"trace: cannot read {path}: {e}")
+        with fh:
+            for raw in fh:
+                raw = raw.strip()
+                if not raw or not raw.startswith("{"):
+                    continue
+                try:
+                    line = json.loads(raw)
+                except ValueError:
+                    continue
+                if (
+                    not isinstance(line, dict)
+                    or line.get("trace_id") != args.trace_id
+                ):
+                    continue
+                line["_log"] = os.path.basename(path)
+                if (
+                    line.get("event") in ("span", "phase")
+                    and line.get("span_id")
+                    and line.get("seconds") is not None
+                ):
+                    # first writer wins: the same span duplicated across
+                    # logs (shared event file) renders once
+                    spans.setdefault(line["span_id"], line)
+                else:
+                    events.append(line)
+    if not spans and not events:
+        print(
+            f"trace {args.trace_id}: no matching lines in "
+            f"{len(args.log)} log(s)",
+            file=sys.stderr,
+        )
+        return EXIT_VIOLATIONS
+
+    children: dict = {}
+    roots = []
+    for sid, sp in spans.items():
+        pid = sp.get("parent_id")
+        if pid in spans:
+            children.setdefault(pid, []).append(sid)
+        else:
+            roots.append(sid)
+    start_key = lambda sid: spans[sid].get("start_ts") or 0.0  # noqa: E731
+
+    ordered = []  # (depth, span line) in timeline order
+
+    def _walk(sid: str, depth: int) -> None:
+        ordered.append((depth, spans[sid]))
+        for kid in sorted(children.get(sid, []), key=start_key):
+            _walk(kid, depth + 1)
+
+    for sid in sorted(roots, key=start_key):
+        _walk(sid, 0)
+
+    # query stage breakdown: stage-attributed spans vs. the batch span
+    stages: dict = {}
+    e2e = 0.0
+    for _, sp in ordered:
+        if sp.get("stage"):
+            stages[sp["stage"]] = (
+                stages.get(sp["stage"], 0.0) + float(sp["seconds"])
+            )
+        if sp.get("name") == "query_batch":
+            e2e += float(sp["seconds"])
+
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "trace_id": args.trace_id,
+                    "logs": args.log,
+                    "spans": [
+                        dict(sp, depth=depth) for depth, sp in ordered
+                    ],
+                    "events": events,
+                    "stages": stages,
+                    "e2e_seconds": e2e or None,
+                },
+                sort_keys=True,
+            )
+        )
+        return EXIT_OK
+
+    t0 = min(
+        (sp.get("start_ts") for _, sp in ordered if sp.get("start_ts")),
+        default=None,
+    )
+    n_logs = len({sp["_log"] for _, sp in ordered})
+    print(
+        f"trace {args.trace_id}: {len(ordered)} spans, "
+        f"{len(events)} events across {n_logs} process log(s)"
+    )
+    for depth, sp in ordered:
+        off = (
+            f"+{(sp['start_ts'] - t0) * 1000.0:9.3f}ms"
+            if t0 is not None and sp.get("start_ts")
+            else " " * 11
+        )
+        dur = f"{float(sp['seconds']) * 1000.0:.3f}ms"
+        flag = "" if sp.get("ok", True) else "  FAILED"
+        print(
+            f"{off}  {'  ' * depth}{sp.get('name', '?')} {dur} "
+            f"[{sp['_log']}]{flag}"
+        )
+    if stages:
+        parts = "  ".join(
+            f"{k}={v * 1000.0:.3f}ms"
+            for k, v in sorted(stages.items())
+        )
+        total = sum(stages.values())
+        tail = (
+            f"  (sum {total * 1000.0:.3f}ms, e2e {e2e * 1000.0:.3f}ms)"
+            if e2e
+            else f"  (sum {total * 1000.0:.3f}ms)"
+        )
+        print(f"stages: {parts}{tail}")
+    return EXIT_OK
+
+
+def cmd_fleet(args) -> int:
+    from .resilience.errors import KvTpuError
+
+    try:
+        with _observed(args):
+            return _run_fleet(args)
+    except KvTpuError as e:
+        return _diagnose(args, e)
+
+
+def _run_fleet(args) -> int:
+    """``kv-tpu fleet``: scrape every ``--replica`` URL's ``/healthz`` +
+    ``/metrics``, render the fleet table, and evaluate the ``--slo``
+    objectives' multi-window burn rates (exit 1 past ``--burn-threshold``)."""
+    from .observe.fleet import (
+        SloMonitor,
+        parse_slo_spec,
+        render_fleet,
+        scrape_replica,
+    )
+    from .resilience.errors import EXIT_OK, EXIT_VIOLATIONS
+
+    try:
+        objectives = [
+            parse_slo_spec(s) for s in (args.slo or ["availability=0.999"])
+        ]
+    except ValueError as e:
+        raise SystemExit(f"fleet: {e}")
+    monitor = SloMonitor(objectives)
+    scrapes = [
+        scrape_replica(url, timeout=args.timeout) for url in args.replica
+    ]
+    for s in scrapes:
+        monitor.observe_scrape(s)
+    burns = monitor.evaluate()
+    worst = max(
+        (b for per in burns.values() for b in per.values()), default=0.0
+    )
+    if args.json:
+        inf = float("inf")
+        print(
+            json.dumps(
+                {
+                    "replicas": [
+                        {
+                            "url": s.url,
+                            "ok": s.ok,
+                            "error": s.error,
+                            "health": s.health,
+                        }
+                        for s in scrapes
+                    ],
+                    "slo": {
+                        name: {
+                            label: ("inf" if b == inf else b)
+                            for label, b in per.items()
+                        }
+                        for name, per in burns.items()
+                    },
+                    "burn_threshold": args.burn_threshold,
+                },
+                sort_keys=True,
+            )
+        )
+    else:
+        for line in render_fleet(scrapes):
+            print(line)
+        for name, per in sorted(burns.items()):
+            txt = "  ".join(
+                f"{label}={burn:.3g}"
+                for label, burn in sorted(per.items())
+            )
+            verdict = (
+                "BURNING"
+                if max(per.values(), default=0.0) > args.burn_threshold
+                else "ok"
+            )
+            print(f"slo {name}: {txt}  [{verdict}]")
+    if worst > args.burn_threshold:
         return EXIT_VIOLATIONS
     return EXIT_OK
 
@@ -2065,6 +2361,56 @@ def main(argv: Optional[list] = None) -> int:
     p.add_argument("--json", action="store_true")
     _add_obs_flags(p)
     p.set_defaults(fn=cmd_lb)
+
+    p = sub.add_parser(
+        "trace",
+        help="reassemble one trace id's cross-process timeline from "
+        "per-replica JSON event logs: span tree, per-log attribution, "
+        "query stage breakdown (queue/dispatch/solve/d2h)",
+    )
+    p.add_argument(
+        "trace_id",
+        help="the trace id to reassemble (16-hex, from any event line or "
+        "an X-Kvtpu-Trace header)",
+    )
+    p.add_argument(
+        "--log", action="append", default=[], required=True, metavar="FILE",
+        help="a JSON event log to scan (repeatable — one per "
+        "process/replica; duplicated spans from shared logs render once)",
+    )
+    p.add_argument("--json", action="store_true")
+    _add_obs_flags(p)
+    p.set_defaults(fn=cmd_trace)
+
+    p = sub.add_parser(
+        "fleet",
+        help="scrape every replica's /healthz + /metrics, render the "
+        "fleet table, and evaluate SLO error-budget burn rates "
+        "(exit 1 past --burn-threshold)",
+    )
+    p.add_argument(
+        "--replica", action="append", default=[], required=True,
+        metavar="URL",
+        help="a replication server base URL, e.g. http://127.0.0.1:8700 "
+        "(repeatable)",
+    )
+    p.add_argument(
+        "--slo", action="append", default=[], metavar="SPEC",
+        help="objective spec: availability=0.999 or staleness=0.995@2.0 "
+        "(repeatable; default availability=0.999)",
+    )
+    p.add_argument(
+        "--burn-threshold", type=float, default=1.0,
+        help="exit 1 when any objective x window burn rate exceeds this "
+        "(1.0 = consuming error budget exactly at the sustainable rate)",
+    )
+    p.add_argument(
+        "--timeout", type=float, default=5.0,
+        help="per-replica scrape timeout (seconds)",
+    )
+    p.add_argument("--json", action="store_true")
+    _add_obs_flags(p)
+    p.set_defaults(fn=cmd_fleet)
 
     p = sub.add_parser("backends", help="list available backends")
     p.set_defaults(fn=cmd_backends)

@@ -24,15 +24,43 @@ One import surface for the whole stack:
 * ``run_calibration`` / ``SentinelSuite`` — fixed-shape compute-bound
   calibration kernels + the dispatch-latency probe; the noise context
   every bench record carries (``sentinel``).
+* ``TRACE_HEADER`` / ``trace_headers`` / ``parse_trace_header`` /
+  ``trace_context`` — distributed-trace context over the wire (``spans``).
+* ``FlightRecorder`` / ``trigger_dump`` — the crash flight recorder
+  (``flight``).
+* ``SloMonitor`` / ``scrape_replica`` — fleet scraping and SLO burn-rate
+  evaluation (``fleet``).
 
 ``utils.observe`` re-exports the seed-era names from here for backward
 compatibility.
 """
 from __future__ import annotations
 
-from . import history, introspect, metrics, sentinel, telemetry
-from .events import configure_logging, log_event, logger
-from .export import dump_registry, to_prometheus, write_metrics
+from . import fleet, flight, history, introspect, metrics, sentinel, telemetry
+from .events import (
+    Clock,
+    configure_logging,
+    get_clock,
+    log_event,
+    logger,
+    set_clock,
+)
+from .export import dump_registry, parse_prometheus, to_prometheus, write_metrics
+from .fleet import (
+    ReplicaScrape,
+    SloMonitor,
+    SloObjective,
+    parse_slo_spec,
+    render_fleet,
+    scrape_replica,
+)
+from .flight import (
+    FlightRecorder,
+    load_dump,
+    render_dump,
+    trigger_dump,
+)
+from .flight import install_from_env as install_flight_recorder_from_env
 from .history import (
     append_run,
     check_regression,
@@ -67,12 +95,19 @@ from .registry import (
     MetricsRegistry,
 )
 from .spans import (
+    TRACE_HEADER,
     Phases,
     Span,
+    add_span_sink,
     current_span,
+    current_trace_id,
+    parse_trace_header,
     profile_to,
+    remove_span_sink,
     set_memory_hook,
     trace,
+    trace_context,
+    trace_headers,
     trace_to_dir,
 )
 from .telemetry import (
@@ -138,4 +173,29 @@ __all__ = [
     "current_span",
     "profile_to",
     "trace",
+    # fleet observability plane
+    "fleet",
+    "flight",
+    "Clock",
+    "get_clock",
+    "set_clock",
+    "TRACE_HEADER",
+    "trace_headers",
+    "trace_context",
+    "current_trace_id",
+    "parse_trace_header",
+    "add_span_sink",
+    "remove_span_sink",
+    "parse_prometheus",
+    "FlightRecorder",
+    "install_flight_recorder_from_env",
+    "trigger_dump",
+    "load_dump",
+    "render_dump",
+    "ReplicaScrape",
+    "scrape_replica",
+    "render_fleet",
+    "SloObjective",
+    "SloMonitor",
+    "parse_slo_spec",
 ]

@@ -5,6 +5,14 @@ a pod log, parse-able by anything. The logger stays silent until either the
 host configures logging itself or ``configure_logging()`` attaches the
 stderr handler (idempotently: calling it twice must not double-print, which
 the seed version did).
+
+Every line is stamped from ONE clock source (:class:`Clock`, injectable via
+``set_clock`` for tests): ``ts`` is wall time (comparable across processes,
+the ordering key ``kv-tpu trace`` uses) and ``perf`` is the monotonic
+counter (meaningful only within a process, immune to wall-clock steps).
+A context provider — installed by ``observe.spans`` — can add trace-context
+fields (``trace_id``/``span_id``) to every line without this module
+importing spans (which imports us).
 """
 from __future__ import annotations
 
@@ -12,15 +20,61 @@ import json
 import logging
 import sys
 import time
-from typing import Optional
+from typing import Callable, Dict, Optional
 
-__all__ = ["logger", "configure_logging", "log_event"]
+__all__ = [
+    "logger",
+    "configure_logging",
+    "log_event",
+    "Clock",
+    "get_clock",
+    "set_clock",
+    "set_context_provider",
+]
 
 logger = logging.getLogger("kvtpu")
 
 #: marker attribute stamped on handlers we own, so repeat calls (and tests)
 #: can find and skip/remove them
 _HANDLER_MARK = "_kvtpu_handler"
+
+
+class Clock:
+    """The one time source observability stamps from: ``wall()`` for
+    cross-process ordering, ``perf()`` for intra-process durations. Tests
+    subclass and ``set_clock`` a deterministic pair."""
+
+    def wall(self) -> float:
+        return time.time()
+
+    def perf(self) -> float:
+        return time.perf_counter()
+
+
+_clock = Clock()
+
+#: optional () -> dict callable merged under every event line; spans.py
+#: installs one that contributes trace_id/span_id so the wire-level trace
+#: context reaches logs this module never knew about
+_context_provider: Optional[Callable[[], Dict[str, object]]] = None
+
+
+def get_clock() -> Clock:
+    return _clock
+
+
+def set_clock(clock: Optional[Clock]) -> Clock:
+    """Install (or with None, reset) the shared clock; returns the active
+    one so tests can restore it."""
+    global _clock
+    _clock = clock if clock is not None else Clock()  # kvtpu: ignore[concurrency-hygiene] single atomic reference rebind; readers tolerate either value
+    return _clock
+
+
+def set_context_provider(provider) -> None:
+    """Install (or clear, with None) the trace-context field provider."""
+    global _context_provider
+    _context_provider = provider  # kvtpu: ignore[concurrency-hygiene] single atomic reference rebind; readers tolerate either value
 
 
 def configure_logging(level: int = logging.INFO, stream=None) -> logging.Handler:
@@ -51,4 +105,11 @@ def log_event(event: str, **fields) -> None:
     """Emit one JSON event line (INFO) on the ``kvtpu`` logger."""
     if not logger.isEnabledFor(logging.INFO):
         return
-    logger.info(json.dumps({"event": event, "ts": time.time(), **fields}))
+    line = {"event": event, "ts": _clock.wall(), "perf": _clock.perf()}
+    if _context_provider is not None:
+        try:
+            line.update(_context_provider())
+        except Exception:  # context must never fail the event it decorates
+            pass
+    line.update(fields)
+    logger.info(json.dumps(line))

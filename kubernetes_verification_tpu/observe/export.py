@@ -7,7 +7,12 @@ from typing import Optional
 
 from .registry import REGISTRY, MetricsRegistry, _format_le
 
-__all__ = ["dump_registry", "write_metrics", "to_prometheus"]
+__all__ = [
+    "dump_registry",
+    "write_metrics",
+    "to_prometheus",
+    "parse_prometheus",
+]
 
 
 def dump_registry(
@@ -94,3 +99,58 @@ def to_prometheus(registry: Optional[MetricsRegistry] = None) -> str:
                     f"{m.name}{_labels_text(key)} {_num(child.value)}"
                 )
     return "\n".join(lines) + "\n"
+
+
+def _parse_labels(text: str) -> dict:
+    """``backend="cpu",mode="k8s"`` -> dict, honoring the exposition
+    escapes ``\\\\``, ``\\"`` and ``\\n``."""
+    labels: dict = {}
+    i, n = 0, len(text)
+    while i < n:
+        eq = text.index("=", i)
+        name = text[i:eq].strip().lstrip(",").strip()
+        assert text[eq + 1] == '"', f"unquoted label value near {text[eq:]!r}"
+        j = eq + 2
+        out = []
+        while j < n:
+            c = text[j]
+            if c == "\\" and j + 1 < n:
+                nxt = text[j + 1]
+                out.append({"n": "\n"}.get(nxt, nxt))
+                j += 2
+                continue
+            if c == '"':
+                break
+            out.append(c)
+            j += 1
+        labels[name] = "".join(out)
+        i = j + 1
+    return labels
+
+
+def parse_prometheus(text: str) -> dict:
+    """Inverse of :func:`to_prometheus`, for the fleet scraper: parse a
+    text-exposition body into ``{sample_name: [(labels, value), ...]}``.
+
+    Histogram series keep their expanded names (``*_bucket``/``_sum``/
+    ``_count``) — the fleet table reads plain gauges and counters, so no
+    re-bucketing is attempted. Unparseable lines are skipped rather than
+    failing the whole scrape (a replica mid-restart may truncate)."""
+    samples: dict = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            if "{" in line:
+                name, rest = line.split("{", 1)
+                label_text, _, value_text = rest.rpartition("}")
+                labels = _parse_labels(label_text)
+            else:
+                name, _, value_text = line.partition(" ")
+                labels = {}
+            value = float(value_text.strip().replace("+Inf", "inf"))
+        except Exception:
+            continue
+        samples.setdefault(name.strip(), []).append((labels, value))
+    return samples

@@ -1,0 +1,295 @@
+"""Fleet scraping and SLO burn-rate monitoring.
+
+``kv-tpu fleet`` points this module at every replica the load balancer
+knows: each one is scraped over its replication port (``GET /healthz`` for
+the JSON health document, ``GET /metrics`` for the Prometheus text the
+exporter already renders), the results are rendered as one fleet table,
+and an :class:`SloMonitor` turns the per-replica observations into
+multi-window error-budget burn rates (``kvtpu_slo_burn_rate{objective,
+window}``) — the Google-SRE-shaped signal that replaces "lag looked fine
+in the bench footnote".
+
+Objectives come from a tiny spec grammar (CLI ``--slo`` flags):
+
+* ``availability=0.999`` — target fraction of scrapes/queries that must
+  succeed; the error budget is ``1 - target``.
+* ``staleness=0.995@2.0`` — target fraction of observations whose replica
+  lag is within the ``@``-bound (seconds).
+
+Burn rate over a window is ``bad_fraction / (1 - target)``: 1.0 means the
+fleet is burning budget exactly at the sustainable rate, above 1 it
+exhausts the budget early (the classic 5m/1h multi-window pair tells fast
+burns from slow leaks).
+
+This module deliberately does NOT import ``serve`` — the serving layer
+imports ``observe`` everywhere, and the scraper only needs a URL and
+stdlib HTTP.
+"""
+from __future__ import annotations
+
+import collections
+import http.client
+import json
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+from urllib.parse import urlsplit
+
+from .events import get_clock
+from .export import parse_prometheus
+from .metrics import SLO_BURN_RATE
+from .spans import trace, trace_headers
+
+__all__ = [
+    "ReplicaScrape",
+    "scrape_replica",
+    "render_fleet",
+    "SloObjective",
+    "parse_slo_spec",
+    "SloMonitor",
+    "DEFAULT_WINDOWS",
+]
+
+#: the classic multi-window pair: a fast window that catches sharp burns
+#: and a slow one that catches leaks (seconds)
+DEFAULT_WINDOWS: Tuple[float, ...] = (300.0, 3600.0)
+
+
+@dataclass
+class ReplicaScrape:
+    """One replica's scrape result: health JSON + parsed metric samples
+    (both None when the scrape failed; ``error`` says why)."""
+
+    url: str
+    ok: bool = False
+    error: Optional[str] = None
+    health: Optional[dict] = None
+    metrics: Optional[dict] = None
+
+    @property
+    def lag_seconds(self) -> Optional[float]:
+        if not self.health:
+            return None
+        lag = self.health.get("lag") or {}
+        return lag.get("seconds")
+
+
+def _get(url: str, path: str, timeout: float) -> Tuple[int, bytes]:
+    parts = urlsplit(url)
+    conn = http.client.HTTPConnection(
+        parts.hostname, parts.port, timeout=timeout
+    )
+    try:
+        conn.request("GET", path, headers=trace_headers())
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+def scrape_replica(url: str, timeout: float = 5.0) -> ReplicaScrape:
+    """Scrape one replica's ``/healthz`` + ``/metrics``; never raises —
+    an unreachable replica is itself an availability observation."""
+    out = ReplicaScrape(url=url)
+    with trace("fleet_scrape", url=url) as span:
+        try:
+            status, body = _get(url, "/healthz", timeout)
+            if status != 200:
+                # kvtpu: ignore[error-taxonomy] raised-and-caught two lines down: a failed scrape is an availability datum, not an error path
+                raise OSError(f"/healthz -> HTTP {status}")
+            out.health = json.loads(body.decode("utf-8"))
+            status, body = _get(url, "/metrics", timeout)
+            if status != 200:
+                # kvtpu: ignore[error-taxonomy] raised-and-caught below: a failed scrape is an availability datum, not an error path
+                raise OSError(f"/metrics -> HTTP {status}")
+            out.metrics = parse_prometheus(body.decode("utf-8"))
+            out.ok = True
+        except Exception as e:  # noqa: BLE001 - scrape failure is data
+            out.error = f"{type(e).__name__}: {e}"
+            span.attrs["error"] = out.error
+    return out
+
+
+def _fmt(value, digits: int = 3) -> str:
+    if value is None:
+        return "-"
+    if isinstance(value, bool):
+        return "yes" if value else "no"
+    if isinstance(value, float):
+        return f"{value:.{digits}f}"
+    return str(value)
+
+
+def render_fleet(scrapes: Sequence[ReplicaScrape]) -> List[str]:
+    """The fleet table: one aligned row per replica, down replicas
+    included (their row says why)."""
+    header = (
+        "replica", "role", "epoch", "last_seq", "lag_s", "breaker", "aot"
+    )
+    rows = [header]
+    for s in scrapes:
+        if not s.ok:
+            rows.append((s.url, "DOWN", "-", "-", "-", s.error or "-", "-"))
+            continue
+        h = s.health or {}
+        breakers = h.get("breakers") or {}
+        btxt = (
+            ",".join(f"{k}={v}" for k, v in sorted(breakers.items()))
+            if breakers
+            else "-"
+        )
+        aot = h.get("aot") or {}
+        if not aot.get("present"):
+            atxt = "-"
+        elif aot.get("env_match") and not aot.get("corrupt"):
+            atxt = f"ok/{aot.get('matching', 0)}"
+        else:
+            atxt = "stale"
+        rows.append(
+            (
+                s.url,
+                str(h.get("role", "-")),
+                _fmt(h.get("epoch")),
+                _fmt(h.get("last_seq")),
+                _fmt(s.lag_seconds),
+                btxt,
+                atxt,
+            )
+        )
+    widths = [max(len(r[i]) for r in rows) for i in range(len(header))]
+    return [
+        "  ".join(cell.ljust(widths[i]) for i, cell in enumerate(row)).rstrip()
+        for row in rows
+    ]
+
+
+@dataclass(frozen=True)
+class SloObjective:
+    """One objective: ``target`` fraction of good events; ``bound`` is the
+    staleness threshold (seconds) for lag-shaped objectives, None for
+    plain availability."""
+
+    name: str
+    target: float
+    bound: Optional[float] = None
+
+    @property
+    def budget(self) -> float:
+        return 1.0 - self.target
+
+
+def parse_slo_spec(spec: str) -> SloObjective:
+    """``availability=0.999`` / ``staleness=0.995@2.0`` -> SloObjective.
+
+    Raises ValueError with the offending spec on malformed input (the CLI
+    surfaces it as an input error, exit code 2)."""
+    name, sep, rest = spec.partition("=")
+    name = name.strip()
+    if not sep or not name:
+        # kvtpu: ignore[error-taxonomy] documented parse contract: the CLI maps ValueError to an input error (exit 2)
+        raise ValueError(f"bad SLO spec {spec!r}: want name=target[@bound]")
+    target_text, at, bound_text = rest.partition("@")
+    try:
+        target = float(target_text)
+        bound = float(bound_text) if at else None
+    except ValueError:
+        # kvtpu: ignore[error-taxonomy] documented parse contract: the CLI maps ValueError to an input error (exit 2)
+        raise ValueError(
+            f"bad SLO spec {spec!r}: target/bound must be numbers"
+        ) from None
+    if not 0.0 < target < 1.0:
+        # kvtpu: ignore[error-taxonomy] documented parse contract: the CLI maps ValueError to an input error (exit 2)
+        raise ValueError(
+            f"bad SLO spec {spec!r}: target must be in (0, 1), got {target}"
+        )
+    return SloObjective(name=name, target=target, bound=bound)
+
+
+def _window_label(seconds: float) -> str:
+    if seconds % 3600 == 0:
+        return f"{int(seconds // 3600)}h"
+    if seconds % 60 == 0:
+        return f"{int(seconds // 60)}m"
+    return f"{int(seconds)}s"
+
+
+@dataclass
+class SloMonitor:
+    """Rolling good/bad observations per objective with multi-window
+    burn-rate evaluation. Timestamps come from the shared injectable clock
+    (``observe.events.set_clock``) so tests drive the windows."""
+
+    objectives: Sequence[SloObjective]
+    max_observations: int = 4096
+    _events: Dict[str, collections.deque] = field(default_factory=dict)
+    _lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def __post_init__(self) -> None:
+        for o in self.objectives:
+            self._events[o.name] = collections.deque(
+                maxlen=self.max_observations
+            )
+
+    def objective(self, name: str) -> SloObjective:
+        for o in self.objectives:
+            if o.name == name:
+                return o
+        raise KeyError(name)  # kvtpu: ignore[error-taxonomy] mapping-lookup contract on a programmer-facing accessor
+
+    def record(self, name: str, ok: bool, ts: Optional[float] = None) -> None:
+        """One observation for ``name``: ``ok`` consumed no budget."""
+        if ts is None:
+            ts = get_clock().wall()
+        with self._lock:
+            self._events[name].append((ts, bool(ok)))
+
+    def observe_scrape(self, scrape: ReplicaScrape) -> None:
+        """Fold one replica scrape into every objective: availability-
+        shaped objectives count scrape success, staleness-shaped ones
+        count the reported lag against their bound (a down replica is
+        bad for those too — its staleness is unbounded)."""
+        for o in self.objectives:
+            if o.bound is None:
+                self.record(o.name, scrape.ok)
+            else:
+                lag = scrape.lag_seconds
+                self.record(o.name, scrape.ok and lag is not None and lag <= o.bound)
+
+    def burn_rate(
+        self, name: str, window_seconds: float, now: Optional[float] = None
+    ) -> float:
+        """``bad_fraction / budget`` over the trailing window; 0.0 with no
+        observations (no data is not a violation), ``inf`` when a
+        zero-budget objective saw a bad event."""
+        if now is None:
+            now = get_clock().wall()
+        o = self.objective(name)
+        cutoff = now - window_seconds
+        with self._lock:
+            events = [e for e in self._events[name] if e[0] >= cutoff]
+        if not events:
+            return 0.0
+        bad = sum(1 for _, ok in events if not ok)
+        bad_fraction = bad / len(events)
+        if o.budget <= 0.0:
+            return float("inf") if bad else 0.0
+        return bad_fraction / o.budget
+
+    def evaluate(
+        self,
+        windows: Sequence[float] = DEFAULT_WINDOWS,
+        now: Optional[float] = None,
+    ) -> Dict[str, Dict[str, float]]:
+        """Burn rates for every objective × window, published to
+        ``kvtpu_slo_burn_rate{objective,window}`` and returned as
+        ``{objective: {window_label: burn}}``."""
+        out: Dict[str, Dict[str, float]] = {}
+        for o in self.objectives:
+            per: Dict[str, float] = {}
+            for w in windows:
+                label = _window_label(w)
+                burn = self.burn_rate(o.name, w, now=now)
+                SLO_BURN_RATE.labels(objective=o.name, window=label).set(burn)
+                per[label] = burn
+            out[o.name] = per
+        return out

@@ -104,6 +104,11 @@ _LOWER_IS_BETTER_METRICS = frozenset(
         "replica_lag_spread_seconds",
         "promote_to_first_answer_s",
         "resume_to_first_answer_s",
+        # the observability tax: aggregate QPS lost to a 1 Hz /metrics
+        # poller during the networked replicate window — the scrape
+        # surface must stay effectively free (<2%), and growth here is a
+        # regression in the serving path, not the environment
+        "net_scrape_overhead_pct",
     }
 )
 #: sentinel context series: the round's NOISE measurements. Never gated —

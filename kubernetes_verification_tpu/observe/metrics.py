@@ -79,6 +79,11 @@ __all__ = [
     "SENTINEL_CALIBRATION_FAILURES_TOTAL",
     "ROOFLINE_ACHIEVED_MACS_PER_SECOND",
     "ROOFLINE_PCT_OF_PEAK",
+    "QUERY_LATENCY_SECONDS",
+    "SLO_BURN_RATE",
+    "LB_RETRIES_TOTAL",
+    "FLIGHT_DUMPS_TOTAL",
+    "SCRAPE_REQUESTS_TOTAL",
     "REQUIRED_FAMILIES",
 ]
 
@@ -602,6 +607,54 @@ ROOFLINE_PCT_OF_PEAK = Gauge(
     ("mode",),
 )
 
+QUERY_LATENCY_SECONDS = Histogram(
+    "kvtpu_query_latency_seconds",
+    "Batched-query latency decomposed by pipeline stage — 'queue' (waiting "
+    "for the coalescing flush), 'dispatch' (cache sync + reference-index "
+    "gather), 'solve' (the device answer), 'd2h' (device→host readback and "
+    "answer assembly) — the per-stage attribution `kv-tpu trace` renders "
+    "per query and this family aggregates per process.",
+    ("stage",),
+    buckets=(0.0001, 0.001, 0.01, 0.1, 1.0, 10.0),
+)
+
+SLO_BURN_RATE = Gauge(
+    "kvtpu_slo_burn_rate",
+    "Error-budget burn rate per SLO objective and evaluation window "
+    "(bad-event fraction over the window divided by the objective's "
+    "budget; 1.0 = burning exactly the budget, >1 = on track to violate) "
+    "— the multi-window signal `kv-tpu fleet` alerts on.",
+    ("objective", "window"),
+)
+
+LB_RETRIES_TOTAL = Counter(
+    "kvtpu_lb_retries_total",
+    "Query batches the load balancer re-routed after the first replica "
+    "failed to answer, by reason: 'stale' (StaleReadError, retried at the "
+    "leader), 'transport' (ejectable transport error, next replica in the "
+    "weighted order), 'exhausted' (every replica failed; the error "
+    "propagated to the caller).",
+    ("reason",),
+)
+
+FLIGHT_DUMPS_TOTAL = Counter(
+    "kvtpu_flight_dumps_total",
+    "Flight-recorder ring dumps written, by trigger: 'error' (a KvTpuError "
+    "escalated out of a CLI command), 'breaker-open' (a circuit breaker "
+    "opened), 'kill-point' (a fault-injection kill fired; the dump lands "
+    "before os._exit), 'sigusr2' (operator-requested via signal).",
+    ("trigger",),
+)
+
+SCRAPE_REQUESTS_TOTAL = Counter(
+    "kvtpu_scrape_requests_total",
+    "Observability scrapes served by this replica's HTTP surface, by "
+    "endpoint ('metrics' for Prometheus text, 'healthz' for the JSON "
+    "health document) — the scrape-path load the <2 percent overhead "
+    "budget in bench replicate --net is measured against.",
+    ("endpoint",),
+)
+
 #: The frozen dashboard contract: families that must exist in every build.
 #: New families are appended here by the PR that introduces them; the
 #: `metrics-names` lint rule and `scripts/check_metrics_names.py` both fail
@@ -689,5 +742,12 @@ REQUIRED_FAMILIES = frozenset(
         "kvtpu_aot_cache_hits_total",
         "kvtpu_aot_cache_misses_total",
         "kvtpu_aot_pack_bytes",
+        # fleet observability plane (observe/flight.py + observe/fleet.py +
+        # serve/transport.py scrape surface)
+        "kvtpu_query_latency_seconds",
+        "kvtpu_slo_burn_rate",
+        "kvtpu_lb_retries_total",
+        "kvtpu_flight_dumps_total",
+        "kvtpu_scrape_requests_total",
     }
 )

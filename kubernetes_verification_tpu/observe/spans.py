@@ -8,10 +8,20 @@ rest of the codebase uses. Each span:
 * emits one JSON event line (with ``ok: false`` added when the body raised,
   instead of pretending the phase completed);
 * nests via a thread-local stack, so events carry ``parent`` and depth;
+* carries distributed-trace identity: a ``trace_id`` shared by every span
+  of one logical operation (across processes, via the ``X-Kvtpu-Trace``
+  header), its own ``span_id``, and ``parent_id`` linking it to its caller
+  — the caller may live in another process (``trace_context`` adopts the
+  parsed wire context so server-side spans parent under the client span);
 * wraps ``jax.profiler.TraceAnnotation`` when jax is importable, so the
   same names line up in a TensorBoard TPU trace captured via
   ``profile_to``. jax is looked up in ``sys.modules`` — tracing never
   forces the heavyweight import on pure-host paths.
+
+Timestamps come from the one injectable clock in ``observe.events``: event
+lines carry wall ``ts`` (cross-process orderable) and monotonic ``perf``
+(duration-stable within a process), so ``kv-tpu trace`` reassembles
+timelines without guessing which clock a line was stamped from.
 
 ``Phases`` keeps the seed's accumulate-into-a-dict API (backends still hand
 ``VerifyResult.timings`` to callers) but is now a thin layer over spans.
@@ -19,24 +29,37 @@ rest of the codebase uses. Each span:
 from __future__ import annotations
 
 import contextlib
+import os
 import sys
 import threading
-import time
 from dataclasses import dataclass, field
-from typing import Dict, Iterator, Optional
+from typing import Dict, Iterator, Optional, Tuple
 
-from .events import log_event
+from .events import get_clock, log_event, set_context_provider
 from .metrics import SPAN_SECONDS
 
 __all__ = [
     "Span",
     "trace",
     "current_span",
+    "current_trace_id",
+    "trace_context",
+    "TRACE_HEADER",
+    "trace_headers",
+    "format_trace_header",
+    "parse_trace_header",
+    "add_span_sink",
+    "remove_span_sink",
     "Phases",
     "profile_to",
     "trace_to_dir",
     "set_memory_hook",
 ]
+
+#: HTTP header carrying trace context over the wire: ``<trace_id>-<span_id>``
+#: (two lowercase-hex tokens). The receiver's spans adopt the trace id and
+#: parent under the sender's span id.
+TRACE_HEADER = "X-Kvtpu-Trace"
 
 _state = threading.local()
 
@@ -45,11 +68,29 @@ _state = threading.local()
 #: ``mem_enter_bytes``/``mem_exit_bytes`` in its event line
 _memory_hook = None
 
+#: callables handed every closed Span — the flight recorder's ring and the
+#: bench stage collector subscribe here instead of parsing event lines
+_span_sinks: list = []
+
 
 def set_memory_hook(hook) -> None:
     """Install (or clear, with None) the span memory snapshot hook."""
     global _memory_hook
     _memory_hook = hook  # kvtpu: ignore[concurrency-hygiene] single atomic reference rebind; span readers tolerate either value
+
+
+def add_span_sink(sink) -> None:
+    """Subscribe ``sink(span)`` to every span close (append-only list —
+    registration is rare; iteration tolerates concurrent appends)."""
+    _span_sinks.append(sink)
+
+
+def remove_span_sink(sink) -> None:
+    """Unsubscribe a sink previously added; missing sinks are ignored."""
+    try:
+        _span_sinks.remove(sink)
+    except ValueError:
+        pass
 
 
 def _memory_bytes():
@@ -68,6 +109,10 @@ def _stack() -> list:
     return st
 
 
+def _new_id(nbytes: int) -> str:
+    return os.urandom(nbytes).hex()
+
+
 @dataclass
 class Span:
     """One timed region. ``seconds``/``ok`` are filled when it closes."""
@@ -77,6 +122,10 @@ class Span:
     parent: Optional["Span"] = None
     seconds: Optional[float] = None
     ok: bool = True
+    trace_id: str = ""
+    span_id: str = ""
+    parent_id: Optional[str] = None
+    start_wall: Optional[float] = None
 
     @property
     def depth(self) -> int:
@@ -86,6 +135,86 @@ class Span:
 def current_span() -> Optional[Span]:
     st = _stack()
     return st[-1] if st else None
+
+
+def current_trace_id() -> Optional[str]:
+    """The trace id spans opened *now* would join: the active span's, else
+    an adopted remote context's, else None (a fresh root would mint one)."""
+    span = current_span()
+    if span is not None:
+        return span.trace_id
+    remote = getattr(_state, "remote", None)
+    return remote[0] if remote else None
+
+
+@contextlib.contextmanager
+def trace_context(
+    trace_id: Optional[str], parent_span_id: Optional[str] = None
+) -> Iterator[None]:
+    """Adopt a remote trace context for the duration of the block: root
+    spans opened inside join ``trace_id`` and parent under
+    ``parent_span_id`` instead of minting a fresh trace. A None
+    ``trace_id`` is a no-op block, so callers can pass the (possibly
+    absent) parsed header straight through."""
+    if not trace_id:
+        yield
+        return
+    prev = getattr(_state, "remote", None)
+    _state.remote = (trace_id, parent_span_id)
+    try:
+        yield
+    finally:
+        _state.remote = prev
+
+
+def format_trace_header(trace_id: str, span_id: str) -> str:
+    return f"{trace_id}-{span_id}"
+
+
+def parse_trace_header(value: Optional[str]) -> Tuple[Optional[str], Optional[str]]:
+    """``(trace_id, parent_span_id)`` from an ``X-Kvtpu-Trace`` value;
+    ``(None, None)`` for absent or malformed headers (never raises — a bad
+    header must not fail the request it rode in on)."""
+    if not value:
+        return None, None
+    head, sep, tail = value.strip().partition("-")
+    if not sep or not head or not tail:
+        return None, None
+    try:
+        int(head, 16), int(tail, 16)
+    except ValueError:
+        return None, None
+    return head, tail
+
+
+def trace_headers() -> Dict[str, str]:
+    """Headers to stamp on an outgoing request: ``{TRACE_HEADER: ...}``
+    when a trace is active on this thread, ``{}`` otherwise. Always pass
+    this to ``conn.request(..., headers=trace_headers())`` — the
+    trace-context lint counts un-headered requests as findings."""
+    span = current_span()
+    if span is not None:
+        return {TRACE_HEADER: format_trace_header(span.trace_id, span.span_id)}
+    remote = getattr(_state, "remote", None)
+    if remote and remote[0]:
+        return {TRACE_HEADER: format_trace_header(remote[0], remote[1] or "0")}
+    return {}
+
+
+def _trace_fields() -> Dict[str, object]:
+    """Context-provider body for ``log_event``: every event line emitted
+    inside a traced region carries the trace/span ids, even when the
+    emitting module has never heard of spans."""
+    span = current_span()
+    if span is not None:
+        return {"trace_id": span.trace_id, "span_id": span.span_id}
+    remote = getattr(_state, "remote", None)
+    if remote and remote[0]:
+        return {"trace_id": remote[0]}
+    return {}
+
+
+set_context_provider(_trace_fields)
 
 
 def _device_annotation(name: str):
@@ -103,12 +232,30 @@ def _device_annotation(name: str):
 def trace(name: str, _event: str = "span", **attrs) -> Iterator[Span]:
     """Open a nested span; yields the live ``Span`` so callers can attach
     attrs mid-flight (``span.attrs["rounds"] = r``)."""
-    span = Span(name=name, attrs=dict(attrs), parent=current_span())
+    parent = current_span()
+    if parent is not None:
+        trace_id, parent_id = parent.trace_id, parent.span_id
+    else:
+        remote = getattr(_state, "remote", None)
+        if remote and remote[0]:
+            trace_id, parent_id = remote
+        else:
+            trace_id, parent_id = _new_id(8), None
+    clock = get_clock()
+    span = Span(
+        name=name,
+        attrs=dict(attrs),
+        parent=parent,
+        trace_id=trace_id,
+        span_id=_new_id(4),
+        parent_id=parent_id,
+        start_wall=clock.wall(),
+    )
     mem0 = _memory_bytes()
     if mem0 is not None:
         span.attrs["mem_enter_bytes"] = mem0
     _stack().append(span)
-    t0 = time.perf_counter()
+    t0 = clock.perf()
     try:
         with _device_annotation(name):
             yield span
@@ -116,20 +263,33 @@ def trace(name: str, _event: str = "span", **attrs) -> Iterator[Span]:
         span.ok = False
         raise
     finally:
-        span.seconds = time.perf_counter() - t0
+        span.seconds = clock.perf() - t0
         _stack().pop()
         SPAN_SECONDS.labels(name=name).observe(span.seconds)
         mem1 = _memory_bytes()
         if mem1 is not None:
             span.attrs["mem_exit_bytes"] = mem1
         fields = dict(span.attrs)
-        fields.update(name=name, seconds=span.seconds)
+        fields.update(
+            name=name,
+            seconds=span.seconds,
+            trace_id=span.trace_id,
+            span_id=span.span_id,
+            start_ts=span.start_wall,
+        )
+        if span.parent_id is not None:
+            fields["parent_id"] = span.parent_id
         if span.parent is not None:
             fields["parent"] = span.parent.name
             fields["depth"] = span.depth
         if not span.ok:
             fields["ok"] = False
         log_event(_event, **fields)
+        for sink in list(_span_sinks):
+            try:
+                sink(span)
+            except Exception:  # a broken sink must not fail traced work
+                pass
 
 
 class Phases:
@@ -137,7 +297,8 @@ class Phases:
     into a dict — the shape ``VerifyResult.timings`` has always carried —
     while each phase also runs as a full span (registry + events + device
     annotation). Timings accumulate even when the body raises, and the
-    emitted ``phase`` event then carries ``ok: false``.
+    emitted ``phase`` event then carries ``ok: false``. Uses the same
+    injectable clock the spans themselves stamp from.
     """
 
     def __init__(self) -> None:
@@ -145,13 +306,14 @@ class Phases:
 
     @contextlib.contextmanager
     def __call__(self, name: str, **attrs) -> Iterator[Span]:
-        t0 = time.perf_counter()
+        clock = get_clock()
+        t0 = clock.perf()
         try:
             with trace(name, _event="phase", **attrs) as span:
                 yield span
         finally:
             self.timings[name] = self.timings.get(name, 0.0) + (
-                time.perf_counter() - t0
+                clock.perf() - t0
             )
 
 

@@ -33,6 +33,7 @@ import time
 from typing import Callable, Dict, List, Tuple
 
 from ..observe import log_event
+from ..observe.flight import trigger_dump
 from ..observe.metrics import BREAKER_TRANSITIONS_TOTAL
 
 __all__ = [
@@ -87,6 +88,12 @@ class CircuitBreaker:
         self.transitions.append(to)
         BREAKER_TRANSITIONS_TOTAL.labels(backend=self.backend, to=to).inc()
         log_event("breaker", backend=self.backend, state=to)
+        if to == OPEN:
+            # a circuit opening is exactly the moment whose prior context
+            # matters for post-mortem: flush the flight-recorder ring (a
+            # no-op unless one is installed; rare, so the dump cost under
+            # this lock is acceptable)
+            trigger_dump("breaker-open", backend=self.backend)
 
     def allow(self) -> bool:
         """May the caller attempt this backend now? An open circuit whose

@@ -348,6 +348,14 @@ def kill_point(name: str, flush=None) -> None:
         FAULTS_INJECTED_TOTAL.labels(backend="durability", kind=name).inc()
         if flush is not None:
             flush.flush()
+        try:
+            # last act before the un-catchable exit: flush the flight
+            # recorder ring so the post-mortem survives the "SIGKILL"
+            from ..observe.flight import trigger_dump
+
+            trigger_dump("kill-point", point=name)
+        except Exception:
+            pass  # dying is the contract; a failed dump must not block it
         os._exit(inj.exit_code)
 
 

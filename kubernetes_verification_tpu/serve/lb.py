@@ -40,8 +40,10 @@ from ..observe import log_event
 from ..observe.metrics import (
     LB_EJECTIONS_TOTAL,
     LB_REQUESTS_TOTAL,
+    LB_RETRIES_TOTAL,
     LB_STALE_RETRIES_TOTAL,
 )
+from ..observe.spans import trace
 from ..resilience.breaker import OPEN, CircuitBreaker
 from ..resilience.errors import ReplicationError, StaleReadError
 
@@ -135,51 +137,80 @@ class QueryLoadBalancer:
             weights.pop(i)
         return order
 
-    def _answer_with_leader(self, method: str, args, kwargs):
+    def _answer_with_leader(self, method: str, args, kwargs, hops=None):
         LB_REQUESTS_TOTAL.labels(replica="leader").inc()
         self.routed["leader"] = self.routed.get("leader", 0) + 1
+        if hops is not None:
+            hops.append(
+                {"hop": len(hops), "replica": "leader", "outcome": "served"}
+            )
         return getattr(self.leader, method)(*args, **kwargs), "leader"
 
     def dispatch_batch(self, method: str, *args, **kwargs) -> Tuple[object, str]:
         """Route one call of ``method`` (e.g. ``can_reach_batch``);
-        returns ``(result, who_answered)``."""
+        returns ``(result, who_answered)``.
+
+        The whole routing decision is recorded on an ``lb_dispatch`` span:
+        every hop's replica, staleness weight and outcome (``served`` /
+        ``stale`` / ``transport``), so a stale-read retry that settles at
+        the leader still names the replica that originally served — the
+        trace answers "why did this batch land there" without correlating
+        counters after the fact."""
         last_error: Optional[Exception] = None
-        for replica in self.pick_order():
-            name = replica.replica
-            breaker = self.breakers[name]
-            LB_REQUESTS_TOTAL.labels(replica=name).inc()
-            try:
-                result = getattr(replica, method)(*args, **kwargs)
-            except StaleReadError:
-                # a healthy replica past its bound: not a failure —
-                # retry against leader-fresh state when we have it
+        with trace("lb_dispatch", method=method) as span:
+            hops: List[Dict[str, object]] = []
+            span.attrs["route"] = hops
+            for hop, replica in enumerate(self.pick_order()):
+                name = replica.replica
+                breaker = self.breakers[name]
+                rec: Dict[str, object] = {
+                    "hop": hop,
+                    "replica": name,
+                    "weight": round(self._weight(replica), 6),
+                }
+                hops.append(rec)
+                LB_REQUESTS_TOTAL.labels(replica=name).inc()
+                try:
+                    result = getattr(replica, method)(*args, **kwargs)
+                except StaleReadError as e:
+                    # a healthy replica past its bound: not a failure —
+                    # retry against leader-fresh state when we have it
+                    breaker.record_success()
+                    LB_STALE_RETRIES_TOTAL.inc()
+                    LB_RETRIES_TOTAL.labels(reason="stale").inc()
+                    self.stale_retries += 1
+                    rec["outcome"] = "stale"
+                    rec["lag_seconds"] = getattr(e, "lag_seconds", None)
+                    if self.leader is not None:
+                        return self._answer_with_leader(
+                            method, args, kwargs, hops
+                        )
+                    raise
+                except _EJECTABLE as e:
+                    was_open = breaker.state == OPEN
+                    breaker.record_failure()
+                    if breaker.state == OPEN and not was_open:
+                        LB_EJECTIONS_TOTAL.labels(replica=name).inc()
+                        self.ejections += 1
+                        log_event(
+                            "lb_eject", replica=name, error=str(e)[:200]
+                        )
+                    LB_RETRIES_TOTAL.labels(reason="transport").inc()
+                    rec["outcome"] = "transport"
+                    last_error = e
+                    continue
                 breaker.record_success()
-                LB_STALE_RETRIES_TOTAL.inc()
-                self.stale_retries += 1
-                if self.leader is not None:
-                    return self._answer_with_leader(method, args, kwargs)
-                raise
-            except _EJECTABLE as e:
-                was_open = breaker.state == OPEN
-                breaker.record_failure()
-                if breaker.state == OPEN and not was_open:
-                    LB_EJECTIONS_TOTAL.labels(replica=name).inc()
-                    self.ejections += 1
-                    log_event(
-                        "lb_eject", replica=name, error=str(e)[:200]
-                    )
-                last_error = e
-                continue
-            breaker.record_success()
-            self.routed[name] = self.routed.get(name, 0) + 1
-            return result, name
-        if self.leader is not None:
-            return self._answer_with_leader(method, args, kwargs)
-        raise ReplicationError(
-            "every replica is ejected or failing and no leader fallback "
-            f"is wired (last error: {last_error})",
-            op="lb",
-        )
+                rec["outcome"] = "served"
+                self.routed[name] = self.routed.get(name, 0) + 1
+                return result, name
+            if self.leader is not None:
+                return self._answer_with_leader(method, args, kwargs, hops)
+            LB_RETRIES_TOTAL.labels(reason="exhausted").inc()
+            raise ReplicationError(
+                "every replica is ejected or failing and no leader fallback "
+                f"is wired (last error: {last_error})",
+                op="lb",
+            )
 
     def can_reach_batch(self, probes):
         return self.dispatch_batch("can_reach_batch", probes)

@@ -34,6 +34,7 @@ concrete witnessing pod pair (the serving form of the reference's
 """
 from __future__ import annotations
 
+import contextlib
 import json
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -55,10 +56,12 @@ from ..observe.metrics import (
     QUERY_BATCH_SIZE,
     QUERY_CACHE_HITS_TOTAL,
     QUERY_CACHE_MISSES_TOTAL,
+    QUERY_LATENCY_SECONDS,
     SERVE_ASSERTION_FAILURES_TOTAL,
     SERVE_QUERIES_TOTAL,
     SERVE_SOLVES_TOTAL,
 )
+from ..observe.spans import trace
 from ..ops.batched import (
     batched_any_port,
     batched_reach_cols,
@@ -598,6 +601,16 @@ class QueryEngine:
         return _port_answer(res, s, d, port, protocol)
 
     # ------------------------------------------------------------- batched
+    @staticmethod
+    @contextlib.contextmanager
+    def _stage(name: str):
+        """One query-pipeline stage: a child span named ``query_<stage>``
+        (so a reassembled trace shows where the batch's latency went) that
+        also feeds ``kvtpu_query_latency_seconds{stage=...}``."""
+        with trace(f"query_{name}", stage=name) as span:
+            yield span
+        QUERY_LATENCY_SECONDS.labels(stage=name).observe(span.seconds or 0.0)
+
     def can_reach_batch(
         self,
         queries: Optional[Sequence] = None,
@@ -662,41 +675,56 @@ class QueryEngine:
             st.queries.get("can_reach_batch", 0) + n_q
         )
         svc = self.service
-        svc.flush()
-        with svc._lock:
-            cache = self._cache
-            cache.sync(svc)
-            ref_idx = cache.ref_idx
-            try:
-                si = np.fromiter(
-                    (ref_idx[r] for r in srcs), np.int64, n_q
-                )
-                di = np.fromiter(
-                    (ref_idx[r] for r in dsts), np.int64, n_q
-                )
-            except KeyError:
-                for r in list(srcs) + list(dsts):
-                    self._idx(r)  # raises ServeError naming the bad ref
-                raise
-            ported = np.fromiter(
-                (p is not None for p in ports), bool, n_q
-            )
-            if not ported.all():
-                idx = np.nonzero(~ported)[0]
-                ans[idx] = self._any_port_batch(si[idx], di[idx])
-            if ported.any():
-                items = [
-                    (
-                        int(k),
-                        int(si[k]),
-                        int(di[k]),
-                        int(ports[k]),
-                        str(protocols[k]),
+        # the four pipeline stages every batched query pays, each a child
+        # span feeding kvtpu_query_latency_seconds{stage}: queue (coalesced
+        # writes flushed ahead of the read), dispatch (cache sync + index
+        # gather), solve (device/oracle answers), d2h (host readback and
+        # answer assembly)
+        with trace("query_batch", n=n_q):
+            with self._stage("queue"):
+                svc.flush()
+            with svc._lock:
+                with self._stage("dispatch"):
+                    cache = self._cache
+                    cache.sync(svc)
+                    ref_idx = cache.ref_idx
+                    try:
+                        si = np.fromiter(
+                            (ref_idx[r] for r in srcs), np.int64, n_q
+                        )
+                        di = np.fromiter(
+                            (ref_idx[r] for r in dsts), np.int64, n_q
+                        )
+                    except KeyError:
+                        for r in list(srcs) + list(dsts):
+                            self._idx(r)  # raises ServeError naming the bad ref
+                        raise
+                    ported = np.fromiter(
+                        (p is not None for p in ports), bool, n_q
                     )
-                    for k in np.nonzero(ported)[0]
-                ]
-                for k, ok in self._ported_batch(items):
-                    ans[k] = ok
+                any_res = ported_res = None
+                with self._stage("solve"):
+                    if not ported.all():
+                        idx = np.nonzero(~ported)[0]
+                        any_res = self._any_port_batch(si[idx], di[idx])
+                    if ported.any():
+                        items = [
+                            (
+                                int(k),
+                                int(si[k]),
+                                int(di[k]),
+                                int(ports[k]),
+                                str(protocols[k]),
+                            )
+                            for k in np.nonzero(ported)[0]
+                        ]
+                        ported_res = list(self._ported_batch(items))
+                with self._stage("d2h"):
+                    if any_res is not None:
+                        ans[idx] = np.asarray(any_res)
+                    if ported_res is not None:
+                        for k, ok in ported_res:
+                            ans[k] = ok
         return ans
 
     def _any_port_batch(self, s: np.ndarray, d: np.ndarray) -> np.ndarray:

@@ -822,3 +822,50 @@ class FollowerService:
                 transport_error=str(err) if err is not None else None,
             )
         return out
+
+    def health(self) -> dict:
+        """The replica-specific half of the ``/healthz`` document — the
+        overlay a :class:`~.transport.ReplicationServer` started by
+        :meth:`serve_http` applies over its base (leader-shaped) fields."""
+        lag = self.lag()
+        self._set_lag_gauges(lag)
+        epoch = self.epoch
+        if epoch is None:
+            epoch = self.source.last_epoch
+        out = {
+            "role": "leader" if self.promoted else "follower",
+            "replica": self.replica,
+            "epoch": epoch,
+            "last_seq": self.source.last_seq,
+            "applied": self.applied,
+            "lag": {"seconds": lag.seconds, "seq": lag.seq},
+            "breakers": {self.probe.backend: self.probe.state},
+            "outcome": self.recovery.outcome,
+            "service": self.service.health(),
+        }
+        out["breakers"].update(out["service"].pop("breaker", {}))
+        if self.client is not None:
+            err = getattr(self.source, "last_error", None)
+            out["leader_url"] = self.leader_url
+            out["transport_error"] = str(err) if err is not None else None
+        return out
+
+    def serve_http(self, *, host: str = "127.0.0.1", port: int = 0):
+        """Expose this replica on the wire: a
+        :class:`~.transport.ReplicationServer` over the follower's own
+        directory and WAL mirror (downstream replicas can chain off it)
+        whose ``/healthz`` carries this replica's role, lag and breaker
+        truth. Returns the started server; the caller owns its
+        lifecycle."""
+        from .transport import ReplicationServer
+
+        server = ReplicationServer(
+            self.directory,
+            self.log_path,
+            host=host,
+            port=port,
+            clock=self._clock,
+            health_source=self.health,
+        )
+        server.start()
+        return server

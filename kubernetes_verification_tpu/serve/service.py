@@ -294,6 +294,26 @@ class VerificationService:
     def n_pods(self) -> int:
         return len(self._engine.pods)
 
+    def health(self) -> dict:
+        """The serving core's fragment of the ``/healthz`` document:
+        engine shape, generation, queue depth and the solve breaker —
+        the process-local truth a replica overlay nests under
+        ``service``."""
+        br = self._breaker
+        out = {
+            "generation": self.generation,
+            "n_pods": self.n_pods,
+            "packed": bool(getattr(self, "packed", False)),
+            "read_only": self.read_only,
+            "events_applied": self.stats.events_applied,
+            "queue_depth": (
+                self._queue.qsize() if self._worker is not None else 0
+            ),
+        }
+        if br is not None:
+            out["breaker"] = {br.backend: br.state}
+        return out
+
     def pod_index(self, namespace: str, name: str) -> int:
         """Engine row index for pod ``namespace/name`` (ServeError when the
         service holds no such pod)."""

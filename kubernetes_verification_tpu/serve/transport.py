@@ -73,10 +73,19 @@ from typing import Callable, Dict, Iterator, List, Optional, Tuple
 from urllib.parse import parse_qs, urlsplit
 
 from ..observe import log_event
+from ..observe.export import to_prometheus
 from ..observe.metrics import (
     NET_BYTES_TOTAL,
     NET_REQUEST_FAILURES_TOTAL,
     NET_REQUESTS_TOTAL,
+    SCRAPE_REQUESTS_TOTAL,
+)
+from ..observe.spans import (
+    TRACE_HEADER,
+    parse_trace_header,
+    trace,
+    trace_context,
+    trace_headers,
 )
 from ..resilience.errors import PersistError, ReplicationError
 from ..resilience.faults import net_fault
@@ -228,31 +237,60 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(payload)
 
+    def _send_text(self, body: bytes, content_type: str) -> None:
+        self.send_response(200)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def do_GET(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler's name
         rep = self.server.replication
         parts = urlsplit(self.path)
         query = {k: v[-1] for k, v in parse_qs(parts.query).items()}
-        try:
-            if parts.path == "/v1/tip":
-                self._send_json(rep.tip())
-            elif parts.path == "/v1/wal":
-                payload, headers = rep.wal_range(query)
-                self._send_bytes(payload, headers)
-            elif parts.path == "/v1/checkpoint/manifest":
-                self._send_json(rep.checkpoint_manifest())
-            elif parts.path == "/v1/checkpoint/file":
-                payload, headers = rep.checkpoint_chunk(query)
-                self._send_bytes(payload, headers)
-            else:
+        # adopt the caller's X-Kvtpu-Trace context: every span opened while
+        # serving this request (this one and any nested) joins the caller's
+        # trace_id and parents under the caller's span, so `kv-tpu trace`
+        # sees the server-side time from the client's own timeline
+        trace_id, parent_id = parse_trace_header(
+            self.headers.get(TRACE_HEADER)
+        )
+        with trace_context(trace_id, parent_id), trace(
+            "http_serve", path=parts.path
+        ) as span:
+            try:
+                if parts.path == "/v1/tip":
+                    self._send_json(rep.tip())
+                elif parts.path == "/v1/wal":
+                    payload, headers = rep.wal_range(query)
+                    self._send_bytes(payload, headers)
+                elif parts.path == "/v1/checkpoint/manifest":
+                    self._send_json(rep.checkpoint_manifest())
+                elif parts.path == "/v1/checkpoint/file":
+                    payload, headers = rep.checkpoint_chunk(query)
+                    self._send_bytes(payload, headers)
+                elif parts.path == "/metrics":
+                    SCRAPE_REQUESTS_TOTAL.labels(endpoint="metrics").inc()
+                    self._send_text(
+                        to_prometheus().encode("utf-8"),
+                        "text/plain; version=0.0.4; charset=utf-8",
+                    )
+                elif parts.path == "/healthz":
+                    SCRAPE_REQUESTS_TOTAL.labels(endpoint="healthz").inc()
+                    self._send_json(rep.health())
+                else:
+                    self._send_json(
+                        {"error": f"unknown endpoint {parts.path!r}"},
+                        status=404,
+                    )
+            except ReplicationError as e:
+                span.attrs["error"] = str(e)
+                self._send_json({"error": str(e)}, status=404)
+            except (OSError, ValueError, KeyError) as e:
+                span.attrs["error"] = f"{type(e).__name__}: {e}"
                 self._send_json(
-                    {"error": f"unknown endpoint {parts.path!r}"}, status=404
+                    {"error": f"{type(e).__name__}: {e}"}, status=500
                 )
-        except ReplicationError as e:
-            self._send_json({"error": str(e)}, status=404)
-        except (OSError, ValueError, KeyError) as e:
-            self._send_json(
-                {"error": f"{type(e).__name__}: {e}"}, status=500
-            )
 
 
 class _Server(ThreadingHTTPServer):
@@ -276,6 +314,7 @@ class ReplicationServer:
         port: int = 0,
         clock: Callable[[], float] = time.time,
         max_range_bytes: int = 8 * DEFAULT_CHUNK_BYTES,
+        health_source: Optional[Callable[[], dict]] = None,
     ) -> None:
         self.directory = directory
         self.log_path = log_path
@@ -283,6 +322,7 @@ class ReplicationServer:
         self.port = port
         self.max_range_bytes = max_range_bytes
         self._clock = clock
+        self._health_source = health_source
         self._cm = CheckpointManager(directory)
         self._tip = _WalTip(log_path)
         self._httpd: Optional[_Server] = None
@@ -340,6 +380,46 @@ class ReplicationServer:
             else None
         )
         out["server_time"] = self._clock()
+        return out
+
+    def health(self) -> dict:
+        """The ``/healthz`` document: role, fencing epoch, WAL tip,
+        replica lag, breaker states and AOT-pack validity. The base
+        document describes the directory this server fronts (a leader's:
+        zero lag, no breakers); a ``health_source`` callable — a
+        :class:`~.replication.FollowerService`'s ``health()`` when the
+        server fronts a follower mirror — overlays the replica-specific
+        truth."""
+        tip = self._tip.refresh()
+        out: dict = {
+            "role": "leader",
+            "url": self.url,
+            "epoch": tip["last_epoch"],
+            "last_seq": tip["last_seq"],
+            "wal_size": tip["size"],
+            "lag": {"seconds": 0.0, "seq": 0},
+            "breakers": {},
+            "server_time": self._clock(),
+        }
+        lp = lease_path(self.directory)
+        if os.path.exists(lp):
+            try:
+                out["lease"] = LeaseFile(lp, clock=self._clock).describe()
+            except (OSError, ValueError):
+                out["lease"] = None
+        try:
+            from ..observe.aot import pack_dir, pack_status
+
+            out["aot"] = pack_status(pack_dir(self.directory))
+        except Exception as e:  # pack inspection must never fail health
+            out["aot"] = {
+                "present": False, "error": f"{type(e).__name__}: {e}",
+            }
+        if self._health_source is not None:
+            try:
+                out.update(self._health_source())
+            except Exception as e:  # a sick overlay is itself a signal
+                out["health_source_error"] = f"{type(e).__name__}: {e}"
         return out
 
     def wal_range(
@@ -491,7 +571,9 @@ class ReplicationClient:
                 self._host, self._port, timeout=self.timeout
             )
             try:
-                conn.request("GET", path)
+                # propagate the active trace context (if any) so the
+                # server-side spans parent under this caller's span
+                conn.request("GET", path, headers=trace_headers())
                 resp = conn.getresponse()
                 body = resp.read()
                 status = resp.status
@@ -544,6 +626,16 @@ class ReplicationClient:
     def tip(self) -> dict:
         body, _ = self._request("tip", "/v1/tip")
         return json.loads(body)
+
+    def healthz(self) -> dict:
+        """The replica's ``/healthz`` document (scrape surface)."""
+        body, _ = self._request("healthz", "/healthz")
+        return json.loads(body)
+
+    def metrics_text(self) -> str:
+        """The replica's ``/metrics`` Prometheus text exposition."""
+        body, _ = self._request("metrics", "/metrics")
+        return body.decode("utf-8")
 
     def wal(
         self,

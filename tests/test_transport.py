@@ -7,7 +7,9 @@ the wire), networked :class:`FollowerService` staleness + failover, the
 staleness-weighted :class:`QueryLoadBalancer`, the ``kv-tpu lb`` /
 ``serve --leader`` / ``recover`` CLI surface, the bench-gate entries for
 the networked series, and the two-host-simulated SIGKILL chaos run."""
+import glob
 import json
+import logging
 import os
 import subprocess
 import sys
@@ -23,16 +25,45 @@ from kubernetes_verification_tpu.harness.generate import (
     random_cluster,
     random_event_stream,
 )
-from kubernetes_verification_tpu.observe import REGISTRY
+from kubernetes_verification_tpu.observe import REGISTRY, configure_logging
+from kubernetes_verification_tpu.observe.events import _HANDLER_MARK
+from kubernetes_verification_tpu.observe.events import logger as kvtpu_logger
+from kubernetes_verification_tpu.observe.export import parse_prometheus
+from kubernetes_verification_tpu.observe.fleet import (
+    SloMonitor,
+    parse_slo_spec,
+    render_fleet,
+    scrape_replica,
+)
+from kubernetes_verification_tpu.observe.flight import (
+    load_dump,
+    render_dump,
+    trigger_dump,
+)
+from kubernetes_verification_tpu.observe.flight import (
+    install as flight_install,
+)
+from kubernetes_verification_tpu.observe.flight import (
+    uninstall as flight_uninstall,
+)
 from kubernetes_verification_tpu.observe.history import _direction
 from kubernetes_verification_tpu.observe.metrics import REQUIRED_FAMILIES
+from kubernetes_verification_tpu.observe.spans import (
+    format_trace_header,
+    parse_trace_header,
+    trace,
+)
 from kubernetes_verification_tpu.resilience import (
     EXIT_OK,
     EXIT_VIOLATIONS,
     ConfigError,
     StaleReadError,
 )
-from kubernetes_verification_tpu.resilience.breaker import CLOSED, OPEN
+from kubernetes_verification_tpu.resilience.breaker import (
+    CLOSED,
+    OPEN,
+    CircuitBreaker,
+)
 from kubernetes_verification_tpu.resilience.errors import ReplicationError
 from kubernetes_verification_tpu.resilience.faults import (
     clear_net_faults,
@@ -850,7 +881,12 @@ def _spawn_net_leader(workdir, kill, *, n_events=160):
     Returns (proc, url, ack_file) — create ack_file to arm the kill."""
     url_file = os.path.join(str(workdir), "url.txt")
     ack_file = os.path.join(str(workdir), "ack")
-    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    # arm the child's flight recorder: a kill-point death must leave a
+    # readable post-mortem behind (asserted by the sigkill chaos test)
+    env = dict(
+        os.environ, JAX_PLATFORMS="cpu",
+        KVTPU_FLIGHT_DIR=os.path.join(str(workdir), "flight"),
+    )
     proc = subprocess.Popen(
         [
             sys.executable, CHILD, "--workdir", str(workdir),
@@ -869,7 +905,7 @@ def _spawn_net_leader(workdir, kill, *, n_events=160):
 
 
 @pytest.mark.slow
-def test_networked_failover_chaos_sigkill(tmp_path):
+def test_networked_failover_chaos_sigkill(tmp_path, capsys):
     """The acceptance chaos, two-host-simulated: a leader process on its
     own 'host' serves checkpoint + WAL over HTTP and is SIGKILLed inside
     a lease renewal mid-stream; two networked followers (shared standby
@@ -896,6 +932,18 @@ def test_networked_failover_chaos_sigkill(tmp_path):
             f.poll()
         time.sleep(0.01)
     assert proc.returncode == 137, proc.communicate()[1]
+    # the dying leader's last act: the armed flight recorder dumped its
+    # ring before os._exit, and `kv-tpu recover` renders the post-mortem
+    dumps = glob.glob(str(tmp_path / "flight" / "flight-*.json"))
+    assert dumps, "kill-point death must leave a flight dump"
+    payload = load_dump(dumps[0])
+    assert payload["trigger"] == "kill-point"
+    assert payload["info"]["point"] == "before-lease-renew"
+    assert payload["entries"], "the ring held the leader's last records"
+    assert render_dump(payload)[0].startswith("flight dump: trigger=kill-point")
+    main(["recover", str(tmp_path / "flight")])
+    out = capsys.readouterr().out
+    assert "trigger=kill-point" in out
     for _ in range(2):
         for f in followers:
             f.heartbeat()
@@ -994,3 +1042,480 @@ def test_slow_link_still_converges_bit_for_bit(tmp_path, churn):
     np.testing.assert_array_equal(_reach(f.service), _reach(leader))
     with open(log, "rb") as a, open(f.log_path, "rb") as b:
         assert a.read() == b.read()
+
+
+# ------------------------------------------------- fleet observability plane
+@pytest.fixture()
+def event_log(tmp_path):
+    """This process's JSON event lines captured to a file — the same shape
+    every replica's log has, so `kv-tpu trace` can scan it. Restores the
+    kvtpu logger afterwards (handler and level)."""
+    path = str(tmp_path / "parent-events.jsonl")
+    fh = open(path, "w", buffering=1)
+    configure_logging(stream=fh)
+    yield path
+    for h in list(kvtpu_logger.handlers):
+        if getattr(h, _HANDLER_MARK, False):
+            kvtpu_logger.removeHandler(h)
+    kvtpu_logger.setLevel(logging.NOTSET)
+    fh.close()
+
+
+def _trace_lines(path, trace_id):
+    """Every JSON line in ``path`` stamped with ``trace_id``."""
+    out = []
+    with open(path) as fh:
+        for raw in fh:
+            raw = raw.strip()
+            if not raw.startswith("{"):
+                continue
+            try:
+                line = json.loads(raw)
+            except ValueError:
+                continue
+            if line.get("trace_id") == trace_id:
+                out.append(line)
+    return out
+
+
+def _gauge(name, key):
+    return REGISTRY.dump()["gauges"].get(name, {}).get(key)
+
+
+def test_trace_header_round_trip_and_malformed_rejection():
+    assert parse_trace_header(format_trace_header("deadbeef", "12ab")) == (
+        "deadbeef", "12ab",
+    )
+    # absent/malformed headers parse to (None, None) — a bad header must
+    # never fail the request it rode in on
+    for bad in (None, "", "deadbeef", "-12ab", "deadbeef-", "gg-12", "12-gg"):
+        assert parse_trace_header(bad) == (None, None), bad
+
+
+def test_scrape_endpoints_serve_health_and_metrics(tmp_path, churn):
+    log, ckdir, _leader = _leader_dir(tmp_path, churn)
+    with ReplicationServer(ckdir, log) as server:
+        client = ReplicationClient(server.url, sleep=_NOSLEEP)
+        before_h = _counter("kvtpu_scrape_requests_total", "endpoint=healthz")
+        before_m = _counter("kvtpu_scrape_requests_total", "endpoint=metrics")
+        h = client.healthz()
+        assert h["role"] == "leader" and h["epoch"] == 1
+        assert h["last_seq"] == scan_wal(log).last_seq
+        assert h["lag"] == {"seconds": 0.0, "seq": 0}
+        assert "aot" in h and h["lease"]["holder"] == "leader-0"
+        text = client.metrics_text()
+        fams = parse_prometheus(text)
+        # the healthz scrape above is already visible in the exposition
+        assert any(
+            labels.get("endpoint") == "healthz" and value >= 1.0
+            for labels, value in fams["kvtpu_scrape_requests_total"]
+        )
+        assert _counter(
+            "kvtpu_scrape_requests_total", "endpoint=healthz"
+        ) == before_h + 1
+        assert _counter(
+            "kvtpu_scrape_requests_total", "endpoint=metrics"
+        ) == before_m + 1
+
+
+def test_follower_health_overlay_rides_the_scrape_surface(tmp_path, churn):
+    log, ckdir, _leader = _leader_dir(tmp_path, churn)
+    f = FollowerService(ckdir, replica="shared-0")
+    f.catch_up()
+    with ReplicationServer(
+        ckdir, log, health_source=f.health
+    ) as server:
+        h = scrape_replica(server.url).health
+    # the overlay replaces the directory's leader-shaped base document
+    # with the replica-specific truth
+    assert h["role"] == "follower" and h["replica"] == "shared-0"
+    assert h["lag"]["seq"] == 0 and "breakers" in h
+
+
+def test_fleet_scrape_and_table_render_down_rows_included(tmp_path, churn):
+    log, ckdir, _leader = _leader_dir(tmp_path, churn)
+    with ReplicationServer(ckdir, log) as server:
+        up = scrape_replica(server.url)
+    down = scrape_replica(server.url, timeout=0.5)  # server closed now
+    assert up.ok and up.health["role"] == "leader" and up.error is None
+    assert up.metrics and "kvtpu_scrape_requests_total" in up.metrics
+    assert not down.ok and down.error and down.lag_seconds is None
+    lines = render_fleet([up, down])
+    assert lines[0].split()[:2] == ["replica", "role"]
+    assert "leader" in lines[1] and server.url in lines[1]
+    assert "DOWN" in lines[2]
+
+
+def test_slo_spec_grammar_and_burn_rate_math():
+    avail = parse_slo_spec("availability=0.999")
+    stale = parse_slo_spec("staleness=0.995@2.0")
+    assert avail.bound is None and avail.budget == pytest.approx(0.001)
+    assert stale.bound == 2.0 and stale.budget == pytest.approx(0.005)
+    for bad in ("junk", "x=nope", "x=1.5", "x=0.9@wat"):
+        with pytest.raises(ValueError):
+            parse_slo_spec(bad)
+
+    mon = SloMonitor([avail, stale])
+    t0 = 1_000_000.0
+    # one bad scrape of two against a 0.1% budget burns at 500x
+    mon.record("availability", True, ts=t0 - 10)
+    mon.record("availability", False, ts=t0 - 5)
+    assert mon.burn_rate("availability", 300.0, now=t0) == pytest.approx(500.0)
+    # the multi-window pair: the burn ages out of the 5m window but the
+    # 1h window still remembers the leak
+    assert mon.burn_rate("availability", 300.0, now=t0 + 400) == 0.0
+    assert mon.burn_rate(
+        "availability", 3600.0, now=t0 + 400
+    ) == pytest.approx(500.0)
+    burns = mon.evaluate(now=t0)
+    assert burns["availability"]["5m"] == pytest.approx(500.0)
+    assert _gauge(
+        "kvtpu_slo_burn_rate", "objective=availability,window=5m"
+    ) == pytest.approx(500.0)
+
+    # staleness objectives judge the reported lag against the bound
+    from kubernetes_verification_tpu.observe.fleet import (
+        ReplicaScrape,
+        SloObjective,
+    )
+
+    mon2 = SloMonitor([stale])
+    mon2.observe_scrape(
+        ReplicaScrape(url="u", ok=True, health={"lag": {"seconds": 0.5}})
+    )
+    mon2.observe_scrape(
+        ReplicaScrape(url="v", ok=True, health={"lag": {"seconds": 5.0}})
+    )
+    assert mon2.burn_rate("staleness", 300.0) == pytest.approx(100.0)
+    # zero-budget objective: any bad event is an infinite burn
+    hard = SloMonitor([SloObjective(name="hard", target=1.0)])
+    hard.record("hard", False, ts=t0)
+    assert hard.burn_rate("hard", 300.0, now=t0) == float("inf")
+    hard2 = SloMonitor([SloObjective(name="hard", target=1.0)])
+    hard2.record("hard", True, ts=t0)
+    assert hard2.burn_rate("hard", 300.0, now=t0) == 0.0
+
+
+def test_cli_fleet_renders_table_and_gates_on_burn(tmp_path, churn, capsys):
+    log, ckdir, _leader = _leader_dir(tmp_path, churn)
+    with ReplicationServer(ckdir, log) as server:
+        rc = main(["fleet", "--replica", server.url, "--json"])
+        out = json.loads(capsys.readouterr().out.strip())
+        assert rc == EXIT_OK
+        (rep,) = out["replicas"]
+        assert rep["ok"] and rep["health"]["role"] == "leader"
+        assert set(out["slo"]["availability"]) == {"5m", "1h"}
+        # one dead replica of two blows a 99.9% availability budget
+        rc = main([
+            "fleet", "--replica", server.url,
+            "--replica", "http://127.0.0.1:9",
+            "--slo", "availability=0.999", "--timeout", "0.5",
+        ])
+        txt = capsys.readouterr().out
+        assert rc == EXIT_VIOLATIONS
+        assert "DOWN" in txt and "[BURNING]" in txt
+        assert "slo availability:" in txt
+    with pytest.raises(SystemExit, match="bad SLO spec"):
+        main(["fleet", "--replica", "http://x", "--slo", "nope"])
+
+
+def test_http_serve_spans_join_the_callers_trace(event_log, tmp_path, churn):
+    log, ckdir, _leader = _leader_dir(tmp_path, churn)
+    with ReplicationServer(ckdir, log) as server:
+        client = ReplicationClient(server.url, sleep=_NOSLEEP)
+        with trace("caller_op") as root:
+            tid = root.trace_id
+            client.tip()
+            client.healthz()
+        # a malformed header must not fail the request — the server just
+        # mints a fresh trace for that serve
+        import http.client as _hc
+
+        conn = _hc.HTTPConnection(server.host, server.port, timeout=5.0)
+        try:
+            conn.request(
+                "GET", "/v1/tip", headers={"X-Kvtpu-Trace": "not-a-trace"}
+            )
+            assert conn.getresponse().status == 200
+        finally:
+            conn.close()
+    lines = _trace_lines(event_log, tid)
+    serves = [l for l in lines if l.get("name") == "http_serve"]
+    # the server thread's spans adopted the wire context: same trace_id,
+    # parented under a span of this trace (the caller side of the hop)
+    assert len(serves) >= 2
+    span_ids = {l.get("span_id") for l in lines}
+    assert all(l["parent_id"] in span_ids for l in serves)
+    assert any(l.get("name") == "caller_op" for l in lines)
+
+
+def test_cli_trace_reassembles_timeline_with_stage_breakdown(
+    event_log, tmp_path, churn, capsys
+):
+    log, ckdir, leader = _leader_dir(tmp_path, churn)
+    f = FollowerService(ckdir, replica="trace-0")
+    pods = leader.engine.pods
+    probes = [
+        (f"{pods[i].namespace}/{pods[i].name}",
+         f"{pods[j].namespace}/{pods[j].name}")
+        for i in range(3) for j in range(3)
+    ]
+    with trace("fleet_query") as root:
+        tid = root.trace_id
+        f.can_reach_batch(probes)
+    rc = main(["trace", tid, "--log", event_log, "--json"])
+    out = json.loads(capsys.readouterr().out.strip())
+    assert rc == EXIT_OK
+    by_name = {s["name"]: s for s in out["spans"]}
+    assert by_name["fleet_query"]["depth"] == 0
+    assert by_name["query_batch"]["depth"] == 1
+    assert by_name["query_solve"]["depth"] == 2
+    # the latency decomposition: every pipeline stage accounted, their sum
+    # bounded by the end-to-end batch time
+    assert set(out["stages"]) == {"queue", "dispatch", "solve", "d2h"}
+    total = sum(out["stages"].values())
+    assert 0.0 < total <= out["e2e_seconds"] * 1.001
+    rc = main(["trace", tid, "--log", event_log])
+    txt = capsys.readouterr().out
+    assert rc == EXIT_OK
+    assert txt.startswith(f"trace {tid}:") and "stages:" in txt
+    assert "query_batch" in txt
+    # an unknown trace id is a violation, not a silent empty timeline
+    rc = main(["trace", "feedfeedfeedfeed", "--log", event_log])
+    capsys.readouterr()
+    assert rc == EXIT_VIOLATIONS
+
+
+def test_query_latency_histogram_fed_per_stage(tmp_path, churn):
+    log, ckdir, _leader = _leader_dir(tmp_path, churn)
+    f = FollowerService(ckdir, replica="lat-0")
+    before = {
+        stage: REGISTRY.dump()["histograms"]
+        .get("kvtpu_query_latency_seconds", {})
+        .get(f"stage={stage}", {})
+        .get("count", 0.0)
+        for stage in ("queue", "dispatch", "solve", "d2h")
+    }
+    f.can_reach_batch([
+        (f"{p.namespace}/{p.name}", f"{q.namespace}/{q.name}")
+        for p in f.service.engine.pods[:2]
+        for q in f.service.engine.pods[:2]
+    ])
+    hist = REGISTRY.dump()["histograms"]["kvtpu_query_latency_seconds"]
+    for stage in ("queue", "dispatch", "solve", "d2h"):
+        assert hist[f"stage={stage}"]["count"] == before[stage] + 1, stage
+
+
+def test_flight_recorder_dumps_on_breaker_open_and_recover_renders(
+    tmp_path, capsys
+):
+    fdir = str(tmp_path / "flight")
+    flight_install(fdir, with_signal=False)
+    try:
+        before = _counter("kvtpu_flight_dumps_total", "trigger=breaker-open")
+        with trace("doomed_op"):
+            pass
+        br = CircuitBreaker("flaky-backend", failure_threshold=1)
+        br.record_failure()
+        assert br.state == OPEN
+    finally:
+        flight_uninstall()
+    assert _counter(
+        "kvtpu_flight_dumps_total", "trigger=breaker-open"
+    ) == before + 1
+    (path,) = glob.glob(os.path.join(fdir, "flight-*.json"))
+    payload = load_dump(path)
+    assert payload["trigger"] == "breaker-open"
+    assert payload["info"]["backend"] == "flaky-backend"
+    # the ring held the spans leading up to the trigger, with their trace
+    # identity — a dump is also a partial trace
+    doomed = [
+        e for e in payload["entries"]
+        if e.get("kind") == "span" and e.get("name") == "doomed_op"
+    ]
+    assert doomed and doomed[0]["trace_id"]
+    # metric deltas show what THIS process did since install, not totals
+    deltas = payload["metric_deltas"]["counters"]
+    assert deltas["kvtpu_breaker_transitions_total"]["backend=flaky-backend,to=open"] == 1
+    lines = render_dump(payload)
+    assert lines[0].startswith("flight dump: trigger=breaker-open")
+    assert any("doomed_op" in l for l in lines)
+    # disarmed: every trigger seam is a no-op again
+    assert trigger_dump("manual") is None
+    # `kv-tpu recover` folds the dumps into the triage report
+    rc = main(["recover", fdir])
+    out = capsys.readouterr().out
+    assert rc == EXIT_OK and "trigger=breaker-open" in out
+    rc = main(["recover", fdir, "--json"])
+    report = json.loads(capsys.readouterr().out.strip())
+    assert report["flight_dumps"][0]["trigger"] == "breaker-open"
+
+
+def test_observe_metric_families_registered():
+    for fam in (
+        "kvtpu_query_latency_seconds",
+        "kvtpu_slo_burn_rate",
+        "kvtpu_lb_retries_total",
+        "kvtpu_flight_dumps_total",
+        "kvtpu_scrape_requests_total",
+    ):
+        assert fam in REQUIRED_FAMILIES
+
+
+def test_bench_gate_directions_for_observability_series():
+    assert _direction("s", "net_stage_latency_solve_p99_s") == "lower"
+    assert _direction("s", "net_stage_latency_queue_p50_s") == "lower"
+    # the observability tax is name-gated lower-is-better in any unit
+    assert _direction("pct", "net_scrape_overhead_pct") == "lower"
+    assert _direction(None, "net_scrape_overhead_pct") == "lower"
+
+
+def test_observe_plane_is_lint_clean_without_baseline():
+    """fleet.py/flight.py must satisfy the taxonomy and concurrency rules
+    outright, and the whole wire surface must satisfy trace-context —
+    every outgoing request carries the header, every do_GET parses it."""
+    from kubernetes_verification_tpu.analysis.baseline import (
+        default_baseline_path,
+        load_baseline,
+    )
+    from kubernetes_verification_tpu.analysis.core import run_package
+
+    new_files = ["observe/fleet.py", "observe/flight.py"]
+    result = run_package(
+        rules=["error-taxonomy", "concurrency-hygiene"], only=new_files
+    )
+    assert result.findings == []
+    assert result.grandfathered == []
+    baseline = load_baseline(default_baseline_path())
+    for rule, by_path in baseline.items():
+        for path in new_files:
+            assert path not in by_path, (rule, path)
+    wired = ["serve/transport.py", "serve/lb.py", "observe/fleet.py"]
+    result = run_package(rules=["trace-context"], only=wired)
+    assert result.findings == []
+
+
+# ----------------------------------------- chaos: the 3-process trace (slow)
+def _spawn_serving_replica(workdir, *, n_events=48):
+    """Start a --serve-only child: a live leader process that serves its
+    state (WAL, checkpoints, /metrics, /healthz) and logs its server-side
+    spans to its own obs log until the ack file appears."""
+    workdir = str(workdir)
+    os.makedirs(workdir, exist_ok=True)
+    url_file = os.path.join(workdir, "url.txt")
+    ack_file = os.path.join(workdir, "ack")
+    # basename must be unique per host: `kv-tpu trace` labels spans by log
+    # basename and the timeline must show three distinct processes
+    obs_log = os.path.join(
+        workdir, f"{os.path.basename(workdir)}-obs.jsonl"
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [
+            sys.executable, CHILD, "--workdir", workdir,
+            "--url-file", url_file, "--ack-file", ack_file,
+            "--serve-only", "--obs-log", obs_log,
+            "--n-events", str(n_events),
+        ],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    return proc, url_file, ack_file, obs_log
+
+
+def _await_url(proc, url_file, timeout=180.0):
+    deadline = time.time() + timeout
+    while not os.path.exists(url_file):
+        assert proc.poll() is None, proc.communicate()[1]
+        assert time.time() < deadline, "replica never published its URL"
+        time.sleep(0.02)
+    with open(url_file) as fh:
+        return fh.read().strip()
+
+
+@pytest.mark.slow
+def test_one_trace_id_spans_three_processes_through_lb_under_net_delay(
+    tmp_path, event_log, capsys
+):
+    """The observability acceptance chaos: a traced query batch enters the
+    `QueryLoadBalancer` in THIS process and fans out to two networked
+    followers whose leaders live in two OTHER processes, with every wire
+    hop under net-delay — and one trace_id stitches all three process
+    logs into a single `kv-tpu trace` timeline with the queue/dispatch/
+    solve/d2h stage breakdown summing to (at most) the e2e batch time."""
+    proc_a, url_a, ack_a, obs_a = _spawn_serving_replica(tmp_path / "host-a")
+    proc_b, url_b, ack_b, obs_b = _spawn_serving_replica(tmp_path / "host-b")
+    try:
+        fa = FollowerService(
+            str(tmp_path / "fa"), leader_url=_await_url(proc_a, url_a),
+            replica="fa", lease_ttl=5.0,
+        )
+        fb = FollowerService(
+            str(tmp_path / "fb"), leader_url=_await_url(proc_b, url_b),
+            replica="fb", lease_ttl=5.0,
+        )
+        cluster, _cfg = _chaos_cluster()
+        pods = cluster.pods
+        probes = [
+            (f"{pods[i].namespace}/{pods[i].name}",
+             f"{pods[j].namespace}/{pods[j].name}")
+            for i in range(4) for j in range(4)
+        ]
+        lb = QueryLoadBalancer([fa, fb], seed=5)
+        sleeps = []
+        install_net_faults(
+            parse_fault_spec("net-delay%1.0"),
+            delay_seconds=0.002, sleep=sleeps.append,
+        )
+        with trace("fleet_query") as root:
+            tid = root.trace_id
+            lb.dispatch([probes] * 6)
+            # the weighted draw could starve one replica across 6 small
+            # batches; pin one traced batch on each so every process MUST
+            # carry this trace
+            fa.can_reach_batch(probes[:2])
+            fb.can_reach_batch(probes[:2])
+        assert sleeps, "net-delay never fired on the traced wire hops"
+        clear_net_faults()
+    finally:
+        for ack in (ack_a, ack_b):
+            open(ack, "w").close()
+        for proc in (proc_a, proc_b):
+            try:
+                proc.wait(timeout=90)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+    assert proc_a.returncode == 0, proc_a.communicate()[1]
+    assert proc_b.returncode == 0, proc_b.communicate()[1]
+    # every process saw this trace: the parent's own spans, and each
+    # child's http_serve spans adopted from the X-Kvtpu-Trace header
+    parent_lines = _trace_lines(event_log, tid)
+    assert any(l.get("name") == "query_batch" for l in parent_lines)
+    assert lb.routed and sum(lb.routed.values()) == 6
+    for obs in (obs_a, obs_b):
+        serves = [
+            l for l in _trace_lines(obs, tid)
+            if l.get("name") == "http_serve"
+        ]
+        assert serves, f"{obs}: the trace never reached this process"
+    rc = main([
+        "trace", tid, "--log", event_log,
+        "--log", obs_a, "--log", obs_b, "--json",
+    ])
+    out = json.loads(capsys.readouterr().out.strip())
+    assert rc == EXIT_OK
+    logs_seen = {s["_log"] for s in out["spans"]}
+    assert len(logs_seen) == 3, logs_seen
+    assert set(out["stages"]) == {"queue", "dispatch", "solve", "d2h"}
+    total = sum(out["stages"].values())
+    # the stage decomposition accounts for the batch latency: nothing
+    # above e2e, and no unexplained majority gap
+    assert 0.0 < total <= out["e2e_seconds"] * 1.001
+    assert total >= out["e2e_seconds"] * 0.5 or (
+        out["e2e_seconds"] - total
+    ) < 0.05
+    # text mode stitches the same cross-process header line
+    rc = main([
+        "trace", tid, "--log", event_log, "--log", obs_a, "--log", obs_b,
+    ])
+    txt = capsys.readouterr().out
+    assert rc == EXIT_OK and "across 3 process log(s)" in txt

@@ -16,6 +16,13 @@ appending the second half — the parent never races the kill window.
 
 Deliberately never solves reach: the child's job is to die while
 writing, not to derive answers nobody will read.
+
+``--serve-only`` flips the job: build the first half, then stay alive
+serving the replication/scrape endpoints (lease renewed, ``--obs-log``
+capturing this process's JSON event lines for ``kv-tpu trace``) until
+the ack file appears — the live replica of the fleet-observability
+chaos test. ``KVTPU_FLIGHT_DIR`` in the environment arms the flight
+recorder either way.
 """
 import argparse
 import os
@@ -37,6 +44,17 @@ def main() -> int:
         help="fault spec armed via install_kill_points AFTER the ack, "
         "e.g. 'before-lease-renew@2' (empty = run to completion)",
     )
+    ap.add_argument(
+        "--serve-only", action="store_true",
+        help="after the first-half build, keep serving (renewing the "
+        "lease) until --ack-file appears, then exit cleanly — no kill, "
+        "no second half (the fleet-observability chaos leader)",
+    )
+    ap.add_argument(
+        "--obs-log", default="",
+        help="write this process's JSON event lines here (the per-replica "
+        "log `kv-tpu trace` scans for cross-process timelines)",
+    )
     ap.add_argument("--seed", type=int, default=3)
     ap.add_argument("--n-events", type=int, default=200)
     ap.add_argument("--pods", type=int, default=24)
@@ -56,10 +74,21 @@ def main() -> int:
         random_cluster,
         random_event_stream,
     )
+    from kubernetes_verification_tpu.observe.flight import install_from_env
     from kubernetes_verification_tpu.resilience.faults import (
         install_kill_points,
         parse_fault_spec,
     )
+
+    # KVTPU_FLIGHT_DIR set by the parent arms the crash flight recorder:
+    # the SIGKILL below then leaves a flight-*.json post-mortem behind
+    install_from_env()
+    if args.obs_log:
+        from kubernetes_verification_tpu.observe import configure_logging
+
+        # line-buffered so the parent reads complete event lines while
+        # this process is still alive and serving
+        configure_logging(stream=open(args.obs_log, "a", buffering=1))
     from kubernetes_verification_tpu.serve import (
         CheckpointManager,
         EventSource,
@@ -120,6 +149,21 @@ def main() -> int:
     with open(tmp, "w") as fh:
         fh.write(url)
     os.replace(tmp, args.url_file)
+
+    if args.serve_only:
+        # fleet-observability mode: this process is a live replica whose
+        # only job is to serve /v1/*, /metrics and /healthz (logging its
+        # server-side spans to --obs-log) until the parent says stop
+        deadline = time.time() + 120.0
+        while not os.path.exists(args.ack_file):
+            if time.time() > deadline:
+                print("parent never acked", file=sys.stderr)
+                return 1
+            lease.renew("net-leader", 1, args.lease_ttl)
+            time.sleep(args.lease_ttl / 4)
+        writer.close()
+        server.close()
+        return 0
 
     if args.ack_file:
         deadline = time.time() + 60.0
