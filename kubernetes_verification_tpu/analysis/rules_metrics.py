@@ -233,3 +233,72 @@ class TraceContextRule(Rule):
                         "spans orphan into fresh traces instead of "
                         "parenting under the caller's span",
                     )
+
+
+#: uppercase module-level counters whose `.inc()` inside a loop marks that
+#: loop as a multi-pass host iteration (squaring passes, BFS levels, delta
+#: rounds — the package's pass-counter naming convention)
+_PASS_COUNTER_RE = re.compile(r"^[A-Z0-9_]*(ITERATIONS|LEVELS|ROUNDS)[A-Z0-9_]*$")
+
+
+@register
+class LongLoopProgressRule(Rule):
+    id = "long-loop-progress"
+    rationale = (
+        "A multi-pass host loop (one that bumps a pass counter like "
+        "CLOSURE_ITERATIONS / *_LEVELS / *_ROUNDS per trip) can run for "
+        "minutes at flagship scale with nothing but a frozen terminal to "
+        "show for it. Every such loop must drive a ProgressTicker "
+        "(`ticker.tick(...)` in the loop body) so operators get pass "
+        "counts, smoothed rates and ETAs on /healthz, `kv-tpu jobs` and "
+        "`kv-tpu top` — a silent long loop is indistinguishable from a "
+        "hung one."
+    )
+    example = (
+        "while True:\n"
+        "    CLOSURE_ITERATIONS.inc()  # pass counter, no ticker.tick()\n"
+        "    cur = step(cur)"
+    )
+
+    @staticmethod
+    def _body_calls(loop: ast.AST) -> Iterable[ast.Call]:
+        # the loop's own body/orelse only — a nested loop's calls belong
+        # to the nested loop's finding (its ticks cannot discharge the
+        # OUTER loop's obligation), and a nested def's calls to neither
+        stack = list(ast.iter_child_nodes(loop))
+        while stack:
+            node = stack.pop()
+            if isinstance(
+                node,
+                (ast.For, ast.While, ast.FunctionDef, ast.AsyncFunctionDef),
+            ):
+                continue
+            if isinstance(node, ast.Call):
+                yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for loop in ast.walk(ctx.tree):
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            counter = None
+            ticked = False
+            for call in self._body_calls(loop):
+                if not isinstance(call.func, ast.Attribute):
+                    continue
+                if call.func.attr == "tick":
+                    ticked = True
+                elif (
+                    call.func.attr == "inc"
+                    and isinstance(call.func.value, ast.Name)
+                    and _PASS_COUNTER_RE.match(call.func.value.id)
+                ):
+                    counter = call.func.value.id
+            if counter and not ticked:
+                yield Finding(
+                    self.id, ctx.rel, loop.lineno,
+                    f"multi-pass loop bumps {counter} but never calls "
+                    "ticker.tick() — drive a ProgressTicker so the pass "
+                    "count, rate and ETA reach /healthz and kv-tpu "
+                    "jobs/top",
+                )

@@ -335,13 +335,22 @@ def _transitive_closure(reach: np.ndarray) -> np.ndarray:
     """Boolean transitive closure by repeated squaring — the full-path
     generalisation of the reference's ≤2-hop ``path``
     (``kubesv/kubesv/constraint.py:233-237``)."""
+    import math
+
+    from ..observe.progress import ProgressTicker
+
     closure = reach.copy()
-    while True:
-        CLOSURE_ITERATIONS.inc()
-        nxt = closure | ((closure.astype(np.int64) @ closure.astype(np.int64)) > 0)
-        if np.array_equal(nxt, closure):
-            return closure
-        closure = nxt
+    bound = max(1, math.ceil(math.log2(max(closure.shape[0], 2))))
+    with ProgressTicker("cpu_closure", total=bound, unit="pass") as ticker:
+        while True:
+            CLOSURE_ITERATIONS.inc()
+            nxt = closure | (
+                (closure.astype(np.int64) @ closure.astype(np.int64)) > 0
+            )
+            ticker.tick()
+            if np.array_equal(nxt, closure):
+                return closure
+            closure = nxt
 
 
 register_backend("cpu", CpuBackend)

@@ -27,8 +27,15 @@ only, SURVEY.md §1); this exposes the full pipeline:
   directory (generation health, WAL valid prefix, flight-recorder dumps);
 * ``kv-tpu trace ID``      — reassemble one trace's cross-process timeline
   from per-replica JSON event logs (span tree + query stage breakdown);
+  ``--slowest --metrics URL`` picks the id from the worst latency exemplar;
 * ``kv-tpu fleet``         — scrape every replica's ``/healthz`` +
   ``/metrics``, render the fleet table, evaluate SLO burn rates;
+* ``kv-tpu jobs``          — merge every replica's in-flight long-job
+  progress (pass counters, rates, ETAs) into one table;
+* ``kv-tpu profile``       — trigger a bounded on-demand ``jax.profiler``
+  capture on a running replica (or locally), rate-limited;
+* ``kv-tpu top``           — live fleet dashboard: replica table, job ETA
+  bars, qps/lag/burn sparklines, recent flight dumps;
 * ``kv-tpu backends``      — list available execution backends.
 """
 from __future__ import annotations
@@ -1663,6 +1670,28 @@ def _run_lb(args) -> int:
     return EXIT_OK
 
 
+def _metrics_source_text(source: str, timeout: float = 5.0) -> str:
+    """Exemplar-annotated metrics text from a replica URL or a saved file."""
+    if source.startswith(("http://", "https://")):
+        from .serve.transport import ReplicationClient
+
+        return ReplicationClient(source, timeout=timeout).metrics_text(
+            exemplars=True
+        )
+    try:
+        with open(source) as fh:
+            return fh.read()
+    except OSError as e:
+        raise SystemExit(f"trace: cannot read metrics source {source}: {e}")
+
+
+def _fmt_labels(labels: dict) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
 def cmd_trace(args) -> int:
     from .resilience.errors import KvTpuError
 
@@ -1680,8 +1709,50 @@ def _run_trace(args) -> int:
     HTTP via the ``X-Kvtpu-Trace`` header), a wall-clock ``ts``/``start_ts``
     and span/parent ids — so scanning each replica's JSON event log for one
     trace id and sorting by wall time rebuilds the span tree across
-    processes, plus the query stage breakdown (queue/dispatch/solve/d2h)."""
+    processes, plus the query stage breakdown (queue/dispatch/solve/d2h).
+
+    ``--slowest`` closes the metric→trace loop: instead of a trace id,
+    read ``/metrics?exemplars=1`` output (``--metrics`` URL or file),
+    take the highest-valued latency exemplar (optionally pinned to one
+    ``--stage``), and reassemble *that* trace — from "the histogram says
+    something was slow" to the full cross-process timeline of the slow
+    request, no log spelunking for the id."""
     from .resilience.errors import EXIT_OK, EXIT_VIOLATIONS
+
+    if args.slowest:
+        from .observe.export import parse_exemplars
+
+        if not args.metrics:
+            raise SystemExit(
+                "trace: --slowest needs --metrics URL|FILE "
+                "(an exemplar-annotated /metrics source)"
+            )
+        exemplars = []
+        for source in args.metrics:
+            exemplars.extend(
+                parse_exemplars(_metrics_source_text(source))
+            )
+        if args.stage:
+            exemplars = [
+                e
+                for e in exemplars
+                if e["labels"].get("stage") == args.stage
+            ]
+        exemplars = [e for e in exemplars if e["exemplar"].get("trace_id")]
+        if not exemplars:
+            stage = f" for stage {args.stage!r}" if args.stage else ""
+            print(f"trace: no exemplars{stage} in the metrics source(s)",
+                  file=sys.stderr)
+            return EXIT_VIOLATIONS
+        best = max(exemplars, key=lambda e: e["value"])
+        args.trace_id = best["exemplar"]["trace_id"]
+        print(
+            f"slowest exemplar: {best['name']}"
+            f"{_fmt_labels(best['labels'])} = {best['value']:.6g}s "
+            f"-> trace {args.trace_id}"
+        )
+    elif not args.trace_id:
+        raise SystemExit("trace: give a TRACE_ID or use --slowest")
 
     spans: dict = {}  # span_id -> span-close line (+ source log)
     events = []  # non-span lines in the trace
@@ -1889,6 +1960,261 @@ def _run_fleet(args) -> int:
     if worst > args.burn_threshold:
         return EXIT_VIOLATIONS
     return EXIT_OK
+
+
+def cmd_jobs(args) -> int:
+    from .resilience.errors import KvTpuError
+
+    try:
+        with _observed(args):
+            return _run_jobs(args)
+    except KvTpuError as e:
+        return _diagnose(args, e)
+
+
+def _run_jobs(args) -> int:
+    """``kv-tpu jobs``: the fleet's in-flight long jobs. Every replica's
+    ``/healthz`` carries its process's live progress table (pass counters,
+    smoothed rates, ETAs — the :class:`~.observe.progress.ProgressTicker`
+    plane); this merges them into one table. A dead replica degrades to a
+    stderr note — the rest still render."""
+    from .observe.fleet import scrape_replica
+    from .observe.progress import render_jobs
+    from .resilience.errors import EXIT_OK
+
+    scrapes = [
+        scrape_replica(url, timeout=args.timeout) for url in args.replica
+    ]
+    jobs, down = [], []
+    for s in scrapes:
+        if not s.ok:
+            down.append({"url": s.url, "error": s.error})
+            continue
+        for j in (s.health or {}).get("jobs") or []:
+            jobs.append(dict(j, replica=s.url))
+    if args.json:
+        print(json.dumps({"jobs": jobs, "down": down}, sort_keys=True))
+        return EXIT_OK
+    if jobs:
+        for line in render_jobs(jobs):
+            print(line)
+    else:
+        print("no jobs in flight")
+    for d in down:
+        print(f"{d['url']}: DOWN ({d['error']})", file=sys.stderr)
+    return EXIT_OK
+
+
+def cmd_profile(args) -> int:
+    from .resilience.errors import KvTpuError
+
+    try:
+        with _observed(args):
+            return _run_profile(args)
+    except KvTpuError as e:
+        return _diagnose(args, e)
+
+
+def _run_profile(args) -> int:
+    """``kv-tpu profile``: on-demand bounded deep profiling. With
+    ``--replica`` it triggers a capture on a *running* replica
+    (``/profile?seconds=N`` — no restart); without, it captures in this
+    process into ``--dir``. Either way the capture is a bounded
+    ``jax.profiler`` trace, rate-limited so a scrape loop cannot DoS the
+    device, and recorded in the capture directory's manifest."""
+    from .resilience.errors import EXIT_OK, EXIT_VIOLATIONS
+
+    if args.replica:
+        from .serve.transport import ReplicationClient
+
+        client = ReplicationClient(
+            args.replica, timeout=max(args.timeout, args.seconds + 10.0)
+        )
+        result = client.profile(args.seconds)
+    else:
+        from .observe.spans import capture_profile
+
+        result = capture_profile(
+            args.seconds, trigger="cli", capture_dir=args.dir
+        )
+    if args.json:
+        print(json.dumps(result, sort_keys=True))
+        return (
+            EXIT_OK if result.get("outcome") == "ok" else EXIT_VIOLATIONS
+        )
+    outcome = result.get("outcome")
+    if outcome == "ok":
+        print(
+            f"captured {result.get('seconds')}s -> {result.get('path')} "
+            f"({result.get('files')} files)"
+        )
+        return EXIT_OK
+    if outcome == "rate-limited":
+        print(
+            f"profile: rate-limited, retry in "
+            f"{result.get('retry_after_s', 0.0):.1f}s",
+            file=sys.stderr,
+        )
+    else:
+        print(
+            f"profile: {outcome}: {result.get('reason', '-')}",
+            file=sys.stderr,
+        )
+    return EXIT_VIOLATIONS
+
+
+_SPARK_BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+def _spark(values, width: int = 16) -> str:
+    """Unicode sparkline over the last ``width`` samples; None samples
+    (scrape misses) render as gaps, a flat series as its floor block."""
+    vals = list(values)[-width:]
+    finite = [v for v in vals if v is not None]
+    if not finite:
+        return "-" * min(len(vals) or 1, width)
+    lo, hi = min(finite), max(finite)
+    span = hi - lo
+    out = []
+    for v in vals:
+        if v is None:
+            out.append(" ")
+        elif span <= 0:
+            out.append(_SPARK_BLOCKS[0])
+        else:
+            idx = int((v - lo) / span * (len(_SPARK_BLOCKS) - 1) + 0.5)
+            out.append(_SPARK_BLOCKS[min(len(_SPARK_BLOCKS) - 1, idx)])
+    return "".join(out)
+
+
+def cmd_top(args) -> int:
+    from .resilience.errors import KvTpuError
+
+    try:
+        with _observed(args):
+            return _run_top(args)
+    except KvTpuError as e:
+        return _diagnose(args, e)
+
+
+def _run_top(args) -> int:
+    """``kv-tpu top``: a live terminal dashboard over the scrape surface —
+    the fleet table, every in-flight job with its ETA bar, QPS / lag /
+    burn-rate sparklines per poll, and recent crash flight dumps. A dead
+    replica renders as a DOWN row and a gap in its sparklines; the rest of
+    the fleet keeps updating. ``--once`` renders a single frame (no screen
+    clearing) for scripts and tests."""
+    import collections
+    import time as _time
+
+    from .observe.fleet import (
+        SloMonitor,
+        parse_slo_spec,
+        render_fleet,
+        scrape_replica,
+    )
+    from .observe.progress import render_jobs
+    from .resilience.errors import EXIT_OK
+
+    try:
+        objectives = [
+            parse_slo_spec(s) for s in (args.slo or ["availability=0.999"])
+        ]
+    except ValueError as e:
+        raise SystemExit(f"top: {e}")
+    monitor = SloMonitor(objectives)
+    depth = 24
+    hist = {
+        url: {
+            "qps": collections.deque(maxlen=depth),
+            "lag": collections.deque(maxlen=depth),
+        }
+        for url in args.replica
+    }
+    burn_hist: collections.deque = collections.deque(maxlen=depth)
+    prev: dict = {}  # url -> (queries_total, monotonic ts)
+    frames = 0
+    try:
+        while True:
+            scrapes = [
+                scrape_replica(url, timeout=args.timeout)
+                for url in args.replica
+            ]
+            now = _time.monotonic()
+            for s in scrapes:
+                monitor.observe_scrape(s)
+                qps = None
+                if s.ok and s.metrics is not None:
+                    total = sum(
+                        v
+                        for _, v in s.metrics.get(
+                            "kvtpu_serve_queries_total", []
+                        )
+                    )
+                    p = prev.get(s.url)
+                    if p is not None and now > p[1]:
+                        qps = max(0.0, (total - p[0]) / (now - p[1]))
+                    prev[s.url] = (total, now)
+                hist[s.url]["qps"].append(qps)
+                hist[s.url]["lag"].append(s.lag_seconds)
+            burns = monitor.evaluate()
+            inf = float("inf")
+            burn_hist.append(
+                max(
+                    (
+                        b
+                        for per in burns.values()
+                        for b in per.values()
+                        if b != inf
+                    ),
+                    default=0.0,
+                )
+            )
+            lines = list(render_fleet(scrapes))
+            jobs, dumps = [], []
+            for s in scrapes:
+                if s.ok and s.health:
+                    jobs.extend(s.health.get("jobs") or [])
+                    dumps.extend(s.health.get("flight_dumps") or [])
+            lines.append("")
+            if jobs:
+                lines.append(f"jobs ({len(jobs)} in flight):")
+                lines.extend("  " + row for row in render_jobs(jobs))
+            else:
+                lines.append("jobs: none in flight")
+            lines.append("")
+            for s in scrapes:
+                h = hist[s.url]
+                last_qps = next(
+                    (v for v in reversed(h["qps"]) if v is not None), None
+                )
+                last_lag = next(
+                    (v for v in reversed(h["lag"]) if v is not None), None
+                )
+                qtxt = "-" if last_qps is None else f"{last_qps:.1f}"
+                ltxt = "-" if last_lag is None else f"{last_lag:.3f}"
+                lines.append(
+                    f"{s.url}  qps {_spark(h['qps'])} {qtxt}  "
+                    f"lag_s {_spark(h['lag'])} {ltxt}"
+                )
+            lines.append(
+                f"burn (worst finite)  {_spark(burn_hist)} "
+                f"{burn_hist[-1]:.3g}"
+            )
+            if dumps:
+                uniq = sorted(set(dumps), reverse=True)[:5]
+                lines.append("flight dumps: " + ", ".join(uniq))
+            frames += 1
+            if args.once:
+                print("\n".join(lines))
+                return EXIT_OK
+            sys.stdout.write("\x1b[2J\x1b[H" + "\n".join(lines) + "\n")
+            sys.stdout.flush()
+            if args.frames and frames >= args.frames:
+                return EXIT_OK
+            _time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return EXIT_OK
 
 
 def cmd_backends(_args) -> int:
@@ -2369,14 +2695,29 @@ def main(argv: Optional[list] = None) -> int:
         "query stage breakdown (queue/dispatch/solve/d2h)",
     )
     p.add_argument(
-        "trace_id",
+        "trace_id", nargs="?", default=None,
         help="the trace id to reassemble (16-hex, from any event line or "
-        "an X-Kvtpu-Trace header)",
+        "an X-Kvtpu-Trace header); omit with --slowest",
     )
     p.add_argument(
         "--log", action="append", default=[], required=True, metavar="FILE",
         help="a JSON event log to scan (repeatable — one per "
         "process/replica; duplicated spans from shared logs render once)",
+    )
+    p.add_argument(
+        "--slowest", action="store_true",
+        help="pick the trace id from the highest-valued latency exemplar "
+        "in --metrics instead of naming one",
+    )
+    p.add_argument(
+        "--stage", metavar="STAGE",
+        help="with --slowest: only consider exemplars whose stage label "
+        "matches (queue/dispatch/solve/d2h/total)",
+    )
+    p.add_argument(
+        "--metrics", action="append", default=[], metavar="URL|FILE",
+        help="exemplar source for --slowest: a replica base URL (fetches "
+        "/metrics?exemplars=1) or a saved metrics text file (repeatable)",
     )
     p.add_argument("--json", action="store_true")
     _add_obs_flags(p)
@@ -2411,6 +2752,85 @@ def main(argv: Optional[list] = None) -> int:
     p.add_argument("--json", action="store_true")
     _add_obs_flags(p)
     p.set_defaults(fn=cmd_fleet)
+
+    p = sub.add_parser(
+        "jobs",
+        help="merge every replica's in-flight long-job progress table "
+        "(pass counters, rates, ETAs) from /healthz into one view",
+    )
+    p.add_argument(
+        "--replica", action="append", default=[], required=True,
+        metavar="URL",
+        help="a replication server base URL (repeatable)",
+    )
+    p.add_argument(
+        "--timeout", type=float, default=5.0,
+        help="per-replica scrape timeout (seconds)",
+    )
+    p.add_argument("--json", action="store_true")
+    _add_obs_flags(p)
+    p.set_defaults(fn=cmd_jobs)
+
+    p = sub.add_parser(
+        "profile",
+        help="trigger a bounded on-demand jax.profiler capture — on a "
+        "running replica (--replica, no restart) or in this process",
+    )
+    p.add_argument(
+        "--replica", metavar="URL",
+        help="capture on this replication server via /profile?seconds=N "
+        "(default: capture locally)",
+    )
+    p.add_argument(
+        "--seconds", type=float, default=2.0,
+        help="capture duration (clamped to 0.01..60)",
+    )
+    p.add_argument(
+        "--dir", metavar="DIR",
+        help="local capture directory (default: $KVTPU_PROFILE_DIR or "
+        "kvtpu-profiles/)",
+    )
+    p.add_argument(
+        "--timeout", type=float, default=5.0,
+        help="HTTP timeout floor for --replica (raised to cover --seconds)",
+    )
+    p.add_argument("--json", action="store_true")
+    _add_obs_flags(p)
+    p.set_defaults(fn=cmd_profile)
+
+    p = sub.add_parser(
+        "top",
+        help="live fleet dashboard: replica table, in-flight jobs with "
+        "ETA bars, qps/lag/burn sparklines, recent flight dumps",
+    )
+    p.add_argument(
+        "--replica", action="append", default=[], required=True,
+        metavar="URL",
+        help="a replication server base URL (repeatable)",
+    )
+    p.add_argument(
+        "--interval", type=float, default=2.0,
+        help="refresh period in live mode (seconds)",
+    )
+    p.add_argument(
+        "--once", action="store_true",
+        help="render one frame to stdout (no screen clearing) and exit",
+    )
+    p.add_argument(
+        "--frames", type=int, default=0, metavar="N",
+        help="stop after N live frames (0 = run until interrupted)",
+    )
+    p.add_argument(
+        "--slo", action="append", default=[], metavar="SPEC",
+        help="objective spec for the burn sparkline (as in kv-tpu fleet; "
+        "default availability=0.999)",
+    )
+    p.add_argument(
+        "--timeout", type=float, default=5.0,
+        help="per-replica scrape timeout (seconds)",
+    )
+    _add_obs_flags(p)
+    p.set_defaults(fn=cmd_top)
 
     p = sub.add_parser("backends", help="list available backends")
     p.set_defaults(fn=cmd_backends)

@@ -36,7 +36,16 @@ compatibility.
 """
 from __future__ import annotations
 
-from . import fleet, flight, history, introspect, metrics, sentinel, telemetry
+from . import (
+    fleet,
+    flight,
+    history,
+    introspect,
+    metrics,
+    progress,
+    sentinel,
+    telemetry,
+)
 from .events import (
     Clock,
     configure_logging,
@@ -45,7 +54,13 @@ from .events import (
     logger,
     set_clock,
 )
-from .export import dump_registry, parse_prometheus, to_prometheus, write_metrics
+from .export import (
+    dump_registry,
+    parse_exemplars,
+    parse_prometheus,
+    to_prometheus,
+    write_metrics,
+)
 from .fleet import (
     ReplicaScrape,
     SloMonitor,
@@ -94,13 +109,19 @@ from .registry import (
     Histogram,
     MetricsRegistry,
 )
+from .progress import ProgressTicker, active_jobs, eta_bar, render_jobs
 from .spans import (
+    PROFILE_DIR_ENV,
     TRACE_HEADER,
     Phases,
     Span,
     add_span_sink,
+    capture_profile,
     current_span,
     current_trace_id,
+    install_profile_from_env,
+    install_profile_signal,
+    load_capture_manifest,
     parse_trace_header,
     profile_to,
     remove_span_sink,
@@ -109,6 +130,7 @@ from .spans import (
     trace_context,
     trace_headers,
     trace_to_dir,
+    uninstall_profile_signal,
 )
 from .telemetry import (
     TelemetrySampler,
@@ -198,4 +220,17 @@ __all__ = [
     "SloObjective",
     "SloMonitor",
     "parse_slo_spec",
+    # deep observability plane (progress + on-demand capture + exemplars)
+    "progress",
+    "ProgressTicker",
+    "active_jobs",
+    "render_jobs",
+    "eta_bar",
+    "PROFILE_DIR_ENV",
+    "capture_profile",
+    "load_capture_manifest",
+    "install_profile_signal",
+    "uninstall_profile_signal",
+    "install_profile_from_env",
+    "parse_exemplars",
 ]

@@ -12,6 +12,7 @@ __all__ = [
     "write_metrics",
     "to_prometheus",
     "parse_prometheus",
+    "parse_exemplars",
 ]
 
 
@@ -70,10 +71,17 @@ def _num(v: float) -> str:
     return repr(float(v))
 
 
-def to_prometheus(registry: Optional[MetricsRegistry] = None) -> str:
+def to_prometheus(
+    registry: Optional[MetricsRegistry] = None, *, exemplars: bool = False
+) -> str:
     """Render the registry in the Prometheus text exposition format
     (version 0.0.4): HELP/TYPE headers, one sample per line, histograms as
-    cumulative ``_bucket{le=...}`` plus ``_sum``/``_count``."""
+    cumulative ``_bucket{le=...}`` plus ``_sum``/``_count``.
+
+    With ``exemplars=True``, bucket lines that retained a slowest-in-window
+    exemplar grow an OpenMetrics annotation (`` # {trace_id="..."} value
+    ts``) — opt-in, so the default output stays byte-identical for parsers
+    that predate exemplar support."""
     reg = REGISTRY if registry is None else registry
     lines = []
     for m in reg.collect():
@@ -83,11 +91,18 @@ def to_prometheus(registry: Optional[MetricsRegistry] = None) -> str:
         for key in sorted(children):
             child = children[key]
             if m.kind == "histogram":
-                for ub, n in child.cumulative_buckets():
+                per_bucket = child.exemplars() if exemplars else None
+                for i, (ub, n) in enumerate(child.cumulative_buckets()):
                     le = f'le="{_format_le(ub)}"'
-                    lines.append(
-                        f"{m.name}_bucket{_labels_text(key, le)} {n}"
-                    )
+                    line = f"{m.name}_bucket{_labels_text(key, le)} {n}"
+                    ex = per_bucket[i] if per_bucket else None
+                    if ex is not None:
+                        value, trace_id, ts = ex
+                        line += (
+                            f' # {{trace_id="{_escape(trace_id)}"}} '
+                            f"{_num(value)} {ts:.3f}"
+                        )
+                    lines.append(line)
                 lines.append(
                     f"{m.name}_sum{_labels_text(key)} {_num(child.sum)}"
                 )
@@ -141,6 +156,11 @@ def parse_prometheus(text: str) -> dict:
         line = line.strip()
         if not line or line.startswith("#"):
             continue
+        # drop any OpenMetrics exemplar annotation — the sample value is
+        # everything before it, and pre-exemplar parsers must keep working
+        cut = line.rfind(" # {")
+        if cut != -1:
+            line = line[:cut].rstrip()
         try:
             if "{" in line:
                 name, rest = line.split("{", 1)
@@ -154,3 +174,47 @@ def parse_prometheus(text: str) -> dict:
             continue
         samples.setdefault(name.strip(), []).append((labels, value))
     return samples
+
+
+def parse_exemplars(text: str) -> list:
+    """Extract the OpenMetrics exemplar annotations from a text exposition
+    (the ``to_prometheus(..., exemplars=True)`` / ``/metrics?exemplars=1``
+    form): one dict per annotated sample line with the sample ``name``, its
+    ``labels``, the ``exemplar`` labels (``trace_id``), the exemplar
+    ``value`` (the observation, not the cumulative bucket count) and its
+    wall ``ts``. Unparseable lines are skipped — same contract as
+    :func:`parse_prometheus`."""
+    out: list = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        cut = line.rfind(" # {")
+        if cut == -1:
+            continue
+        sample, annotation = line[:cut].rstrip(), line[cut + len(" # ") :]
+        try:
+            if "{" in sample:
+                name, rest = sample.split("{", 1)
+                label_text, _, _ = rest.rpartition("}")
+                labels = _parse_labels(label_text)
+            else:
+                name, _, _ = sample.partition(" ")
+                labels = {}
+            ex_text, _, tail = annotation.lstrip("{").partition("}")
+            ex_labels = _parse_labels(ex_text) if ex_text else {}
+            parts = tail.split()
+            value = float(parts[0].replace("+Inf", "inf"))
+            ts = float(parts[1]) if len(parts) > 1 else None
+        except Exception:
+            continue
+        out.append(
+            {
+                "name": name.strip(),
+                "labels": labels,
+                "exemplar": ex_labels,
+                "value": value,
+                "ts": ts,
+            }
+        )
+    return out

@@ -42,6 +42,7 @@ __all__ = [
     "uninstall",
     "installed",
     "trigger_dump",
+    "recent_dumps",
     "load_dump",
     "render_dump",
     "FLIGHT_SCHEMA",
@@ -240,10 +241,17 @@ def install(
     rec.attach()
     _RECORDER = rec  # kvtpu: ignore[concurrency-hygiene] single atomic reference rebind; trigger_dump tolerates either value
     if with_signal and hasattr(signal, "SIGUSR2"):
+
+        def _handler(signum, frame):
+            trigger_dump("sigusr2")
+            # chain any pre-existing Python handler: arming the recorder
+            # must not silently disable another subsystem's SIGUSR2
+            prev = _prev_sigusr2
+            if callable(prev):
+                prev(signum, frame)
+
         try:
-            _prev_sigusr2 = signal.signal(  # kvtpu: ignore[concurrency-hygiene] install/uninstall run on the main thread only
-                signal.SIGUSR2, lambda signum, frame: trigger_dump("sigusr2")
-            )
+            _prev_sigusr2 = signal.signal(signal.SIGUSR2, _handler)  # kvtpu: ignore[concurrency-hygiene] install/uninstall run on the main thread only
         except ValueError:  # not the main thread — programmatic triggers only
             _prev_sigusr2 = None  # kvtpu: ignore[concurrency-hygiene] install/uninstall run on the main thread only
     return rec
@@ -285,6 +293,32 @@ def trigger_dump(trigger: str, **info) -> Optional[str]:
 
 
 # -- reading dumps back (kv-tpu recover / tests) -------------------------
+
+
+def recent_dumps(
+    directory: Optional[str] = None, limit: int = 5
+) -> List[str]:
+    """Newest-first ``flight-*.json`` paths under ``directory`` (default:
+    the installed recorder's directory, else ``KVTPU_FLIGHT_DIR``); [] when
+    nothing is armed or nothing was dumped — the list ``/healthz`` and
+    ``kv-tpu top`` surface."""
+    if directory is None:
+        rec = _RECORDER
+        directory = rec.directory if rec is not None else os.environ.get(
+            FLIGHT_DIR_ENV
+        )
+    if not directory:
+        return []
+    try:
+        names = [
+            n
+            for n in os.listdir(directory)
+            if n.startswith("flight-") and n.endswith(".json")
+        ]
+    except OSError:
+        return []
+    names.sort(reverse=True)
+    return [os.path.join(directory, n) for n in names[: max(limit, 0)]]
 
 
 def load_dump(path: str) -> dict:

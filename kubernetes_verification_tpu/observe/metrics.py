@@ -11,7 +11,7 @@ name (``_seconds``, ``_per_second``) — Prometheus conventions.
 """
 from __future__ import annotations
 
-from .registry import Counter, Gauge, Histogram
+from .registry import Counter, Gauge, Histogram, set_exemplar_counter
 
 __all__ = [
     "SPAN_SECONDS",
@@ -84,6 +84,12 @@ __all__ = [
     "LB_RETRIES_TOTAL",
     "FLIGHT_DUMPS_TOTAL",
     "SCRAPE_REQUESTS_TOTAL",
+    "PROGRESS_PASSES_TOTAL",
+    "PROGRESS_FRACTION",
+    "PROGRESS_ETA_SECONDS",
+    "PROGRESS_ACTIVE_JOBS",
+    "PROFILE_CAPTURES_TOTAL",
+    "TRACE_EXEMPLARS_TOTAL",
     "REQUIRED_FAMILIES",
 ]
 
@@ -655,6 +661,54 @@ SCRAPE_REQUESTS_TOTAL = Counter(
     ("endpoint",),
 )
 
+PROGRESS_PASSES_TOTAL = Counter(
+    "kvtpu_progress_passes_total",
+    "Pass boundaries a long-running multi-pass host loop crossed (closure "
+    "squaring passes, bounded-BFS levels, bootstrap files shipped, WAL "
+    "replay batches, checkpoint phases), by job name — the raw tick count "
+    "behind the ProgressTicker's rate/ETA estimates.",
+    ("job",),
+)
+
+PROGRESS_FRACTION = Gauge(
+    "kvtpu_progress_fraction",
+    "Completed fraction (0..1) of each in-flight long-running job, by job "
+    "name; -1 when the job's total is unknown (pure fixpoint loops with no "
+    "usable bound). `kv-tpu jobs` / `kv-tpu top` render this as the ETA "
+    "bar.",
+    ("job",),
+)
+
+PROGRESS_ETA_SECONDS = Gauge(
+    "kvtpu_progress_eta_seconds",
+    "Smoothed remaining-seconds estimate per in-flight long-running job "
+    "(exponential moving average of the per-pass rate, so one slow stripe "
+    "does not whipsaw the estimate); -1 while no rate is established.",
+    ("job",),
+)
+
+PROGRESS_ACTIVE_JOBS = Gauge(
+    "kvtpu_progress_active_jobs",
+    "Long-running jobs currently registered with the progress plane in "
+    "this process — nonzero means `kv-tpu jobs` has something to show.",
+)
+
+PROFILE_CAPTURES_TOTAL = Counter(
+    "kvtpu_profile_captures_total",
+    "Bounded on-demand jax.profiler captures completed, by trigger: "
+    "'sigusr1' (operator signal), 'http' (the /profile?seconds=N route), "
+    "'cli' (kv-tpu profile), 'api' (programmatic). Rate-limited attempts "
+    "and degraded (profiler-unavailable) attempts do not count.",
+    ("trigger",),
+)
+
+TRACE_EXEMPLARS_TOTAL = Counter(
+    "kvtpu_trace_exemplars_total",
+    "Histogram bucket exemplars recorded (a slowest-in-window observation "
+    "replaced the bucket's retained trace_id) — the write-side volume of "
+    "the metric-to-trace join `kv-tpu trace --slowest` reads.",
+)
+
 #: The frozen dashboard contract: families that must exist in every build.
 #: New families are appended here by the PR that introduces them; the
 #: `metrics-names` lint rule and `scripts/check_metrics_names.py` both fail
@@ -749,5 +803,17 @@ REQUIRED_FAMILIES = frozenset(
         "kvtpu_lb_retries_total",
         "kvtpu_flight_dumps_total",
         "kvtpu_scrape_requests_total",
+        # deep observability plane (observe/progress.py + on-demand
+        # profiler captures + histogram trace exemplars)
+        "kvtpu_progress_passes_total",
+        "kvtpu_progress_fraction",
+        "kvtpu_progress_eta_seconds",
+        "kvtpu_progress_active_jobs",
+        "kvtpu_profile_captures_total",
+        "kvtpu_trace_exemplars_total",
     }
 )
+
+# the registry cannot import this module (it is our import parent), so the
+# exemplar-volume counter is injected instead
+set_exemplar_counter(TRACE_EXEMPLARS_TOTAL)

@@ -20,16 +20,20 @@ from __future__ import annotations
 
 import re
 import threading
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 __all__ = [
     "METRIC_NAME_RE",
     "DEFAULT_BUCKETS",
+    "EXEMPLAR_WINDOW_SECONDS",
     "MetricsRegistry",
     "REGISTRY",
     "Counter",
     "Gauge",
     "Histogram",
+    "set_exemplar_provider",
+    "set_exemplar_counter",
 ]
 
 METRIC_NAME_RE = re.compile(r"^kvtpu_[a-z0-9_]+$")
@@ -42,6 +46,36 @@ DEFAULT_BUCKETS: Tuple[float, ...] = (
     0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
     1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0,
 )
+
+
+#: Exemplar retention window (seconds): within it a bucket keeps the
+#: trace_id of its *slowest* observation; once the retained exemplar ages
+#: past the window any newer observation replaces it, so a scrape always
+#: joins to a recent trace instead of an hours-old outlier.
+EXEMPLAR_WINDOW_SECONDS = 300.0
+
+#: () -> Optional[str] returning the active trace_id, installed by
+#: ``observe.spans`` — the registry stays stdlib-only and import-cycle-free
+#: (spans imports metrics imports this module) by receiving the provider
+#: instead of importing it.
+_exemplar_provider = None
+
+#: .inc()-able counter (``kvtpu_trace_exemplars_total``), installed by
+#: ``observe.metrics`` for the same cycle reason.
+_exemplar_counter = None
+
+
+def set_exemplar_provider(provider) -> None:
+    """Install (or clear, with None) the trace-id source histograms consult
+    when retaining bucket exemplars."""
+    global _exemplar_provider
+    _exemplar_provider = provider  # kvtpu: ignore[concurrency-hygiene] single atomic reference rebind; observers tolerate either value
+
+
+def set_exemplar_counter(counter) -> None:
+    """Install the counter bumped whenever a bucket exemplar is retained."""
+    global _exemplar_counter
+    _exemplar_counter = counter  # kvtpu: ignore[concurrency-hygiene] single atomic reference rebind; observers tolerate either value
 
 
 def _label_key(labelnames: Sequence[str], labels: Dict[str, str]) -> str:
@@ -101,7 +135,7 @@ class _GaugeChild(_Child):
 
 
 class _HistogramChild(_Child):
-    __slots__ = ("_uppers", "_counts", "_sum", "_count", "_last")
+    __slots__ = ("_uppers", "_counts", "_sum", "_count", "_last", "_exemplars")
 
     def __init__(self, lock, uppers: Tuple[float, ...]) -> None:
         super().__init__(lock)
@@ -110,17 +144,45 @@ class _HistogramChild(_Child):
         self._sum = 0.0
         self._count = 0
         self._last: Optional[float] = None
+        # per-bucket (value, trace_id, wall_ts) of the slowest observation
+        # inside the retention window, None where no traced observation
+        # landed yet — aligned with _uppers
+        self._exemplars: List[Optional[Tuple[float, str, float]]] = (
+            [None] * len(uppers)
+        )
 
     def observe(self, value: float) -> None:
         value = float(value)
+        trace_id = None
+        provider = _exemplar_provider
+        if provider is not None:
+            try:
+                trace_id = provider()
+            except Exception:  # the exemplar tap must never fail an observe
+                trace_id = None
+        retained = False
         with self._lock:
+            idx = None
             for i, ub in enumerate(self._uppers):
                 if value <= ub:
                     self._counts[i] += 1
+                    idx = i
                     break
             self._sum += value
             self._count += 1
             self._last = value
+            if trace_id is not None and idx is not None:
+                ex = self._exemplars[idx]
+                now = time.time()
+                if (
+                    ex is None
+                    or value >= ex[0]
+                    or now - ex[2] > EXEMPLAR_WINDOW_SECONDS
+                ):
+                    self._exemplars[idx] = (value, trace_id, now)
+                    retained = True
+        if retained and _exemplar_counter is not None:
+            _exemplar_counter.inc()
 
     @property
     def count(self) -> int:
@@ -143,6 +205,12 @@ class _HistogramChild(_Child):
             acc += c
             out.append((ub, acc))
         return out
+
+    def exemplars(self) -> List[Optional[Tuple[float, str, float]]]:
+        """Per-bucket retained (value, trace_id, wall_ts), aligned with the
+        bucket upper bounds; None where no traced observation landed."""
+        with self._lock:
+            return list(self._exemplars)
 
 
 class _Metric:

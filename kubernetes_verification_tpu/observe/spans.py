@@ -29,14 +29,18 @@ timelines without guessing which clock a line was stamped from.
 from __future__ import annotations
 
 import contextlib
+import json
 import os
+import signal
 import sys
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Dict, Iterator, Optional, Tuple
 
 from .events import get_clock, log_event, set_context_provider
-from .metrics import SPAN_SECONDS
+from .metrics import PROFILE_CAPTURES_TOTAL, SPAN_SECONDS
+from .registry import set_exemplar_provider
 
 __all__ = [
     "Span",
@@ -54,6 +58,13 @@ __all__ = [
     "profile_to",
     "trace_to_dir",
     "set_memory_hook",
+    "PROFILE_DIR_ENV",
+    "capture_profile",
+    "load_capture_manifest",
+    "install_profile_signal",
+    "uninstall_profile_signal",
+    "install_profile_from_env",
+    "reset_profile_rate_limit",
 ]
 
 #: HTTP header carrying trace context over the wire: ``<trace_id>-<span_id>``
@@ -215,6 +226,9 @@ def _trace_fields() -> Dict[str, object]:
 
 
 set_context_provider(_trace_fields)
+# histograms retain the slowest-in-window trace id per bucket; the registry
+# cannot import us (cycle), so it receives the trace-id source here
+set_exemplar_provider(current_trace_id)
 
 
 def _device_annotation(name: str):
@@ -375,3 +389,240 @@ def profile_to(log_dir: str) -> Iterator[None]:
 
 #: the name ISSUE/older docs use for the same facility
 trace_to_dir = profile_to
+
+
+# ---------------------------------------------------- on-demand deep capture
+#: environment variable arming the SIGUSR1 capture handler in subprocess
+#: harnesses (same regime as the flight recorder's KVTPU_FLIGHT_DIR)
+PROFILE_DIR_ENV = "KVTPU_PROFILE_DIR"
+
+#: minimum seconds between completed captures (override with
+#: KVTPU_PROFILE_MIN_INTERVAL or a ``min_interval`` argument): a scrape
+#: loop hammering /profile must not keep the device profiler permanently
+#: on
+DEFAULT_CAPTURE_MIN_INTERVAL = 30.0
+
+#: bound on one capture window — /profile?seconds=N is operator-facing and
+#: a typo must not profile for an hour
+MAX_CAPTURE_SECONDS = 60.0
+
+CAPTURE_MANIFEST = "manifest.json"
+
+_capture_lock = threading.Lock()
+_last_capture_perf: Optional[float] = None
+
+
+def reset_profile_rate_limit() -> None:
+    """Forget the last capture time (tests; also after reconfiguring the
+    interval)."""
+    global _last_capture_perf
+    with _capture_lock:
+        _last_capture_perf = None
+
+
+def _capture_min_interval(min_interval: Optional[float]) -> float:
+    if min_interval is not None:
+        return float(min_interval)
+    raw = os.environ.get("KVTPU_PROFILE_MIN_INTERVAL")
+    try:
+        return float(raw) if raw else DEFAULT_CAPTURE_MIN_INTERVAL
+    except ValueError:
+        return DEFAULT_CAPTURE_MIN_INTERVAL
+
+
+def _capture_file_count(path: str) -> int:
+    total = 0
+    for _dir, _sub, files in os.walk(path):
+        total += len(files)
+    return total
+
+
+def load_capture_manifest(capture_dir: str) -> list:
+    """The capture dir's manifest entries (newest last); [] when no capture
+    has completed there."""
+    try:
+        with open(os.path.join(capture_dir, CAPTURE_MANIFEST)) as fh:
+            entries = json.load(fh)
+    except (OSError, ValueError):
+        return []
+    return entries if isinstance(entries, list) else []
+
+
+def _append_manifest(capture_dir: str, entry: dict) -> None:
+    # caller holds _capture_lock; atomic replace so a reader mid-capture
+    # never sees a torn manifest
+    entries = load_capture_manifest(capture_dir)
+    entries.append(entry)
+    path = os.path.join(capture_dir, CAPTURE_MANIFEST)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(entries, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+
+
+def capture_profile(
+    seconds: float,
+    *,
+    trigger: str = "api",
+    capture_dir: Optional[str] = None,
+    min_interval: Optional[float] = None,
+) -> dict:
+    """One bounded ``jax.profiler`` capture: ``start_trace``, wait
+    ``seconds`` (clamped to :data:`MAX_CAPTURE_SECONDS`), ``stop_trace``,
+    record the capture in ``<capture_dir>/manifest.json``.
+
+    Returns a JSON-safe outcome dict and never raises: ``ok`` (path,
+    seconds, file count), ``rate-limited`` (a capture completed less than
+    ``min_interval`` ago — the device is not re-profiled), or ``skipped``
+    (jax or its profiler unavailable; the triggering surface stays up).
+    Completed captures count into
+    ``kvtpu_profile_captures_total{trigger}``."""
+    global _last_capture_perf
+    seconds = min(max(float(seconds), 0.01), MAX_CAPTURE_SECONDS)
+    capture_dir = (
+        capture_dir
+        or os.environ.get(PROFILE_DIR_ENV)
+        or os.path.join(os.getcwd(), "kvtpu-profiles")
+    )
+    interval = _capture_min_interval(min_interval)
+    with _capture_lock:
+        now = get_clock().perf()
+        if (
+            _last_capture_perf is not None
+            and now - _last_capture_perf < interval
+        ):
+            retry = interval - (now - _last_capture_perf)
+            log_event(
+                "profile_rate_limited",
+                trigger=trigger,
+                retry_after_s=round(retry, 3),
+            )
+            return {
+                "outcome": "rate-limited",
+                "trigger": trigger,
+                "retry_after_s": round(retry, 3),
+            }
+        try:
+            import jax
+        except Exception as e:  # pragma: no cover - exercised without jax
+            log_event(
+                "profile_skipped", trigger=trigger,
+                reason=f"{type(e).__name__}: {e}",
+            )
+            return {"outcome": "skipped", "trigger": trigger,
+                    "reason": "jax unavailable"}
+        wall = get_clock().wall()
+        path = os.path.join(
+            capture_dir, f"capture-{int(wall * 1000)}-{trigger}"
+        )
+        os.makedirs(path, exist_ok=True)
+        try:
+            jax.profiler.start_trace(path)
+        except Exception as e:
+            log_event(
+                "profile_skipped", trigger=trigger,
+                reason=f"{type(e).__name__}: {e}", path=path,
+            )
+            return {"outcome": "skipped", "trigger": trigger,
+                    "reason": f"{type(e).__name__}: {e}"}
+        time.sleep(seconds)
+        try:
+            jax.profiler.stop_trace()
+        except Exception as e:
+            log_event(
+                "profile_skipped", trigger=trigger,
+                reason=f"{type(e).__name__}: {e}", path=path,
+            )
+            return {"outcome": "skipped", "trigger": trigger,
+                    "reason": f"{type(e).__name__}: {e}"}
+        _last_capture_perf = get_clock().perf()
+        entry = {
+            "path": path,
+            "trigger": trigger,
+            "seconds": seconds,
+            "ts": wall,
+            "files": _capture_file_count(path),
+        }
+        _append_manifest(capture_dir, entry)
+    PROFILE_CAPTURES_TOTAL.labels(trigger=trigger).inc()
+    log_event("profile_capture", **entry)
+    return {"outcome": "ok", **entry}
+
+
+_prev_sigusr1 = None
+_sigusr1_config: Optional[tuple] = None
+_last_sigusr1_thread: Optional[threading.Thread] = None
+
+
+def install_profile_signal(
+    capture_dir: Optional[str] = None,
+    seconds: float = 2.0,
+    min_interval: Optional[float] = None,
+) -> bool:
+    """Bind SIGUSR1 to a bounded profiler capture (in a worker thread — a
+    signal handler must not block the main thread for the whole window).
+
+    Chains any pre-existing Python handler: the profile capture fires AND
+    the previous handler still runs, so arming deep profiling never
+    disables another subsystem's signal (the flight recorder does the same
+    on SIGUSR2). Returns False where signals cannot be bound (no SIGUSR1 on
+    the platform, or not the main thread)."""
+    global _prev_sigusr1, _sigusr1_config
+    uninstall_profile_signal()
+    _sigusr1_config = (capture_dir, float(seconds), min_interval)  # kvtpu: ignore[concurrency-hygiene] install/uninstall run on the main thread only
+    if not hasattr(signal, "SIGUSR1"):
+        return False
+
+    def _handler(signum, frame):
+        global _last_sigusr1_thread
+        cfg = _sigusr1_config
+        if cfg is not None:
+            t = threading.Thread(
+                target=capture_profile,
+                args=(cfg[1],),
+                kwargs={
+                    "trigger": "sigusr1",
+                    "capture_dir": cfg[0],
+                    "min_interval": cfg[2],
+                },
+                daemon=True,
+                name="kvtpu-profile-capture",
+            )
+            _last_sigusr1_thread = t  # kvtpu: ignore[concurrency-hygiene] signal handlers run on the main thread only
+            t.start()
+        prev = _prev_sigusr1
+        if callable(prev):
+            prev(signum, frame)
+
+    try:
+        _prev_sigusr1 = signal.signal(signal.SIGUSR1, _handler)  # kvtpu: ignore[concurrency-hygiene] install/uninstall run on the main thread only
+    except ValueError:  # not the main thread — HTTP/CLI triggers still work
+        _prev_sigusr1 = None  # kvtpu: ignore[concurrency-hygiene] install/uninstall run on the main thread only
+        _sigusr1_config = None  # kvtpu: ignore[concurrency-hygiene] install/uninstall run on the main thread only
+        return False
+    return True
+
+
+def uninstall_profile_signal() -> None:
+    """Restore the previous SIGUSR1 disposition (tests; also the first half
+    of re-install)."""
+    global _prev_sigusr1, _sigusr1_config
+    _sigusr1_config = None  # kvtpu: ignore[concurrency-hygiene] install/uninstall run on the main thread only
+    if _prev_sigusr1 is not None and hasattr(signal, "SIGUSR1"):
+        try:
+            signal.signal(signal.SIGUSR1, _prev_sigusr1)
+        except ValueError:
+            pass
+        _prev_sigusr1 = None  # kvtpu: ignore[concurrency-hygiene] install/uninstall run on the main thread only
+
+
+def install_profile_from_env() -> bool:
+    """Arm the SIGUSR1 capture handler from ``KVTPU_PROFILE_DIR`` — the
+    zero-flag hook subprocess harnesses call at startup."""
+    directory = os.environ.get(PROFILE_DIR_ENV)
+    if not directory:
+        return False
+    return install_profile_signal(directory)

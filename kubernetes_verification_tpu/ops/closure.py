@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..observe.metrics import CLOSURE_ITERATIONS, DELTA_CLOSURE_ROUNDS
+from ..observe.progress import ProgressTicker
 from ..resilience.errors import ConfigError
 
 __all__ = [
@@ -159,7 +160,14 @@ def _packed_pair_total(packed: jnp.ndarray) -> int:
 
 
 def packed_closure(
-    packed, *, tile: int = 7168, max_iter: int = 32, dst_tile: int = 14336
+    packed,
+    *,
+    tile: int = 7168,
+    max_iter: int = 32,
+    dst_tile: int = 14336,
+    checkpoint_dir=None,
+    checkpoint_every: int = 0,
+    resume: bool = False,
 ):
     """Transitive closure of a bit-packed reachability matrix
     (``uint32 [Np, Np/32]``, Np a multiple of 32 — the layout
@@ -180,7 +188,20 @@ def packed_closure(
     run to run far beyond the ±30% noise of compute-bound kernels (the
     synthetic A/B measured the same step at 55 s/pass — between the two).
     The restructure removes that O(N³/tile) unpack term; per-pass spread
-    across reps is now <1% (see ``bench.py --mode closure``)."""
+    across reps is now <1% (see ``bench.py --mode closure``).
+
+    The loop drives a :class:`~..observe.progress.ProgressTicker` (job
+    ``packed_closure``, total = the ⌈log₂N⌉ pass bound — an upper bound,
+    so early fixpoints finish ``converged`` below fraction 1.0), feeding
+    ``kv-tpu jobs`` / ``/healthz`` with live pass counts and a smoothed
+    ETA. With ``checkpoint_dir`` set and ``checkpoint_every`` > 0, the
+    ticker's pass-boundary callback commits an atomic closure checkpoint
+    (packed matrix + pass counter) every that many passes via
+    :meth:`~..serve.durability.CheckpointManager.checkpoint_closure`;
+    ``resume=True`` restarts from the newest valid one (falling back to
+    the given ``packed`` at pass 0 when the ladder is empty or damaged)
+    — a killed multi-hour closure re-runs only the passes after its last
+    checkpoint."""
     packed = jnp.asarray(packed)
     N, W = packed.shape
     if N != W * 32:
@@ -190,16 +211,68 @@ def packed_closure(
         )
     if N == 0:
         return packed
+    start_pass = 0
+    cm = None
+    if checkpoint_dir:
+        from ..serve.durability import (
+            CheckpointManager,
+            load_closure_checkpoint,
+        )
+
+        cm = CheckpointManager(checkpoint_dir)
+        if resume:
+            from ..resilience.errors import PersistError
+
+            try:
+                arr, start_pass, _manifest = load_closure_checkpoint(
+                    checkpoint_dir
+                )
+                if tuple(arr.shape) != (N, W):
+                    raise ConfigError(
+                        f"closure checkpoint shape {tuple(arr.shape)} != "
+                        f"input shape {(N, W)}"
+                    )
+                packed = jnp.asarray(arr)
+            except PersistError:
+                start_pass = 0
     t = _fit_tile(N, tile)
     dt = _fit_tile(N, dst_tile)
     total = _packed_pair_total(packed)
-    for _ in range(max_iter):
-        CLOSURE_ITERATIONS.inc()
-        packed = _packed_square_step(packed, row_tile=t, dst_tile=dt)
-        new_total = _packed_pair_total(packed)
-        if new_total == total:
-            break
-        total = new_total
+    state = {"packed": packed, "pairs": total}
+
+    def _maybe_checkpoint(done: int) -> None:
+        if cm is not None and checkpoint_every > 0 and (
+            done % checkpoint_every == 0
+        ):
+            cm.checkpoint_closure(
+                np.asarray(state["packed"]), done, pairs=state["pairs"]
+            )
+
+    bound = max(1, math.ceil(math.log2(max(N, 2))))
+    ticker = ProgressTicker(
+        "packed_closure",
+        total=min(bound, max_iter) if max_iter else bound,
+        unit="pass",
+        initial=start_pass,
+        on_pass=_maybe_checkpoint,
+    )
+    converged = False
+    try:
+        for _ in range(start_pass, max_iter):
+            CLOSURE_ITERATIONS.inc()
+            packed = _packed_square_step(packed, row_tile=t, dst_tile=dt)
+            new_total = _packed_pair_total(packed)
+            state["packed"] = packed
+            state["pairs"] = new_total
+            ticker.tick(pairs=new_total)
+            if new_total == total:
+                converged = True
+                break
+            total = new_total
+    except BaseException:
+        ticker.finish("error")
+        raise
+    ticker.finish("converged" if converged else "done", pairs=total)
     return packed
 
 
@@ -401,21 +474,25 @@ def packed_closure_delta(
         C = prev | new_base
         kg = max(32, min(row_group, N))
         total = _packed_pair_total(C)
-        for _ in range(max_iter):
-            DELTA_CLOSURE_ROUNDS.inc()
-            for i in range(0, len(rows_np), kg):
-                g = rows_np[i : i + kg]
-                pad = kg - len(g)
-                idx = np.concatenate(
-                    [g, np.repeat(g[-1:], pad)]
-                ).astype(np.int32)
-                C = _add_edges_round(
-                    C, added, jnp.asarray(idx), tile=dstt_add
-                )
-            new_total = _packed_pair_total(C)
-            if new_total == total:
-                break
-            total = new_total
+        with ProgressTicker(
+            "packed_closure_delta", unit="round"
+        ) as ticker:
+            for _ in range(max_iter):
+                DELTA_CLOSURE_ROUNDS.inc()
+                for i in range(0, len(rows_np), kg):
+                    g = rows_np[i : i + kg]
+                    pad = kg - len(g)
+                    idx = np.concatenate(
+                        [g, np.repeat(g[-1:], pad)]
+                    ).astype(np.int32)
+                    C = _add_edges_round(
+                        C, added, jnp.asarray(idx), tile=dstt_add
+                    )
+                new_total = _packed_pair_total(C)
+                ticker.tick(pairs=new_total)
+                if new_total == total:
+                    break
+                total = new_total
         return C
     # removals present: rows whose old paths may route through a touched
     # node restart from the base (suspect analysis)
@@ -429,24 +506,29 @@ def packed_closure_delta(
     changed = np.asarray(_rows_differ(seed, prev))
     packed = seed
     kg = max(32, min(row_group, N))
-    for _ in range(max_iter):
-        if not changed.any():
-            break
-        DELTA_CLOSURE_ROUNDS.inc()
-        frontier = (
-            np.asarray(_rows_touching(packed, pack_mask(changed))) | changed
-        )
-        rows = np.nonzero(frontier)[0]
-        nxt = np.zeros(N, dtype=bool)
-        for i in range(0, len(rows), kg):
-            g = rows[i : i + kg]
-            pad = kg - len(g)
-            idx = np.concatenate([g, np.repeat(g[-1:], pad)]).astype(np.int32)
-            packed, ch = _closure_rows_step(
-                packed, jnp.asarray(idx), tile=dstt
+    with ProgressTicker("packed_closure_delta", unit="round") as ticker:
+        for _ in range(max_iter):
+            if not changed.any():
+                break
+            DELTA_CLOSURE_ROUNDS.inc()
+            frontier = (
+                np.asarray(_rows_touching(packed, pack_mask(changed)))
+                | changed
             )
-            nxt[g] |= np.asarray(ch)[: len(g)]
-        changed = nxt
+            rows = np.nonzero(frontier)[0]
+            nxt = np.zeros(N, dtype=bool)
+            for i in range(0, len(rows), kg):
+                g = rows[i : i + kg]
+                pad = kg - len(g)
+                idx = np.concatenate(
+                    [g, np.repeat(g[-1:], pad)]
+                ).astype(np.int32)
+                packed, ch = _closure_rows_step(
+                    packed, jnp.asarray(idx), tile=dstt
+                )
+                nxt[g] |= np.asarray(ch)[: len(g)]
+            changed = nxt
+            ticker.tick(frontier_rows=int(len(rows)))
     return packed
 
 
@@ -539,21 +621,28 @@ def bounded_packed_closure(
         any_fresh = bool(np.asarray(_any_bits(frontier)))
     level = 1
     limit = int(hops) if hops is not None else N
-    while any_fresh and level < limit:
-        CLOSURE_BOUNDED_LEVELS.inc()
-        nxt = _bounded_frontier_step(packed, frontier, tile=t)
-        fresh = nxt & ~acc
-        acc = acc | fresh
-        frontier = fresh
-        level += 1
-        if want_hops:
-            from ..ops.tiled import unpack_cols
+    with ProgressTicker(
+        "bounded_closure",
+        total=limit if hops is not None else None,
+        unit="level",
+        initial=1,
+    ) as ticker:
+        while any_fresh and level < limit:
+            CLOSURE_BOUNDED_LEVELS.inc()
+            nxt = _bounded_frontier_step(packed, frontier, tile=t)
+            fresh = nxt & ~acc
+            acc = acc | fresh
+            frontier = fresh
+            level += 1
+            if want_hops:
+                from ..ops.tiled import unpack_cols
 
-            fresh_np = unpack_cols(np.asarray(fresh), N)
-            hop[fresh_np] = level
-            any_fresh = bool(fresh_np.any())
-        else:
-            any_fresh = bool(np.asarray(_any_bits(fresh)))
+                fresh_np = unpack_cols(np.asarray(fresh), N)
+                hop[fresh_np] = level
+                any_fresh = bool(fresh_np.any())
+            else:
+                any_fresh = bool(np.asarray(_any_bits(fresh)))
+            ticker.tick(level)
     return acc, hop
 
 
@@ -588,23 +677,30 @@ def bounded_closure_rows(
     frontier = acc.copy()
     level = 1
     limit = int(hops) if hops is not None else n
-    while frontier.any() and level < limit:
-        CLOSURE_BOUNDED_LEVELS.inc()
-        # nodes on any seed's frontier; their rows are fetched once and
-        # OR-combined per seed by a [K, c] × [c, n] uint8 dot, chunked so
-        # the oracle transient stays bounded
-        U = np.nonzero(frontier.any(axis=0))[0]
-        nxt = np.zeros((K, n), bool)
-        for i in range(0, len(U), chunk):
-            u = U[i : i + chunk]
-            R = np.asarray(row_fn(u), dtype=np.uint8).reshape(len(u), n)
-            memb = frontier[:, u].astype(np.uint8)
-            nxt |= (memb @ R) > 0
-        fresh = nxt & ~acc
-        acc |= fresh
-        hop[fresh] = level + 1
-        frontier = fresh
-        level += 1
+    with ProgressTicker(
+        "bounded_closure_rows",
+        total=limit if hops is not None else None,
+        unit="level",
+        initial=1,
+    ) as ticker:
+        while frontier.any() and level < limit:
+            CLOSURE_BOUNDED_LEVELS.inc()
+            # nodes on any seed's frontier; their rows are fetched once and
+            # OR-combined per seed by a [K, c] × [c, n] uint8 dot, chunked
+            # so the oracle transient stays bounded
+            U = np.nonzero(frontier.any(axis=0))[0]
+            nxt = np.zeros((K, n), bool)
+            for i in range(0, len(U), chunk):
+                u = U[i : i + chunk]
+                R = np.asarray(row_fn(u), dtype=np.uint8).reshape(len(u), n)
+                memb = frontier[:, u].astype(np.uint8)
+                nxt |= (memb @ R) > 0
+            fresh = nxt & ~acc
+            acc |= fresh
+            hop[fresh] = level + 1
+            frontier = fresh
+            level += 1
+            ticker.tick(level)
     return acc, hop
 
 

@@ -32,6 +32,7 @@ lower the tile caps — instead of letting XLA OOM mid-fixpoint.
 """
 from __future__ import annotations
 
+import math
 import os
 from functools import partial
 from typing import Optional
@@ -47,6 +48,7 @@ from ..observe.metrics import (
     CLOSURE_STRIPE_ROWS,
     HBM_GUARD_REFUSALS,
 )
+from ..observe.progress import ProgressTicker
 from ..ops.closure import _fit_tile, _unpack_rows_i8
 from ..resilience.errors import ConfigError
 from .mesh import GRANT_AXIS, POD_AXIS, shard_map
@@ -344,15 +346,22 @@ def sharded_packed_closure(
         key_extras=(Np, t, dt, dp, mp),
     )
     cur = jnp.asarray(padded)
-    for _ in range(max_iter):
-        CLOSURE_ITERATIONS.inc()
-        CLOSURE_SHARDED_ITERATIONS.inc()
-        cur, changed = fn(cur)
-        # the one sanctioned host sync of the loop: the globally-psum'd
-        # change flag decides convergence — without the readback every run
-        # would pay the full ⌈log₂N⌉ schedule
-        if int(np.asarray(changed)) == 0:
-            break
+    bound = max(1, math.ceil(math.log2(max(Np, 2))))
+    with ProgressTicker(
+        "sharded_closure",
+        total=min(bound, max_iter) if max_iter else bound,
+        unit="pass",
+    ) as ticker:
+        for _ in range(max_iter):
+            CLOSURE_ITERATIONS.inc()
+            CLOSURE_SHARDED_ITERATIONS.inc()
+            cur, changed = fn(cur)
+            ticker.tick()
+            # the one sanctioned host sync of the loop: the globally-psum'd
+            # change flag decides convergence — without the readback every
+            # run would pay the full ⌈log₂N⌉ schedule
+            if int(np.asarray(changed)) == 0:
+                break
     out = np.asarray(cur)
     if (Np, Wp) == (n, W0):
         return out

@@ -49,14 +49,19 @@ from .events import EventSource, WalInfo, scan_wal
 
 __all__ = [
     "MANIFEST_FORMAT",
+    "CLOSURE_FORMAT",
     "CheckpointInfo",
     "CheckpointManager",
     "RecoveryManager",
     "RecoveryResult",
+    "load_closure_checkpoint",
     "load_manifest",
 ]
 
 MANIFEST_FORMAT = 1
+#: snapshot format tag for long-closure pass checkpoints (packed matrix +
+#: pass counter) — same atomic generation discipline, different payload
+CLOSURE_FORMAT = "closure-v1"
 _GEN_RE = re.compile(r"^gen-(\d{8})$")
 _MANIFEST_RE = re.compile(r"^manifest-(\d{8})\.json$")
 
@@ -284,6 +289,78 @@ class CheckpointManager:
             last_seq=int(last_seq),
         )
 
+    def checkpoint_closure(
+        self, packed, passes: int, *, pairs: Optional[int] = None
+    ) -> CheckpointInfo:
+        """Commit one atomic generation of a long closure job's state: the
+        bit-packed reachability matrix plus the squaring-pass counter. Same
+        write discipline as :meth:`checkpoint` (tmp tree → digest → fsync →
+        rename → manifest last), so a kill at any instant leaves either the
+        previous pass checkpoint or a complete new one. The manifest is
+        tagged ``kind: closure`` — :class:`RecoveryManager` refuses to load
+        it as a serving snapshot, and :func:`load_closure_checkpoint` walks
+        the same ladder to resume the loop at the recorded pass."""
+        import numpy as np
+
+        gen = self._next_generation()
+        snap_dir = self.snapshot_dir(gen)
+        tmp_dir = os.path.join(self.directory, f".tmp-gen-{gen:08d}")
+        if os.path.exists(tmp_dir):
+            shutil.rmtree(tmp_dir)
+        os.makedirs(tmp_dir)
+        arr = np.asarray(packed)
+        np.savez_compressed(os.path.join(tmp_dir, "packed.npz"), packed=arr)
+        state = {
+            "format": CLOSURE_FORMAT,
+            "passes": int(passes),
+            "shape": list(arr.shape),
+            "dtype": str(arr.dtype),
+            "pairs": None if pairs is None else int(pairs),
+        }
+        _atomic_write_json(
+            os.path.join(tmp_dir, "closure.json"), state, fsync=self.fsync
+        )
+        digest = _tree_digest(tmp_dir)
+        kill_point("after-tmp-write")
+        if self.fsync:
+            _fsync_tree(tmp_dir)
+        kill_point("before-rename")
+        os.replace(tmp_dir, snap_dir)
+        if self.fsync:
+            _fsync_dir(self.directory)
+        manifest = {
+            "format": MANIFEST_FORMAT,
+            "kind": "closure",
+            "generation": gen,
+            "snapshot": os.path.basename(snap_dir),
+            "snapshot_digest": digest,
+            "event_log": None,
+            "log_offset": 0,
+            "last_seq": -1,
+            "passes": int(passes),
+        }
+        manifest["checksum"] = _manifest_checksum(manifest)
+        _atomic_write_json(
+            self.manifest_path(gen), manifest, fsync=self.fsync
+        )
+        kill_point("after-manifest")
+        CHECKPOINTS_TOTAL.inc()
+        log_event(
+            "closure_checkpoint", generation=gen, directory=self.directory,
+            passes=int(passes),
+            pairs=None if pairs is None else int(pairs),
+        )
+        self._rotate()
+        return CheckpointInfo(
+            generation=gen,
+            manifest_path=self.manifest_path(gen),
+            snapshot_dir=snap_dir,
+            snapshot_digest=digest,
+            log_path=None,
+            log_offset=0,
+            last_seq=-1,
+        )
+
     def _ship_pack(self) -> None:
         """Ship the warm executable pack alongside the ``gen-N/``
         snapshots (``aot-pack/`` is invisible to :meth:`_rotate` — it is
@@ -325,6 +402,65 @@ class CheckpointManager:
             m = _GEN_RE.match(name)
             if m and int(m.group(1)) not in keep and int(m.group(1)) < newest:
                 shutil.rmtree(full, ignore_errors=True)
+
+
+def load_closure_checkpoint(directory: str):
+    """Resume state for a long closure job: walk the checkpoint ladder in
+    ``directory`` newest-first, skip generations whose manifest checksum or
+    tree digest fail (same damage tolerance as :class:`RecoveryManager`),
+    and return ``(packed, passes, manifest)`` from the first valid
+    ``kind: closure`` generation. Raises :class:`PersistError` when no
+    generation holds — the caller restarts the closure from pass 0."""
+    import numpy as np
+
+    cm = CheckpointManager(directory)
+    errors: List[Tuple[int, str]] = []
+    for gen in cm.generations():
+        mpath = cm.manifest_path(gen)
+        try:
+            manifest = load_manifest(mpath)
+            if manifest.get("kind") != "closure":
+                raise PersistError(
+                    f"{mpath}: not a closure checkpoint", path=mpath
+                )
+            snap = os.path.join(directory, manifest["snapshot"])
+            if not os.path.isdir(snap):
+                raise PersistError(
+                    f"{mpath}: snapshot {manifest['snapshot']} missing",
+                    path=snap,
+                )
+            if _tree_digest(snap) != manifest["snapshot_digest"]:
+                raise PersistError(
+                    f"{snap}: snapshot digest mismatch", path=snap
+                )
+            with open(os.path.join(snap, "closure.json")) as fh:
+                state = json.load(fh)
+            if state.get("format") != CLOSURE_FORMAT:
+                raise PersistError(
+                    f"{snap}: unknown closure format "
+                    f"{state.get('format')!r}",
+                    path=snap,
+                )
+            with np.load(os.path.join(snap, "packed.npz")) as z:
+                arr = z["packed"]
+            log_event(
+                "closure_resume",
+                directory=directory,
+                generation=gen,
+                passes=int(state["passes"]),
+            )
+            return arr, int(state["passes"]), manifest
+        except (
+            PersistError, FileNotFoundError, KeyError, OSError, ValueError,
+        ) as e:
+            errors.append((gen, str(e)))
+            log_event("recovery_skip", generation=gen, reason=str(e))
+    detail = "; ".join(f"gen {g}: {why}" for g, why in errors)
+    raise PersistError(
+        f"{directory}: no usable closure checkpoint "
+        f"({detail or 'none found'})",
+        path=directory,
+    )
 
 
 @dataclass
@@ -475,6 +611,12 @@ class RecoveryManager:
             mpath = self._cm.manifest_path(gen)
             try:
                 manifest = load_manifest(mpath)
+                if manifest.get("kind") == "closure":
+                    raise PersistError(
+                        f"{mpath}: closure pass checkpoint, not a serving "
+                        "snapshot",
+                        path=mpath,
+                    )
                 snap = os.path.join(self.directory, manifest["snapshot"])
                 if not os.path.isdir(snap):
                     raise PersistError(

@@ -59,6 +59,7 @@ from dataclasses import dataclass
 from typing import Callable, Optional
 
 from ..observe import log_event
+from ..observe.progress import ProgressTicker
 from ..observe.metrics import (
     PROMOTIONS_TOTAL,
     REPLICA_LAG_SECONDS,
@@ -473,14 +474,35 @@ class FollowerService:
         last-line retry but still counts as a pending newline, so a poll
         that applies nothing without advancing the offset means the
         remainder is not consumable right now — return instead of
-        spinning; a later catch-up (or the recovery ladder) retries it."""
+        spinning; a later catch-up (or the recovery ladder) retries it.
+
+        A genuinely long replay (more than one batch pending — a follower
+        restarted hours behind, not the per-read freshness poll) drives
+        the progress plane: one ``wal_replay`` tick per poll round, total
+        = the records pending at entry (an estimate — the leader may keep
+        appending — so the fraction is against the tip as first seen)."""
+        pending = self._pending_records()
         applied = self.poll()
-        while self._pending_records() > 0:
-            before = self.source.offset
-            got = self.poll()
-            applied += got
-            if got == 0 and self.source.offset == before:
-                break
+        if pending <= self.batch_size:
+            # the common per-read freshness poll: at most one batch —
+            # not worth a progress job per query
+            while self._pending_records() > 0:
+                before = self.source.offset
+                got = self.poll()
+                applied += got
+                if got == 0 and self.source.offset == before:
+                    break
+            return applied
+        with ProgressTicker(
+            "wal_replay", total=pending, unit="record", initial=applied
+        ) as ticker:
+            while self._pending_records() > 0:
+                before = self.source.offset
+                got = self.poll()
+                applied += got
+                ticker.tick(applied)
+                if got == 0 and self.source.offset == before:
+                    break
         return applied
 
     # ----------------------------------------------------------- bounded reads

@@ -74,14 +74,17 @@ from urllib.parse import parse_qs, urlsplit
 
 from ..observe import log_event
 from ..observe.export import to_prometheus
+from ..observe.flight import recent_dumps
 from ..observe.metrics import (
     NET_BYTES_TOTAL,
     NET_REQUEST_FAILURES_TOTAL,
     NET_REQUESTS_TOTAL,
     SCRAPE_REQUESTS_TOTAL,
 )
+from ..observe.progress import ProgressTicker, active_jobs
 from ..observe.spans import (
     TRACE_HEADER,
+    capture_profile,
     parse_trace_header,
     trace,
     trace_context,
@@ -271,13 +274,30 @@ class _Handler(BaseHTTPRequestHandler):
                     self._send_bytes(payload, headers)
                 elif parts.path == "/metrics":
                     SCRAPE_REQUESTS_TOTAL.labels(endpoint="metrics").inc()
+                    # ?exemplars=1 opts into the OpenMetrics exemplar
+                    # annotations; the default stays byte-compatible with
+                    # pre-exemplar scrapers
                     self._send_text(
-                        to_prometheus().encode("utf-8"),
+                        to_prometheus(
+                            exemplars=query.get("exemplars")
+                            in ("1", "true")
+                        ).encode("utf-8"),
                         "text/plain; version=0.0.4; charset=utf-8",
                     )
                 elif parts.path == "/healthz":
                     SCRAPE_REQUESTS_TOTAL.labels(endpoint="healthz").inc()
                     self._send_json(rep.health())
+                elif parts.path == "/profile":
+                    SCRAPE_REQUESTS_TOTAL.labels(endpoint="profile").inc()
+                    result = rep.profile(
+                        seconds=float(query.get("seconds", 2.0))
+                    )
+                    self._send_json(
+                        result,
+                        status=429
+                        if result.get("outcome") == "rate-limited"
+                        else 200,
+                    )
                 else:
                     self._send_json(
                         {"error": f"unknown endpoint {parts.path!r}"},
@@ -315,6 +335,7 @@ class ReplicationServer:
         clock: Callable[[], float] = time.time,
         max_range_bytes: int = 8 * DEFAULT_CHUNK_BYTES,
         health_source: Optional[Callable[[], dict]] = None,
+        profile_dir: Optional[str] = None,
     ) -> None:
         self.directory = directory
         self.log_path = log_path
@@ -323,6 +344,11 @@ class ReplicationServer:
         self.max_range_bytes = max_range_bytes
         self._clock = clock
         self._health_source = health_source
+        #: where ``/profile`` captures land (shared with the SIGUSR1 path
+        #: when the process installed it over the same directory)
+        self.profile_dir = profile_dir or os.path.join(
+            directory, "profiles"
+        )
         self._cm = CheckpointManager(directory)
         self._tip = _WalTip(log_path)
         self._httpd: Optional[_Server] = None
@@ -415,12 +441,29 @@ class ReplicationServer:
             out["aot"] = {
                 "present": False, "error": f"{type(e).__name__}: {e}",
             }
+        # live progress plane: every in-flight long job in this process
+        # (closure passes, bootstrap shipping, WAL replay, …) plus the
+        # newest crash flight dumps — so one /healthz answers "what is
+        # this replica doing right now and did it crash recently"
+        out["jobs"] = active_jobs()
+        out["flight_dumps"] = [
+            os.path.basename(p) for p in recent_dumps(limit=3)
+        ]
         if self._health_source is not None:
             try:
                 out.update(self._health_source())
             except Exception as e:  # a sick overlay is itself a signal
                 out["health_source_error"] = f"{type(e).__name__}: {e}"
         return out
+
+    def profile(self, *, seconds: float = 2.0) -> dict:
+        """On-demand deep profiling (``/profile?seconds=N``): a bounded
+        ``jax.profiler`` capture into this server's ``profile_dir``,
+        rate-limited by :func:`~..observe.spans.capture_profile` so a
+        scrape loop cannot DoS the device."""
+        return capture_profile(
+            seconds, trigger="http", capture_dir=self.profile_dir
+        )
 
     def wal_range(
         self, query: Dict[str, str]
@@ -632,10 +675,22 @@ class ReplicationClient:
         body, _ = self._request("healthz", "/healthz")
         return json.loads(body)
 
-    def metrics_text(self) -> str:
-        """The replica's ``/metrics`` Prometheus text exposition."""
-        body, _ = self._request("metrics", "/metrics")
+    def metrics_text(self, *, exemplars: bool = False) -> str:
+        """The replica's ``/metrics`` Prometheus text exposition
+        (``exemplars=True`` requests the OpenMetrics exemplar
+        annotations)."""
+        path = "/metrics?exemplars=1" if exemplars else "/metrics"
+        body, _ = self._request("metrics", path)
         return body.decode("utf-8")
+
+    def profile(self, seconds: float = 2.0) -> dict:
+        """Trigger a bounded deep-profile capture on the replica
+        (``/profile?seconds=N``); raises :class:`ReplicationError` when
+        the replica refused (rate-limited → HTTP 429)."""
+        body, _ = self._request(
+            "profile", f"/profile?seconds={float(seconds)}"
+        )
+        return json.loads(body)
 
     def wal(
         self,
@@ -770,18 +825,26 @@ def bootstrap_from_leader(
         shutil.rmtree(tmp_dir)
     os.makedirs(tmp_dir)
     total = 0
-    for entry in info["files"]:
-        rel = entry["path"]
-        dest = os.path.abspath(os.path.normpath(os.path.join(tmp_dir, rel)))
-        if not dest.startswith(os.path.abspath(tmp_dir) + os.sep):
-            raise ReplicationError(
-                f"leader listed a snapshot path {rel!r} that escapes the "
-                "generation — refusing the transfer",
-                op="manifest", url=client.base_url,
+    # chunk shipping is the long pole of a cold follower start: one tick
+    # per manifest file feeds `kv-tpu jobs` / /healthz with a live ETA
+    with ProgressTicker(
+        "bootstrap", total=len(info["files"]), unit="file"
+    ) as ticker:
+        for entry in info["files"]:
+            rel = entry["path"]
+            dest = os.path.abspath(
+                os.path.normpath(os.path.join(tmp_dir, rel))
             )
-        total += client.fetch_file(
-            gen, rel, dest, expected_sha256=entry.get("sha256")
-        )
+            if not dest.startswith(os.path.abspath(tmp_dir) + os.sep):
+                raise ReplicationError(
+                    f"leader listed a snapshot path {rel!r} that escapes "
+                    "the generation — refusing the transfer",
+                    op="manifest", url=client.base_url,
+                )
+            total += client.fetch_file(
+                gen, rel, dest, expected_sha256=entry.get("sha256")
+            )
+            ticker.tick(bytes=total, file=rel)
     tree = _tree_digest(tmp_dir)
     if tree != manifest["snapshot_digest"]:
         raise ReplicationError(

@@ -576,6 +576,56 @@ def test_suppression_on_own_line_covers_next_line():
     assert result.findings == [] and len(result.suppressed) == 1
 
 
+def test_long_loop_progress_positive_and_negative():
+    bad = _lint(
+        """
+        def f(cur, step):
+            while True:
+                CLOSURE_ITERATIONS.inc()
+                cur = step(cur)
+        """,
+        ["long-loop-progress"],
+    )
+    assert [f.rule for f in bad] == ["long-loop-progress"]
+    assert "CLOSURE_ITERATIONS" in bad[0].message
+    ok = _lint(
+        """
+        def f(cur, step, ticker):
+            while True:
+                CLOSURE_ITERATIONS.inc()
+                cur = step(cur)
+                ticker.tick()
+        """,
+        ["long-loop-progress"],
+    )
+    assert ok == []
+    # a plain counter (not the pass-counter naming convention) is not a
+    # multi-pass loop marker; and a nested instrumented loop does not
+    # satisfy the OUTER loop's obligation
+    plain = _lint(
+        """
+        def f(items):
+            for x in items:
+                SERVE_BATCHES.inc()
+        """,
+        ["long-loop-progress"],
+    )
+    assert plain == []
+    nested = _lint(
+        """
+        def f(chunks, step, ticker):
+            while True:
+                CLOSURE_ITERATIONS.inc()
+                for c in chunks:
+                    DELTA_ROUNDS.inc()
+                    step(c)
+                    ticker.tick()
+        """,
+        ["long-loop-progress"],
+    )
+    assert [f.rule for f in nested] == ["long-loop-progress"]
+
+
 def test_unused_suppression_is_itself_a_finding():
     src = "x = 1  # kvtpu: ignore[bare-except] nothing here\n"
     findings = run_lint({"m.py": src}).findings

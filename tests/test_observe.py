@@ -311,3 +311,87 @@ def test_write_metrics_formats(tmp_path):
 def test_default_buckets_are_ascending():
     assert list(DEFAULT_BUCKETS) == sorted(DEFAULT_BUCKETS)
     assert DEFAULT_BUCKETS[0] <= 0.001 and DEFAULT_BUCKETS[-1] >= 300.0
+
+
+# ---------------------------------------------------------- exemplars
+@pytest.fixture()
+def exemplar_provider():
+    """Install a controllable trace-id provider on the registry's exemplar
+    tap; restores the span-layer provider afterwards (spans.py wires
+    current_trace_id at import)."""
+    from kubernetes_verification_tpu.observe import registry as regmod
+    from kubernetes_verification_tpu.observe.spans import current_trace_id
+
+    state = {"trace_id": None}
+    regmod.set_exemplar_provider(lambda: state["trace_id"])
+    yield state
+    regmod.set_exemplar_provider(current_trace_id)
+
+
+def test_exemplar_retains_slowest_in_window(reg, exemplar_provider):
+    from kubernetes_verification_tpu.observe import registry as regmod
+
+    h = Histogram("kvtpu_ex_seconds", "t", registry=reg, buckets=(1.0,))
+    exemplar_provider["trace_id"] = "aaaa"
+    h.observe(0.2)
+    exemplar_provider["trace_id"] = "bbbb"
+    h.observe(0.9)  # slower, same bucket: replaces
+    exemplar_provider["trace_id"] = "cccc"
+    h.observe(0.3)  # faster: does NOT replace inside the window
+    ex = h.labels().exemplars()
+    assert ex[0][:2] == (0.9, "bbbb")
+    # an observation with no active trace carries no exemplar
+    exemplar_provider["trace_id"] = None
+    h2 = Histogram("kvtpu_ex2_seconds", "t", registry=reg, buckets=(1.0,))
+    h2.observe(0.5)
+    assert h2.labels().exemplars() == [None, None]
+    # once the retained exemplar ages out, recency beats magnitude
+    old = regmod.EXEMPLAR_WINDOW_SECONDS
+    regmod.EXEMPLAR_WINDOW_SECONDS = 0.0
+    try:
+        exemplar_provider["trace_id"] = "dddd"
+        h.observe(0.1)
+    finally:
+        regmod.EXEMPLAR_WINDOW_SECONDS = old
+    assert h.labels().exemplars()[0][:2] == (0.1, "dddd")
+
+
+def test_exemplar_no_cross_label_leak(reg, exemplar_provider):
+    h = Histogram(
+        "kvtpu_leak_seconds", "t", ("stage",), registry=reg, buckets=(1.0,)
+    )
+    exemplar_provider["trace_id"] = "solveid1"
+    h.labels(stage="solve").observe(0.7)
+    exemplar_provider["trace_id"] = "queueid2"
+    h.labels(stage="queue").observe(0.2)
+    assert h.labels(stage="solve").exemplars()[0][1] == "solveid1"
+    assert h.labels(stage="queue").exemplars()[0][1] == "queueid2"
+    from kubernetes_verification_tpu.observe.export import parse_exemplars
+
+    rendered = parse_exemplars(to_prometheus(reg, exemplars=True))
+    by_stage = {
+        e["labels"]["stage"]: e["exemplar"]["trace_id"] for e in rendered
+    }
+    assert by_stage == {"solve": "solveid1", "queue": "queueid2"}
+
+
+def test_prometheus_exemplars_opt_in_and_round_trip(reg, exemplar_provider):
+    from kubernetes_verification_tpu.observe.export import (
+        parse_exemplars,
+        parse_prometheus,
+    )
+
+    h = Histogram("kvtpu_rt_seconds", "t", registry=reg, buckets=(0.1, 1.0))
+    exemplar_provider["trace_id"] = "cafe" * 4
+    h.observe(0.25)
+    plain = to_prometheus(reg)
+    annotated = to_prometheus(reg, exemplars=True)
+    # default output is byte-identical to the pre-exemplar contract
+    assert " # {" not in plain
+    assert 'kvtpu_rt_seconds_bucket{le="1.0"} 1 # {trace_id="' in annotated
+    # the parser skips annotations: both renderings parse to the same samples
+    assert parse_prometheus(annotated) == parse_prometheus(plain)
+    ex = parse_exemplars(annotated)
+    assert len(ex) == 1 and ex[0]["exemplar"]["trace_id"] == "cafe" * 4
+    assert ex[0]["value"] == pytest.approx(0.25)
+    assert parse_exemplars(plain) == []

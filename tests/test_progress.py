@@ -1,0 +1,562 @@
+"""The long-job progress plane and on-demand deep profiling: ProgressTicker
+rate/ETA semantics under a fake clock, pass-boundary closure checkpointing
+and resume, SIGUSR1/HTTP profiler captures (and SIGUSR2 coexistence), and
+the `kv-tpu jobs` / `profile` / `top` / `trace --slowest` CLI surface."""
+import json
+import logging
+import os
+import signal
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from kubernetes_verification_tpu.cli import main
+from kubernetes_verification_tpu.observe import configure_logging
+from kubernetes_verification_tpu.observe.events import (
+    _HANDLER_MARK,
+    Clock,
+    logger as kvtpu_logger,
+    set_clock,
+)
+from kubernetes_verification_tpu.observe.progress import (
+    ProgressTicker,
+    active_jobs,
+    eta_bar,
+    render_jobs,
+)
+from kubernetes_verification_tpu.resilience.errors import (
+    EXIT_OK,
+    EXIT_VIOLATIONS,
+)
+
+
+class FakeClock(Clock):
+    def __init__(self, start: float = 1000.0) -> None:
+        self.t = start
+
+    def wall(self) -> float:
+        return self.t
+
+    def perf(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+@pytest.fixture()
+def fake_clock():
+    clk = FakeClock()
+    set_clock(clk)
+    yield clk
+    set_clock(None)
+
+
+@pytest.fixture()
+def event_log(tmp_path):
+    """This process's JSON event lines captured to a file (the shape every
+    replica log has); restores the kvtpu logger afterwards."""
+    path = str(tmp_path / "events.jsonl")
+    fh = open(path, "w", buffering=1)
+    configure_logging(stream=fh)
+    yield path
+    for h in list(kvtpu_logger.handlers):
+        if getattr(h, _HANDLER_MARK, False):
+            kvtpu_logger.removeHandler(h)
+    kvtpu_logger.setLevel(logging.NOTSET)
+    fh.close()
+
+
+def _events(path, name=None, job=None):
+    out = []
+    with open(path) as fh:
+        for raw in fh:
+            raw = raw.strip()
+            if not raw.startswith("{"):
+                continue
+            try:
+                line = json.loads(raw)
+            except ValueError:
+                continue
+            if name is not None and line.get("event") != name:
+                continue
+            if job is not None and line.get("job") != job:
+                continue
+            out.append(line)
+    return out
+
+
+def _dead_url():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return f"http://127.0.0.1:{port}"
+
+
+def _server(tmp_path, name):
+    from kubernetes_verification_tpu.serve.transport import ReplicationServer
+
+    d = tmp_path / name
+    d.mkdir()
+    log = str(d / "wal.jsonl")
+    open(log, "w").close()
+    return ReplicationServer(str(d), log)
+
+
+# ------------------------------------------------------------- the ticker
+def test_ticker_monotone_rate_and_eta(fake_clock):
+    t = ProgressTicker("t_eta", total=10, unit="pass")
+    for _ in range(5):
+        fake_clock.advance(1.0)
+        t.tick()
+    assert t.done == 5 and t.fraction == 0.5
+    # steady 1 pass/s: the EMA rate is exact and the halfway ETA lands
+    # within the 50% acceptance bound (here: exact)
+    assert t.rate == pytest.approx(1.0)
+    assert t.eta_s == pytest.approx(5.0)
+    assert abs(t.eta_s - 5.0) / 5.0 < 0.5
+    # monotone clamp: a lower absolute count never regresses the counter
+    t.tick(done=2)
+    assert t.done == 5
+    mine = [j for j in active_jobs() if j["job_id"] == t.job_id]
+    assert mine and mine[0]["done"] == 5
+    assert mine[0]["fraction"] == 0.5
+    t.finish()
+    assert t.outcome == "done"
+    assert not [j for j in active_jobs() if j["job_id"] == t.job_id]
+    t.finish("again")  # idempotent: first outcome wins
+    assert t.outcome == "done"
+
+
+def test_ticker_eta_tracks_slowdown(fake_clock):
+    """EMA smoothing: after passes slow from 1s to 3s the ETA converges
+    toward the slow rate within a few passes instead of whipsawing."""
+    t = ProgressTicker("t_slow", total=12)
+    for _ in range(4):
+        fake_clock.advance(1.0)
+        t.tick()
+    for _ in range(4):
+        fake_clock.advance(3.0)
+        t.tick()
+    remaining = 12 - t.done
+    assert t.eta_s > remaining * 1.0  # slower than the fast-phase estimate
+    assert t.rate < 1.0
+    t.finish()
+
+
+def test_ticker_unknown_total_and_error_outcome(fake_clock):
+    with pytest.raises(RuntimeError):
+        with ProgressTicker("t_err", unit="round") as t:
+            fake_clock.advance(1.0)
+            t.tick()
+            assert t.fraction is None and t.eta_s is None
+            raise RuntimeError("boom")
+    assert t.outcome == "error"
+    assert not [j for j in active_jobs() if j["job_id"] == t.job_id]
+
+
+def test_ticker_on_pass_callback_and_min_interval(fake_clock, event_log):
+    seen = []
+    t = ProgressTicker(
+        "t_cb", total=4, on_pass=seen.append, min_interval=10.0
+    )
+    for _ in range(4):
+        fake_clock.advance(1.0)
+        t.tick()
+    t.finish()
+    assert seen == [1, 2, 3, 4]  # every boundary, regardless of emit gate
+    # min_interval rate-limits event lines, not callbacks or counters
+    lines = _events(event_log, "progress", job="t_cb")
+    assert 1 <= len(lines) < 4
+
+
+def test_eta_bar_and_render_jobs():
+    assert eta_bar(0.5, width=10) == "[#####-----]"
+    assert eta_bar(None, width=4) == "[????]"
+    assert eta_bar(2.0, width=4) == "[####]"
+    rows = render_jobs(
+        [
+            {"job_id": "a-1", "unit": "pass", "done": 3, "total": 6,
+             "fraction": 0.5, "rate": 2.0, "eta_s": 1.5},
+            {"job_id": "b-2", "unit": "level", "done": 7, "total": None,
+             "fraction": None, "rate": None, "eta_s": None},
+        ]
+    )
+    assert rows[0].split()[:3] == ["job", "unit", "done"]
+    assert "3/6" in rows[1] and "1.5s" in rows[1]
+    assert "[????" in rows[2] and rows[2].split()[2] == "7"
+
+
+# ------------------------------------- closure loops drive the ticker
+def _chain_packed(n=64):
+    import jax.numpy as jnp
+
+    from kubernetes_verification_tpu.ops.tiled import pack_bool_cols
+
+    a = np.zeros((n, n), dtype=bool)
+    for i in range(n - 1):
+        a[i, i + 1] = True
+    return pack_bool_cols(jnp.asarray(a))
+
+
+def test_closure_progress_events_monotone(event_log):
+    from kubernetes_verification_tpu.ops.closure import packed_closure
+
+    packed_closure(_chain_packed(), tile=32)
+    lines = _events(event_log, "progress", job="packed_closure")
+    assert lines, "closure loop emitted no progress events"
+    dones = [l["done"] for l in lines]
+    assert dones == sorted(dones) and dones[0] >= 1
+    fracs = [l["fraction"] for l in lines if l["fraction"] is not None]
+    assert fracs == sorted(fracs)
+    # the log2 bound is an upper bound on PRODUCTIVE passes; the final
+    # confirming pass may exceed it, but the fraction clamps at 1.0
+    assert all(0.0 <= f <= 1.0 for f in fracs)
+    ends = _events(event_log, "progress_end", job="packed_closure")
+    assert ends and ends[-1]["outcome"] in ("converged", "done")
+
+
+def test_closure_checkpoint_resume_skips_passes(tmp_path, event_log):
+    from kubernetes_verification_tpu.observe.metrics import (
+        CLOSURE_ITERATIONS,
+    )
+    from kubernetes_verification_tpu.ops.closure import packed_closure
+    from kubernetes_verification_tpu.serve.durability import (
+        PersistError,
+        RecoveryManager,
+        load_closure_checkpoint,
+    )
+
+    ckpt = str(tmp_path / "closure-ckpt")
+    packed = _chain_packed()
+    it0 = CLOSURE_ITERATIONS.value
+    want = np.asarray(
+        packed_closure(
+            packed, tile=32, checkpoint_dir=ckpt, checkpoint_every=1
+        )
+    )
+    full_passes = CLOSURE_ITERATIONS.value - it0
+    assert full_passes >= 2
+    arr, passes, manifest = load_closure_checkpoint(ckpt)
+    assert passes == full_passes and manifest["kind"] == "closure"
+    np.testing.assert_array_equal(arr, want)
+    # resume re-runs only the confirming pass on the converged matrix
+    it0 = CLOSURE_ITERATIONS.value
+    got = np.asarray(
+        packed_closure(
+            packed, tile=32, checkpoint_dir=ckpt, checkpoint_every=1,
+            resume=True,
+        )
+    )
+    assert CLOSURE_ITERATIONS.value - it0 == 1
+    np.testing.assert_array_equal(got, want)
+    resumed = _events(event_log, "closure_resume")
+    assert resumed and resumed[-1]["passes"] == full_passes
+    # a closure pass checkpoint is NOT a serving snapshot: recovery must
+    # refuse it instead of loading bitmaps as service state
+    with pytest.raises(PersistError):
+        RecoveryManager(ckpt).recover()
+
+
+def test_closure_resume_against_empty_dir_starts_cold(tmp_path):
+    from kubernetes_verification_tpu.ops.closure import packed_closure
+
+    packed = _chain_packed(32)
+    got = packed_closure(
+        packed, tile=32, checkpoint_dir=str(tmp_path / "none"),
+        checkpoint_every=2, resume=True,
+    )
+    want = packed_closure(packed, tile=32)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_bootstrap_ships_chunks_with_progress(tmp_path, event_log):
+    from kubernetes_verification_tpu.serve.transport import (
+        ReplicationClient,
+        bootstrap_from_leader,
+    )
+    from kubernetes_verification_tpu.serve.durability import (
+        CheckpointManager,
+    )
+
+    server = _server(tmp_path, "leader")
+    cm = CheckpointManager(server.directory)
+    cm.checkpoint_closure(np.asarray(_chain_packed(32)), 3)
+    with server:
+        dst = str(tmp_path / "follower")
+        bootstrap_from_leader(ReplicationClient(server.url), dst)
+    lines = _events(event_log, "progress", job="bootstrap")
+    assert lines and lines[-1]["done"] == lines[-1]["total"]
+    ends = _events(event_log, "progress_end", job="bootstrap")
+    assert ends and ends[-1]["outcome"] == "done"
+
+
+# ------------------------------------------- on-demand deep profiling
+def _wait_manifest(capture_dir, n=1, timeout=10.0):
+    from kubernetes_verification_tpu.observe.spans import (
+        load_capture_manifest,
+    )
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        entries = load_capture_manifest(capture_dir)
+        if len(entries) >= n:
+            return entries
+        time.sleep(0.05)
+    raise AssertionError(
+        f"no capture manifest entry in {capture_dir} after {timeout}s"
+    )
+
+
+def test_capture_profile_local_and_rate_limit(tmp_path):
+    from kubernetes_verification_tpu.observe.spans import (
+        capture_profile,
+        reset_profile_rate_limit,
+    )
+
+    d = str(tmp_path / "prof")
+    reset_profile_rate_limit()
+    result = capture_profile(0.05, trigger="api", capture_dir=d)
+    assert result["outcome"] == "ok", result
+    assert result["files"] > 0 and os.path.isdir(result["path"])
+    entries = _wait_manifest(d)
+    assert entries[-1]["trigger"] == "api" and entries[-1]["files"] > 0
+    # a second immediate capture is refused, with a retry hint
+    again = capture_profile(0.05, trigger="api", capture_dir=d)
+    assert again["outcome"] == "rate-limited"
+    assert again["retry_after_s"] > 0
+    reset_profile_rate_limit()
+
+
+def test_sigusr1_and_http_captures(tmp_path):
+    from kubernetes_verification_tpu.observe.spans import (
+        install_profile_signal,
+        reset_profile_rate_limit,
+        uninstall_profile_signal,
+    )
+
+    sig_dir = str(tmp_path / "sig-prof")
+    reset_profile_rate_limit()
+    assert install_profile_signal(sig_dir, seconds=0.05, min_interval=0.0)
+    try:
+        os.kill(os.getpid(), signal.SIGUSR1)
+        entries = _wait_manifest(sig_dir)
+        assert entries[-1]["trigger"] == "sigusr1"
+        assert entries[-1]["files"] > 0
+    finally:
+        uninstall_profile_signal()
+    # the HTTP trigger: /profile?seconds=N on a running replica
+    from kubernetes_verification_tpu.serve.transport import (
+        ReplicationClient,
+        ReplicationError,
+    )
+
+    reset_profile_rate_limit()
+    server = _server(tmp_path, "prof-leader")
+    with server:
+        client = ReplicationClient(server.url, timeout=15.0)
+        result = client.profile(0.05)
+        assert result["outcome"] == "ok" and result["trigger"] == "http"
+        entries = _wait_manifest(server.profile_dir)
+        assert entries[-1]["files"] > 0
+        # immediate repeat → HTTP 429, surfaced as a typed failure
+        with pytest.raises(ReplicationError):
+            client.profile(0.05)
+    reset_profile_rate_limit()
+
+
+def test_sigusr1_sigusr2_coexist_and_chain(tmp_path):
+    from kubernetes_verification_tpu.observe import flight
+    from kubernetes_verification_tpu.observe.spans import (
+        install_profile_signal,
+        reset_profile_rate_limit,
+        uninstall_profile_signal,
+    )
+
+    chained = []
+    prev = signal.signal(signal.SIGUSR1, lambda s, f: chained.append(s))
+    prof_dir = str(tmp_path / "coexist-prof")
+    flight_dir = str(tmp_path / "coexist-flight")
+    reset_profile_rate_limit()
+    try:
+        assert install_profile_signal(
+            prof_dir, seconds=0.05, min_interval=0.0
+        )
+        flight.install(flight_dir)
+        os.kill(os.getpid(), signal.SIGUSR1)
+        os.kill(os.getpid(), signal.SIGUSR2)
+        # both subsystems fired off their own signal...
+        entries = _wait_manifest(prof_dir)
+        assert entries[-1]["trigger"] == "sigusr1"
+        assert flight.recent_dumps(flight_dir)
+        # ...and the pre-existing SIGUSR1 handler was chained, not eaten
+        assert chained == [signal.SIGUSR1]
+    finally:
+        flight.uninstall()
+        uninstall_profile_signal()
+        signal.signal(signal.SIGUSR1, prev)
+        reset_profile_rate_limit()
+
+
+# --------------------------------------------------- the CLI surface
+def test_cli_jobs_degrades_on_dead_replica(tmp_path, capsys):
+    server = _server(tmp_path, "jobs-leader")
+    with server:
+        t = ProgressTicker("cli_jobs_demo", total=8, unit="pass")
+        t.tick(3)
+        try:
+            rc = main(
+                ["jobs", "--replica", server.url, "--replica", _dead_url()]
+            )
+        finally:
+            t.finish()
+    out, err = capsys.readouterr()
+    assert rc == EXIT_OK
+    assert "cli_jobs_demo" in out and "3/8" in out
+    assert "DOWN" in err  # the dead replica degrades, not fails
+
+
+def test_cli_jobs_json(tmp_path, capsys):
+    server = _server(tmp_path, "jobs-json")
+    with server:
+        t = ProgressTicker("cli_jobs_json", total=2)
+        t.tick()
+        try:
+            rc = main(["jobs", "--json", "--replica", server.url])
+        finally:
+            t.finish()
+    assert rc == EXIT_OK
+    payload = json.loads(capsys.readouterr().out)
+    mine = [
+        j for j in payload["jobs"] if j["job"] == "cli_jobs_json"
+    ]
+    assert mine and mine[0]["replica"] == server.url
+
+
+def test_cli_top_once_renders_two_replica_fleet(tmp_path, capsys):
+    a = _server(tmp_path, "top-a")
+    b = _server(tmp_path, "top-b")
+    with a, b:
+        t = ProgressTicker("cli_top_demo", total=4)
+        t.tick(2)
+        try:
+            rc = main(
+                [
+                    "top", "--once",
+                    "--replica", a.url,
+                    "--replica", b.url,
+                    "--replica", _dead_url(),
+                ]
+            )
+        finally:
+            t.finish()
+    out = capsys.readouterr().out
+    assert rc == EXIT_OK
+    assert a.url in out and b.url in out
+    assert "cli_top_demo" in out and "[##########----------]" in out
+    assert "DOWN" in out  # dead replica renders as a row, not a crash
+    assert "qps" in out and "lag_s" in out and "burn" in out
+
+
+def test_cli_profile_local(tmp_path, capsys):
+    from kubernetes_verification_tpu.observe.spans import (
+        reset_profile_rate_limit,
+    )
+
+    reset_profile_rate_limit()
+    d = str(tmp_path / "cli-prof")
+    rc = main(["profile", "--seconds", "0.05", "--dir", d])
+    out, _ = capsys.readouterr()
+    assert rc == EXIT_OK and "captured" in out
+    # back-to-back: rate-limited, nonzero exit, retry hint on stderr
+    rc = main(["profile", "--seconds", "0.05", "--dir", d])
+    _, err = capsys.readouterr()
+    assert rc == EXIT_VIOLATIONS and "rate-limited" in err
+    reset_profile_rate_limit()
+
+
+def test_cli_trace_slowest_resolves_exemplar(tmp_path, capsys):
+    from kubernetes_verification_tpu.observe.export import to_prometheus
+    from kubernetes_verification_tpu.observe.metrics import (
+        QUERY_LATENCY_SECONDS,
+    )
+    from kubernetes_verification_tpu.observe.spans import trace_context
+
+    trace_id = "feedbead" * 2
+    with trace_context(trace_id):
+        QUERY_LATENCY_SECONDS.labels(stage="total").observe(43210.5)
+    metrics_file = tmp_path / "metrics.prom"
+    metrics_file.write_text(to_prometheus(exemplars=True))
+    log = tmp_path / "events.jsonl"
+    log.write_text(
+        json.dumps(
+            {
+                "event": "span", "trace_id": trace_id, "span_id": "s1",
+                "name": "solve", "seconds": 43210.5,
+                "start_ts": 10.0, "ts": 43220.5,
+            }
+        )
+        + "\n"
+    )
+    rc = main(
+        [
+            "trace", "--slowest", "--stage", "total",
+            "--metrics", str(metrics_file), "--log", str(log),
+        ]
+    )
+    out, _ = capsys.readouterr()
+    assert rc == EXIT_OK
+    assert trace_id in out and "solve" in out  # metric → full timeline
+
+
+def test_cli_trace_requires_id_or_slowest(tmp_path):
+    log = tmp_path / "e.jsonl"
+    log.write_text("")
+    with pytest.raises(SystemExit):
+        main(["trace", "--log", str(log)])
+    with pytest.raises(SystemExit):
+        main(["trace", "--slowest", "--log", str(log)])  # no --metrics
+
+
+def test_healthz_overlays_jobs_and_flight_dumps(tmp_path):
+    from kubernetes_verification_tpu.observe import flight
+    from kubernetes_verification_tpu.observe.fleet import scrape_replica
+
+    server = _server(tmp_path, "health-leader")
+    flight.install(str(tmp_path / "health-flight"))
+    try:
+        flight.trigger_dump("test")
+        with server:
+            t = ProgressTicker("healthz_demo", total=3)
+            t.tick()
+            try:
+                s = scrape_replica(server.url)
+            finally:
+                t.finish()
+        assert s.ok, s.error
+        jobs = [j for j in s.health["jobs"] if j["job"] == "healthz_demo"]
+        assert jobs and jobs[0]["done"] == 1
+        assert s.health["flight_dumps"]
+    finally:
+        flight.uninstall()
+
+
+def test_progress_metric_families_registered():
+    from kubernetes_verification_tpu.observe import REGISTRY
+
+    dump = REGISTRY.dump()
+    for family in (
+        "kvtpu_progress_passes_total",
+        "kvtpu_profile_captures_total",
+        "kvtpu_trace_exemplars_total",
+    ):
+        assert family in dump["counters"], family
+    for family in (
+        "kvtpu_progress_fraction",
+        "kvtpu_progress_eta_seconds",
+        "kvtpu_progress_active_jobs",
+    ):
+        assert family in dump["gauges"], family
