@@ -266,6 +266,77 @@ class LeaseAtomicRule(Rule):
                 )
 
 
+@register
+class BoundedQueueRule(Rule):
+    id = "bounded-queue"
+    rationale = (
+        "The serve path stands between unbounded client demand and a "
+        "fixed-capacity device: any `queue.Queue`/`collections.deque` "
+        "constructed there without an explicit positive `maxsize`/`maxlen` "
+        "is an overload liability — memory grows with offered load until "
+        "the process dies, which is exactly the failure the ingress tier's "
+        "typed `queue-full` rejection exists to replace. `SimpleQueue` has "
+        "no bound at all and is flagged unconditionally; `maxsize=0` is "
+        "the unbounded spelling and counts as missing. Genuinely "
+        "drain-bounded sites (a queue whose producer is itself bounded) "
+        "carry an inline `# kvtpu: ignore[bounded-queue]` with the reason."
+    )
+    example = "self._queue = queue.Queue()  # in serve/"
+
+    #: package-relative prefixes on the serve path (between clients and
+    #: the device); queues elsewhere are tooling and may buffer freely
+    SERVE_PREFIXES = ("serve/",)
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if not ctx.rel.startswith(self.SERVE_PREFIXES):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _last_name(node.func)
+            if name == "SimpleQueue":
+                yield Finding(
+                    self.id, ctx.rel, node.lineno,
+                    "SimpleQueue on the serve path — it cannot be bounded; "
+                    "use queue.Queue(maxsize=N) so overload becomes "
+                    "back-pressure instead of memory growth",
+                )
+            elif name in ("Queue", "LifoQueue", "PriorityQueue"):
+                if not self._has_bound(node, "maxsize", positional=0):
+                    yield Finding(
+                        self.id, ctx.rel, node.lineno,
+                        f"{name}() without a positive maxsize on the serve "
+                        "path — unbounded queues turn overload into memory "
+                        "growth; pass maxsize=N (or justify with an inline "
+                        "ignore)",
+                    )
+            elif name == "deque":
+                if not self._has_bound(node, "maxlen", positional=1):
+                    yield Finding(
+                        self.id, ctx.rel, node.lineno,
+                        "deque() without maxlen on the serve path — "
+                        "unbounded buffers turn overload into memory "
+                        "growth; pass maxlen=N (or justify with an inline "
+                        "ignore)",
+                    )
+
+    @staticmethod
+    def _has_bound(call: ast.Call, kwarg: str, *, positional: int) -> bool:
+        """An explicit bound argument that is not the unbounded literal
+        (0/None). Computed values are trusted — the author bounded it."""
+        value: Optional[ast.expr] = None
+        for kw in call.keywords:
+            if kw.arg == kwarg:
+                value = kw.value
+        if value is None and len(call.args) > positional:
+            value = call.args[positional]
+        if value is None:
+            return False
+        if isinstance(value, ast.Constant):
+            return bool(value.value)
+        return True
+
+
 def _is_thread_class(node: ast.ClassDef) -> bool:
     return any(_last_name(b) == "Thread" for b in node.bases)
 

@@ -172,9 +172,13 @@ class MetricDisciplineRule(Rule):
             )
 
 
-#: names whose appearance inside a ``do_GET`` body proves the handler
-#: adopts the incoming trace context (observe/spans.py wire contract)
+#: names whose appearance inside a ``do_GET``/``do_POST`` body proves the
+#: handler adopts the incoming trace context (observe/spans.py wire
+#: contract)
 _TRACE_PARSE_NAMES = frozenset({"parse_trace_header", "TRACE_HEADER"})
+
+#: BaseHTTPRequestHandler entry points the adoption requirement covers
+_HTTP_HANDLER_NAMES = frozenset({"do_GET", "do_POST"})
 
 
 @register
@@ -184,8 +188,8 @@ class TraceContextRule(Rule):
         "Distributed traces only join up when every HTTP hop carries the "
         "`X-Kvtpu-Trace` header: an outgoing `conn.request(...)` that "
         "passes no `headers` drops the caller's trace context on the "
-        "floor, and a `do_GET` handler that never parses the header "
-        "(`parse_trace_header` / `TRACE_HEADER`) orphans every "
+        "floor, and a `do_GET`/`do_POST` handler that never parses the "
+        "header (`parse_trace_header` / `TRACE_HEADER`) orphans every "
         "server-side span into a fresh trace. Either break silently turns "
         "`kv-tpu trace <id>` into a single-process view — the cross-"
         "process timeline still renders, it just lies by omission."
@@ -215,7 +219,10 @@ class TraceContextRule(Rule):
                     "headers=trace_headers() so the X-Kvtpu-Trace context "
                     "survives the hop",
                 )
-            if isinstance(node, ast.FunctionDef) and node.name == "do_GET":
+            if (
+                isinstance(node, ast.FunctionDef)
+                and node.name in _HTTP_HANDLER_NAMES
+            ):
                 refs = {
                     n.id
                     for n in ast.walk(node)
@@ -228,10 +235,10 @@ class TraceContextRule(Rule):
                 if not (refs & _TRACE_PARSE_NAMES):
                     yield Finding(
                         self.id, ctx.rel, node.lineno,
-                        "do_GET never parses the incoming trace header "
-                        "(parse_trace_header/TRACE_HEADER) — server-side "
-                        "spans orphan into fresh traces instead of "
-                        "parenting under the caller's span",
+                        f"{node.name} never parses the incoming trace "
+                        "header (parse_trace_header/TRACE_HEADER) — "
+                        "server-side spans orphan into fresh traces "
+                        "instead of parenting under the caller's span",
                     )
 
 

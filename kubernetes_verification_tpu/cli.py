@@ -2133,6 +2133,9 @@ def _run_top(args) -> int:
     }
     burn_hist: collections.deque = collections.deque(maxlen=depth)
     prev: dict = {}  # url -> (queries_total, monotonic ts)
+    prev_shed: dict = {}  # url -> ({tenant: rejections_total}, monotonic ts)
+    shed_rates: dict = {}  # url -> {tenant: sheds/s}
+    quota_util: dict = {}  # url -> {tenant: bucket utilization 0..1}
     frames = 0
     try:
         while True:
@@ -2155,6 +2158,31 @@ def _run_top(args) -> int:
                     if p is not None and now > p[1]:
                         qps = max(0.0, (total - p[0]) / (now - p[1]))
                     prev[s.url] = (total, now)
+                    # per-tenant admission telemetry: shed-rate from the
+                    # rejection counter deltas, quota utilisation straight
+                    # off the gauge
+                    shed: dict = {}
+                    for labels, v in s.metrics.get(
+                        "kvtpu_admission_rejections_total", []
+                    ):
+                        t = labels.get("tenant")
+                        if t is not None:
+                            shed[t] = shed.get(t, 0.0) + v
+                    ps = prev_shed.get(s.url)
+                    if ps is not None and now > ps[1]:
+                        dt = now - ps[1]
+                        shed_rates[s.url] = {
+                            t: max(0.0, (v - ps[0].get(t, 0.0)) / dt)
+                            for t, v in shed.items()
+                        }
+                    prev_shed[s.url] = (shed, now)
+                    quota_util[s.url] = {
+                        labels["tenant"]: v
+                        for labels, v in s.metrics.get(
+                            "kvtpu_admission_quota_utilization", []
+                        )
+                        if "tenant" in labels
+                    }
                 hist[s.url]["qps"].append(qps)
                 hist[s.url]["lag"].append(s.lag_seconds)
             burns = monitor.evaluate()
@@ -2197,6 +2225,19 @@ def _run_top(args) -> int:
                     f"{s.url}  qps {_spark(h['qps'])} {qtxt}  "
                     f"lag_s {_spark(h['lag'])} {ltxt}"
                 )
+                tenants = sorted(
+                    set(shed_rates.get(s.url, {}))
+                    | set(quota_util.get(s.url, {}))
+                )
+                if tenants:
+                    cells = []
+                    for t in tenants:
+                        rate = shed_rates.get(s.url, {}).get(t)
+                        util = quota_util.get(s.url, {}).get(t)
+                        rtxt = "-" if rate is None else f"{rate:.1f}"
+                        utxt = "-" if util is None else f"{util:.2f}"
+                        cells.append(f"{t} shed/s {rtxt} quota {utxt}")
+                    lines.append("  tenants: " + "; ".join(cells))
             lines.append(
                 f"burn (worst finite)  {_spark(burn_hist)} "
                 f"{burn_hist[-1]:.3g}"

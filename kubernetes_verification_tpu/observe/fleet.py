@@ -120,16 +120,44 @@ def _fmt(value, digits: int = 3) -> str:
     return str(value)
 
 
+def _tenant_summary(samples, *, digits: Optional[int] = None, top: int = 2) -> str:
+    """Compact per-tenant column text from parsed metric samples
+    (``[(labels, value), ...]``): the ``top`` largest as ``tenant=value``,
+    a ``+N`` tail for the rest, ``-`` when the family is absent."""
+    per: Dict[str, float] = {}
+    for labels, value in samples or []:
+        tenant = labels.get("tenant")
+        if tenant is None:
+            continue
+        per[tenant] = per.get(tenant, 0.0) + value
+    if not per:
+        return "-"
+    items = sorted(per.items(), key=lambda kv: (-kv[1], kv[0]))
+    cells = [
+        f"{t}={v:.{digits}f}" if digits is not None else f"{t}={int(v)}"
+        for t, v in items[:top]
+    ]
+    if len(items) > top:
+        cells.append(f"+{len(items) - top}")
+    return ",".join(cells)
+
+
 def render_fleet(scrapes: Sequence[ReplicaScrape]) -> List[str]:
     """The fleet table: one aligned row per replica, down replicas
-    included (their row says why)."""
+    included (their row says why). ``shed`` / ``quota`` summarise the
+    front-door admission metrics per tenant (total typed rejections and
+    token-bucket utilisation) so an operator sees who is being refused
+    where without correlating counters by hand."""
     header = (
-        "replica", "role", "epoch", "last_seq", "lag_s", "breaker", "aot"
+        "replica", "role", "epoch", "last_seq", "lag_s", "breaker", "aot",
+        "shed", "quota",
     )
     rows = [header]
     for s in scrapes:
         if not s.ok:
-            rows.append((s.url, "DOWN", "-", "-", "-", s.error or "-", "-"))
+            rows.append(
+                (s.url, "DOWN", "-", "-", "-", s.error or "-", "-", "-", "-")
+            )
             continue
         h = s.health or {}
         breakers = h.get("breakers") or {}
@@ -145,6 +173,7 @@ def render_fleet(scrapes: Sequence[ReplicaScrape]) -> List[str]:
             atxt = f"ok/{aot.get('matching', 0)}"
         else:
             atxt = "stale"
+        metrics = s.metrics or {}
         rows.append(
             (
                 s.url,
@@ -154,6 +183,13 @@ def render_fleet(scrapes: Sequence[ReplicaScrape]) -> List[str]:
                 _fmt(s.lag_seconds),
                 btxt,
                 atxt,
+                _tenant_summary(
+                    metrics.get("kvtpu_admission_rejections_total")
+                ),
+                _tenant_summary(
+                    metrics.get("kvtpu_admission_quota_utilization"),
+                    digits=2,
+                ),
             )
         )
     widths = [max(len(r[i]) for r in rows) for i in range(len(header))]

@@ -90,6 +90,18 @@ __all__ = [
     "PROGRESS_ACTIVE_JOBS",
     "PROFILE_CAPTURES_TOTAL",
     "TRACE_EXEMPLARS_TOTAL",
+    "INGRESS_REQUESTS_TOTAL",
+    "INGRESS_QUEUE_DEPTH",
+    "INGRESS_BATCH_FILL",
+    "INGRESS_WAIT_SECONDS",
+    "INGRESS_BATCHES_TOTAL",
+    "INGRESS_FAULTS_INJECTED_TOTAL",
+    "ADMISSION_REJECTIONS_TOTAL",
+    "ADMISSION_QUOTA_UTILIZATION",
+    "ADMISSION_BROWNOUT_LEVEL",
+    "ADMISSION_BROWNOUT_TRANSITIONS_TOTAL",
+    "AUTOSCALE_DECISIONS_TOTAL",
+    "AUTOSCALE_FLEET_SIZE",
     "REQUIRED_FAMILIES",
 ]
 
@@ -709,6 +721,110 @@ TRACE_EXEMPLARS_TOTAL = Counter(
     "the metric-to-trace join `kv-tpu trace --slowest` reads.",
 )
 
+INGRESS_REQUESTS_TOTAL = Counter(
+    "kvtpu_ingress_requests_total",
+    "Client probe requests at the front-door ingress tier, by tenant and "
+    "outcome: 'answered' (batched, dispatched, result returned within the "
+    "deadline), 'rejected' (typed AdmissionRejectedError with a finite "
+    "retry-after), 'failed' (the backend dispatch itself errored after "
+    "admission).",
+    ("tenant", "outcome"),
+)
+
+INGRESS_QUEUE_DEPTH = Gauge(
+    "kvtpu_ingress_queue_depth",
+    "Probes admitted but not yet dispatched by the continuous-batching "
+    "queue, sampled at every enqueue and flush — bounded by construction "
+    "(the bounded-queue lint enforces it); sustained sits near the bound "
+    "mean the brown-out ladder is about to climb.",
+)
+
+INGRESS_BATCH_FILL = Histogram(
+    "kvtpu_ingress_batch_fill",
+    "Fill fraction (probes dispatched / device batch shape) of each "
+    "continuous-batching flush — the TPU-KNN peak-FLOP/s shape only pays "
+    "off when this stays near 1.0 under load; a time-triggered flush on a "
+    "quiet door legitimately dispatches low-fill batches.",
+    buckets=(0.0625, 0.125, 0.25, 0.5, 0.75, 1.0),
+)
+
+INGRESS_WAIT_SECONDS = Histogram(
+    "kvtpu_ingress_wait_seconds",
+    "Seconds each admitted request waited in the batching queue between "
+    "enqueue and dispatch — the coalescing tax every probe pays for "
+    "riding a full device-shaped batch, bounded by the dual trigger's "
+    "max-wait.",
+    buckets=(0.0005, 0.002, 0.01, 0.05, 0.2, 1.0),
+)
+
+INGRESS_BATCHES_TOTAL = Counter(
+    "kvtpu_ingress_batches_total",
+    "Device-shaped batches the ingress tier dispatched, by flush trigger: "
+    "'size' (the batch filled), 'time' (the oldest request hit max-wait), "
+    "'deadline' (a request's budget demanded dispatch now), 'drain' "
+    "(shutdown flushed the residue).",
+    ("trigger",),
+)
+
+INGRESS_FAULTS_INJECTED_TOTAL = Counter(
+    "kvtpu_ingress_faults_injected_total",
+    "Ingress-seam faults fired by the injection harness, by kind: "
+    "'client-burst' (one submission amplified into an N-times arrival "
+    "spike) or 'slow-client' (a stalled request body delaying the "
+    "submission) — the chaos suite's ground truth for front-door runs.",
+    ("kind",),
+)
+
+ADMISSION_REJECTIONS_TOTAL = Counter(
+    "kvtpu_admission_rejections_total",
+    "Requests the admission controller refused with a typed "
+    "AdmissionRejectedError, by tenant and reason ('over-quota', "
+    "'concurrency', 'queue-full', 'brownout', 'deadline') — every one "
+    "carried a finite computed retry-after; kv-tpu fleet/top render the "
+    "per-tenant shed columns from this family.",
+    ("tenant", "reason"),
+)
+
+ADMISSION_QUOTA_UTILIZATION = Gauge(
+    "kvtpu_admission_quota_utilization",
+    "Fraction of each tenant's token-bucket burst currently spent "
+    "(0 = idle, 1 = the next request is over quota), sampled at every "
+    "admission decision — the quota-pressure column in kv-tpu fleet/top.",
+    ("tenant",),
+)
+
+ADMISSION_BROWNOUT_LEVEL = Gauge(
+    "kvtpu_admission_brownout_level",
+    "Current rung of the graceful-degradation ladder: 0 = normal, 1 = "
+    "what-if overlays disabled, 2 = lowest-priority tenants shed, 3 = "
+    "rejecting at the door — each transition is traced and "
+    "flight-recorded.",
+)
+
+ADMISSION_BROWNOUT_TRANSITIONS_TOTAL = Counter(
+    "kvtpu_admission_brownout_transitions_total",
+    "Brown-out ladder transitions, by destination level ('0'..'3') — "
+    "escalations and recoveries both count, so a flapping door shows up "
+    "as volume here even when the level gauge looks calm.",
+    ("to",),
+)
+
+AUTOSCALE_DECISIONS_TOTAL = Counter(
+    "kvtpu_autoscale_decisions_total",
+    "Fleet autoscaler decisions, by action: 'scale-up' / 'scale-down' "
+    "(a follower was spawned/retired), 'hold' (signals inside the "
+    "hysteresis band or cooling down), 'clamped' (the controller wanted "
+    "to move but the fenced min/max fleet bound refused).",
+    ("action",),
+)
+
+AUTOSCALE_FLEET_SIZE = Gauge(
+    "kvtpu_autoscale_fleet_size",
+    "Followers currently managed by the fleet autoscaler — always within "
+    "the fenced [min_fleet, max_fleet] bound; reconcile this against "
+    "kvtpu_autoscale_decisions_total to audit every spawn/retire.",
+)
+
 #: The frozen dashboard contract: families that must exist in every build.
 #: New families are appended here by the PR that introduces them; the
 #: `metrics-names` lint rule and `scripts/check_metrics_names.py` both fail
@@ -811,6 +927,20 @@ REQUIRED_FAMILIES = frozenset(
         "kvtpu_progress_active_jobs",
         "kvtpu_profile_captures_total",
         "kvtpu_trace_exemplars_total",
+        # front-door ingress tier (serve/ingress.py + serve/admission.py +
+        # serve/autoscale.py)
+        "kvtpu_ingress_requests_total",
+        "kvtpu_ingress_queue_depth",
+        "kvtpu_ingress_batch_fill",
+        "kvtpu_ingress_wait_seconds",
+        "kvtpu_ingress_batches_total",
+        "kvtpu_ingress_faults_injected_total",
+        "kvtpu_admission_rejections_total",
+        "kvtpu_admission_quota_utilization",
+        "kvtpu_admission_brownout_level",
+        "kvtpu_admission_brownout_transitions_total",
+        "kvtpu_autoscale_decisions_total",
+        "kvtpu_autoscale_fleet_size",
     }
 )
 

@@ -38,6 +38,7 @@ __all__ = [
     "StaleReadError",
     "FencedError",
     "ReplicationError",
+    "AdmissionRejectedError",
     "BackendError",
     "BackendOOM",
     "BackendTimeout",
@@ -169,6 +170,36 @@ class ReplicationError(ServeError):
         super().__init__(message)
         self.op = op
         self.url = url
+
+
+class AdmissionRejectedError(ServeError):
+    """The ingress admission controller refused a request at the front
+    door. ``reason`` is one of the stable rejection classes —
+    ``over-quota`` (the tenant's token bucket is empty; HTTP 429),
+    ``concurrency`` (the global in-flight limit is reached; HTTP 503),
+    ``queue-full`` (the bounded continuous-batching queue has no slot;
+    HTTP 503), ``brownout`` (the overload ladder is shedding this
+    tenant's priority class or the whole door; HTTP 503), ``deadline``
+    (the request's budget cannot survive the current queue + service
+    estimate, so admitting it would only manufacture a deadline
+    violation; HTTP 503). ``retry_after_s`` is always finite and
+    computed, never a guess: for ``over-quota`` it is the bucket's
+    refill horizon, for the capacity reasons an escalating backoff hint
+    — the HTTP seam renders it as a ``Retry-After`` header so clients
+    back off instead of hammering. ``tenant`` names who was refused."""
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        retry_after_s: float = 1.0,
+        tenant: Optional[str] = None,
+        reason: str = "over-quota",
+    ) -> None:
+        super().__init__(message)
+        self.retry_after_s = float(retry_after_s)
+        self.tenant = tenant
+        self.reason = reason
 
 
 class BackendError(KvTpuError, RuntimeError):

@@ -46,7 +46,11 @@ import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence
 
-from ..observe.metrics import FAULTS_INJECTED_TOTAL, NET_FAULTS_INJECTED_TOTAL
+from ..observe.metrics import (
+    FAULTS_INJECTED_TOTAL,
+    INGRESS_FAULTS_INJECTED_TOTAL,
+    NET_FAULTS_INJECTED_TOTAL,
+)
 from .errors import (
     BackendError,
     BackendOOM,
@@ -59,11 +63,13 @@ __all__ = [
     "FAULT_KINDS",
     "KILL_POINTS",
     "NET_FAULT_KINDS",
+    "INGRESS_FAULT_KINDS",
     "FaultRule",
     "FaultInjector",
     "FaultyBackend",
     "KillPointInjector",
     "NetFaultInjector",
+    "IngressFaultInjector",
     "parse_fault_spec",
     "register_faulty",
     "install_kill_points",
@@ -73,6 +79,9 @@ __all__ = [
     "clear_net_faults",
     "heal_net_partition",
     "net_fault",
+    "install_ingress_faults",
+    "clear_ingress_faults",
+    "ingress_fault",
 ]
 
 #: named crash points in the durability write path (serve/durability.py,
@@ -95,8 +104,18 @@ KILL_POINTS = (
 #: :func:`heal_net_partition` (or :func:`clear_net_faults`)
 NET_FAULT_KINDS = ("net-drop", "net-delay", "net-partition")
 
+#: client-behaviour faults injected at the ingress seam (serve/ingress.py
+#: calls :func:`ingress_fault` once per client submission): ``client-burst``
+#: amplifies one submission into an N-times arrival spike, ``slow-client``
+#: stalls the request body before it reaches admission — both exercisable
+#: under ``JAX_PLATFORMS=cpu``
+INGRESS_FAULT_KINDS = ("client-burst", "slow-client")
+
 FAULT_KINDS = (
-    ("oom", "timeout", "device_loss", "flaky") + KILL_POINTS + NET_FAULT_KINDS
+    ("oom", "timeout", "device_loss", "flaky")
+    + KILL_POINTS
+    + NET_FAULT_KINDS
+    + INGRESS_FAULT_KINDS
 )
 
 #: tile assumed when an ``oom>T`` rule fires against a config carrying no
@@ -257,6 +276,12 @@ def register_faulty(
                 f"network fault {rule.kind!r} fires at the replication-"
                 "transport seam, not in a backend — arm it with "
                 "install_net_faults()"
+            )
+        if rule.kind in INGRESS_FAULT_KINDS:
+            raise ConfigError(
+                f"ingress fault {rule.kind!r} fires at the front-door "
+                "ingress seam, not in a backend — arm it with "
+                "install_ingress_faults()"
             )
     injector = FaultInjector(rules, seed=seed)
     name = f"faulty:{inner_name}"
@@ -477,3 +502,116 @@ def net_fault(op: str) -> None:
         inj._sleep(inj.delay_seconds)
         return
     raise ReplicationError(f"injected {kind} on {op!r} request", op=op)
+
+
+# ---------------------------------------------------------- ingress faults
+class IngressFaultInjector:
+    """Seeded, submission-counting client-behaviour fault schedule for the
+    front-door seam. One counter spans every client submission, so
+    ``client-burst@3`` means "the 4th submission this process sees arrives
+    as a burst". ``client-burst`` amplifies one submission into
+    ``burst_factor`` arrivals (an arrival-rate spike the admission
+    controller and bounded queue must absorb or shed); ``slow-client``
+    stalls the submission ``stall_seconds`` before it reaches admission —
+    a request body trickling in, which eats the request's own deadline
+    budget, not the batcher's."""
+
+    def __init__(
+        self,
+        rules: Sequence[FaultRule],
+        *,
+        seed: int = 0,
+        burst_factor: int = 8,
+        stall_seconds: float = 0.05,
+        sleep=time.sleep,
+    ) -> None:
+        self.rules = [r for r in rules if r.kind in INGRESS_FAULT_KINDS]
+        if not self.rules:
+            raise ConfigError(
+                f"no ingress fault rules in {list(rules)!r}; known kinds: "
+                f"{INGRESS_FAULT_KINDS}"
+            )
+        if burst_factor < 1:
+            raise ConfigError(
+                f"burst_factor must be >= 1, got {burst_factor}"
+            )
+        self.burst_factor = int(burst_factor)
+        self.stall_seconds = float(stall_seconds)
+        self._sleep = sleep
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self.calls = 0
+        self.injected: Dict[str, int] = {}
+
+    def next_fault(self) -> Optional[str]:
+        """Advance the submission counter and return the fault kind to
+        inject on this submission, or None."""
+        with self._lock:
+            idx = self.calls
+            self.calls += 1
+            for rule in self.rules:
+                if rule.at_call is not None:
+                    fired = rule.at_call == idx
+                elif rule.prob is not None:
+                    fired = self._rng.random() < rule.prob
+                else:
+                    fired = True
+                if fired:
+                    self.injected[rule.kind] = (
+                        self.injected.get(rule.kind, 0) + 1
+                    )
+                    return rule.kind
+        return None
+
+
+#: the process-wide armed schedule (None = every ingress_fault() is a no-op)
+_INGRESS_INJECTOR: Optional[IngressFaultInjector] = None
+
+
+def install_ingress_faults(
+    rules: Sequence[FaultRule],
+    *,
+    seed: int = 0,
+    burst_factor: int = 8,
+    stall_seconds: float = 0.05,
+    sleep=time.sleep,
+) -> IngressFaultInjector:
+    """Arm the front-door client faults process-wide (rules typically come
+    from ``parse_fault_spec("client-burst@2,slow-client%0.1")``); returns
+    the injector so a harness can inspect counters."""
+    global _INGRESS_INJECTOR
+    # kvtpu: ignore[concurrency-hygiene] armed by the chaos harness before any client submits; arm/disarm is single-threaded
+    _INGRESS_INJECTOR = IngressFaultInjector(
+        rules, seed=seed, burst_factor=burst_factor,
+        stall_seconds=stall_seconds, sleep=sleep,
+    )
+    return _INGRESS_INJECTOR
+
+
+def clear_ingress_faults() -> None:
+    """Disarm every ingress fault (tests)."""
+    global _INGRESS_INJECTOR
+    _INGRESS_INJECTOR = None  # kvtpu: ignore[concurrency-hygiene] disarm happens on the harness thread after the scenario finishes
+
+
+def ingress_fault() -> int:
+    """The front-door seam. The ingress tier calls this once per client
+    submission, *before* admission; returns the arrival amplification
+    factor (1 = no fault). A firing ``client-burst`` returns
+    ``burst_factor`` — the submission counts as that many arrivals, so
+    quota, queue slots and batch pressure all see the spike. A firing
+    ``slow-client`` sleeps ``stall_seconds`` (the stalled request body)
+    and returns 1 — the stall burns the request's own deadline budget
+    while the batcher keeps serving everyone else. No-op unless armed via
+    :func:`install_ingress_faults`."""
+    inj = _INGRESS_INJECTOR
+    if inj is None:
+        return 1
+    kind = inj.next_fault()
+    if kind is None:
+        return 1
+    INGRESS_FAULTS_INJECTED_TOTAL.labels(kind=kind).inc()
+    if kind == "slow-client":
+        inj._sleep(inj.stall_seconds)
+        return 1
+    return inj.burst_factor

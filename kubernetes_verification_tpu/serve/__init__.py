@@ -34,13 +34,29 @@ incremental engines were built for — BASELINE config 5):
   (:func:`bootstrap_from_leader` is the snapshot-shipping bootstrap);
 * ``lb`` — :class:`QueryLoadBalancer`: staleness-weighted routing of
   query batches across replicas, ``StaleReadError`` retried against the
-  leader, unreachable replicas ejected via per-replica breakers.
+  leader, unreachable replicas ejected via per-replica breakers;
+* ``ingress`` — the front door for real traffic: :class:`Ingress`
+  coalesces thousands of concurrent few-probe clients into full
+  device-shaped batches (bounded queue, size/time/deadline triggers,
+  per-request deadlines honoured or refused up front);
+* ``admission`` — :class:`AdmissionController`: per-tenant token-bucket
+  quotas, a global concurrency limit and priority classes; every refusal
+  is a typed ``AdmissionRejectedError`` with a finite retry-after
+  (rendered as 429/503 + ``Retry-After`` on the wire) and the
+  :class:`BrownoutController` ladder degrades gracefully under sustained
+  overload (what-if off → shed low priority → reject at the door);
+* ``autoscale`` — :class:`FleetAutoscaler`: spawns/retires capacity off
+  SLO burn rates, replica lag and queue pressure, with hysteresis,
+  cooldown and a fenced max-fleet bound.
 
 CLI: ``kv-tpu serve`` (``--follow DIR`` for a replica, ``--leader URL``
 for a networked one) / ``kv-tpu query`` (``--batch FILE.jsonl`` for the
 vectorized path) / ``kv-tpu lb`` / ``kv-tpu recover``; benchmarks:
 ``bench.py --mode serve`` / ``--mode query`` / ``--mode replicate``
-(``--net`` for the networked fleet); metric families: ``kvtpu_serve_*``,
+(``--net`` for the networked fleet) / ``--mode ingress`` (open-loop
+arrival-rate sweep with the saturation knee per fleet size); metric
+families: ``kvtpu_ingress_*``, ``kvtpu_admission_*``,
+``kvtpu_autoscale_*``, ``kvtpu_serve_*``,
 ``kvtpu_query_cache_*``, ``kvtpu_query_batch_size``,
 ``kvtpu_checkpoints_total``, ``kvtpu_recoveries_total``,
 ``kvtpu_wal_truncations_total``, ``kvtpu_replica_lag_seconds``/``_seq``,
@@ -73,6 +89,15 @@ from .events import (
     scan_wal,
     write_events,
 )
+from .admission import (
+    AdmissionConfig,
+    AdmissionController,
+    BrownoutController,
+    TenantQuota,
+    TokenBucket,
+)
+from .autoscale import AutoscaleConfig, FleetAutoscaler
+from .ingress import Ingress, IngressConfig
 from .lb import QueryLoadBalancer
 from .replication import (
     FollowerService,
@@ -135,6 +160,15 @@ __all__ = [
     "RemoteEventSource",
     "bootstrap_from_leader",
     "QueryLoadBalancer",
+    "Ingress",
+    "IngressConfig",
+    "AdmissionConfig",
+    "AdmissionController",
+    "BrownoutController",
+    "TenantQuota",
+    "TokenBucket",
+    "AutoscaleConfig",
+    "FleetAutoscaler",
     "QueryCache",
     "QueryEngine",
     "PodSelector",

@@ -100,6 +100,9 @@ class ServeConfig:
     #: seconds an open serving breaker waits before probing the
     #: incremental engine again
     breaker_cooldown: float = 30.0
+    #: bound on the pending event queue; a full queue back-pressures
+    #: submitters (blocking put) instead of growing without limit
+    max_queue_events: int = 65536
 
 
 @dataclass
@@ -190,7 +193,9 @@ class VerificationService:
         self.assertions: list = []
         self.violations: list = []
         self._lock = threading.RLock()
-        self._queue: "queue.Queue[Event]" = queue.Queue()
+        self._queue: "queue.Queue[Event]" = queue.Queue(
+            maxsize=self.serve_config.max_queue_events
+        )
         self._worker: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._worker_error: Optional[KvTpuError] = None

@@ -90,7 +90,12 @@ from ..observe.spans import (
     trace_context,
     trace_headers,
 )
-from ..resilience.errors import PersistError, ReplicationError
+from ..resilience.errors import (
+    AdmissionRejectedError,
+    PersistError,
+    ReplicationError,
+    ServeError,
+)
 from ..resilience.faults import net_fault
 from ..resilience.retry import RetryPolicy
 from .durability import (
@@ -221,11 +226,18 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, format: str, *args) -> None:  # noqa: A002
         pass
 
-    def _send_json(self, obj: dict, status: int = 200) -> None:
+    def _send_json(
+        self,
+        obj: dict,
+        status: int = 200,
+        headers: Optional[Dict[str, str]] = None,
+    ) -> None:
         body = json.dumps(obj, sort_keys=True).encode("utf-8")
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        for key, value in (headers or {}).items():
+            self.send_header(key, value)
         self.end_headers()
         self.wfile.write(body)
 
@@ -312,6 +324,81 @@ class _Handler(BaseHTTPRequestHandler):
                     {"error": f"{type(e).__name__}: {e}"}, status=500
                 )
 
+    def do_POST(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler's name
+        rep = self.server.replication
+        parts = urlsplit(self.path)
+        # same trace adoption as do_GET: the submit's ingress_batch span
+        # parents under the caller's X-Kvtpu-Trace context
+        trace_id, parent_id = parse_trace_header(
+            self.headers.get(TRACE_HEADER)
+        )
+        with trace_context(trace_id, parent_id), trace(
+            "http_serve", path=parts.path
+        ) as span:
+            try:
+                if parts.path != "/v1/query":
+                    self._send_json(
+                        {"error": f"unknown endpoint {parts.path!r}"},
+                        status=404,
+                    )
+                    return
+                ingress = getattr(rep, "ingress", None)
+                if ingress is None:
+                    self._send_json(
+                        {"error": "this replica has no ingress tier wired"},
+                        status=503,
+                    )
+                    return
+                length = int(self.headers.get("Content-Length", 0) or 0)
+                raw = self.rfile.read(length) if length > 0 else b""
+                doc = json.loads(raw.decode("utf-8")) if raw else {}
+                tenant = str(
+                    doc.get("tenant")
+                    or self.headers.get("X-Kvtpu-Tenant")
+                    or "default"
+                )
+                span.attrs["tenant"] = tenant
+                deadline_s = doc.get("deadline_s")
+                priority = doc.get("priority")
+                answers = ingress.submit(
+                    [tuple(p) for p in doc.get("probes", [])],
+                    tenant=tenant,
+                    deadline_s=(
+                        float(deadline_s) if deadline_s is not None else None
+                    ),
+                    priority=int(priority) if priority is not None else None,
+                )
+                self._send_json(
+                    {"answers": [bool(a) for a in answers], "tenant": tenant}
+                )
+            except AdmissionRejectedError as e:
+                # the typed refusal contract: over-quota is the client's
+                # own pacing problem (429), everything else is the
+                # server shedding (503); both carry the computed
+                # Retry-After so well-behaved clients back off exactly
+                # as long as the door asks
+                span.attrs["rejected"] = e.reason
+                self._send_json(
+                    {
+                        "error": str(e),
+                        "reason": e.reason,
+                        "tenant": e.tenant,
+                        "retry_after_s": e.retry_after_s,
+                    },
+                    status=429 if e.reason == "over-quota" else 503,
+                    headers={
+                        "Retry-After": f"{max(0.0, e.retry_after_s):.3f}"
+                    },
+                )
+            except ServeError as e:
+                span.attrs["error"] = str(e)
+                self._send_json({"error": str(e)}, status=400)
+            except (OSError, ValueError, KeyError) as e:
+                span.attrs["error"] = f"{type(e).__name__}: {e}"
+                self._send_json(
+                    {"error": f"{type(e).__name__}: {e}"}, status=500
+                )
+
 
 class _Server(ThreadingHTTPServer):
     daemon_threads = True
@@ -336,9 +423,14 @@ class ReplicationServer:
         max_range_bytes: int = 8 * DEFAULT_CHUNK_BYTES,
         health_source: Optional[Callable[[], dict]] = None,
         profile_dir: Optional[str] = None,
+        ingress=None,
     ) -> None:
         self.directory = directory
         self.log_path = log_path
+        #: optional front-door tier (:class:`~.ingress.Ingress`): when
+        #: wired, ``POST /v1/query`` coalesces client probes through it
+        #: and ``/healthz`` carries its queue/admission fragment
+        self.ingress = ingress
         self.host = host
         self.port = port
         self.max_range_bytes = max_range_bytes
@@ -449,6 +541,11 @@ class ReplicationServer:
         out["flight_dumps"] = [
             os.path.basename(p) for p in recent_dumps(limit=3)
         ]
+        if self.ingress is not None:
+            try:
+                out["ingress"] = self.ingress.describe()
+            except Exception as e:  # a sick front door is itself a signal
+                out["ingress"] = {"error": f"{type(e).__name__}: {e}"}
         if self._health_source is not None:
             try:
                 out.update(self._health_source())
@@ -691,6 +788,77 @@ class ReplicationClient:
             "profile", f"/profile?seconds={float(seconds)}"
         )
         return json.loads(body)
+
+    def query(
+        self,
+        probes,
+        *,
+        tenant: str = "default",
+        deadline_s: Optional[float] = None,
+        priority: Optional[int] = None,
+    ) -> List[bool]:
+        """``POST /v1/query``: answer ``probes`` through the replica's
+        front-door ingress tier. A 429/503 refusal is re-raised as the
+        same typed :class:`AdmissionRejectedError` the server threw
+        (reason + finite retry-after reconstructed from the body), so a
+        local caller and a wire caller handle overload identically."""
+        op = "query"
+        NET_REQUESTS_TOTAL.labels(op=op).inc()
+        body = json.dumps(
+            {
+                "probes": [list(p) for p in probes],
+                "tenant": tenant,
+                "deadline_s": deadline_s,
+                "priority": priority,
+            }
+        ).encode("utf-8")
+        try:
+            net_fault(op)  # the injection seam, same as every wire request
+            conn = HTTPConnection(
+                self._host, self._port, timeout=self.timeout
+            )
+            try:
+                headers = dict(trace_headers())
+                headers["Content-Type"] = "application/json"
+                headers["X-Kvtpu-Tenant"] = tenant
+                conn.request("POST", "/v1/query", body=body, headers=headers)
+                resp = conn.getresponse()
+                payload = resp.read()
+                status = resp.status
+            finally:
+                conn.close()
+        except (OSError, HTTPException) as e:
+            NET_REQUEST_FAILURES_TOTAL.labels(op=op).inc()
+            raise ReplicationError(
+                f"query request to {self.base_url} failed: "
+                f"{type(e).__name__}: {e}",
+                op=op, url=self.base_url,
+            ) from e
+        try:
+            doc = json.loads(payload.decode("utf-8"))
+        except (json.JSONDecodeError, UnicodeDecodeError) as e:
+            NET_REQUEST_FAILURES_TOTAL.labels(op=op).inc()
+            raise ReplicationError(
+                f"query response from {self.base_url} was not JSON "
+                f"(HTTP {status})",
+                op=op, url=self.base_url,
+            ) from e
+        if status in (429, 503) and "retry_after_s" in doc:
+            raise AdmissionRejectedError(
+                doc.get("error", f"admission rejected (HTTP {status})"),
+                retry_after_s=float(doc["retry_after_s"]),
+                tenant=doc.get("tenant"),
+                reason=doc.get("reason", "over-quota"),
+            )
+        if status != 200:
+            NET_REQUEST_FAILURES_TOTAL.labels(op=op).inc()
+            raise ReplicationError(
+                f"query request to {self.base_url} returned HTTP {status}: "
+                f"{doc.get('error', '')[:200]}",
+                op=op, url=self.base_url,
+            )
+        NET_BYTES_TOTAL.labels(op=op).inc(len(payload))
+        return [bool(a) for a in doc.get("answers", [])]
 
     def wal(
         self,
