@@ -1208,6 +1208,128 @@ def bench_serve(args) -> None:
     )
 
 
+def bench_posture(args) -> None:
+    """Posture-plane overhead on the serving apply path: the same churn
+    stream runs twice through identical packed services — once bare, once
+    with the posture tracker recording an exact reach delta per applied
+    batch — and the gap is the observability tax. Emits the gated
+    lower-is-better ``posture_overhead_pct`` (budget <5% of the apply
+    path) plus the ``posture_deltas_per_second`` throughput series, and
+    asserts the budget inline so a CI run fails loudly rather than just
+    recording the regression."""
+    import jax
+
+    from kubernetes_verification_tpu.backends.base import VerifyConfig
+    from kubernetes_verification_tpu.harness.generate import (
+        GeneratorConfig,
+        random_cluster,
+        random_event_stream,
+    )
+    from kubernetes_verification_tpu.packed_incremental import (
+        PackedIncrementalVerifier,
+    )
+    from kubernetes_verification_tpu.serve import VerificationService
+
+    dev = jax.devices()[0]
+    log(f"device: {dev} ({jax.default_backend()})")
+    n = args.pods
+    t0 = time.perf_counter()
+    cluster = random_cluster(
+        GeneratorConfig(
+            n_pods=n, n_policies=args.policies, n_namespaces=args.namespaces,
+            p_ipblock_peer=0.0, min_selector_labels=1, seed=0,
+        )
+    )
+    events = random_event_stream(cluster, n_events=args.n_events, seed=1)
+    t1 = time.perf_counter()
+    log(f"generate+stream {t1 - t0:.1f}s ({len(events)} events)")
+    batch = 64
+    batches = [events[i:i + batch] for i in range(0, len(events), batch)]
+
+    def run(with_posture: bool):
+        eng = PackedIncrementalVerifier(
+            cluster, VerifyConfig(compute_ports=False), device=dev,
+            keep_matrix=True,
+        )
+        svc = VerificationService(engine=eng)
+        if with_posture:
+            svc.enable_posture()
+        # first batch absorbs the per-kind engine-op (and delta-kernel)
+        # compiles so the timed band is steady-state
+        svc.apply(batches[0])
+        times = []
+        for b in batches[1:]:
+            s = time.perf_counter()
+            svc.apply(b)
+            times.append(time.perf_counter() - s)
+        return times, svc
+
+    bare_times, bare_svc = run(False)
+    posture_times, posture_svc = run(True)
+    bare_band = _band(bare_times)
+    posture_band = _band(posture_times)
+    records = list(posture_svc.posture.records)
+    deltas = [r for r in records if not r.baseline]
+    # cross-check the incremental accounting against the bare service's
+    # final matrix before trusting the timing comparison
+    oracle = int(bare_svc.reach().sum())
+    tracked = records[-1].reachable_pairs
+    assert tracked == oracle, (
+        f"posture accounting drifted: tracked {tracked} != oracle {oracle}"
+    )
+    bare_svc.close()
+    posture_svc.close()
+    overhead_pct = max(
+        0.0,
+        100.0 * (posture_band["median_s"] / bare_band["median_s"] - 1.0),
+    )
+    delta_s = [r.delta_s for r in deltas]
+    delta_band = _band(delta_s)
+    deltas_per_s = (
+        len(deltas) / sum(delta_s) if sum(delta_s) > 0 else 0.0
+    )
+    log(
+        f"apply batch median {bare_band['median_s'] * 1e3:.2f}ms bare -> "
+        f"{posture_band['median_s'] * 1e3:.2f}ms with posture "
+        f"({overhead_pct:+.2f}%); delta median "
+        f"{delta_band['median_s'] * 1e3:.2f}ms over {len(deltas)} "
+        f"generations = {deltas_per_s:.0f} deltas/s"
+    )
+    # the budget from the posture plane's contract: the exact per-batch
+    # reach delta must stay under 5% of the apply path at churn scale
+    assert overhead_pct < 5.0, (
+        f"posture delta overhead {overhead_pct:.2f}% breaches the 5% "
+        f"apply-path budget"
+    )
+    _emit(
+        {
+            "metric": "posture_overhead_pct",
+            "value": round(overhead_pct, 3),
+            "unit": "pct",
+            "pods": n,
+            "policies": args.policies,
+            "events": len(events),
+            "generations": len(deltas),
+            "apply_bare_band": bare_band,
+            "apply_posture_band": posture_band,
+            "delta_band": delta_band,
+            "steady_s": round(posture_band["median_s"], 4),
+        }
+    )
+    _emit(
+        {
+            "metric": "posture_deltas_per_second",
+            "value": round(deltas_per_s, 1),
+            "unit": "deltas/s",
+            "pods": n,
+            "policies": args.policies,
+            "generations": len(deltas),
+            "delta_band": delta_band,
+            "steady_s": round(delta_band["median_s"], 6),
+        }
+    )
+
+
 def _ingress_open_loop(
     ing, requests, rate_probes_s, duration_s, deadline_s
 ):
@@ -2382,7 +2504,7 @@ def main() -> None:
         choices=(
             "tiled", "k8s", "kano", "incremental", "closure", "stripe",
             "headtohead", "serve", "query", "replicate", "ingress",
-            "sentinel",
+            "posture", "sentinel",
         ),
         default="tiled",
         help="tiled = the BASELINE north-star config (100k pods / 10k "
@@ -2404,6 +2526,10 @@ def main() -> None:
         "ingress = open-loop arrival-rate sweep through the front-door "
         "continuous batcher per fleet size (saturation knee, post-knee "
         "goodput hold, typed-rejection accounting); "
+        "posture = same churn stream through identical packed services "
+        "bare vs posture-tracked (per-batch exact reach delta) — gated "
+        "posture_overhead_pct (<5% apply-path budget) + "
+        "posture_deltas_per_second; "
         "sentinel = ONLY the perf-sentinel calibration round (fixed-shape "
         "compute-bound kernels + dispatch probe, recorded as gated "
         "sentinel_<k>_s series + ungated noise context)",
@@ -2482,12 +2608,14 @@ def main() -> None:
             "tiled": 100_000, "incremental": 100_000, "closure": 100_000,
             "stripe": 1_000_000, "headtohead": 100_000, "serve": 1_024,
             "query": 10_000, "replicate": 1_024, "ingress": 1_024,
+            "posture": 10_000,
         }.get(args.mode, 10_000)
     if args.policies is None:
         args.policies = {
             "tiled": 10_000, "incremental": 10_000, "closure": 10_000,
             "stripe": 512, "headtohead": 10_000, "serve": 256,
             "query": 1_000, "replicate": 256, "ingress": 256,
+            "posture": 1_000,
         }.get(args.mode, 1_000)
 
     import jax
@@ -2517,6 +2645,8 @@ def main() -> None:
         return bench_replicate(args)
     if args.mode == "ingress":
         return bench_ingress(args)
+    if args.mode == "posture":
+        return bench_posture(args)
 
     from kubernetes_verification_tpu.encode.encoder import (
         encode_cluster,

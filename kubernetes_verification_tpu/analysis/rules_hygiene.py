@@ -460,3 +460,59 @@ class ConcurrencyHygieneRule(Rule):
             if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 break
         return False
+
+
+@register
+class BoundedJournalRule(Rule):
+    id = "bounded-journal"
+    rationale = (
+        "The posture plane journals *witnesses* extracted from per-batch "
+        "delta planes, and the extraction index sets (`np.nonzero` / "
+        "`flatnonzero` / `argwhere`) scale with the delta — a pathological "
+        "batch (FullResync flipping half the matrix) would otherwise "
+        "balloon one journal record to O(N²) witness entries and stall the "
+        "apply path serialising them. Any function on the posture modules "
+        "that extracts indices must also cap what it keeps: at least one "
+        "slice with an explicit upper bound (`[:TOP_K]`, `[:cap]`) in the "
+        "same function body. Extractions bounded some other way (a loop "
+        "over an already-small [G, G] namespace matrix) carry an inline "
+        "`# kvtpu: ignore[bounded-journal]` with the reason."
+    )
+    example = "witnesses = np.flatnonzero(changed)  # no [:cap] in scope"
+
+    #: the modules whose extraction feeds the posture journal; index
+    #: extraction elsewhere is not a journal-size liability
+    POSTURE_FILES = ("serve/posture.py", "ops/posture.py")
+
+    #: calls that materialise an index set proportional to the delta
+    #: (`where` only in its single-argument extractor form — the
+    #: three-argument select returns a same-shaped array, not indices)
+    EXTRACTORS = frozenset({"nonzero", "flatnonzero", "argwhere", "where"})
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.rel not in self.POSTURE_FILES:
+            return
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            extractions = []
+            capped = False
+            for node in walk_own(fn):
+                if isinstance(node, ast.Call):
+                    name = _last_name(node.func)
+                    if name in self.EXTRACTORS and (
+                        name != "where" or len(node.args) == 1
+                    ):
+                        extractions.append((node.lineno, name))
+                elif isinstance(node, ast.Slice) and node.upper is not None:
+                    capped = True
+            if extractions and not capped:
+                for lineno, name in extractions:
+                    yield Finding(
+                        self.id, ctx.rel, lineno,
+                        f"{name}() extracts delta-proportional indices but "
+                        f"{fn.name}() has no bounding slice — a "
+                        "pathological batch makes the journal record "
+                        "O(N^2); keep a top-k cap ([:TOP_K]) next to every "
+                        "extraction (or justify with an inline ignore)",
+                    )

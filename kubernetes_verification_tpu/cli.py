@@ -957,6 +957,27 @@ def _resume_serve_service(args, serve_config):
     return result.service, skipped, result.source, result
 
 
+def _maybe_enable_posture(svc, args):
+    """Enable the posture plane when any --posture* flag asked for it;
+    returns the tracker (or None). Malformed alert rules are input
+    errors, like malformed --slo specs."""
+    journal = getattr(args, "posture_journal", None)
+    alerts = getattr(args, "posture_alert", None) or []
+    if not (getattr(args, "posture", False) or journal or alerts):
+        return None
+    from .serve import parse_posture_rule
+
+    try:
+        rules = [parse_posture_rule(s) for s in alerts]
+    except ValueError as e:
+        raise SystemExit(f"serve: {e}")
+    return svc.enable_posture(
+        journal_path=journal,
+        rules=rules,
+        top_k=getattr(args, "posture_top_k", None),
+    )
+
+
 def _run_serve(args) -> int:
     from .resilience.errors import (
         EXIT_OK,
@@ -992,6 +1013,7 @@ def _run_serve(args) -> int:
         cm = CheckpointManager(args.checkpoint_dir)
     if getattr(args, "assert_file", None):
         svc.assertions.extend(load_assertions(args.assert_file))
+    posture = _maybe_enable_posture(svc, args)
     checkpoints = 0
 
     def _checkpoint() -> None:
@@ -1067,6 +1089,8 @@ def _run_serve(args) -> int:
     }
     if skipped:
         out["skipped_documents"] = skipped
+    if posture is not None:
+        out["posture"] = posture.health()
     if args.snapshot_out:
         out["snapshot"] = args.snapshot_out
     if cm is not None:
@@ -1092,6 +1116,14 @@ def _run_serve(args) -> int:
         )
         for v in svc.violations:
             print(f"  VIOLATION: {v.describe()}")
+        if posture is not None:
+            ph = posture.health()
+            print(
+                f"  posture: {ph['reachable_pairs']} reachable pairs @ "
+                f"gen {ph['generation']} "
+                f"(+{ph['widened_last']}/-{ph['narrowed_last']} last, "
+                f"{ph['violations']} alert violations)"
+            )
         if args.snapshot_out:
             print(f"  snapshot: {args.snapshot_out}")
         if recovery is not None:
@@ -1133,6 +1165,7 @@ def _run_follow(args) -> int:
     svc = follower.service
     if getattr(args, "assert_file", None):
         svc.assertions.extend(load_assertions(args.assert_file))
+    posture = _maybe_enable_posture(svc, args)
     # tail loop: the same capped exponential backoff EventSource.tail
     # uses, with a leader heartbeat (and, opted in, a promotion check)
     # between drains
@@ -1173,6 +1206,8 @@ def _run_follow(args) -> int:
         "violations": [v.describe() for v in svc.violations],
         **svc.stats.to_dict(),
     }
+    if posture is not None:
+        out["posture"] = posture.health()
     if args.json:
         print(json.dumps(out, sort_keys=True))
     else:
@@ -1895,6 +1930,7 @@ def _run_fleet(args) -> int:
     objectives' multi-window burn rates (exit 1 past ``--burn-threshold``)."""
     from .observe.fleet import (
         SloMonitor,
+        fleet_row,
         parse_slo_spec,
         render_fleet,
         scrape_replica,
@@ -1922,13 +1958,10 @@ def _run_fleet(args) -> int:
         print(
             json.dumps(
                 {
+                    # each replica object mirrors the table row
+                    # (fleet_row) plus the raw health document
                     "replicas": [
-                        {
-                            "url": s.url,
-                            "ok": s.ok,
-                            "error": s.error,
-                            "health": s.health,
-                        }
+                        dict(fleet_row(s), health=s.health)
                         for s in scrapes
                     ],
                     "slo": {
@@ -1960,6 +1993,127 @@ def _run_fleet(args) -> int:
     if worst > args.burn_threshold:
         return EXIT_VIOLATIONS
     return EXIT_OK
+
+
+def cmd_posture(args) -> int:
+    from .resilience.errors import KvTpuError
+
+    try:
+        with _observed(args):
+            return _run_posture(args)
+    except KvTpuError as e:
+        return _diagnose(args, e)
+
+
+def _posture_journal_path(arg: str) -> str:
+    import os
+
+    from .serve.posture import POSTURE_JOURNAL
+
+    path = arg
+    if os.path.isdir(path):
+        path = os.path.join(path, POSTURE_JOURNAL)
+    if not os.path.exists(path):
+        raise SystemExit(f"posture: no journal at {path}")
+    return path
+
+
+def _run_posture(args) -> int:
+    """``kv-tpu posture``: read a crc'd posture journal — timeline of
+    per-generation reach deltas, ``--watch`` tailing, ``--diff A B``
+    aggregation. Exit 1 when any rendered record carries an alert
+    violation (the CI-gate contract); a torn journal tail is reported on
+    stderr, everything before it is trusted."""
+    import time as _time
+
+    from .resilience.errors import EXIT_OK, EXIT_VIOLATIONS
+    from .serve.posture import (
+        posture_diff,
+        render_posture_timeline,
+        scan_posture,
+    )
+
+    path = _posture_journal_path(args.journal)
+    scan = scan_posture(path)
+    if not scan.ok:
+        print(
+            f"posture: journal torn at line {scan.torn_lineno} "
+            f"({scan.torn_error}); rendering the valid prefix",
+            file=sys.stderr,
+        )
+    records = scan.records
+
+    if args.diff:
+        gen_a, gen_b = args.diff
+        diff = posture_diff(records, gen_a, gen_b)
+        if args.json:
+            print(json.dumps(diff, sort_keys=True))
+        else:
+            print(
+                f"gen {diff['gen_a']} -> {diff['gen_b']} "
+                f"({diff['generations']} generations): "
+                f"+{diff['widened']}/-{diff['narrowed']} pairs, "
+                f"reachable {diff['reachable_at_a']} -> "
+                f"{diff['reachable_at_b']}"
+            )
+            for label, moved in (
+                ("widened", diff["ns_widened"]),
+                ("narrowed", diff["ns_narrowed"]),
+            ):
+                for pair, count in moved.items():
+                    print(f"  {label} {pair}: {count}")
+            if diff["alerts"]:
+                print(f"  alert violations in range: {diff['alerts']}")
+        return EXIT_VIOLATIONS if diff["alerts"] else EXIT_OK
+
+    if args.watch:
+        seen = 0
+        idle_since = _time.monotonic()
+        violations = 0
+        try:
+            while True:
+                scan = scan_posture(path)
+                fresh = scan.records[seen:]
+                for r in fresh:
+                    violations += len(r.alerts)
+                    if args.json:
+                        print(json.dumps(r.to_dict(), sort_keys=True))
+                    else:
+                        for line in render_posture_timeline(
+                            [r], limit=1
+                        )[1:]:
+                            print(line)
+                if fresh:
+                    seen = len(scan.records)
+                    idle_since = _time.monotonic()
+                elif (
+                    args.idle_timeout is not None
+                    and _time.monotonic() - idle_since >= args.idle_timeout
+                ):
+                    break
+                _time.sleep(args.poll)
+        except KeyboardInterrupt:
+            pass
+        return EXIT_VIOLATIONS if violations else EXIT_OK
+
+    shown = list(records)[-args.limit:]
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "journal": path,
+                    "records": [r.to_dict() for r in shown],
+                    "torn_lineno": scan.torn_lineno,
+                },
+                sort_keys=True,
+            )
+        )
+    else:
+        for line in render_posture_timeline(records, limit=args.limit):
+            print(line)
+    return (
+        EXIT_VIOLATIONS if any(r.alerts for r in shown) else EXIT_OK
+    )
 
 
 def cmd_jobs(args) -> int:
@@ -2564,6 +2718,30 @@ def main(argv: Optional[list] = None) -> int:
         "PATH, if given, enables a from-scratch rebuild when every "
         "generation is damaged",
     )
+    p.add_argument(
+        "--posture", action="store_true",
+        help="enable the posture observability plane: record the exact "
+        "reachability delta (widened/narrowed pairs, per-namespace "
+        "movement, top-k witnesses) for every applied batch",
+    )
+    p.add_argument(
+        "--posture-journal", metavar="FILE",
+        help="append each posture record to this crc'd JSONL journal "
+        "(read back with kv-tpu posture); implies --posture",
+    )
+    p.add_argument(
+        "--posture-alert", action="append", default=[], metavar="RULE",
+        help="posture drift alert rule, repeatable — 'deny ns:SRC -> "
+        "ns:DST', 'max-widening N pairs/batch' or 'max-narrowing N "
+        "pairs/batch'; violations exit 1, increment "
+        "kvtpu_posture_alert_violations_total and flight-record the "
+        "offending delta; implies --posture",
+    )
+    p.add_argument(
+        "--posture-top-k", type=int, default=None, metavar="K",
+        help="most-changed source rows decoded into witnesses per "
+        "record (default 8; every extraction stays capped)",
+    )
     p.add_argument("--no-self-traffic", dest="self_traffic", action="store_false")
     p.add_argument("--no-default-allow", dest="default_allow", action="store_false")
     p.add_argument("--json", action="store_true")
@@ -2793,6 +2971,44 @@ def main(argv: Optional[list] = None) -> int:
     p.add_argument("--json", action="store_true")
     _add_obs_flags(p)
     p.set_defaults(fn=cmd_fleet)
+
+    p = sub.add_parser(
+        "posture",
+        help="read a posture journal: reachability-drift timeline per "
+        "generation, --watch tailing, --diff between two generations "
+        "(exit 1 when rendered records carry alert violations)",
+    )
+    p.add_argument(
+        "journal",
+        help="posture journal file (posture.jsonl) or a directory "
+        "containing one (e.g. the serve --posture-journal target)",
+    )
+    p.add_argument(
+        "--limit", type=int, default=20, metavar="N",
+        help="timeline: render the last N records (default 20)",
+    )
+    p.add_argument(
+        "--diff", nargs=2, type=int, metavar=("GEN_A", "GEN_B"),
+        help="aggregate the exact posture movement between two "
+        "generations (net widened/narrowed, namespace movement, "
+        "witnesses)",
+    )
+    p.add_argument(
+        "--watch", action="store_true",
+        help="tail the journal, rendering each new record as it lands",
+    )
+    p.add_argument(
+        "--poll", type=float, default=0.5, metavar="SECONDS",
+        help="with --watch: journal poll interval",
+    )
+    p.add_argument(
+        "--idle-timeout", type=float, default=None, metavar="SECONDS",
+        help="with --watch: stop after this long with no new records "
+        "(default: run until interrupted)",
+    )
+    p.add_argument("--json", action="store_true")
+    _add_obs_flags(p)
+    p.set_defaults(fn=cmd_posture)
 
     p = sub.add_parser(
         "jobs",

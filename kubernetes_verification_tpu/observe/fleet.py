@@ -44,6 +44,7 @@ __all__ = [
     "ReplicaScrape",
     "scrape_replica",
     "render_fleet",
+    "fleet_row",
     "SloObjective",
     "parse_slo_spec",
     "SloMonitor",
@@ -120,16 +121,23 @@ def _fmt(value, digits: int = 3) -> str:
     return str(value)
 
 
-def _tenant_summary(samples, *, digits: Optional[int] = None, top: int = 2) -> str:
-    """Compact per-tenant column text from parsed metric samples
-    (``[(labels, value), ...]``): the ``top`` largest as ``tenant=value``,
-    a ``+N`` tail for the rest, ``-`` when the family is absent."""
+def _tenant_totals(samples) -> Dict[str, float]:
+    """Per-tenant sums from parsed metric samples ``[(labels, value), ...]``
+    (labels without a ``tenant`` key are skipped)."""
     per: Dict[str, float] = {}
     for labels, value in samples or []:
         tenant = labels.get("tenant")
         if tenant is None:
             continue
         per[tenant] = per.get(tenant, 0.0) + value
+    return per
+
+
+def _tenant_summary(samples, *, digits: Optional[int] = None, top: int = 2) -> str:
+    """Compact per-tenant column text from parsed metric samples
+    (``[(labels, value), ...]``): the ``top`` largest as ``tenant=value``,
+    a ``+N`` tail for the rest, ``-`` when the family is absent."""
+    per = _tenant_totals(samples)
     if not per:
         return "-"
     items = sorted(per.items(), key=lambda kv: (-kv[1], kv[0]))
@@ -142,21 +150,44 @@ def _tenant_summary(samples, *, digits: Optional[int] = None, top: int = 2) -> s
     return ",".join(cells)
 
 
+def _posture_summary(health: Optional[dict]) -> str:
+    """Compact posture column text from a ``/healthz`` document: current
+    reachable-pair count, last generation's movement (``+widened/-narrowed``)
+    and a ``!N`` suffix for accumulated alert violations; ``-`` when the
+    replica has no posture plane enabled."""
+    p = (health or {}).get("service") or {}
+    p = p.get("posture")
+    if not p or p.get("reachable_pairs") is None:
+        return "-"
+    txt = (
+        f"{p['reachable_pairs']}p "
+        f"+{p.get('widened_last', 0)}/-{p.get('narrowed_last', 0)}"
+    )
+    violations = p.get("violations") or 0
+    if violations:
+        txt += f" !{violations}"
+    return txt
+
+
 def render_fleet(scrapes: Sequence[ReplicaScrape]) -> List[str]:
     """The fleet table: one aligned row per replica, down replicas
     included (their row says why). ``shed`` / ``quota`` summarise the
     front-door admission metrics per tenant (total typed rejections and
     token-bucket utilisation) so an operator sees who is being refused
-    where without correlating counters by hand."""
+    where without correlating counters by hand; ``posture`` is the
+    reach-drift plane (reachable pairs, last movement, alert count)."""
     header = (
         "replica", "role", "epoch", "last_seq", "lag_s", "breaker", "aot",
-        "shed", "quota",
+        "shed", "quota", "posture",
     )
     rows = [header]
     for s in scrapes:
         if not s.ok:
             rows.append(
-                (s.url, "DOWN", "-", "-", "-", s.error or "-", "-", "-", "-")
+                (
+                    s.url, "DOWN", "-", "-", "-", s.error or "-", "-",
+                    "-", "-", "-",
+                )
             )
             continue
         h = s.health or {}
@@ -190,6 +221,7 @@ def render_fleet(scrapes: Sequence[ReplicaScrape]) -> List[str]:
                     metrics.get("kvtpu_admission_quota_utilization"),
                     digits=2,
                 ),
+                _posture_summary(h),
             )
         )
     widths = [max(len(r[i]) for r in rows) for i in range(len(header))]
@@ -197,6 +229,35 @@ def render_fleet(scrapes: Sequence[ReplicaScrape]) -> List[str]:
         "  ".join(cell.ljust(widths[i]) for i, cell in enumerate(row)).rstrip()
         for row in rows
     ]
+
+
+def fleet_row(s: ReplicaScrape) -> dict:
+    """The machine-readable mirror of one :func:`render_fleet` row —
+    ``kv-tpu fleet --json`` emits these so CI consumes fleet state without
+    screen-scraping the aligned table. Raw values, not column text: lag is
+    a float, shed/quota are per-tenant maps, ``posture`` is the replica's
+    posture health fragment (None when the plane is disabled)."""
+    h = s.health or {}
+    metrics = s.metrics or {}
+    svc = h.get("service") or {}
+    return {
+        "url": s.url,
+        "ok": s.ok,
+        "error": s.error,
+        "role": h.get("role"),
+        "epoch": h.get("epoch"),
+        "last_seq": h.get("last_seq"),
+        "lag_s": s.lag_seconds,
+        "breakers": h.get("breakers") or {},
+        "aot": h.get("aot"),
+        "shed": _tenant_totals(
+            metrics.get("kvtpu_admission_rejections_total")
+        ),
+        "quota": _tenant_totals(
+            metrics.get("kvtpu_admission_quota_utilization")
+        ),
+        "posture": svc.get("posture"),
+    }
 
 
 @dataclass(frozen=True)
@@ -257,7 +318,12 @@ class SloMonitor:
 
     objectives: Sequence[SloObjective]
     max_observations: int = 4096
+    #: seconds a known source (replica URL) stays on the books after its
+    #: last observation; a source silent for longer is treated as
+    #: decommissioned rather than unscrapeable
+    source_ttl: float = 7200.0
     _events: Dict[str, collections.deque] = field(default_factory=dict)
+    _sources: Dict[str, Dict[str, float]] = field(default_factory=dict)
     _lock: threading.Lock = field(default_factory=threading.Lock)
 
     def __post_init__(self) -> None:
@@ -265,6 +331,7 @@ class SloMonitor:
             self._events[o.name] = collections.deque(
                 maxlen=self.max_observations
             )
+            self._sources[o.name] = {}
 
     def objective(self, name: str) -> SloObjective:
         for o in self.objectives:
@@ -272,12 +339,24 @@ class SloMonitor:
                 return o
         raise KeyError(name)  # kvtpu: ignore[error-taxonomy] mapping-lookup contract on a programmer-facing accessor
 
-    def record(self, name: str, ok: bool, ts: Optional[float] = None) -> None:
-        """One observation for ``name``: ``ok`` consumed no budget."""
+    def record(
+        self,
+        name: str,
+        ok: bool,
+        ts: Optional[float] = None,
+        source: Optional[str] = None,
+    ) -> None:
+        """One observation for ``name``: ``ok`` consumed no budget.
+        ``source`` (a replica URL) registers where it came from, so a
+        source that later falls silent is charged against the objective
+        instead of vanishing from it; sourceless observations keep the
+        pre-source semantics (no data, no violation)."""
         if ts is None:
             ts = get_clock().wall()
         with self._lock:
             self._events[name].append((ts, bool(ok)))
+            if source is not None:
+                self._sources[name][source] = ts
 
     def observe_scrape(self, scrape: ReplicaScrape) -> None:
         """Fold one replica scrape into every objective: availability-
@@ -286,27 +365,56 @@ class SloMonitor:
         bad for those too — its staleness is unbounded)."""
         for o in self.objectives:
             if o.bound is None:
-                self.record(o.name, scrape.ok)
+                self.record(o.name, scrape.ok, source=scrape.url)
             else:
                 lag = scrape.lag_seconds
-                self.record(o.name, scrape.ok and lag is not None and lag <= o.bound)
+                self.record(
+                    o.name,
+                    scrape.ok and lag is not None and lag <= o.bound,
+                    source=scrape.url,
+                )
+
+    def _silent_sources(
+        self, name: str, cutoff: float, now: float
+    ) -> List[str]:
+        """Known sources (seen within ``source_ttl``) with zero
+        observations inside the window — each is one synthetic bad
+        availability event: a replica nobody managed to scrape is not
+        healthy, it is invisible (lock held)."""
+        horizon = now - self.source_ttl
+        sources = self._sources[name]
+        for src in [s for s, ts in sources.items() if ts < horizon]:
+            del sources[src]  # decommissioned, not unscrapeable
+        return [s for s, ts in sources.items() if ts < cutoff]
 
     def burn_rate(
         self, name: str, window_seconds: float, now: Optional[float] = None
     ) -> float:
         """``bad_fraction / budget`` over the trailing window; 0.0 with no
         observations (no data is not a violation), ``inf`` when a
-        zero-budget objective saw a bad event."""
+        zero-budget objective saw a bad event.
+
+        A *known* source with zero in-window observations counts as one
+        bad event (availability-shaped objectives only): before this, a
+        replica that stopped answering scrapes entirely aged out of the
+        window and silently contributed zero burn — the least available
+        replica was the one the monitor ignored."""
         if now is None:
             now = get_clock().wall()
         o = self.objective(name)
         cutoff = now - window_seconds
         with self._lock:
             events = [e for e in self._events[name] if e[0] >= cutoff]
-        if not events:
+            silent = (
+                self._silent_sources(name, cutoff, now)
+                if o.bound is None
+                else []
+            )
+        total = len(events) + len(silent)
+        if not total:
             return 0.0
-        bad = sum(1 for _, ok in events if not ok)
-        bad_fraction = bad / len(events)
+        bad = sum(1 for _, ok in events if not ok) + len(silent)
+        bad_fraction = bad / total
         if o.budget <= 0.0:
             return float("inf") if bad else 0.0
         return bad_fraction / o.budget

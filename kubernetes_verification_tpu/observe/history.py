@@ -109,6 +109,10 @@ _LOWER_IS_BETTER_METRICS = frozenset(
         # surface must stay effectively free (<2%), and growth here is a
         # regression in the serving path, not the environment
         "net_scrape_overhead_pct",
+        # the posture plane's tax on the serving apply path: the exact
+        # per-batch reach delta must stay under 5% of apply (bench.py
+        # --mode posture asserts the budget inline as well)
+        "posture_overhead_pct",
     }
 )
 #: sentinel context series: the round's NOISE measurements. Never gated —

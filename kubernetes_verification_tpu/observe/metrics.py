@@ -102,6 +102,11 @@ __all__ = [
     "ADMISSION_BROWNOUT_TRANSITIONS_TOTAL",
     "AUTOSCALE_DECISIONS_TOTAL",
     "AUTOSCALE_FLEET_SIZE",
+    "POSTURE_REACHABLE_PAIRS",
+    "POSTURE_WIDENED_TOTAL",
+    "POSTURE_NARROWED_TOTAL",
+    "POSTURE_DELTA_SECONDS",
+    "POSTURE_ALERT_VIOLATIONS_TOTAL",
     "REQUIRED_FAMILIES",
 ]
 
@@ -825,6 +830,49 @@ AUTOSCALE_FLEET_SIZE = Gauge(
     "kvtpu_autoscale_decisions_total to audit every spawn/retire.",
 )
 
+POSTURE_REACHABLE_PAIRS = Gauge(
+    "kvtpu_posture_reachable_pairs",
+    "Total reachable (src, dst) pod pairs in the current verifier "
+    "generation, recomputed from the packed word state after every applied "
+    "mutation batch — the level whose per-generation first difference is "
+    "exactly widened minus narrowed.",
+)
+
+POSTURE_WIDENED_TOTAL = Counter(
+    "kvtpu_posture_widened_total",
+    "Pod pairs that became reachable across all applied mutation batches "
+    "— each batch contributes the popcount of `cur & ~prev` over the "
+    "packed word states, bit-identical to a dense recompute-and-diff; "
+    "monotone drift here against a flat narrowed counter is a posture "
+    "regression even when every batch stays under the alert bound.",
+)
+
+POSTURE_NARROWED_TOTAL = Counter(
+    "kvtpu_posture_narrowed_total",
+    "Pod pairs that became unreachable across all applied mutation "
+    "batches (`prev & ~cur` popcount per batch) — the lockdown half of "
+    "the posture ledger; reconcile widened - narrowed against the "
+    "reachable-pair gauge's movement to audit the journal.",
+)
+
+POSTURE_DELTA_SECONDS = Histogram(
+    "kvtpu_posture_delta_seconds",
+    "Wall-clock seconds the posture tracker spent deriving one "
+    "generation's delta record (packed XOR/popcount kernels + namespace "
+    "aggregation + witness decode + journal append) — the overhead "
+    "`bench.py --mode posture` gates at < 5% of the apply path.",
+    buckets=(0.0005, 0.002, 0.01, 0.05, 0.2, 1.0),
+)
+
+POSTURE_ALERT_VIOLATIONS_TOTAL = Counter(
+    "kvtpu_posture_alert_violations_total",
+    "Posture alert rules violated by an applied generation, by rule kind "
+    "('deny', 'max-widening', 'max-narrowing') — every increment rides "
+    "with a typed PostureAlertError on the service, a traced event, and "
+    "a flight-recorder dump of the offending delta.",
+    ("rule",),
+)
+
 #: The frozen dashboard contract: families that must exist in every build.
 #: New families are appended here by the PR that introduces them; the
 #: `metrics-names` lint rule and `scripts/check_metrics_names.py` both fail
@@ -941,6 +989,12 @@ REQUIRED_FAMILIES = frozenset(
         "kvtpu_admission_brownout_transitions_total",
         "kvtpu_autoscale_decisions_total",
         "kvtpu_autoscale_fleet_size",
+        # posture observability plane (serve/posture.py + ops/posture.py)
+        "kvtpu_posture_reachable_pairs",
+        "kvtpu_posture_widened_total",
+        "kvtpu_posture_narrowed_total",
+        "kvtpu_posture_delta_seconds",
+        "kvtpu_posture_alert_violations_total",
     }
 )
 

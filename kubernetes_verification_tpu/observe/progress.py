@@ -24,6 +24,7 @@ so tests drive rates and ETAs deterministically.
 from __future__ import annotations
 
 import itertools
+import math
 import os
 import threading
 from typing import Callable, Dict, List, Optional
@@ -85,7 +86,11 @@ class ProgressTicker:
         min_interval: float = 0.0,
     ) -> None:
         self.job = job
-        self.total = int(total) if total else None
+        # a job reporting total_passes=0 (or any non-positive total) has an
+        # *unknown* extent, not a zero-length one: normalise to None so the
+        # fraction/ETA math never divides by it and renderers draw the
+        # indeterminate bar
+        self.total = int(total) if total and int(total) > 0 else None
         self.unit = unit
         self.done = int(initial)
         self.on_pass = on_pass
@@ -231,12 +236,17 @@ class ProgressTicker:
 
 # ------------------------------------------------------------- rendering
 def eta_bar(fraction: Optional[float], width: int = 20) -> str:
-    """``[########------------]`` for a known fraction, a spinner-less
-    unknown marker otherwise — shared by ``kv-tpu jobs`` and ``kv-tpu
-    top``."""
-    if fraction is None or fraction < 0:
+    """``[########------------]`` for a known fraction, an indeterminate
+    ``[????]`` bar otherwise — shared by ``kv-tpu jobs`` and ``kv-tpu
+    top``. Anything unrenderable (None, negative, NaN/inf from a job that
+    reported a zero or garbage total) is "unknown", never a raise: this
+    runs inside the operator's status loop."""
+    if fraction is None:
         return "[" + "?" * width + "]"
-    fraction = max(0.0, min(1.0, float(fraction)))
+    fraction = float(fraction)
+    if not math.isfinite(fraction) or fraction < 0:
+        return "[" + "?" * width + "]"
+    fraction = max(0.0, min(1.0, fraction))
     fill = int(round(fraction * width))
     return "[" + "#" * fill + "-" * (width - fill) + "]"
 
@@ -260,7 +270,10 @@ def render_jobs(jobs: List[dict], bar_width: int = 20) -> List[str]:
     for j in jobs:
         total = j.get("total")
         done = j.get("done", 0)
-        counter = f"{done}/{total}" if total else str(done)
+        # non-positive totals come from jobs that reported total_passes=0:
+        # unknown extent — render the bare counter + indeterminate bar
+        known_total = isinstance(total, (int, float)) and total > 0
+        counter = f"{done}/{total}" if known_total else str(done)
         rate = j.get("rate")
         rows.append(
             (

@@ -108,6 +108,13 @@ class DeviceStateCache:
     def peek(self) -> Optional[DeviceQueryState]:
         return self._front
 
+    def retired(self) -> Optional[DeviceQueryState]:
+        """The previous front (None before the second publish). Valid until
+        the next :meth:`publish` ages it out — the posture tracker reads the
+        outgoing generation's packed words from here, so the double buffer
+        doubles as the generation-over-generation diff window."""
+        return self._retired
+
     def publish(self, state: DeviceQueryState) -> DeviceQueryState:
         """Flip ``state`` in as the new front; returns it for chaining."""
         with self._lock:
@@ -139,13 +146,45 @@ def _upload_i32(vec, device) -> Tuple[Any, int]:
     return arr, host.nbytes
 
 
-def dense_query_state(engine, generation: int) -> DeviceQueryState:
+def _dense_reach_words(engine) -> Tuple[Any, int]:
+    """Pack the dense engine's bool reach matrix into uint32 words
+    host-side (little bit order, matching `ops.tiled.pack_bool_cols`) and
+    upload the ``[n, ceil32(n)]`` word plane; returns (array, h2d bytes).
+    Forces the dense engine's lazy reach derivation — the documented cost
+    of posture on the dense path."""
+    reach = np.asarray(engine.reach, dtype=bool)
+    n = reach.shape[0]
+    n_words = max(1, -(-n // 32))
+    bits = np.zeros((n, n_words * 32), dtype=bool)
+    bits[:, :n] = reach
+    packed = np.packbits(
+        bits.reshape(n, n_words, 32), axis=2, bitorder="little"
+    )
+    host = np.ascontiguousarray(
+        packed.reshape(n, n_words, 4).view("<u4")[..., 0]
+    )
+    device = getattr(engine, "device", None)
+    if device is not None:
+        arr = jax.device_put(host, device)
+    else:
+        arr = jnp.asarray(host)
+    return arr, host.nbytes
+
+
+def dense_query_state(
+    engine, generation: int, with_reach_words: bool = False
+) -> DeviceQueryState:
     """Snapshot a dense `IncrementalVerifier`'s query operands.
 
     The count matrices already live on device (aliased); the isolation
     vectors are host mirrors on the dense engine, so they are uploaded
     once per generation here — the transfer the per-dispatch
     ``jnp.asarray`` used to repeat for every batch.
+
+    With ``with_reach_words`` the state also carries an owned packed
+    uint32 copy of the reach matrix for the posture tracker, so the
+    retired slot of the double buffer holds the previous generation's
+    exact posture.
     """
     device = getattr(engine, "device", None)
     h2d = 0
@@ -153,24 +192,32 @@ def dense_query_state(engine, generation: int) -> DeviceQueryState:
     h2d += nb
     eg_iso, nb = _upload_i32(engine._eg_iso, device)
     h2d += nb
+    arrays = {
+        "ing_count": engine._ing_count,
+        "eg_count": engine._eg_count,
+        "ing_iso": ing_iso,
+        "eg_iso": eg_iso,
+    }
+    owned = ["ing_iso", "eg_iso"]
+    if with_reach_words:
+        arrays["reach_words"], nb = _dense_reach_words(engine)
+        owned.append("reach_words")
+        h2d += nb
     if h2d:
         QUERY_H2D_BYTES_TOTAL.labels(kind="dense").inc(h2d)
     return DeviceQueryState(
         generation=generation,
         kind="dense",
         n=int(engine._ing_count.shape[0]),
-        arrays={
-            "ing_count": engine._ing_count,
-            "eg_count": engine._eg_count,
-            "ing_iso": ing_iso,
-            "eg_iso": eg_iso,
-        },
-        owned=("ing_iso", "eg_iso"),
+        arrays=arrays,
+        owned=tuple(owned),
         meta={"h2d_bytes": h2d},
     )
 
 
-def packed_query_state(engine, generation: int) -> DeviceQueryState:
+def packed_query_state(
+    engine, generation: int, with_reach_words: bool = False
+) -> DeviceQueryState:
     """Snapshot a `PackedIncrementalVerifier`'s query operands.
 
     Every operand — the six per-policy maps, the column mask and the row
@@ -178,25 +225,44 @@ def packed_query_state(engine, generation: int) -> DeviceQueryState:
     snapshot aliases them all and owns nothing: zero host→device bytes,
     which is exactly what ``kvtpu_query_h2d_bytes_total`` staying flat
     across warm batches asserts.
+
+    With ``with_reach_words`` the state additionally *owns* a device copy
+    of the engine's packed reach words. A copy is mandatory: the packed
+    mutation kernels donate ``_packed`` on every step, so an alias would
+    be deleted out from under the retired state the posture tracker diffs
+    against. This is the one deliberate device→device copy on the packed
+    path — still no dense [N, N] anywhere.
     """
     (
         sel_ing8, sel_eg8, ing_by_pol, eg_by_pol, ing_cnt, eg_cnt,
     ) = engine._maps
+    arrays = {
+        "sel_ing8": sel_ing8,
+        "sel_eg8": sel_eg8,
+        "ing_by_pol": ing_by_pol,
+        "eg_by_pol": eg_by_pol,
+        "ing_cnt": ing_cnt,
+        "eg_cnt": eg_cnt,
+        "col_mask": engine._col_mask,
+        "row_valid": engine._row_valid,
+    }
+    owned: Tuple[str, ...] = ()
+    if with_reach_words:
+        if engine._packed is None:
+            from ..resilience.errors import ServeError
+
+            raise ServeError(
+                "packed engine is matrix-free (keep_matrix=False): no "
+                "reach words to snapshot for posture"
+            )
+        arrays["reach_words"] = jnp.array(engine._packed, copy=True)
+        owned = ("reach_words",)
     return DeviceQueryState(
         generation=generation,
         kind="packed",
         n=int(engine.n_pods),
-        arrays={
-            "sel_ing8": sel_ing8,
-            "sel_eg8": sel_eg8,
-            "ing_by_pol": ing_by_pol,
-            "eg_by_pol": eg_by_pol,
-            "ing_cnt": ing_cnt,
-            "eg_cnt": eg_cnt,
-            "col_mask": engine._col_mask,
-            "row_valid": engine._row_valid,
-        },
-        owned=(),
+        arrays=arrays,
+        owned=owned,
         meta={
             "h2d_bytes": 0,
             "n_padded": int(engine._n_padded),

@@ -122,6 +122,15 @@ from .queries import (
     check_assertions,
     load_assertions,
 )
+from .posture import (
+    PostureAlertError,
+    PostureRecord,
+    PostureRule,
+    PostureTracker,
+    parse_posture_rule,
+    posture_diff,
+    scan_posture,
+)
 from .service import ServeConfig, ServeStats, VerificationService
 
 __all__ = [
@@ -177,4 +186,11 @@ __all__ = [
     "WhatIfResult",
     "load_assertions",
     "check_assertions",
+    "PostureAlertError",
+    "PostureRecord",
+    "PostureRule",
+    "PostureTracker",
+    "parse_posture_rule",
+    "posture_diff",
+    "scan_posture",
 ]

@@ -224,6 +224,10 @@ class VerificationService:
             if sc.breaker_threshold > 0
             else None
         )
+        #: posture observability plane (serve/posture.py), None until
+        #: :meth:`enable_posture` — when set, every applied batch's
+        #: device-state flip is followed by an exact reach-delta record
+        self._posture = None
 
     # ------------------------------------------------------------ snapshots
     @classmethod
@@ -317,7 +321,55 @@ class VerificationService:
         }
         if br is not None:
             out["breaker"] = {br.backend: br.state}
+        if self._posture is not None:
+            out["posture"] = self._posture.health()
         return out
+
+    # --------------------------------------------------------------- posture
+    @property
+    def posture(self):
+        """The :class:`~.posture.PostureTracker` when posture observability
+        is enabled, else None."""
+        return self._posture
+
+    def enable_posture(
+        self,
+        journal_path=None,
+        rules=(),
+        top_k: Optional[int] = None,
+    ):
+        """Enable the posture observability plane: from the next applied
+        batch on, every generation gets an exact reach-delta record
+        (journaled when ``journal_path`` is set) with the alert ``rules``
+        evaluated against it. The current generation is recorded
+        immediately as the baseline.
+
+        Refused on a matrix-free packed engine: with ``keep_matrix=False``
+        there are no reach words to diff — posture needs the packed word
+        state resident (still no dense [N, N] anywhere)."""
+        from .posture import TOP_K_ROWS, PostureTracker
+
+        with self._lock:
+            if self._posture is not None:
+                raise ServeError("posture observability already enabled")
+            if self.packed and self._engine._packed is None:
+                raise ServeError(
+                    "matrix-free packed engine (keep_matrix=False) has no "
+                    "reach words to diff — build the engine with "
+                    "keep_matrix=True to enable posture observability"
+                )
+            self._posture = PostureTracker(
+                self,
+                journal_path=journal_path,
+                rules=rules,
+                top_k=top_k if top_k is not None else TOP_K_ROWS,
+            )
+            # force a posture-bearing front state NOW: the next flip
+            # retires it, making it the previous generation every
+            # subsequent diff runs against
+            self._device_states.publish(self._build_device_state())
+            self._posture.record()
+            return self._posture
 
     def pod_index(self, namespace: str, name: str) -> int:
         """Engine row index for pod ``namespace/name`` (ServeError when the
@@ -367,6 +419,10 @@ class VerificationService:
                     self._generation += 1
                     self._fallback_reach = None
                     self._refresh_device_state()
+                    if self._posture is not None:
+                        # the flip just retired the outgoing generation's
+                        # words: diff them against the new front, exactly
+                        self._posture.record()
                     if self._dirty_since is None:
                         self._dirty_since = time.monotonic()
             if self.assertions:
@@ -432,10 +488,19 @@ class VerificationService:
 
     # ------------------------------------------------------- device residency
     def _build_device_state(self):
+        with_words = self._posture is not None
         return (
-            packed_query_state(self._engine, self._generation)
+            packed_query_state(
+                self._engine,
+                self._generation,
+                with_reach_words=with_words,
+            )
             if self.packed
-            else dense_query_state(self._engine, self._generation)
+            else dense_query_state(
+                self._engine,
+                self._generation,
+                with_reach_words=with_words,
+            )
         )
 
     def _query_state(self):
@@ -620,6 +685,8 @@ class VerificationService:
                 self._worker = None
         if snapshot and self.serve_config.snapshot_dir:
             self.snapshot()
+        if self._posture is not None:
+            self._posture.close()
 
     def _run(self) -> None:
         sc = self.serve_config

@@ -395,3 +395,42 @@ def test_prometheus_exemplars_opt_in_and_round_trip(reg, exemplar_provider):
     assert len(ex) == 1 and ex[0]["exemplar"]["trace_id"] == "cafe" * 4
     assert ex[0]["value"] == pytest.approx(0.25)
     assert parse_exemplars(plain) == []
+
+
+def test_prometheus_escaped_label_values_round_trip(reg, exemplar_provider):
+    """Label values carrying the exposition's three escapes (backslash,
+    double-quote, newline) survive exporter -> parser byte-exactly, for
+    plain samples and for exemplar annotations."""
+    from kubernetes_verification_tpu.observe.export import (
+        parse_exemplars,
+        parse_prometheus,
+    )
+
+    tricky = 'quote:"q" back\\slash\nsecond line'
+    c = Counter("kvtpu_esc_total", "t", ("path",), registry=reg)
+    c.labels(path=tricky).inc(3)
+    c.labels(path="plain").inc()
+    text = to_prometheus(reg)
+    # escaped on the wire, never a raw newline inside a sample line
+    assert "\\n" in text
+    got = {
+        labels["path"]: value
+        for labels, value in parse_prometheus(text)["kvtpu_esc_total"]
+    }
+    assert got == {tricky: 3.0, "plain": 1.0}
+
+    h = Histogram(
+        "kvtpu_esc_seconds", "t", ("stage",), registry=reg, buckets=(1.0,)
+    )
+    exemplar_provider["trace_id"] = 'tr"ace\\id\ntail'
+    h.labels(stage=tricky).observe(0.5)
+    annotated = to_prometheus(reg, exemplars=True)
+    ex = [
+        e for e in parse_exemplars(annotated)
+        if e["name"].startswith("kvtpu_esc_seconds")
+    ]
+    assert ex and ex[0]["labels"]["stage"] == tricky
+    assert ex[0]["exemplar"]["trace_id"] == 'tr"ace\\id\ntail'
+    assert ex[0]["value"] == pytest.approx(0.5)
+    # the annotated body still parses to the same plain samples
+    assert parse_prometheus(annotated) == parse_prometheus(to_prometheus(reg))

@@ -1,0 +1,552 @@
+"""The posture observability plane: packed delta kernels against numpy
+oracles, the 500-event churn fuzz holding the tracker bit-identical to a
+dense recompute-and-diff at every generation, the crc'd journal's
+torn-tail contract, declarative drift alerts (typed error + metric +
+flight dump), the `kv-tpu posture` / fleet surface, and the
+``bounded-journal`` lint rule's fixtures."""
+import json
+import textwrap
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubernetes_verification_tpu.analysis import lint_source, rule_ids
+from kubernetes_verification_tpu.backends.base import VerifyConfig
+from kubernetes_verification_tpu.cli import main
+from kubernetes_verification_tpu.harness.generate import (
+    GeneratorConfig,
+    random_cluster,
+    random_event_stream,
+)
+from kubernetes_verification_tpu.observe import flight
+from kubernetes_verification_tpu.observe.metrics import REQUIRED_FAMILIES
+from kubernetes_verification_tpu.observe.registry import REGISTRY
+from kubernetes_verification_tpu.ops.posture import (
+    changed_columns,
+    ns_pair_counts,
+    ns_word_masks,
+    packed_row_popcount,
+    packed_xor_popcount,
+    topk_changed_rows,
+)
+from kubernetes_verification_tpu.packed_incremental import (
+    PackedIncrementalVerifier,
+)
+from kubernetes_verification_tpu.resilience import (
+    EXIT_OK,
+    EXIT_VIOLATIONS,
+    ServeError,
+)
+from kubernetes_verification_tpu.serve import (
+    PostureAlertError,
+    VerificationService,
+    parse_posture_rule,
+    posture_diff,
+    scan_posture,
+)
+from kubernetes_verification_tpu.serve.posture import (
+    NS_PAIR_CAP,
+    TOP_K_ROWS,
+    WITNESS_CAP,
+    PostureRecord,
+    _encode_record,
+    render_posture_timeline,
+)
+
+
+def _counter(name, key=""):
+    return REGISTRY.dump()["counters"].get(name, {}).get(key, 0.0)
+
+
+def _unpack(words: np.ndarray, n_cols: int) -> np.ndarray:
+    """uint32 [R, W] -> bool [R, n_cols] little-bit-order oracle."""
+    w = np.ascontiguousarray(np.asarray(words), dtype="<u4")
+    bits = np.unpackbits(
+        w.view(np.uint8).reshape(w.shape[0], -1), axis=1, bitorder="little"
+    )
+    return bits[:, :n_cols].astype(bool)
+
+
+# ----------------------------------------------------------- ops kernels
+def test_packed_xor_popcount_matches_unpacked_oracle():
+    rng = np.random.default_rng(5)
+    prev = rng.integers(0, 2**32, (13, 4), dtype=np.uint32)
+    cur = rng.integers(0, 2**32, (13, 4), dtype=np.uint32)
+    widened, narrowed, row_w, row_n = packed_xor_popcount(
+        jnp.asarray(prev), jnp.asarray(cur)
+    )
+    p, c = _unpack(prev, 128), _unpack(cur, 128)
+    assert np.array_equal(_unpack(widened, 128), c & ~p)
+    assert np.array_equal(_unpack(narrowed, 128), p & ~c)
+    assert np.array_equal(np.asarray(row_w), (c & ~p).sum(axis=1))
+    assert np.array_equal(np.asarray(row_n), (p & ~c).sum(axis=1))
+    assert np.array_equal(
+        np.asarray(packed_row_popcount(jnp.asarray(cur))), c.sum(axis=1)
+    )
+
+
+def test_topk_changed_rows_is_static_k():
+    counts, rows = topk_changed_rows(jnp.asarray([3, 0, 9, 1, 9], np.int32), 3)
+    assert np.asarray(counts).shape == (3,)
+    assert np.asarray(counts)[0] == 9
+    assert set(np.asarray(rows)[:2]) == {2, 4}
+
+
+def test_ns_pair_counts_matches_dense_grouping():
+    rng = np.random.default_rng(9)
+    n, words = 50, 2
+    delta = rng.integers(0, 2**32, (n, words), dtype=np.uint32)
+    # zero the padding columns beyond n so the oracle sees the same plane
+    dense = _unpack(delta, words * 32)
+    dense[:, n:] = False
+    delta = np.packbits(
+        np.pad(dense, ((0, 0), (0, words * 32 - dense.shape[1]))).reshape(
+            n, words, 32
+        ),
+        axis=2,
+        bitorder="little",
+    ).reshape(n, words, 4).view("<u4")[..., 0]
+    g = 3
+    col_ns = rng.integers(0, g, n)
+    row_ns = rng.integers(0, g, n).astype(np.int32)
+    masks = ns_word_masks(col_ns, g, words)
+    out = np.asarray(
+        ns_pair_counts(
+            jnp.asarray(delta), jnp.asarray(masks), jnp.asarray(row_ns), g
+        )
+    )
+    want = np.zeros((g, g), dtype=np.int64)
+    for s in range(g):
+        for d in range(g):
+            want[s, d] = dense[:, :n][np.ix_(row_ns == s, col_ns == d)].sum()
+    assert np.array_equal(out, want)
+
+
+def test_changed_columns_capped_and_ordered():
+    row = np.zeros(3, dtype=np.uint32)
+    row[0] = 0b1010110
+    row[2] = 1  # column 64
+    cols = changed_columns(row, cap=100)
+    assert list(cols) == [1, 2, 4, 6, 64]
+    assert list(changed_columns(row, cap=2)) == [1, 2]
+
+
+# ----------------------------------------------- rule grammar + journal
+def test_parse_posture_rule_grammar():
+    deny = parse_posture_rule("deny  ns:dev ->  ns:prod")
+    assert (deny.kind, deny.src_ns, deny.dst_ns) == ("deny", "dev", "prod")
+    widen = parse_posture_rule("max-widening 500 pairs/batch")
+    assert (widen.kind, widen.bound) == ("max-widening", 500)
+    assert parse_posture_rule("max-narrowing 7").bound == 7
+    for bad in ("deny dev -> prod", "max-widening", "max-widening -3", "nope"):
+        with pytest.raises(ValueError):
+            parse_posture_rule(bad)
+
+
+def test_journal_crc_round_trip_and_torn_tail(tmp_path):
+    path = str(tmp_path / "posture.jsonl")
+    records = [
+        PostureRecord(
+            seq=i, ts=100.0 + i, n_pods=8, reachable_pairs=10 + i,
+            widened=i, narrowed=0, delta_s=0.001,
+            ns_widened={"a->b": i} if i else {},
+            baseline=(i == 0),
+        )
+        for i in range(3)
+    ]
+    with open(path, "w") as fh:
+        for r in records:
+            fh.write(_encode_record(r) + "\n")
+    scan = scan_posture(path)
+    assert scan.ok and len(scan.records) == 3
+    assert [r.seq for r in scan.records] == [0, 1, 2]
+    assert scan.records[0].baseline and not scan.records[1].baseline
+    assert scan.records[2].ns_widened == {"a->b": 2}
+
+    # a torn tail (crash mid-append) keeps the valid prefix and reports
+    # the tear; a bit-flipped crc is detected, not silently decoded
+    with open(path, "a") as fh:
+        fh.write(_encode_record(records[0])[: 40])
+    scan = scan_posture(path)
+    assert not scan.ok and scan.torn_lineno == 4 and len(scan.records) == 3
+    lines = open(path).read().splitlines()
+    flipped = json.loads(lines[1])
+    flipped["reachable_pairs"] = 999_999
+    with open(path, "w") as fh:
+        fh.write(lines[0] + "\n" + json.dumps(flipped) + "\n")
+    scan = scan_posture(path)
+    assert scan.torn_lineno == 2 and len(scan.records) == 1
+    assert scan_posture(str(tmp_path / "missing.jsonl")).ok
+
+
+def test_posture_diff_telescopes_and_caps():
+    records = [
+        PostureRecord(
+            seq=i, ts=float(i), n_pods=4, reachable_pairs=100 + 2 * i,
+            widened=3 if i else 0, narrowed=1 if i else 0, delta_s=0.0,
+            ns_widened={"a->b": 3} if i else {},
+            witnesses=[{"src": f"s{i}", "dst": "d", "port": "*",
+                        "dir": "widened"}] if i else [],
+            baseline=(i == 0),
+        )
+        for i in range(5)
+    ]
+    d = posture_diff(records, 1, 4)
+    assert d["generations"] == 3
+    assert d["widened"] == 9 and d["narrowed"] == 3
+    assert d["reachable_at_a"] == 102 and d["reachable_at_b"] == 108
+    assert d["ns_widened"] == {"a->b": 9}
+    assert len(d["witnesses"]) <= TOP_K_ROWS * WITNESS_CAP
+    # argument order is normalised; empty span is a zero diff
+    assert posture_diff(records, 4, 1) == d
+    assert posture_diff(records, 4, 4)["generations"] == 0
+    lines = render_posture_timeline(records, limit=3)
+    assert lines[0].split()[0] == "gen"
+    assert len(lines) == 4 and lines[1].startswith("2")
+
+
+# --------------------------------------------------- the acceptance fuzz
+@pytest.fixture(scope="module")
+def churn64():
+    cluster = random_cluster(
+        GeneratorConfig(
+            n_pods=64, n_policies=24, n_namespaces=6, seed=7,
+            p_ipblock_peer=0.0, min_selector_labels=1,
+        )
+    )
+    events = random_event_stream(cluster, n_events=500, seed=3)
+    return cluster, events
+
+
+def test_posture_bit_identical_to_dense_oracle_500_events(churn64):
+    """The acceptance criterion: across a 500-event/64-pod churn stream
+    the packed tracker's widened/narrowed/reachable must equal a dense
+    recompute-and-compare oracle at EVERY generation, with no dense
+    [N, N] live on the packed path."""
+    cluster, events = churn64
+    n = len(cluster.pods)
+    cfg = VerifyConfig(compute_ports=False)
+    eng = PackedIncrementalVerifier(cluster, cfg, keep_matrix=True)
+    svc = VerificationService(engine=eng)
+    tracker = svc.enable_posture()
+    oracle = VerificationService(cluster, cfg)
+    prev_dense = np.asarray(oracle.reach(), dtype=bool)
+
+    state = svc._device_states.peek()
+    words = state.arrays["reach_words"]
+    # the packed posture path carries uint32 word planes, not an [N, N]
+    # bool matrix: W words cover the slot-rounded columns bit-packed
+    assert words.dtype == jnp.uint32
+    assert words.shape[1] * 32 < words.shape[0] * 8
+    assert tracker.records[0].baseline
+    assert tracker.records[0].reachable_pairs == int(prev_dense.sum())
+
+    checked = 0
+    for i in range(0, len(events), 25):
+        batch = events[i:i + 25]
+        applied = svc.apply(batch)
+        oracle.apply(batch)
+        if not applied:
+            continue
+        cur_dense = np.asarray(oracle.reach(), dtype=bool)
+        record = tracker.records[-1]
+        assert record.seq == svc.generation
+        widened = int((cur_dense & ~prev_dense).sum())
+        narrowed = int((prev_dense & ~cur_dense).sum())
+        assert record.widened == widened, f"gen {record.seq}"
+        assert record.narrowed == narrowed, f"gen {record.seq}"
+        assert record.reachable_pairs == int(cur_dense.sum()), (
+            f"gen {record.seq}"
+        )
+        # witnesses name real flipped pairs of this very generation
+        for w in record.witnesses:
+            s = oracle.pod_index(*w["src"].split("/"))
+            d = oracle.pod_index(*w["dst"].split("/"))
+            flipped = (
+                (cur_dense[s, d] and not prev_dense[s, d])
+                if w["dir"] == "widened"
+                else (prev_dense[s, d] and not cur_dense[s, d])
+            )
+            assert flipped, w
+        prev_dense = cur_dense
+        checked += 1
+    assert checked >= 10, "stream applied too few generations to mean much"
+
+    # the running namespace-pair totals (what deny rules read) equal a
+    # dense per-namespace grouping of the final reach matrix
+    ns = [p.namespace for p in cluster.pods]
+    want = {}
+    for s in range(n):
+        for d in range(n):
+            if prev_dense[s, d]:
+                key = (ns[s], ns[d])
+                want[key] = want.get(key, 0) + 1
+    assert tracker._ns_pairs == want
+    svc.close()
+    oracle.close()
+
+
+def test_tracker_journal_and_health_through_service(churn64, tmp_path):
+    cluster, events = churn64
+    path = str(tmp_path / "sub" / "posture.jsonl")
+    svc = VerificationService(cluster, VerifyConfig(compute_ports=False))
+    svc.enable_posture(journal_path=path)
+    for i in range(0, 100, 25):
+        svc.apply(events[i:i + 25])
+    h = svc.health()["posture"]
+    assert h["generation"] == svc.generation
+    assert h["journal"] == path and h["violations"] == 0
+    svc.close()
+    scan = scan_posture(path)
+    assert scan.ok and scan.records[0].baseline
+    assert [r.seq for r in scan.records] == sorted(
+        r.seq for r in scan.records
+    )
+    assert scan.records[-1].reachable_pairs == h["reachable_pairs"]
+
+
+# ------------------------------------------------------------ alerting
+def test_alert_violation_error_metric_and_flight_dump(churn64, tmp_path):
+    cluster, events = churn64
+    flight_dir = str(tmp_path / "flight")
+    flight.install(flight_dir, with_signal=False)
+    try:
+        svc = VerificationService(cluster, VerifyConfig(compute_ports=False))
+        before = _counter(
+            "kvtpu_posture_alert_violations_total", "rule=max-widening"
+        )
+        svc.enable_posture(rules=[parse_posture_rule("max-widening 0")])
+        applied = 0
+        for i in range(0, len(events), 25):
+            applied += svc.apply(events[i:i + 25])
+            if svc.violations:
+                break
+        assert svc.violations, "500-event churn never widened a pair?"
+        err = svc.violations[0]
+        assert isinstance(err, PostureAlertError)
+        assert err.kind == "max-widening" and err.measured > 0
+        assert f"gen {err.generation}" in err.describe()
+        assert _counter(
+            "kvtpu_posture_alert_violations_total", "rule=max-widening"
+        ) > before
+        record = next(r for r in svc.posture.records if r.alerts)
+        assert record.alerts[0]["kind"] == "max-widening"
+        svc.close()
+    finally:
+        flight.uninstall()
+    dumps = flight.recent_dumps(flight_dir)
+    assert dumps, "violation must leave a flight dump"
+    payload = flight.load_dump(dumps[0])
+    assert payload["trigger"] == "posture-alert"
+    assert payload["info"]["record"]["seq"] == err.generation
+
+    # the dump is loadable by `kv-tpu recover` even with zero checkpoint
+    # generations in the directory
+    assert main(["recover", flight_dir]) == EXIT_OK
+
+
+def test_deny_rule_reads_running_ns_pairs(churn64):
+    cluster, _ = churn64
+    ns = sorted({p.namespace for p in cluster.pods})
+    svc = VerificationService(cluster, VerifyConfig(compute_ports=False))
+    tracker = svc.enable_posture(
+        rules=[parse_posture_rule(f"deny ns:{ns[0]} -> ns:{ns[1]}")]
+    )
+    reach = np.asarray(svc.reach(), dtype=bool)
+    pods = [p.namespace for p in cluster.pods]
+    crossing = sum(
+        int(reach[s, d])
+        for s in range(len(pods))
+        for d in range(len(pods))
+        if pods[s] == ns[0] and pods[d] == ns[1]
+    )
+    # the baseline record itself is checked against the rule
+    if crossing:
+        assert tracker.violations
+        assert tracker.violations[0].measured == crossing
+    else:
+        assert not tracker.violations
+    svc.close()
+
+
+def test_enable_posture_refusals(churn64):
+    cluster, _ = churn64
+    eng = PackedIncrementalVerifier(
+        cluster, VerifyConfig(compute_ports=False), keep_matrix=False
+    )
+    svc = VerificationService(engine=eng)
+    with pytest.raises(ServeError, match="matrix-free"):
+        svc.enable_posture()
+    svc.close()
+    svc = VerificationService(cluster, VerifyConfig(compute_ports=False))
+    svc.enable_posture()
+    with pytest.raises(ServeError, match="already enabled"):
+        svc.enable_posture()
+    svc.close()
+
+
+# ------------------------------------------------------------- the CLI
+@pytest.fixture()
+def cli_cluster(tmp_path, capsys):
+    d = str(tmp_path / "cluster")
+    ev = str(tmp_path / "events.jsonl")
+    assert main([
+        "generate", d, "--pods", "24", "--policies", "8",
+        "--namespaces", "3", "--events-out", ev, "--n-events", "60",
+    ]) == EXIT_OK
+    capsys.readouterr()
+    return d, ev
+
+
+def test_cli_serve_posture_journal_then_timeline(cli_cluster, tmp_path,
+                                                 capsys):
+    d, ev = cli_cluster
+    journal = str(tmp_path / "posture.jsonl")
+    assert main([
+        "serve", d, "--events", ev, "--batch-size", "16",
+        "--posture-journal", journal, "--json",
+    ]) == EXIT_OK
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert summary["posture"]["journal"] == journal
+    scan = scan_posture(journal)
+    assert scan.ok and len(scan.records) >= 2
+
+    assert main(["posture", journal]) == EXIT_OK
+    out = capsys.readouterr().out
+    assert out.splitlines()[0].split()[0] == "gen"
+    assert "0*" in out  # the baseline generation is marked
+
+    assert main(["posture", str(tmp_path), "--json"]) == EXIT_OK
+    payload = json.loads(capsys.readouterr().out.strip())
+    rows = payload["records"]
+    assert payload["torn_lineno"] is None
+    assert rows[0]["baseline"] is True
+    assert rows[-1]["seq"] == scan.records[-1].seq
+
+    last = scan.records[-1].seq
+    assert main(["posture", journal, "--diff", "0", str(last),
+                 "--json"]) == EXIT_OK
+    diff = json.loads(capsys.readouterr().out.strip())
+    assert diff["generations"] == len(scan.records) - 1
+    assert diff["reachable_at_a"] == scan.records[0].reachable_pairs
+    assert diff["reachable_at_b"] == scan.records[-1].reachable_pairs
+
+    with pytest.raises(SystemExit):
+        main(["posture", str(tmp_path / "nope.jsonl")])
+
+
+def test_cli_serve_posture_alert_exit_code(cli_cluster, capsys):
+    d, ev = cli_cluster
+    # an impossible bound: any widening across 60 churn events violates
+    code = main([
+        "serve", d, "--events", ev, "--batch-size", "16",
+        "--posture", "--posture-alert", "max-widening 0 pairs/batch",
+    ])
+    out = capsys.readouterr().out
+    assert code == EXIT_VIOLATIONS
+    assert "posture-alert [max-widening]" in out
+
+    with pytest.raises(SystemExit):
+        main(["serve", d, "--events", ev, "--posture-alert", "garbage"])
+
+
+def test_fleet_row_and_posture_column():
+    from kubernetes_verification_tpu.observe.fleet import (
+        ReplicaScrape,
+        fleet_row,
+        render_fleet,
+    )
+
+    up = ReplicaScrape(
+        url="http://a", ok=True,
+        health={
+            "role": "leader", "epoch": 3, "last_seq": 41,
+            "lag": {"seconds": 0.5, "seq": 0},
+            "service": {
+                "posture": {
+                    "generation": 41, "reachable_pairs": 123,
+                    "widened_last": 4, "narrowed_last": 5,
+                    "rules": 1, "violations": 2, "journal": None,
+                },
+            },
+        },
+        metrics={},
+    )
+    down = ReplicaScrape(url="http://b", ok=False, error="boom")
+    lines = render_fleet([up, down])
+    assert lines[0].split()[-1] == "posture"
+    assert "123p +4/-5 !2" in lines[1]
+    assert "DOWN" in lines[2]
+
+    row = fleet_row(up)
+    assert row["url"] == "http://a" and row["ok"] is True
+    assert row["role"] == "leader" and row["last_seq"] == 41
+    assert row["posture"]["reachable_pairs"] == 123
+    assert fleet_row(down)["error"] == "boom"
+    assert fleet_row(down)["posture"] is None
+
+
+# --------------------------------------------------- metrics + lint rule
+def test_required_families_contains_posture_plane():
+    assert {
+        "kvtpu_posture_reachable_pairs",
+        "kvtpu_posture_widened_total",
+        "kvtpu_posture_narrowed_total",
+        "kvtpu_posture_delta_seconds",
+        "kvtpu_posture_alert_violations_total",
+    } <= REQUIRED_FAMILIES
+
+
+def test_bounded_journal_rule_fixtures():
+    bad = textwrap.dedent(
+        """
+        import numpy as np
+
+        def leaky(delta):
+            return np.flatnonzero(delta)
+        """
+    )
+    findings = lint_source(
+        bad, path="serve/posture.py", rules=["bounded-journal"]
+    )
+    assert "bounded-journal" in rule_ids()  # registered by the lint run
+    assert [f.rule for f in findings] == ["bounded-journal"]
+    assert "bounding slice" in findings[0].message
+
+    good = textwrap.dedent(
+        """
+        import numpy as np
+
+        CAP = 4
+
+        def capped(delta):
+            return np.flatnonzero(delta)[:CAP]
+
+        def select_form(delta):
+            return np.where(delta > 0, delta, 0)  # 3-arg select, no indices
+
+        def suppressed(mat):
+            return list(zip(*np.nonzero(mat)))  # kvtpu: ignore[bounded-journal] [G, G] matrix
+        """
+    )
+    assert lint_source(
+        good, path="serve/posture.py", rules=["bounded-journal"]
+    ) == []
+    # the rule is scoped to the posture modules: extraction elsewhere is
+    # not a journal-size liability
+    assert lint_source(
+        bad, path="serve/queries.py", rules=["bounded-journal"]
+    ) == []
+    bad_ops = lint_source(
+        bad, path="ops/posture.py", rules=["bounded-journal"]
+    )
+    assert len(bad_ops) == 1
+
+
+def test_posture_caps_are_positive_and_modest():
+    # the journal-bound contract the lint enforces structurally: the
+    # constants themselves must stay small enough that a record is O(1)
+    assert 0 < TOP_K_ROWS <= 64
+    assert 0 < WITNESS_CAP <= 16
+    assert 0 < NS_PAIR_CAP <= 128

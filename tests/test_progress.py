@@ -560,3 +560,43 @@ def test_progress_metric_families_registered():
         "kvtpu_progress_active_jobs",
     ):
         assert family in dump["gauges"], family
+
+
+def test_total_zero_or_garbage_renders_indeterminate():
+    """A job reporting total_passes=0 (or a negative/garbage total) has an
+    unknown extent: no fraction, no ETA, no ZeroDivisionError anywhere on
+    the render path — the regression that motivated this normalised a
+    zero total straight into `done / total`."""
+    t = ProgressTicker("zero-total", total=0)
+    try:
+        assert t.total is None
+        assert t.fraction is None and t.eta_s is None
+        t.tick()
+        t.tick(done=5)
+        assert t.fraction is None  # still unknown, not 5/0
+    finally:
+        t.finish()
+    t2 = ProgressTicker("neg-total", total=-3)
+    try:
+        assert t2.total is None
+    finally:
+        t2.finish()
+
+    unknown = "[" + "?" * 20 + "]"
+    assert eta_bar(None) == unknown
+    assert eta_bar(float("nan")) == unknown
+    assert eta_bar(float("inf")) == unknown
+    assert eta_bar(-0.25) == unknown
+    assert eta_bar(1.5) == "[" + "#" * 20 + "]"  # clamped, not overflowed
+
+    rows = render_jobs(
+        [
+            {"job": "a", "job_id": "a-1", "unit": "pass", "done": 7,
+             "total": 0, "fraction": None, "rate": None, "eta_s": None},
+            {"job": "b", "job_id": "b-1", "unit": "pass", "done": 2,
+             "total": 4, "fraction": 0.5, "rate": 1.0, "eta_s": 2.0},
+        ]
+    )
+    assert "7" in rows[1] and "7/0" not in rows[1]
+    assert unknown in rows[1]
+    assert "2/4" in rows[2]

@@ -1519,3 +1519,43 @@ def test_one_trace_id_spans_three_processes_through_lb_under_net_delay(
     ])
     txt = capsys.readouterr().out
     assert rc == EXIT_OK and "across 3 process log(s)" in txt
+
+
+def test_slo_unscrapeable_replica_counts_against_availability():
+    """A replica with zero scrapes inside the window is one synthetic bad
+    availability event, not a vanished data point: before this, the least
+    available replica was the one the monitor silently ignored once its
+    last observation aged out of the window."""
+    avail = parse_slo_spec("availability=0.999")
+    mon = SloMonitor([avail])
+    t0 = 2_000_000.0
+    mon.record("availability", True, ts=t0, source="http://a")
+    mon.record("availability", True, ts=t0, source="http://b")
+    assert mon.burn_rate("availability", 300.0, now=t0 + 100) == 0.0
+
+    # b keeps answering, a falls silent: one synthetic bad of two
+    mon.record("availability", True, ts=t0 + 350, source="http://b")
+    assert mon.burn_rate(
+        "availability", 300.0, now=t0 + 400
+    ) == pytest.approx(0.5 / 0.001)
+    # both silent: the whole fleet is invisible, full burn
+    assert mon.burn_rate(
+        "availability", 300.0, now=t0 + 800
+    ) == pytest.approx(1.0 / 0.001)
+    # a source silent past source_ttl is decommissioned, not unscrapeable
+    assert mon.burn_rate(
+        "availability", 300.0, now=t0 + mon.source_ttl + 400
+    ) == 0.0
+
+    # sourceless records keep the pre-source semantics: aged-out data is
+    # no data, and no data is not a violation
+    mon2 = SloMonitor([parse_slo_spec("availability=0.999")])
+    mon2.record("availability", False, ts=t0)
+    assert mon2.burn_rate("availability", 300.0, now=t0 + 400) == 0.0
+
+    # staleness-shaped objectives never get synthetic silent events (a
+    # silent replica has no lag to judge; availability already burns)
+    stale = parse_slo_spec("staleness=0.995@2.0")
+    mon3 = SloMonitor([stale])
+    mon3.record("staleness", True, ts=t0, source="http://a")
+    assert mon3.burn_rate("staleness", 300.0, now=t0 + 400) == 0.0
