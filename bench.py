@@ -792,10 +792,64 @@ def bench_closure(args) -> None:
             "metric": "closure_resume_passes_skipped",
             "value": int(full_passes - resumed_passes),
             "unit": "passes",
+            "loop": "single",
             "full_passes": int(full_passes),
             "resumed_passes": int(resumed_passes),
             "checkpointed_full_s": round(ckpt_full_s, 3),
             "resume_s": round(resume_s, 3),
+        }
+    )
+    # fourth record: the SAME checkpoint/resume proof for the mesh-sharded
+    # loop — per-shard state is gathered into one checkpoint_closure
+    # generation at each pass boundary, and the resumed run re-executes
+    # only the passes after the newest generation. Runs on whatever device
+    # set is present (a single device degenerates to a (1, 1) mesh, which
+    # is exactly the single-device pass sequence — still a real proof that
+    # the sharded loop's gather/commit/restore round-trips bit-exactly).
+    from kubernetes_verification_tpu.parallel.mesh import mesh_for
+    from kubernetes_verification_tpu.parallel.sharded_closure import (
+        sharded_packed_closure,
+    )
+
+    mesh = mesh_for((len(jax.devices()), 1))
+    ckpt_dir = tempfile.mkdtemp(prefix="kvtpu-closure-ckpt-sharded-")
+    try:
+        it0 = CLOSURE_ITERATIONS.value
+        s = time.perf_counter()
+        full_out = sharded_packed_closure(
+            mesh, np.asarray(inc._packed), tile=args.closure_tile,
+            checkpoint_dir=ckpt_dir, checkpoint_every=1,
+        )
+        sh_full_s = time.perf_counter() - s
+        sh_full_passes = CLOSURE_ITERATIONS.value - it0
+        it0 = CLOSURE_ITERATIONS.value
+        s = time.perf_counter()
+        resume_out = sharded_packed_closure(
+            mesh, np.asarray(inc._packed), tile=args.closure_tile,
+            checkpoint_dir=ckpt_dir, checkpoint_every=1, resume=True,
+        )
+        sh_resume_s = time.perf_counter() - s
+        sh_resumed_passes = CLOSURE_ITERATIONS.value - it0
+        if not np.array_equal(full_out, resume_out):
+            sys.exit("sharded closure resume diverged from the full run")
+    finally:
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+    log(
+        f"sharded closure checkpoint/resume (mesh {mesh.shape}): full run "
+        f"{sh_full_passes} passes {sh_full_s:.2f}s; resume re-ran "
+        f"{sh_resumed_passes} pass(es) in {sh_resume_s:.2f}s"
+    )
+    _emit(
+        {
+            "metric": "closure_resume_passes_skipped",
+            "value": int(sh_full_passes - sh_resumed_passes),
+            "unit": "passes",
+            "loop": "sharded",
+            "mesh": list(int(x) for x in (mesh.shape[a] for a in mesh.axis_names)),
+            "full_passes": int(sh_full_passes),
+            "resumed_passes": int(sh_resumed_passes),
+            "checkpointed_full_s": round(sh_full_s, 3),
+            "resume_s": round(sh_resume_s, 3),
         }
     )
 
@@ -991,6 +1045,198 @@ def bench_stripe(args) -> None:
             * (enc_big.ingress.n + enc_big.egress.n),
             "macs_basis": "n_src * stripe_width * (ingress_grants + egress_grants)",
             **sweep_extra,
+        }
+    )
+
+
+def bench_stripes(args) -> None:
+    """Stripe-sharded serving fleet vs one whole-state follower: K stripe
+    owners (each holding only its ``[lo, hi)`` rows — per-process state
+    asserted ≤ 1/K + ε of the whole-state engine) behind a
+    ``StripeCoordinator``, replaying the same churn WAL batches as a
+    single-stripe (1/1) baseline. Every answer the coordinator merges is
+    cross-checked bit-for-bit against the baseline before any timing is
+    trusted. Emits the gated higher-is-better
+    ``stripe_aggregate_queries_per_second`` (threaded mixed probe
+    workload through the coordinator) and the gated lower-is-better
+    ``stripe_cross_stripe_p99_s`` (full-scatter ``who_can_reach``
+    latency tail)."""
+    import threading
+
+    import jax
+    import numpy as np
+
+    from kubernetes_verification_tpu.backends.base import VerifyConfig
+    from kubernetes_verification_tpu.harness.generate import (
+        GeneratorConfig,
+        random_cluster,
+        random_event_stream,
+    )
+    from kubernetes_verification_tpu.serve.stripes import (
+        StripeCoordinator,
+        StripeFollower,
+    )
+
+    dev = jax.devices()[0]
+    log(f"device: {dev} ({jax.default_backend()})")
+    n = args.pods
+    k_stripes = max(2, args.stripes)
+    t0 = time.perf_counter()
+    cluster = random_cluster(
+        GeneratorConfig(
+            n_pods=n, n_policies=args.policies, n_namespaces=args.namespaces,
+            p_ipblock_peer=0.0, min_selector_labels=1, seed=0,
+        )
+    )
+    events = random_event_stream(cluster, n_events=args.n_events, seed=1)
+    t1 = time.perf_counter()
+    log(f"generate+stream {t1 - t0:.1f}s ({len(events)} events)")
+    cfg = VerifyConfig(compute_ports=False)
+
+    baseline = StripeFollower(
+        cluster, cfg, stripe=(0, 1), replica="whole", device=dev,
+    )
+    owners = [
+        StripeFollower(
+            cluster, cfg, stripe=(k, k_stripes),
+            replica=f"stripe-{k + 1}-of-{k_stripes}", device=dev,
+        )
+        for k in range(k_stripes)
+    ]
+    t2 = time.perf_counter()
+    log(f"bootstrap 1 whole + {k_stripes} stripe owners {t2 - t1:.1f}s")
+
+    # the 1/K + ε state bound is the whole point — assert it before any
+    # throughput number is allowed to look good
+    base_bytes = baseline.engine.state_bytes()
+    worst = max(o.engine.state_bytes() for o in owners)
+    bound = base_bytes / k_stripes + 64 * n  # ε: the O(N) iso/aux vectors
+    assert worst <= bound, (
+        f"stripe state {worst}B breaches the 1/K+eps bound "
+        f"({base_bytes}B whole / {k_stripes} + O(N) = {bound:.0f}B)"
+    )
+
+    batch = 64
+    batches = [events[i:i + batch] for i in range(0, len(events), batch)]
+    s = time.perf_counter()
+    for b in batches:
+        baseline.apply(b)
+        for o in owners:
+            o.apply(b)
+    apply_s = time.perf_counter() - s
+    fanout = sum(o.fanout_total for o in owners)
+    log(
+        f"replayed {len(events)} events into all engines {apply_s:.1f}s "
+        f"({fanout} cross-stripe fan-out applies)"
+    )
+
+    coord = StripeCoordinator(owners, pods=cluster.pods)
+    oracle = StripeCoordinator([baseline], pods=cluster.pods)
+    names = [f"{p.namespace}/{p.name}" for p in cluster.pods]
+    rng = np.random.default_rng(7)
+
+    # ---- correctness first: merged answers must be bit-identical -------
+    q_pairs = rng.integers(0, n, size=(1024, 2))
+    probe_q = [(names[a], names[b]) for a, b in q_pairs]
+    got = coord.can_reach_batch(probe_q)
+    want = oracle.can_reach_batch(probe_q)
+    assert np.array_equal(got, want), "stripe probe answers diverged"
+    dsts = [names[i] for i in rng.integers(0, n, size=32)]
+    assert coord.who_can_reach_batch(dsts) == oracle.who_can_reach_batch(
+        dsts
+    ), "stripe column scatter-gather diverged"
+    srcs = [names[i] for i in rng.integers(0, n, size=32)]
+    assert coord.blast_radius_batch(srcs) == oracle.blast_radius_batch(
+        srcs
+    ), "stripe blast radius diverged"
+    for a, b in q_pairs[:8]:
+        assert coord.path_exists(names[a], names[b], 3) == oracle.path_exists(
+            names[a], names[b], 3
+        )
+        assert coord.hops(names[a], names[b], 4) == oracle.hops(
+            names[a], names[b], 4
+        )
+    log("parity: probes/cols/blast/paths bit-identical to whole-state")
+
+    # ---- aggregate QPS: threaded mixed probe workload ------------------
+    n_q = args.n_queries
+    work = rng.integers(0, n, size=(n_q, 2))
+    work_q = [(names[a], names[b]) for a, b in work]
+    sub = 256
+    chunks = [work_q[i:i + sub] for i in range(0, len(work_q), sub)]
+    coord.can_reach_batch(chunks[0])  # absorb probe-path compiles
+    n_threads = min(4, k_stripes)
+
+    def drive(parts):
+        for c in parts:
+            coord.can_reach_batch(c)
+
+    qps_runs = []
+    for _ in range(max(2, args.repeats)):
+        threads = [
+            threading.Thread(target=drive, args=(chunks[t::n_threads],))
+            for t in range(n_threads)
+        ]
+        s = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        qps_runs.append(len(work_q) / (time.perf_counter() - s))
+    qps_band = _band([len(work_q) / q for q in qps_runs])
+    qps = max(qps_runs)
+    log(
+        f"aggregate: {len(work_q)} probes x {n_threads} threads over "
+        f"{k_stripes} stripes = {qps:.0f} queries/s best "
+        f"(median window {qps_band['median_s']:.3f}s)"
+    )
+
+    # ---- cross-stripe latency tail: full scatter per call --------------
+    lat = []
+    tail_dsts = [names[i] for i in rng.integers(0, n, size=256)]
+    coord.who_can_reach(tail_dsts[0])
+    for d in tail_dsts:
+        s = time.perf_counter()
+        coord.who_can_reach(d)
+        lat.append(time.perf_counter() - s)
+    lat_sorted = sorted(lat)
+    p99 = lat_sorted[min(len(lat_sorted) - 1, int(0.99 * len(lat_sorted)))]
+    log(
+        f"cross-stripe who_can_reach: median "
+        f"{lat_sorted[len(lat_sorted) // 2] * 1e3:.2f}ms p99 "
+        f"{p99 * 1e3:.2f}ms over {len(lat)} full scatters"
+    )
+
+    common = {
+        "pods": n,
+        "policies": args.policies,
+        "stripes": k_stripes,
+        "events": len(events),
+        "fanout_applies": fanout,
+        "whole_state_bytes": base_bytes,
+        "stripe_state_bytes_max": worst,
+        "state_fraction": round(worst / base_bytes, 4),
+    }
+    _emit(
+        {
+            "metric": "stripe_aggregate_queries_per_second",
+            "value": round(qps, 1),
+            "unit": "queries/s",
+            "threads": n_threads,
+            "window_band": qps_band,
+            "steady_s": round(qps_band["median_s"], 4),
+            **common,
+        }
+    )
+    _emit(
+        {
+            "metric": "stripe_cross_stripe_p99_s",
+            "value": round(p99, 5),
+            "unit": "s",
+            "median_s": round(lat_sorted[len(lat_sorted) // 2], 5),
+            "samples": len(lat),
+            "steady_s": round(p99, 5),
+            **common,
         }
     )
 
@@ -2503,8 +2749,8 @@ def main() -> None:
         "--mode",
         choices=(
             "tiled", "k8s", "kano", "incremental", "closure", "stripe",
-            "headtohead", "serve", "query", "replicate", "ingress",
-            "posture", "sentinel",
+            "stripes", "headtohead", "serve", "query", "replicate",
+            "ingress", "posture", "sentinel",
         ),
         default="tiled",
         help="tiled = the BASELINE north-star config (100k pods / 10k "
@@ -2513,6 +2759,11 @@ def main() -> None:
         "closure = full + after-diff packed closure at 100k; stripe = the "
         "1M-pod dst stripe + 250k matrix-free diff (config 5's single-chip "
         "share; --full-sweep runs ALL dst tiles with an oracle cross-check); "
+        "stripes = the stripe-sharded serving fleet: K stripe owners "
+        "(--stripes) replay the same churn WAL as one whole-state "
+        "follower, merged answers are cross-checked bit-identical, and "
+        "the gated stripe_aggregate_queries_per_second + "
+        "stripe_cross_stripe_p99_s pair is recorded; "
         "headtohead = interleaved xla-vs-pallas kernel A/B with bands; "
         "serve = churn event stream through the coalescing verification "
         "service with interleaved queries (events/s + query latency); "
@@ -2543,6 +2794,11 @@ def main() -> None:
     ap.add_argument(
         "--closure-tile", type=int, default=7168,
         help="closure mode: squaring row tile (dst stripe auto-picks ~14336)",
+    )
+    ap.add_argument(
+        "--stripes", type=int, default=4,
+        help="stripes mode: stripe owner count K (fleet width; the "
+        "per-process state bound asserted is 1/K + eps)",
     )
     ap.add_argument(
         "--stripe-width", type=int, default=32_768,
@@ -2606,16 +2862,16 @@ def main() -> None:
     if args.pods is None:
         args.pods = {
             "tiled": 100_000, "incremental": 100_000, "closure": 100_000,
-            "stripe": 1_000_000, "headtohead": 100_000, "serve": 1_024,
-            "query": 10_000, "replicate": 1_024, "ingress": 1_024,
-            "posture": 10_000,
+            "stripe": 1_000_000, "stripes": 4_096, "headtohead": 100_000,
+            "serve": 1_024, "query": 10_000, "replicate": 1_024,
+            "ingress": 1_024, "posture": 10_000,
         }.get(args.mode, 10_000)
     if args.policies is None:
         args.policies = {
             "tiled": 10_000, "incremental": 10_000, "closure": 10_000,
-            "stripe": 512, "headtohead": 10_000, "serve": 256,
-            "query": 1_000, "replicate": 256, "ingress": 256,
-            "posture": 1_000,
+            "stripe": 512, "stripes": 256, "headtohead": 10_000,
+            "serve": 256, "query": 1_000, "replicate": 256,
+            "ingress": 256, "posture": 1_000,
         }.get(args.mode, 1_000)
 
     import jax
@@ -2635,6 +2891,8 @@ def main() -> None:
         return bench_closure(args)
     if args.mode == "stripe":
         return bench_stripe(args)
+    if args.mode == "stripes":
+        return bench_stripes(args)
     if args.mode == "headtohead":
         return bench_headtohead(args)
     if args.mode == "serve":

@@ -516,3 +516,73 @@ class BoundedJournalRule(Rule):
                         "O(N^2); keep a top-k cap ([:TOP_K]) next to every "
                         "extraction (or justify with an inline ignore)",
                     )
+
+
+@register
+class StripeLocalityRule(Rule):
+    id = "stripe-locality"
+    rationale = (
+        "A stripe engine's count matrices are `[S, N]` row stripes — row "
+        "index 0 is GLOBAL pod `lo`, not pod 0. Any function in "
+        "`serve/stripes.py` that subscripts the striped count state "
+        "(`_ing_count` / `_eg_count`) with an unbounded global index "
+        "silently reads or patches the WRONG pod's row: the answer is "
+        "well-shaped, plausible, and incorrect for every pod outside "
+        "`[lo, hi)` — the worst failure mode a sharded serving plane "
+        "has. Every such function must reference the owned stripe range "
+        "in the same body (the `_lo`/`_hi` bounds, `stripe_rows`, "
+        "`local()`/`owns()` translation, or a `row_base` rebase) so the "
+        "global→local mapping is visible at the indexing site. Helpers "
+        "whose operands arrive pre-bounded by the caller carry an inline "
+        "`# kvtpu: ignore[stripe-locality]` with the reason."
+    )
+    example = "self._ing_count.at[idx, :]  # idx is GLOBAL; no lo/hi in scope"
+
+    #: the stripe serving plane; count-state subscripts elsewhere are a
+    #: different engine's (whole-state) indexing and globally addressed
+    STRIPE_FILES = ("serve/stripes.py",)
+
+    #: terminal names of the striped count state ("count" covers the
+    #: jitted patch helpers' parameter spelling)
+    COUNT_NAMES = frozenset({"_ing_count", "_eg_count", "count"})
+
+    #: in-scope references that make the stripe range visible: the owned
+    #: bounds themselves, the range property, the geometry helpers, the
+    #: global→local translators, and the kernel rebase scalar
+    BOUND_NAMES = frozenset({
+        "_lo", "_hi", "lo", "hi", "stripe_rows", "stripe_bounds",
+        "local", "owns", "row_base",
+    })
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.rel not in self.STRIPE_FILES:
+            return
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            subscripts = []
+            bounded = False
+            for node in walk_own(fn):
+                if isinstance(node, ast.Subscript):
+                    chain = _dotted(node.value) or ""
+                    parts = set(chain.split("."))
+                    if parts & self.COUNT_NAMES:
+                        subscripts.append(node.lineno)
+                elif isinstance(node, (ast.Name, ast.Attribute)):
+                    if _last_name(node) in self.BOUND_NAMES:
+                        bounded = True
+                elif isinstance(node, ast.keyword):
+                    if node.arg in self.BOUND_NAMES:
+                        bounded = True
+            if subscripts and not bounded:
+                for lineno in sorted(set(subscripts)):
+                    yield Finding(
+                        self.id, ctx.rel, lineno,
+                        "striped count state subscripted but "
+                        f"{fn.name}() never references the owned stripe "
+                        "range — row 0 here is global pod `lo`, so an "
+                        "unbounded index answers for the wrong pod; keep "
+                        "the lo/hi bound (or the local()/owns() "
+                        "translation) in the same function, or justify "
+                        "with an inline ignore",
+                    )

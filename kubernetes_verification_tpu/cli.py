@@ -986,6 +986,10 @@ def _run_serve(args) -> int:
     )
     from .serve import EventSource, ServeConfig, load_assertions
 
+    if getattr(args, "stripe", None):
+        if getattr(args, "follow", None):
+            raise SystemExit("serve: --stripe and --follow are exclusive")
+        return _run_stripe(args)
     if getattr(args, "follow", None):
         return _run_follow(args)
     serve_config = ServeConfig(
@@ -1140,6 +1144,145 @@ def _run_serve(args) -> int:
     return EXIT_VIOLATIONS if svc.violations else EXIT_OK
 
 
+def _run_stripe(args) -> int:
+    """Stripe owner: own pod rows ``[lo, hi)`` of the count state only
+    (``--stripe K/N``, 1-based), bootstrap from manifests or — with
+    ``--resume`` — a stripe-sliced checkpoint ladder, then tail
+    ``--events`` applying EVERY mutation (cross-stripe effects fan out by
+    design; the ``fanout`` counter in the summary is the measured tax).
+    ``--checkpoint-dir`` writes stripe-sliced generations the same way
+    whole-state serve writes whole ones."""
+    import random as _random
+    import time as _time
+    import zlib as _zlib
+
+    from .parallel.stripes import parse_stripe
+    from .resilience.errors import EXIT_OK
+    from .serve import CheckpointManager, RecoveryManager
+    from .serve.stripes import StripeFollower
+
+    stripe = parse_stripe(args.stripe)
+    replica = (
+        args.replica
+        if args.replica != "follower"
+        else f"stripe-{stripe[0] + 1}-of-{stripe[1]}"
+    )
+    cm = (
+        CheckpointManager(args.checkpoint_dir)
+        if getattr(args, "checkpoint_dir", None)
+        else None
+    )
+    recovery = None
+    skipped: list = []
+    initial_cluster, cfg = None, None
+    if args.path:
+        import kubernetes_verification_tpu as kv
+
+        initial_cluster, skipped = kv.load_cluster(args.path)
+        cfg = kv.VerifyConfig(
+            backend="cpu",
+            compute_ports=False,
+            self_traffic=args.self_traffic,
+            default_allow_unselected=args.default_allow,
+        )
+    if getattr(args, "resume", False):
+        if not args.checkpoint_dir:
+            raise SystemExit("serve: --resume requires --checkpoint-dir")
+        recovery = RecoveryManager(args.checkpoint_dir).recover_stripe(
+            stripe,
+            log_path=args.events,
+            initial_cluster=initial_cluster,
+            config=cfg,
+            batch_size=args.batch_size,
+            replica=replica,
+        )
+        follower = recovery.service
+    else:
+        if initial_cluster is None:
+            raise SystemExit(
+                "serve: --stripe needs a manifest PATH (or --resume "
+                "with --checkpoint-dir)"
+            )
+        follower = StripeFollower(
+            initial_cluster,
+            cfg,
+            stripe=stripe,
+            replica=replica,
+            log_path=args.events,
+        )
+    # tail loop: same capped exponential backoff + per-replica jitter as
+    # _run_follow — a fleet of stripe owners started together must not
+    # poll the shared WAL in phase
+    interval = args.tail_poll
+    max_interval = max(args.tail_poll, min(1.0, args.tail_poll * 32))
+    rng = _random.Random(_zlib.crc32(replica.encode()))
+    idle_since = _time.monotonic()
+    checkpoints = 0
+    batches_since = 0
+    while args.events:
+        applied = follower.poll(args.batch_size)
+        now = _time.monotonic()
+        if applied:
+            batches_since += 1
+            if (
+                cm is not None
+                and args.checkpoint_every
+                and batches_since >= args.checkpoint_every
+            ):
+                follower.checkpoint(cm)
+                checkpoints += 1
+                batches_since = 0
+            interval = args.tail_poll
+            idle_since = now
+            continue
+        if not args.tail:
+            break
+        if now - idle_since >= args.idle_timeout:
+            break
+        _time.sleep(
+            min(interval, args.idle_timeout) * (1.0 + rng.random() * 0.1)
+        )
+        interval = min(interval * 2, max_interval)
+    if cm is not None:
+        follower.checkpoint(cm)  # the exit checkpoint: resume loses nothing
+        checkpoints += 1
+    out = dict(follower.health())
+    if skipped:
+        out["skipped_documents"] = skipped
+    if cm is not None:
+        out["checkpoints"] = checkpoints
+        out["checkpoint_dir"] = args.checkpoint_dir
+    if recovery is not None:
+        out["recovery"] = {
+            "outcome": recovery.outcome,
+            "generation": recovery.generation,
+            "replayed": recovery.replayed,
+            "duplicates_skipped": recovery.duplicates_skipped,
+            "rejected_generations": len(recovery.errors),
+        }
+    if args.json:
+        print(json.dumps(out, sort_keys=True))
+    else:
+        frag = out["stripe"]
+        print(
+            f"stripe {frag['index'] + 1}/{frag['count']} ({out['replica']}): "
+            f"rows [{frag['lo']}, {frag['hi']}) of {frag['n']} pods, "
+            f"{out['applied']} events applied "
+            f"({out['fanout']} cross-stripe fan-out) at gen "
+            f"{out['generation']}"
+        )
+        if recovery is not None:
+            print(
+                f"  recovered: {recovery.outcome} (gen "
+                f"{recovery.generation}, {recovery.replayed} events "
+                f"replayed, {recovery.duplicates_skipped} duplicates "
+                "skipped)"
+            )
+        if cm is not None:
+            print(f"  checkpoints: {checkpoints} -> {args.checkpoint_dir}")
+    return EXIT_OK
+
+
 def _run_follow(args) -> int:
     """Follower replica: bootstrap from the newest checkpoint generation
     in ``--follow DIR``, tail the leader's WAL under the ``--staleness``
@@ -1257,8 +1400,19 @@ def _run_recover(args) -> int:
             print(f"{args.dir}: no checkpoint generations")
         for g in gens:
             if g["valid"]:
+                kind = g.get("kind", "serve")
+                if kind == "stripe":
+                    st = g.get("stripe") or {}
+                    tag = (
+                        f"stripe {st.get('index', 0) + 1}"
+                        f"/{st.get('count', '?')}  "
+                    )
+                elif kind != "serve":
+                    tag = f"{kind}  "
+                else:
+                    tag = ""
                 print(
-                    f"gen {g['generation']:>3}  OK   "
+                    f"gen {g['generation']:>3}  OK   {tag}"
                     f"offset={g['log_offset']} last_seq={g['last_seq']} "
                     f"log={g['event_log']}"
                 )
@@ -1934,6 +2088,7 @@ def _run_fleet(args) -> int:
         parse_slo_spec,
         render_fleet,
         scrape_replica,
+        stripe_coverage,
     )
     from .resilience.errors import EXIT_OK, EXIT_VIOLATIONS
 
@@ -1972,6 +2127,10 @@ def _run_fleet(args) -> int:
                         for name, per in burns.items()
                     },
                     "burn_threshold": args.burn_threshold,
+                    # fleet-wide stripe coverage (None for a whole-state
+                    # fleet): a stripe with no live owner is an outage,
+                    # surfaced here and as the table's GAP line
+                    "stripe_coverage": stripe_coverage(scrapes),
                 },
                 sort_keys=True,
             )
@@ -2647,9 +2806,18 @@ def main(argv: Optional[list] = None) -> int:
         "log path), answer queries under the --staleness bound",
     )
     p.add_argument(
+        "--stripe", metavar="K/N",
+        help="run as stripe owner K of N (1-based): own only this "
+        "contiguous pod-row stripe of the count state, bootstrap from "
+        "manifests or a stripe-sliced checkpoint (--resume), and tail "
+        "--events applying every mutation (cross-stripe effects fan "
+        "out by design and are counted, never filtered)",
+    )
+    p.add_argument(
         "--replica", default="follower", metavar="NAME",
-        help="with --follow: this replica's name (lag gauges, lease "
-        "holder on promotion)",
+        help="with --follow / --stripe: this replica's name (lag "
+        "gauges, lease holder on promotion; default for --stripe: "
+        "stripe-K-of-N)",
     )
     p.add_argument(
         "--leader", metavar="URL",

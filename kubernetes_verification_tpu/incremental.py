@@ -117,8 +117,7 @@ class IncrementalVerifier:
         self.namespaces = list(cluster.namespaces)
         self.policies: Dict[str, NetworkPolicy] = {}
         n = len(self.pods)
-        self._ing_count = jnp.zeros((n, n), dtype=_I32, device=self.device)
-        self._eg_count = jnp.zeros((n, n), dtype=_I32, device=self.device)
+        self._ing_count, self._eg_count = self._alloc_counts(n)
         self._ing_iso = np.zeros(n, dtype=np.int64)
         self._eg_iso = np.zeros(n, dtype=np.int64)
         #: per-policy contribution vectors (host copies, bool [N])
@@ -127,6 +126,16 @@ class IncrementalVerifier:
         self._reach = None
         self.update_count = 0
         self._batch_init(cluster)
+
+    def _alloc_counts(self, n: int):
+        """Empty device count matrices for ``n`` pods. The one allocation
+        hook subclasses with partial row ownership override — the stripe
+        engine (``serve/stripes.py``) returns [S, N] row stripes here so
+        no [N, N] operand ever exists in its process."""
+        return (
+            jnp.zeros((n, n), dtype=_I32, device=self.device),
+            jnp.zeros((n, n), dtype=_I32, device=self.device),
+        )
 
     def _batch_init(self, cluster: Cluster) -> None:
         """Initial build: one encoder pass + one batched device contraction
@@ -196,15 +205,10 @@ class IncrementalVerifier:
             if cfg.direction_aware_isolation:
                 ing_peers &= aff_i[:, None]
                 eg_peers &= aff_e[:, None]
-            dot = lambda a, b: jax.lax.dot_general(
-                a.astype(jnp.int8), b.astype(jnp.int8),
-                (((0,), (0,)), ((), ())), preferred_element_type=_I32,
+            ing_c, eg_c = self._contract_counts(
+                sel_ing, sel_eg, ing_peers, eg_peers
             )
-            return (
-                dot(ing_peers, sel_ing),
-                dot(sel_eg, eg_peers),
-                sel_ing, sel_eg, ing_peers, eg_peers,
-            )
+            return ing_c, eg_c, sel_ing, sel_eg, ing_peers, eg_peers
 
         args = jax.device_put(
             (
@@ -232,6 +236,25 @@ class IncrementalVerifier:
                 sel_ing[i].copy(), sel_eg[i].copy(),
                 ing_peers[i].copy(), eg_peers[i].copy(),
             )
+
+    @staticmethod
+    def _count_dot(a, b):
+        """The count contraction: int8 policy-axis matmul accumulating to
+        int32 (traced — called inside the init build jit)."""
+        return jax.lax.dot_general(
+            a.astype(jnp.int8), b.astype(jnp.int8),
+            (((0,), (0,)), ((), ())), preferred_element_type=_I32,
+        )
+
+    def _contract_counts(self, sel_ing, sel_eg, ing_peers, eg_peers):
+        """Collapse P rank-1 contributions into the two count matrices
+        (traced, inside the build jit). The stripe engine overrides this
+        to slice the source axis BEFORE the contraction, so the [N, N]
+        products are never formed in a striped process."""
+        return (
+            self._count_dot(ing_peers, sel_ing),
+            self._count_dot(sel_eg, eg_peers),
+        )
 
     # ---------------------------------------------------------------- diffs
     def _key(self, pol: NetworkPolicy) -> str:
@@ -340,8 +363,33 @@ class IncrementalVerifier:
             for vec, f in zip(self._vectors[key], flags):
                 vec[idx] = f
         new = row_col_sums()
-        d_row = jnp.asarray(new[0] - old[0], dtype=_I32)
-        d_col = jnp.asarray(new[1] - old[1], dtype=_I32)
+        self._patch_row_col(
+            idx,
+            new[0] - old[0], new[1] - old[1],
+            new[2] - old[2], new[3] - old[3],
+        )
+        self._ing_iso[idx] += new[4] - old[4]
+        self._eg_iso[idx] += new[5] - old[5]
+        self._reach_dirty = True
+        self.update_count += 1
+        self._count_op("pod_relabel")
+
+    def _patch_row_col(
+        self,
+        idx: int,
+        d_ing_row: np.ndarray,
+        d_ing_col: np.ndarray,
+        d_eg_row: np.ndarray,
+        d_eg_col: np.ndarray,
+    ) -> None:
+        """Apply one relabel's count deltas on device: row ``idx`` and
+        column ``idx`` of both matrices (the (idx, idx) corner rides the
+        row deltas — ``d_*_col[idx] == 0`` by construction). The stripe
+        engine overrides this: the row patch lands only on the owning
+        stripe (at its local offset) while the column slice lands on
+        every stripe."""
+        d_row = jnp.asarray(d_ing_row, dtype=_I32)
+        d_col = jnp.asarray(d_ing_col, dtype=_I32)
         _TRACKER.track(
             "_row_col_patch",
             self._ing_count,
@@ -352,14 +400,9 @@ class IncrementalVerifier:
         self._ing_count = _row_col_patch(self._ing_count, idx, d_row, d_col)
         self._eg_count = _row_col_patch(
             self._eg_count, idx,
-            jnp.asarray(new[2] - old[2], dtype=_I32),
-            jnp.asarray(new[3] - old[3], dtype=_I32),
+            jnp.asarray(d_eg_row, dtype=_I32),
+            jnp.asarray(d_eg_col, dtype=_I32),
         )
-        self._ing_iso[idx] += new[4] - old[4]
-        self._eg_iso[idx] += new[5] - old[5]
-        self._reach_dirty = True
-        self.update_count += 1
-        self._count_op("pod_relabel")
 
     # ----------------------------------------------------------- namespaces
     # registration bookkeeping (live _ns_labels dict + namespaces list +

@@ -45,6 +45,7 @@ __all__ = [
     "scrape_replica",
     "render_fleet",
     "fleet_row",
+    "stripe_coverage",
     "SloObjective",
     "parse_slo_spec",
     "SloMonitor",
@@ -169,6 +170,60 @@ def _posture_summary(health: Optional[dict]) -> str:
     return txt
 
 
+def _human_pods(n) -> str:
+    """``1250000 -> "1.25M"`` — the stripe column's pod-count rendering."""
+    n = float(n)
+    for div, suffix in ((1e9, "G"), (1e6, "M"), (1e3, "k")):
+        if n >= div:
+            txt = f"{n / div:.2f}".rstrip("0").rstrip(".")
+            return f"{txt}{suffix}"
+    return str(int(n))
+
+
+def _stripe_summary(health: Optional[dict]) -> str:
+    """Compact stripe-ownership column text from a ``/healthz`` document:
+    ``3/8 · 1.25M pods`` (1-based stripe index / stripe count, owned pod
+    rows); ``-`` when the replica serves whole state."""
+    frag = (health or {}).get("stripe")
+    if not frag or frag.get("count") is None:
+        return "-"
+    return (
+        f"{int(frag.get('index', 0)) + 1}/{int(frag['count'])} · "
+        f"{_human_pods(frag.get('pods', 0))} pods"
+    )
+
+
+def stripe_coverage(scrapes: Sequence[ReplicaScrape]) -> Optional[dict]:
+    """Fleet-wide stripe coverage from the scrapes' ``/healthz`` stripe
+    fragments: which stripe indices have at least one LIVE owner. Returns
+    None when no replica reports a stripe (a whole-state fleet has no
+    coverage concept). A stripe with no live owner is an outage for every
+    query touching its rows — the coordinator fails it typed
+    (:class:`~..resilience.errors.StripeCoverageError`), never silently
+    answers from the surviving stripes — so the fleet view must shout the
+    gap, not average it away. Disagreeing stripe counts across replicas
+    (a mid-resharding scrape) report ``consistent: False``."""
+    by_count: Dict[int, set] = {}
+    for s in scrapes:
+        frag = (s.health or {}).get("stripe") if s.ok else None
+        if frag and frag.get("count"):
+            by_count.setdefault(int(frag["count"]), set()).add(
+                int(frag.get("index", 0))
+            )
+    if not by_count:
+        return None
+    if len(by_count) > 1:
+        return {"consistent": False, "counts": sorted(by_count)}
+    count, owned = next(iter(by_count.items()))
+    missing = sorted(set(range(count)) - owned)
+    return {
+        "consistent": True,
+        "count": count,
+        "owned": sorted(owned),
+        "missing": missing,
+    }
+
+
 def render_fleet(scrapes: Sequence[ReplicaScrape]) -> List[str]:
     """The fleet table: one aligned row per replica, down replicas
     included (their row says why). ``shed`` / ``quota`` summarise the
@@ -178,7 +233,7 @@ def render_fleet(scrapes: Sequence[ReplicaScrape]) -> List[str]:
     reach-drift plane (reachable pairs, last movement, alert count)."""
     header = (
         "replica", "role", "epoch", "last_seq", "lag_s", "breaker", "aot",
-        "shed", "quota", "posture",
+        "shed", "quota", "posture", "stripe",
     )
     rows = [header]
     for s in scrapes:
@@ -186,7 +241,7 @@ def render_fleet(scrapes: Sequence[ReplicaScrape]) -> List[str]:
             rows.append(
                 (
                     s.url, "DOWN", "-", "-", "-", s.error or "-", "-",
-                    "-", "-", "-",
+                    "-", "-", "-", "-",
                 )
             )
             continue
@@ -222,13 +277,36 @@ def render_fleet(scrapes: Sequence[ReplicaScrape]) -> List[str]:
                     digits=2,
                 ),
                 _posture_summary(h),
+                _stripe_summary(h),
             )
         )
     widths = [max(len(r[i]) for r in rows) for i in range(len(header))]
-    return [
+    out = [
         "  ".join(cell.ljust(widths[i]) for i, cell in enumerate(row)).rstrip()
         for row in rows
     ]
+    cov = stripe_coverage(scrapes)
+    if cov is not None:
+        if not cov["consistent"]:
+            out.append(
+                "stripe coverage: INCONSISTENT stripe counts "
+                f"{cov['counts']} across the fleet"
+            )
+        elif cov["missing"]:
+            gaps = ", ".join(
+                f"{i + 1}/{cov['count']}" for i in cov["missing"]
+            )
+            out.append(
+                f"stripe coverage: GAP — stripe(s) {gaps} have no live "
+                "owner (queries touching those rows fail typed, not "
+                "truncated)"
+            )
+        else:
+            out.append(
+                f"stripe coverage: {cov['count']}/{cov['count']} stripes "
+                "owned"
+            )
+    return out
 
 
 def fleet_row(s: ReplicaScrape) -> dict:
@@ -257,6 +335,7 @@ def fleet_row(s: ReplicaScrape) -> dict:
             metrics.get("kvtpu_admission_quota_utilization")
         ),
         "posture": svc.get("posture"),
+        "stripe": h.get("stripe"),
     }
 
 

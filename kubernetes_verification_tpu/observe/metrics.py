@@ -107,6 +107,10 @@ __all__ = [
     "POSTURE_NARROWED_TOTAL",
     "POSTURE_DELTA_SECONDS",
     "POSTURE_ALERT_VIOLATIONS_TOTAL",
+    "STRIPE_FANOUT_TOTAL",
+    "STRIPE_QUERIES_TOTAL",
+    "STRIPE_COVERAGE_GAPS_TOTAL",
+    "STRIPE_OWNED_ROWS",
     "REQUIRED_FAMILIES",
 ]
 
@@ -873,6 +877,38 @@ POSTURE_ALERT_VIOLATIONS_TOTAL = Counter(
     ("rule",),
 )
 
+STRIPE_FANOUT_TOTAL = Counter(
+    "kvtpu_stripe_fanout_total",
+    "WAL mutations a stripe owner applied that did NOT originate in its "
+    "own pod range (label/policy events whose selector membership crosses "
+    "stripes fan out as full applies — correctness first), by event kind; "
+    "the ratio to kvtpu_serve_events_total is the fan-out tax of striping.",
+    ("kind",),
+)
+
+STRIPE_QUERIES_TOTAL = Counter(
+    "kvtpu_stripe_queries_total",
+    "Queries the stripe coordinator routed, by route shape: 'local' "
+    "(answered by one source-pod stripe owner), 'scatter' (fanned out to "
+    "every stripe and merged), 'retry' (a fragment re-dispatched to a "
+    "backup owner after the primary failed mid-query).",
+    ("route",),
+)
+
+STRIPE_COVERAGE_GAPS_TOTAL = Counter(
+    "kvtpu_stripe_coverage_gaps_total",
+    "Scatter-gather queries refused with StripeCoverageError because a "
+    "stripe had no live owner — every increment is an outage surfaced as "
+    "a typed failure instead of a silently truncated answer.",
+)
+
+STRIPE_OWNED_ROWS = Gauge(
+    "kvtpu_stripe_owned_rows",
+    "Pod rows [lo, hi) this stripe owner holds of the packed reachability "
+    "maps — the numerator of the (1/N + eps) per-process state bound the "
+    "stripe fleet exists to enforce.",
+)
+
 #: The frozen dashboard contract: families that must exist in every build.
 #: New families are appended here by the PR that introduces them; the
 #: `metrics-names` lint rule and `scripts/check_metrics_names.py` both fail
@@ -995,6 +1031,11 @@ REQUIRED_FAMILIES = frozenset(
         "kvtpu_posture_narrowed_total",
         "kvtpu_posture_delta_seconds",
         "kvtpu_posture_alert_violations_total",
+        # stripe-sharded serving fleet (serve/stripes.py)
+        "kvtpu_stripe_fanout_total",
+        "kvtpu_stripe_queries_total",
+        "kvtpu_stripe_coverage_gaps_total",
+        "kvtpu_stripe_owned_rows",
     }
 )
 

@@ -48,6 +48,9 @@ __all__ = [
     "packed_reach_rows",
     "packed_reach_cols",
     "packed_any_port",
+    "stripe_reach_rows",
+    "stripe_reach_cols",
+    "stripe_any_port",
 ]
 
 _I32 = jnp.int32
@@ -478,6 +481,216 @@ def packed_any_port(
     )
 
 
+# --------------------------------------------------------------- stripes
+# Stripe twins (serve/stripes.py): the same row/column formulas against a
+# [S, N] row-stripe of the count matrices instead of the full [N, N].
+# ``row_base`` (the stripe's first global row) enters as a TRACED scalar,
+# so every base-size stripe of a fleet shares one compiled executable and
+# only the ragged last stripe adds a second signature. The egress
+# isolation vector arrives as the stripe's local [S] slice — the stripe
+# owner holds no full-length egress state for its own rows — while the
+# ingress vector stays full [N] (destinations span the whole cluster).
+
+
+@partial(
+    jax.jit,
+    static_argnames=("self_traffic", "default_allow_unselected"),
+)
+def _stripe_rows_kernel(
+    ing_stripe,
+    eg_stripe,
+    ing_iso,
+    eg_iso_local,
+    row_base,
+    src_loc,
+    *,
+    self_traffic: bool,
+    default_allow_unselected: bool,
+):
+    """Reach rows for stripe-LOCAL sources ``src_loc`` (positions into the
+    [S, N] stripe) — ``_reach_rows_kernel`` with the self-traffic diagonal
+    shifted by ``row_base`` and egress isolation read from the local
+    slice."""
+    ing_ok = ing_stripe[src_loc, :] > 0
+    eg_ok = eg_stripe[src_loc, :] > 0
+    if default_allow_unselected:
+        ing_ok |= (ing_iso == 0)[None, :]
+        eg_ok |= (eg_iso_local[src_loc] == 0)[:, None]
+    rows = ing_ok & eg_ok
+    if self_traffic:
+        n = ing_stripe.shape[1]
+        rows |= (src_loc + row_base)[:, None] == jnp.arange(n)[None, :]
+    return rows
+
+
+@partial(
+    jax.jit,
+    static_argnames=("self_traffic", "default_allow_unselected"),
+)
+def _stripe_probe_kernel(
+    ing_stripe,
+    eg_stripe,
+    ing_iso,
+    eg_iso_local,
+    row_base,
+    src_loc,
+    q_row,
+    q_dst,
+    *,
+    self_traffic: bool,
+    default_allow_unselected: bool,
+):
+    """Stripe rows plus per-probe answers, one dispatch (the stripe twin
+    of ``_probe_rows_kernel``). ``q_dst`` stays a GLOBAL pod index — the
+    row axis is striped, the column axis never is."""
+    rows = _stripe_rows_kernel(
+        ing_stripe,
+        eg_stripe,
+        ing_iso,
+        eg_iso_local,
+        row_base,
+        src_loc,
+        self_traffic=self_traffic,
+        default_allow_unselected=default_allow_unselected,
+    )
+    return rows, rows[q_row, q_dst]
+
+
+@partial(
+    jax.jit,
+    static_argnames=("self_traffic", "default_allow_unselected"),
+)
+def _stripe_cols_kernel(
+    ing_stripe,
+    eg_stripe,
+    ing_iso,
+    eg_iso_local,
+    row_base,
+    dst_idx,
+    *,
+    self_traffic: bool,
+    default_allow_unselected: bool,
+):
+    """This stripe's [S, U] FRAGMENT of the reach columns for global
+    destinations ``dst_idx`` — the coordinator concatenates the fleet's
+    fragments in stripe order to reassemble ``_reach_cols_kernel``'s
+    [N, U] answer bit-for-bit."""
+    ing_ok = ing_stripe[:, dst_idx] > 0
+    eg_ok = eg_stripe[:, dst_idx] > 0
+    if default_allow_unselected:
+        ing_ok |= (ing_iso[dst_idx] == 0)[None, :]
+        eg_ok |= (eg_iso_local == 0)[:, None]
+    cols = ing_ok & eg_ok
+    if self_traffic:
+        s = ing_stripe.shape[0]
+        cols |= (jnp.arange(s) + row_base)[:, None] == dst_idx[None, :]
+    return cols
+
+
+def stripe_reach_rows(
+    ing_stripe,
+    eg_stripe,
+    ing_iso,
+    eg_iso_local,
+    src_loc,
+    *,
+    row_base: int,
+    self_traffic: bool,
+    default_allow_unselected: bool,
+) -> np.ndarray:
+    """Gather reach rows for stripe-local sources ``src_loc`` (host int
+    array of positions in [0, S)) from a [S, N] stripe; returns bool
+    [U, N] — bit-identical to :func:`batched_reach_rows` on the whole
+    matrix at global indices ``src_loc + row_base``."""
+    src_loc = np.asarray(src_loc, dtype=np.int64)
+    n = int(ing_stripe.shape[1])
+    if src_loc.size == 0:
+        return np.zeros((0, n), dtype=bool)
+    rows = _stripe_rows_kernel(
+        ing_stripe,
+        eg_stripe,
+        _as_iso(ing_iso),
+        _as_iso(eg_iso_local),
+        jnp.int32(row_base),
+        _pad_idx(src_loc, _pow2(src_loc.size)),
+        self_traffic=self_traffic,
+        default_allow_unselected=default_allow_unselected,
+    )
+    return np.asarray(rows)[: src_loc.size]
+
+
+def stripe_reach_cols(
+    ing_stripe,
+    eg_stripe,
+    ing_iso,
+    eg_iso_local,
+    dst_idx,
+    *,
+    row_base: int,
+    self_traffic: bool,
+    default_allow_unselected: bool,
+) -> np.ndarray:
+    """This stripe's column fragment for global destinations ``dst_idx``;
+    returns bool [S, U]. Concatenating every stripe's fragment along axis
+    0 in stripe order equals :func:`batched_reach_cols`."""
+    dst_idx = np.asarray(dst_idx, dtype=np.int64)
+    s = int(ing_stripe.shape[0])
+    if dst_idx.size == 0:
+        return np.zeros((s, 0), dtype=bool)
+    cols = _stripe_cols_kernel(
+        ing_stripe,
+        eg_stripe,
+        _as_iso(ing_iso),
+        _as_iso(eg_iso_local),
+        jnp.int32(row_base),
+        _pad_idx(dst_idx, _pow2(dst_idx.size)),
+        self_traffic=self_traffic,
+        default_allow_unselected=default_allow_unselected,
+    )
+    return np.asarray(cols)[:, : dst_idx.size]
+
+
+def stripe_any_port(
+    ing_stripe,
+    eg_stripe,
+    ing_iso,
+    eg_iso_local,
+    src_loc,
+    q_row,
+    q_dst,
+    *,
+    row_base: int,
+    self_traffic: bool,
+    default_allow_unselected: bool,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Answer an any-port probe batch whose sources all live on this
+    stripe, one fused dispatch: ``src_loc`` [U] stripe-local source
+    positions, ``q_row`` [Q] positions into ``src_loc``, ``q_dst`` [Q]
+    GLOBAL destinations. Returns ``(rows [U, N], answers [Q])``."""
+    src_loc = np.asarray(src_loc, dtype=np.int64)
+    q_row = np.asarray(q_row, dtype=np.int64)
+    q_dst = np.asarray(q_dst, dtype=np.int64)
+    n = int(ing_stripe.shape[1])
+    if q_row.size == 0:
+        return np.zeros((0, n), dtype=bool), np.zeros(0, dtype=bool)
+    rows, ans = _stripe_probe_kernel(
+        ing_stripe,
+        eg_stripe,
+        _as_iso(ing_iso),
+        _as_iso(eg_iso_local),
+        jnp.int32(row_base),
+        _pad_idx(src_loc, _pow2(src_loc.size)),
+        _pad_idx(q_row, _pow2(q_row.size)),
+        _pad_idx(q_dst, _pow2(q_dst.size)),
+        self_traffic=self_traffic,
+        default_allow_unselected=default_allow_unselected,
+    )
+    return (
+        np.asarray(rows)[: src_loc.size],
+        np.asarray(ans)[: q_row.size],
+    )
+
+
 # Kernel-manifest registration (observe/aot.py): rebinding each jitted
 # entry point to its WarmKernel keeps every call site above unchanged
 # (late binding) while the warm-start pack can serve packed executables.
@@ -502,4 +715,16 @@ _packed_probe_kernel = _register_kernel(
 _packed_cols_kernel = _register_kernel(
     "query", "_packed_cols_kernel", _packed_cols_kernel,
     static_argnames=("self_traffic", "default_allow"),
+)
+_stripe_rows_kernel = _register_kernel(
+    "query", "_stripe_rows_kernel", _stripe_rows_kernel,
+    static_argnames=("self_traffic", "default_allow_unselected"),
+)
+_stripe_probe_kernel = _register_kernel(
+    "query", "_stripe_probe_kernel", _stripe_probe_kernel,
+    static_argnames=("self_traffic", "default_allow_unselected"),
+)
+_stripe_cols_kernel = _register_kernel(
+    "query", "_stripe_cols_kernel", _stripe_cols_kernel,
+    static_argnames=("self_traffic", "default_allow_unselected"),
 )

@@ -39,6 +39,8 @@ __all__ = [
     "FencedError",
     "ReplicationError",
     "AdmissionRejectedError",
+    "StripeRouteError",
+    "StripeCoverageError",
     "BackendError",
     "BackendOOM",
     "BackendTimeout",
@@ -200,6 +202,46 @@ class AdmissionRejectedError(ServeError):
         self.retry_after_s = float(retry_after_s)
         self.tenant = tenant
         self.reason = reason
+
+
+class StripeRouteError(ServeError):
+    """A query landed on a stripe owner that does not own the source rows
+    it needs: the routing layer (or a direct caller) asked stripe ``k``
+    for a row outside its ``[lo, hi)`` range. ``pod`` is the offending
+    global row index, ``stripe`` the ``(index, count)`` pair that refused
+    it. Always a routing bug or a direct misuse, never data loss — the
+    row exists on its owning stripe."""
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        pod: Optional[int] = None,
+        stripe: Optional[tuple] = None,
+    ) -> None:
+        super().__init__(message)
+        self.pod = pod
+        self.stripe = stripe
+
+
+class StripeCoverageError(ServeError):
+    """A scatter-gather query needed a stripe that has **no live owner**:
+    every registered owner for that pod range failed or none was ever
+    registered. The coordinator raises this instead of returning a
+    silently-truncated answer — a coverage gap is an outage, not a
+    smaller result set. ``stripe`` is the dead ``(index, count)`` pair,
+    ``rows`` its ``(lo, hi)`` pod range."""
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        stripe: Optional[tuple] = None,
+        rows: Optional[tuple] = None,
+    ) -> None:
+        super().__init__(message)
+        self.stripe = stripe
+        self.rows = rows
 
 
 class BackendError(KvTpuError, RuntimeError):

@@ -47,7 +47,14 @@ incremental engines were built for — BASELINE config 5):
   overload (what-if off → shed low priority → reject at the door);
 * ``autoscale`` — :class:`FleetAutoscaler`: spawns/retires capacity off
   SLO burn rates, replica lag and queue pressure, with hysteresis,
-  cooldown and a fenced max-fleet bound.
+  cooldown and a fenced max-fleet bound;
+* ``stripes`` — the stripe-sharded serving fleet: :class:`StripeEngine`
+  (an incremental engine owning only rows ``[lo, hi)`` of the count
+  state), :class:`StripeFollower` (stripe + WAL tail + stripe-sliced
+  checkpoints), and :class:`StripeCoordinator` (source-stripe routing,
+  scatter-gather merges bit-identical to a whole-state follower, typed
+  ``StripeCoverageError`` on DOWN stripes instead of truncated answers)
+  — the first serving configuration where no process holds full state.
 
 CLI: ``kv-tpu serve`` (``--follow DIR`` for a replica, ``--leader URL``
 for a networked one) / ``kv-tpu query`` (``--batch FILE.jsonl`` for the
@@ -132,6 +139,12 @@ from .posture import (
     scan_posture,
 )
 from .service import ServeConfig, ServeStats, VerificationService
+from .stripes import (
+    RemoteStripeOwner,
+    StripeCoordinator,
+    StripeEngine,
+    StripeFollower,
+)
 
 __all__ = [
     "Event",
@@ -193,4 +206,8 @@ __all__ = [
     "parse_posture_rule",
     "posture_diff",
     "scan_posture",
+    "StripeEngine",
+    "StripeFollower",
+    "StripeCoordinator",
+    "RemoteStripeOwner",
 ]

@@ -361,6 +361,77 @@ class CheckpointManager:
             last_seq=-1,
         )
 
+    def checkpoint_stripe(
+        self,
+        engine,
+        *,
+        log_path: Optional[str] = None,
+        log_offset: int = 0,
+        last_seq: int = -1,
+    ) -> CheckpointInfo:
+        """Commit one atomic generation of a
+        :class:`~.stripes.StripeEngine`: the stripe-sliced snapshot
+        (``utils/persist.save_stripe_incremental`` — ``[S, N]`` counts,
+        never the whole matrix) bound to the WAL position, manifest
+        tagged ``kind: stripe`` with the geometry block so recovery can
+        refuse a generation written under a different stripe layout.
+        Same write discipline (and kill-points) as :meth:`checkpoint`."""
+        from ..utils.persist import save_stripe_incremental
+
+        gen = self._next_generation()
+        snap_dir = self.snapshot_dir(gen)
+        tmp_dir = os.path.join(self.directory, f".tmp-gen-{gen:08d}")
+        if os.path.exists(tmp_dir):
+            shutil.rmtree(tmp_dir)
+        save_stripe_incremental(engine, tmp_dir)
+        digest = _tree_digest(tmp_dir)
+        kill_point("after-tmp-write")
+        if self.fsync:
+            _fsync_tree(tmp_dir)
+        kill_point("before-rename")
+        os.replace(tmp_dir, snap_dir)
+        if self.fsync:
+            _fsync_dir(self.directory)
+        lo, hi = engine.stripe_rows
+        manifest = {
+            "format": MANIFEST_FORMAT,
+            "kind": "stripe",
+            "generation": gen,
+            "snapshot": os.path.basename(snap_dir),
+            "snapshot_digest": digest,
+            "event_log": os.path.abspath(log_path) if log_path else None,
+            "log_offset": int(log_offset),
+            "last_seq": int(last_seq),
+            "stripe": {
+                "index": int(engine.stripe_index),
+                "count": int(engine.stripe_count),
+                "lo": int(lo),
+                "hi": int(hi),
+                "n": len(engine.pods),
+            },
+        }
+        manifest["checksum"] = _manifest_checksum(manifest)
+        _atomic_write_json(
+            self.manifest_path(gen), manifest, fsync=self.fsync
+        )
+        kill_point("after-manifest")
+        CHECKPOINTS_TOTAL.inc()
+        log_event(
+            "stripe_checkpoint", generation=gen, directory=self.directory,
+            stripe=f"{engine.stripe_index + 1}/{engine.stripe_count}",
+            log_offset=int(log_offset), last_seq=int(last_seq),
+        )
+        self._rotate()
+        return CheckpointInfo(
+            generation=gen,
+            manifest_path=self.manifest_path(gen),
+            snapshot_dir=snap_dir,
+            snapshot_digest=digest,
+            log_path=manifest["event_log"],
+            log_offset=int(log_offset),
+            last_seq=int(last_seq),
+        )
+
     def _ship_pack(self) -> None:
         """Ship the warm executable pack alongside the ``gen-N/``
         snapshots (``aot-pack/`` is invisible to :meth:`_rotate` — it is
@@ -509,10 +580,13 @@ class RecoveryManager:
             try:
                 manifest = load_manifest(self._cm.manifest_path(gen))
                 entry.update(
+                    kind=manifest.get("kind", "serve"),
                     log_offset=manifest["log_offset"],
                     last_seq=manifest["last_seq"],
                     event_log=manifest["event_log"],
                 )
+                if "stripe" in manifest:
+                    entry["stripe"] = manifest["stripe"]
                 snap = os.path.join(self.directory, manifest["snapshot"])
                 if not os.path.isdir(snap):
                     entry["valid"] = False
@@ -617,6 +691,13 @@ class RecoveryManager:
                         "snapshot",
                         path=mpath,
                     )
+                if manifest.get("kind") == "stripe":
+                    raise PersistError(
+                        f"{mpath}: stripe-sliced checkpoint (partial rows) "
+                        "— recover it with recover_stripe, not as a "
+                        "whole-state serving snapshot",
+                        path=mpath,
+                    )
                 snap = os.path.join(self.directory, manifest["snapshot"])
                 if not os.path.isdir(snap):
                     raise PersistError(
@@ -688,6 +769,140 @@ class RecoveryManager:
         )
         return RecoveryResult(
             service=service,
+            outcome=outcome,
+            generation=generation,
+            replayed=replayed,
+            duplicates_skipped=source.skipped if source else 0,
+            last_seq=source.last_seq if source else after_seq,
+            wal=wal,
+            source=source,
+            errors=errors,
+        )
+
+    def recover_stripe(
+        self,
+        stripe,
+        *,
+        log_path: Optional[str] = None,
+        initial_cluster=None,
+        config=None,
+        device=None,
+        strict_wal: bool = False,
+        batch_size: int = 256,
+        replica: str = "stripe",
+    ) -> "RecoveryResult":
+        """Recover ONE stripe owner: walk the ladder newest-first
+        accepting only ``kind: stripe`` generations whose recorded
+        geometry matches ``stripe = (index, count)`` exactly (a serving
+        or closure generation, a different stripe's snapshot, or a
+        drifted pod count are all rung failures, not silent loads),
+        bootstrap the :class:`~.stripes.StripeEngine` from the sliced
+        snapshot, then replay the WAL from the recorded position —
+        skipping already-applied sequence numbers like :meth:`recover`.
+        Degrades to a rebuild from ``initial_cluster`` (full log replay)
+        when no rung holds. ``result.service`` is the positioned
+        :class:`~.stripes.StripeFollower`."""
+        from ..utils.persist import load_stripe_incremental
+        from .stripes import StripeFollower
+
+        k, count = int(stripe[0]), int(stripe[1])
+        errors: List[Tuple[int, str]] = []
+        chosen: Optional[dict] = None
+        engine = None
+        gens = self._cm.generations()
+        for gen in gens:
+            mpath = self._cm.manifest_path(gen)
+            try:
+                manifest = load_manifest(mpath)
+                if manifest.get("kind") != "stripe":
+                    raise PersistError(
+                        f"{mpath}: not a stripe checkpoint "
+                        f"(kind={manifest.get('kind', 'serve')!r})",
+                        path=mpath,
+                    )
+                geo = manifest.get("stripe") or {}
+                if (
+                    int(geo.get("index", -1)) != k
+                    or int(geo.get("count", -1)) != count
+                ):
+                    raise PersistError(
+                        f"{mpath}: stripe {geo.get('index')}"
+                        f"/{geo.get('count')} snapshot, caller owns "
+                        f"{k}/{count}",
+                        path=mpath,
+                    )
+                snap = os.path.join(self.directory, manifest["snapshot"])
+                if not os.path.isdir(snap):
+                    raise PersistError(
+                        f"{mpath}: snapshot {manifest['snapshot']} missing",
+                        path=snap,
+                    )
+                digest = _tree_digest(snap)
+                if digest != manifest["snapshot_digest"]:
+                    raise PersistError(
+                        f"{snap}: snapshot digest mismatch (manifest "
+                        f"{manifest['snapshot_digest'][:12]}…, tree "
+                        f"{digest[:12]}…)",
+                        path=snap,
+                    )
+                engine = load_stripe_incremental(
+                    snap, (k, count), config=config, device=device
+                )
+                chosen = manifest
+                break
+            except (PersistError, FileNotFoundError, KeyError) as e:
+                errors.append((gen, str(e)))
+                log_event("recovery_skip", generation=gen, reason=str(e))
+                continue
+        if chosen is not None:
+            outcome = (
+                "newest" if chosen["generation"] == gens[0] else "fallback"
+            )
+            offset = int(chosen["log_offset"])
+            after_seq = int(chosen["last_seq"])
+            generation = int(chosen["generation"])
+            replay_path = log_path or chosen["event_log"]
+        else:
+            if initial_cluster is None:
+                detail = "; ".join(f"gen {g}: {why}" for g, why in errors)
+                raise PersistError(
+                    f"{self.directory}: no usable stripe checkpoint for "
+                    f"stripe {k + 1}/{count} ({detail or 'none found'}) "
+                    "and no initial cluster to rebuild from",
+                    path=self.directory,
+                )
+            from .stripes import StripeEngine
+
+            engine = StripeEngine(
+                initial_cluster, config, device, stripe=(k, count)
+            )
+            outcome = "rebuild"
+            offset, after_seq, generation = 0, -1, -1
+            replay_path = log_path
+        wal: Optional[WalInfo] = None
+        replayed = 0
+        follower = StripeFollower(engine=engine, replica=replica)
+        source: Optional[EventSource] = None
+        if replay_path and os.path.exists(replay_path):
+            wal = scan_wal(replay_path, strict=strict_wal)
+            source = EventSource(
+                replay_path, offset=offset, start_after_seq=after_seq
+            )
+            follower.log_path = replay_path
+            follower.source = source
+            replayed = 0
+            for batch in source.batches(batch_size):
+                follower.apply(batch)
+                replayed += len(batch)
+        RECOVERIES_TOTAL.labels(outcome=outcome).inc()
+        log_event(
+            "stripe_recovery", outcome=outcome, generation=generation,
+            stripe=f"{k + 1}/{count}", replayed=replayed,
+            duplicates_skipped=source.skipped if source else 0,
+            rejected_generations=len(errors),
+        )
+        return RecoveryResult(
+            service=follower,
             outcome=outcome,
             generation=generation,
             replayed=replayed,

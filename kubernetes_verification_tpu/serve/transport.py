@@ -336,6 +336,22 @@ class _Handler(BaseHTTPRequestHandler):
             "http_serve", path=parts.path
         ) as span:
             try:
+                if parts.path == "/v1/stripe":
+                    source = getattr(rep, "stripe_source", None)
+                    if source is None:
+                        self._send_json(
+                            {"error": "this replica serves no stripe"},
+                            status=503,
+                        )
+                        return
+                    length = int(
+                        self.headers.get("Content-Length", 0) or 0
+                    )
+                    raw = self.rfile.read(length) if length > 0 else b""
+                    doc = json.loads(raw.decode("utf-8")) if raw else {}
+                    span.attrs["op"] = str(doc.get("op", ""))
+                    self._send_json(source(doc))
+                    return
                 if parts.path != "/v1/query":
                     self._send_json(
                         {"error": f"unknown endpoint {parts.path!r}"},
@@ -424,6 +440,7 @@ class ReplicationServer:
         health_source: Optional[Callable[[], dict]] = None,
         profile_dir: Optional[str] = None,
         ingress=None,
+        stripe_source: Optional[Callable[[dict], dict]] = None,
     ) -> None:
         self.directory = directory
         self.log_path = log_path
@@ -431,6 +448,13 @@ class ReplicationServer:
         #: wired, ``POST /v1/query`` coalesces client probes through it
         #: and ``/healthz`` carries its queue/admission fragment
         self.ingress = ingress
+        #: optional stripe-owner surface (a
+        #: :meth:`~.stripes.StripeFollower.handle_stripe_op` bound method):
+        #: when wired, ``POST /v1/stripe`` answers describe/probes/rows/cols
+        #: ops against the owned row range — a typed :class:`ServeError`
+        #: (wrong-stripe routing, unknown op) maps to HTTP 400, never a
+        #: silently smaller answer
+        self.stripe_source = stripe_source
         self.host = host
         self.port = port
         self.max_range_bytes = max_range_bytes
@@ -859,6 +883,64 @@ class ReplicationClient:
             )
         NET_BYTES_TOTAL.labels(op=op).inc(len(payload))
         return [bool(a) for a in doc.get("answers", [])]
+
+    def stripe_op(self, doc: dict) -> dict:
+        """``POST /v1/stripe``: one stripe-owner operation (``describe`` /
+        ``probes`` / ``rows`` / ``cols`` — the wire form of
+        :meth:`~.stripes.StripeFollower.handle_stripe_op`). An HTTP 400
+        is re-raised as the typed :class:`ServeError` the owner threw
+        (a routing bug — e.g. a row outside the owned stripe — not a
+        transport fault, so the coordinator must NOT eject the owner for
+        it); transport failures and non-owner replicas (503) raise
+        :class:`ReplicationError` as every other wire op does."""
+        op = "stripe"
+        NET_REQUESTS_TOTAL.labels(op=op).inc()
+        body = json.dumps(doc).encode("utf-8")
+        try:
+            net_fault(op)  # the injection seam, same as every wire request
+            conn = HTTPConnection(
+                self._host, self._port, timeout=self.timeout
+            )
+            try:
+                headers = dict(trace_headers())
+                headers["Content-Type"] = "application/json"
+                conn.request(
+                    "POST", "/v1/stripe", body=body, headers=headers
+                )
+                resp = conn.getresponse()
+                payload = resp.read()
+                status = resp.status
+            finally:
+                conn.close()
+        except (OSError, HTTPException) as e:
+            NET_REQUEST_FAILURES_TOTAL.labels(op=op).inc()
+            raise ReplicationError(
+                f"stripe request to {self.base_url} failed: "
+                f"{type(e).__name__}: {e}",
+                op=op, url=self.base_url,
+            ) from e
+        try:
+            out = json.loads(payload.decode("utf-8"))
+        except (json.JSONDecodeError, UnicodeDecodeError) as e:
+            NET_REQUEST_FAILURES_TOTAL.labels(op=op).inc()
+            raise ReplicationError(
+                f"stripe response from {self.base_url} was not JSON "
+                f"(HTTP {status})",
+                op=op, url=self.base_url,
+            ) from e
+        if status == 400:
+            raise ServeError(
+                out.get("error", "stripe op rejected (HTTP 400)")
+            )
+        if status != 200:
+            NET_REQUEST_FAILURES_TOTAL.labels(op=op).inc()
+            raise ReplicationError(
+                f"stripe request to {self.base_url} returned HTTP "
+                f"{status}: {out.get('error', '')[:200]}",
+                op=op, url=self.base_url,
+            )
+        NET_BYTES_TOTAL.labels(op=op).inc(len(payload))
+        return out
 
     def wal(
         self,

@@ -1,0 +1,87 @@
+"""Subprocess body for the stripe-owner SIGKILL chaos test
+(tests/test_stripes.py).
+
+One stripe owner on its own simulated host: it rebuilds the
+deterministic chaos cluster, replays the deterministic event stream
+into its :class:`StripeEngine`, and serves ``POST /v1/stripe`` +
+``/healthz`` over HTTP (:class:`StripeFollower.serve_http`). The parent
+kills it with a raw SIGKILL — no graceful shutdown, exactly like a
+machine loss — and asserts the coordinator either retries a surviving
+owner of the same stripe or fails typed, never truncating an answer.
+
+Handshake: the URL is published to ``--url-file`` via tmp +
+``os.replace`` so the parent never reads a half-written line; the child
+then idles until ``--ack-file`` appears (clean-exit path — the chaos
+paths never create it).
+
+MUST mirror the parent test's generator knobs exactly
+(``_chaos_cluster`` in tests/test_stripes.py): the parent's whole-state
+oracle replays the same stream against the same cluster.
+"""
+import argparse
+import os
+import sys
+import time
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--workdir", required=True)
+    ap.add_argument("--url-file", required=True)
+    ap.add_argument("--ack-file", required=True)
+    ap.add_argument("--stripe-index", type=int, required=True)
+    ap.add_argument("--stripe-count", type=int, required=True)
+    ap.add_argument("--pods", type=int, default=36)
+    ap.add_argument("--n-events", type=int, default=48)
+    ap.add_argument("--replica", default="")
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+    import kubernetes_verification_tpu as kv
+    from kubernetes_verification_tpu.harness.generate import (
+        GeneratorConfig,
+        random_cluster,
+        random_event_stream,
+    )
+    from kubernetes_verification_tpu.serve.stripes import StripeFollower
+
+    cluster = random_cluster(
+        GeneratorConfig(
+            n_pods=args.pods, n_policies=16, n_namespaces=5, seed=11,
+            p_ipblock_peer=0.0, min_selector_labels=1,
+        )
+    )
+    events = random_event_stream(cluster, n_events=args.n_events, seed=13)
+    cfg = kv.VerifyConfig(backend="cpu", compute_ports=False)
+    replica = args.replica or (
+        f"chaos-{args.stripe_index + 1}-of-{args.stripe_count}"
+    )
+    follower = StripeFollower(
+        cluster, cfg,
+        stripe=(args.stripe_index, args.stripe_count),
+        replica=replica,
+    )
+    follower.apply(events)
+
+    server = follower.serve_http(args.workdir)
+    tmp = args.url_file + ".tmp"
+    with open(tmp, "w") as fh:
+        fh.write(server.url)
+    os.replace(tmp, args.url_file)
+
+    deadline = time.time() + 120.0
+    while not os.path.exists(args.ack_file):
+        if time.time() > deadline:
+            print("parent never acked", file=sys.stderr)
+            return 1
+        time.sleep(0.05)
+    server.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

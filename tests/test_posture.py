@@ -475,7 +475,8 @@ def test_fleet_row_and_posture_column():
     )
     down = ReplicaScrape(url="http://b", ok=False, error="boom")
     lines = render_fleet([up, down])
-    assert lines[0].split()[-1] == "posture"
+    # posture sits before the (newer) trailing stripe-ownership column
+    assert lines[0].split()[-2:] == ["posture", "stripe"]
     assert "123p +4/-5 !2" in lines[1]
     assert "DOWN" in lines[2]
 

@@ -1,0 +1,647 @@
+"""Stripe-sharded serving fleet: geometry, scatter-gather identity vs
+a whole-state follower, fan-out accounting, typed coverage failures,
+wire parity, stripe-sliced checkpoint recovery, the sharded-closure
+checkpoint/resume ladder, the stripe-locality lint, and the
+stripe-owner SIGKILL chaos (retried or typed-failed, never silently
+truncated)."""
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+import kubernetes_verification_tpu as kv
+from kubernetes_verification_tpu.analysis import lint_source, rule_ids
+from kubernetes_verification_tpu.backends.base import VerifyConfig
+from kubernetes_verification_tpu.harness.generate import (
+    GeneratorConfig,
+    random_cluster,
+    random_event_stream,
+)
+from kubernetes_verification_tpu.incremental import IncrementalVerifier
+from kubernetes_verification_tpu.observe.metrics import (
+    STRIPE_COVERAGE_GAPS_TOTAL,
+    STRIPE_FANOUT_TOTAL,
+    STRIPE_QUERIES_TOTAL,
+)
+from kubernetes_verification_tpu.parallel.mesh import mesh_for
+from kubernetes_verification_tpu.parallel.sharded_closure import (
+    sharded_packed_closure,
+)
+from kubernetes_verification_tpu.parallel.stripes import (
+    parse_stripe,
+    stripe_bounds,
+    stripe_of,
+    stripe_table,
+)
+from kubernetes_verification_tpu.resilience.errors import (
+    ConfigError,
+    PersistError,
+    ServeError,
+    StripeCoverageError,
+)
+from kubernetes_verification_tpu.resilience.retry import RetryPolicy
+from kubernetes_verification_tpu.serve import (
+    CheckpointManager,
+    RecoveryManager,
+)
+from kubernetes_verification_tpu.serve.events import (
+    AddPolicy,
+    UpdatePodLabels,
+)
+from kubernetes_verification_tpu.serve.stripes import (
+    RemoteStripeOwner,
+    StripeCoordinator,
+    StripeEngine,
+    StripeFollower,
+    _pack_bool,
+    _unpack_bool,
+)
+from kubernetes_verification_tpu.serve.transport import ReplicationClient
+
+CHILD = os.path.join(os.path.dirname(__file__), "stripe_child.py")
+
+_FAST = RetryPolicy(max_retries=0, backoff_base=0.001)
+
+
+# ------------------------------------------------------------- geometry
+@pytest.mark.parametrize(
+    "n,k_stripes",
+    [(0, 1), (1, 1), (7, 3), (13, 4), (5, 8), (100, 7), (523, 4)],
+)
+def test_stripe_bounds_partition_exactly(n, k_stripes):
+    """Stripes are contiguous, disjoint, cover [0, n) exactly, differ in
+    size by at most one, and the ragged remainder rides the FIRST
+    stripes (np.array_split convention)."""
+    table = stripe_table(n, k_stripes)
+    assert table == [
+        stripe_bounds(n, k, k_stripes) for k in range(k_stripes)
+    ]
+    cursor = 0
+    sizes = []
+    for lo, hi in table:
+        assert lo == cursor and hi >= lo
+        cursor = hi
+        sizes.append(hi - lo)
+    assert cursor == n
+    assert max(sizes) - min(sizes) <= 1
+    assert sizes == sorted(sizes, reverse=True)  # remainder rides first
+    for pod in range(n):
+        k = stripe_of(n, k_stripes, pod)
+        lo, hi = table[k]
+        assert lo <= pod < hi
+
+
+def test_stripe_geometry_rejects_bad_inputs():
+    with pytest.raises(ConfigError):
+        stripe_bounds(10, 0, 0)  # n_stripes = 0
+    with pytest.raises(ConfigError):
+        stripe_bounds(10, 4, 4)  # k out of range
+    with pytest.raises(ConfigError):
+        stripe_of(10, 4, 10)  # pod out of range
+    with pytest.raises(ConfigError):
+        stripe_of(-1, 4, 0)
+
+
+def test_parse_stripe():
+    assert parse_stripe("3/8") == (2, 8)
+    assert parse_stripe(" 1/1 ") == (0, 1)
+    for bad in ("0/4", "5/4", "x/4", "3", "3/", "/4", "3/0", "3/-1"):
+        with pytest.raises(ConfigError):
+            parse_stripe(bad)
+
+
+# ------------------------------------------------- single-stripe == whole
+def _mini_cluster(n=48, policies=16, seed=11):
+    return random_cluster(
+        GeneratorConfig(
+            n_pods=n, n_policies=policies, n_namespaces=5, seed=seed,
+            p_ipblock_peer=0.0, min_selector_labels=1,
+        )
+    )
+
+
+def test_single_stripe_degenerates_to_whole_state():
+    """A (0, 1) stripe engine IS a whole-state engine: bit-for-bit equal
+    reach to the dense IncrementalVerifier, initially and after the
+    same mutations applied to both."""
+    cluster = _mini_cluster()
+    cfg = VerifyConfig(compute_ports=False)
+    dense = IncrementalVerifier(cluster, cfg)
+    striped = StripeEngine(cluster, cfg, stripe=(0, 1))
+    n = len(cluster.pods)
+    assert striped.stripe_rows == (0, n)
+    all_rows = list(range(n))
+    np.testing.assert_array_equal(
+        striped.reach_rows(all_rows), np.asarray(dense.reach, dtype=bool)
+    )
+
+    pol = cluster.policies[0]
+    for eng in (dense, striped):
+        eng.remove_policy(pol.namespace, pol.name)
+    np.testing.assert_array_equal(
+        striped.reach_rows(all_rows), np.asarray(dense.reach, dtype=bool)
+    )
+    for eng in (dense, striped):
+        eng.add_policy(pol)
+        eng.update_pod_labels(3, {"role": "db", "tier": "gold"})
+    np.testing.assert_array_equal(
+        striped.reach_rows(all_rows), np.asarray(dense.reach, dtype=bool)
+    )
+
+
+# ------------------------------------- scatter-gather identity (ragged N)
+def _fleet(cluster, k_stripes, events=None):
+    """One whole-state (0, 1) follower + k_stripes stripe followers, all
+    having replayed the same event batch."""
+    cfg = VerifyConfig(compute_ports=False)
+    whole = StripeFollower(cluster, cfg, stripe=(0, 1), replica="whole")
+    owners = [
+        StripeFollower(
+            cluster, cfg, stripe=(k, k_stripes),
+            replica=f"s{k + 1}-of-{k_stripes}",
+        )
+        for k in range(k_stripes)
+    ]
+    if events:
+        whole.apply(events)
+        for o in owners:
+            o.apply(events)
+    return whole, owners
+
+
+def test_scatter_gather_identity_ragged():
+    """37 pods / 5 stripes (ragged: the first two stripes carry 8 rows,
+    the rest 7): every coordinator answer — probes, columns, blast
+    radius, bounded paths — is bit-identical to the whole-state
+    follower, and row fragments vertically reassemble the whole
+    matrix."""
+    cluster = _mini_cluster(n=37)
+    events = random_event_stream(cluster, n_events=48, seed=13)
+    whole, owners = _fleet(cluster, 5, events)
+    coord = StripeCoordinator(owners, pods=cluster.pods)
+    oracle = StripeCoordinator([whole], pods=cluster.pods)
+    names = [f"{p.namespace}/{p.name}" for p in cluster.pods]
+    n = len(names)
+
+    frags = [
+        o.engine.reach_rows(range(lo, hi))
+        for o, (lo, hi) in zip(owners, stripe_table(n, 5))
+    ]
+    assert all(
+        f.shape[0] == hi - lo
+        for f, (lo, hi) in zip(frags, stripe_table(n, 5))
+    )
+    np.testing.assert_array_equal(
+        np.vstack(frags), whole.engine.reach_rows(range(n))
+    )
+    whole_bytes = whole.engine.state_bytes()
+    assert all(
+        o.engine.state_bytes() < whole_bytes for o in owners
+    )
+
+    rng = np.random.default_rng(3)
+    pairs = rng.integers(0, n, size=(200, 2))
+    q = [(names[a], names[b]) for a, b in pairs]
+    np.testing.assert_array_equal(
+        coord.can_reach_batch(q), oracle.can_reach_batch(q)
+    )
+    some = [names[i] for i in rng.integers(0, n, size=16)]
+    assert coord.who_can_reach_batch(some) == oracle.who_can_reach_batch(
+        some
+    )
+    assert coord.blast_radius_batch(some) == oracle.blast_radius_batch(
+        some
+    )
+    for a, b in pairs[:6]:
+        assert coord.path_exists(names[a], names[b], 3) == (
+            oracle.path_exists(names[a], names[b], 3)
+        )
+        assert coord.hops(names[a], names[b], 4) == oracle.hops(
+            names[a], names[b], 4
+        )
+    assert coord.can_reach(q[0][0], q[0][1]) == bool(
+        oracle.can_reach_batch(q[:1])[0]
+    )
+
+
+def test_more_stripes_than_pods_still_answers():
+    """n < K leaves trailing stripes empty — they contribute [0, U]
+    fragments, never break the concatenation."""
+    cluster = _mini_cluster(n=5, policies=6)
+    whole, owners = _fleet(cluster, 8)
+    assert owners[-1].engine.stripe_rows[0] == owners[-1].engine.stripe_rows[1]
+    coord = StripeCoordinator(owners, pods=cluster.pods)
+    names = [f"{p.namespace}/{p.name}" for p in cluster.pods]
+    got = coord.who_can_reach_batch(names)
+    want = StripeCoordinator([whole], pods=cluster.pods).who_can_reach_batch(
+        names
+    )
+    assert got == want
+
+
+def test_coordinator_rejects_mixed_geometry_and_ported_probes():
+    cluster = _mini_cluster(n=12, policies=6)
+    cfg = VerifyConfig(compute_ports=False)
+    a = StripeFollower(cluster, cfg, stripe=(0, 2))
+    b = StripeFollower(cluster, cfg, stripe=(0, 3))
+    with pytest.raises(ConfigError):
+        StripeCoordinator([a, b], pods=cluster.pods)
+    with pytest.raises(ConfigError):
+        StripeCoordinator([], pods=cluster.pods)
+    coord = StripeCoordinator([a], pods=cluster.pods)
+    names = [f"{p.namespace}/{p.name}" for p in cluster.pods]
+    with pytest.raises(ServeError):
+        coord.can_reach(names[0], names[1], 8080)
+    with pytest.raises(ServeError):
+        coord.can_reach("not-a-ref", names[1])
+    with pytest.raises(ServeError):
+        coord.can_reach("ghost/pod", names[1])
+
+
+# ------------------------------------------------------ fan-out accounting
+def test_fanout_counted_never_filtered():
+    """Every event applies on every stripe (correctness first); the ones
+    whose home pod lives elsewhere — or that have no single home — are
+    counted, and a single-stripe fleet counts none."""
+    cluster = _mini_cluster(n=30, policies=8)
+    cfg = VerifyConfig(compute_ports=False)
+    f = StripeFollower(cluster, cfg, stripe=(1, 3))
+    lo, hi = f.engine.stripe_rows
+    own_pod = cluster.pods[lo]
+    far_pod = cluster.pods[0]
+    assert not f.engine.owns(0) and f.engine.owns(lo)
+
+    before = f.fanout_total
+    f.apply(
+        [UpdatePodLabels(own_pod.namespace, own_pod.name, {"zone": "a"})]
+    )
+    assert f.fanout_total == before  # home event, no fan-out
+    f.apply(
+        [UpdatePodLabels(far_pod.namespace, far_pod.name, {"zone": "b"})]
+    )
+    assert f.fanout_total == before + 1  # off-home row, still applied
+    f.apply([AddPolicy(cluster.policies[0])])
+    assert f.fanout_total == before + 2  # no single home: fans out
+    assert f.applied_total >= 3  # ...and every one of them applied
+
+    whole = StripeFollower(cluster, cfg, stripe=(0, 1))
+    whole.apply([AddPolicy(cluster.policies[0])])
+    assert whole.fanout_total == 0  # K=1 has nowhere to fan out to
+
+
+# --------------------------------------------------- typed coverage gaps
+def test_down_stripe_fails_typed_never_truncated():
+    cluster = _mini_cluster(n=24, policies=8)
+    _, owners = _fleet(cluster, 3)
+    alive = [owners[0], owners[2]]  # stripe 2/3 has no owner at all
+    coord = StripeCoordinator(alive, pods=cluster.pods)
+    assert coord.coverage_gaps() == [1]
+    desc = coord.describe()
+    assert desc["stripes"][1]["down"] and not desc["stripes"][0]["down"]
+    names = [f"{p.namespace}/{p.name}" for p in cluster.pods]
+    lo, hi = stripe_bounds(24, 1, 3)
+
+    before = STRIPE_COVERAGE_GAPS_TOTAL.value
+    # a query owned by a live stripe still answers
+    assert coord.can_reach(names[0], names[1]) in (True, False)
+    # a scalar routed to the dead stripe fails typed...
+    with pytest.raises(StripeCoverageError) as ei:
+        coord.can_reach(names[lo], names[0])
+    assert ei.value.stripe == (1, 3)
+    assert ei.value.rows == (lo, hi)
+    # ...and so does any scatter that needs the dead stripe's fragment —
+    # never a silently shorter answer
+    with pytest.raises(StripeCoverageError):
+        coord.who_can_reach(names[0])
+    assert STRIPE_COVERAGE_GAPS_TOTAL.value >= before + 2
+
+
+# ---------------------------------------------------------- wire parity
+def test_wire_parity_bit_identical(tmp_path):
+    """A remote stripe owner answers probes/rows/cols byte-for-byte like
+    the in-process follower it fronts, and a coordinator mixing remote
+    and local owners matches the whole-state oracle."""
+    cluster = _mini_cluster(n=26, policies=8)
+    events = random_event_stream(cluster, n_events=32, seed=13)
+    whole, owners = _fleet(cluster, 2, events)
+    server = owners[0].serve_http(str(tmp_path))
+    try:
+        remote = RemoteStripeOwner(
+            ReplicationClient(server.url, policy=_FAST)
+        )
+        assert remote.stripe == (0, 2)
+        assert remote.replica == owners[0].replica
+        srcs = list(range(0, 13))
+        dsts = [0, 5, 25]
+        np.testing.assert_array_equal(
+            remote.rows(srcs), owners[0].rows(srcs)
+        )
+        np.testing.assert_array_equal(
+            remote.cols_fragment(dsts), owners[0].cols_fragment(dsts)
+        )
+        np.testing.assert_array_equal(
+            remote.probes(srcs[:3], dsts), owners[0].probes(srcs[:3], dsts)
+        )
+        health = remote.health()
+        assert health["stripe"]["count"] == 2
+        assert health["stripe"]["n"] == 26
+
+        coord = StripeCoordinator(
+            [remote, owners[1]], pods=cluster.pods
+        )
+        oracle = StripeCoordinator([whole], pods=cluster.pods)
+        names = [f"{p.namespace}/{p.name}" for p in cluster.pods]
+        rng = np.random.default_rng(5)
+        pairs = rng.integers(0, 26, size=(64, 2))
+        q = [(names[a], names[b]) for a, b in pairs]
+        np.testing.assert_array_equal(
+            coord.can_reach_batch(q), oracle.can_reach_batch(q)
+        )
+        assert coord.who_can_reach_batch(names[:6]) == (
+            oracle.who_can_reach_batch(names[:6])
+        )
+
+        # a malformed op is the CLIENT's typed ServeError (HTTP 400), not
+        # a transport failure — it must NOT eject the owner
+        with pytest.raises(ServeError):
+            remote.client.stripe_op({"op": "nonsense"})
+    finally:
+        server.close()
+
+
+def test_pack_bool_roundtrip():
+    rng = np.random.default_rng(0)
+    for shape in [(3, 7), (1, 1), (4, 32), (0, 5)]:
+        arr = rng.random(shape) < 0.4
+        doc = _pack_bool(arr)
+        assert len(doc["b64"]) < max(64, arr.size)  # 8x + b64 overhead
+        np.testing.assert_array_equal(_unpack_bool(doc), arr)
+
+
+# --------------------------------------------- stripe checkpoint ladder
+def test_stripe_checkpoint_recover_roundtrip(tmp_path):
+    cluster = _mini_cluster(n=20, policies=8)
+    cfg = VerifyConfig(compute_ports=False)
+    f = StripeFollower(cluster, cfg, stripe=(1, 3), replica="ck")
+    events = random_event_stream(cluster, n_events=24, seed=13)
+    f.apply(events)
+    cm = CheckpointManager(str(tmp_path))
+    f.checkpoint(cm)
+
+    res = RecoveryManager(str(tmp_path)).recover_stripe((1, 3), config=cfg)
+    assert res.outcome == "newest"
+    rec = res.service
+    assert rec.stripe == (1, 3)
+    lo, hi = rec.engine.stripe_rows
+    np.testing.assert_array_equal(
+        rec.engine.reach_rows(range(lo, hi)),
+        f.engine.reach_rows(range(lo, hi)),
+    )
+    assert rec.engine.state_bytes() == f.engine.state_bytes()
+
+    # geometry drift is a typed refusal, never a silent load...
+    with pytest.raises(PersistError):
+        RecoveryManager(str(tmp_path)).recover_stripe((0, 3), config=cfg)
+    with pytest.raises(PersistError):
+        RecoveryManager(str(tmp_path)).recover_stripe((1, 4), config=cfg)
+    # ...unless an initial cluster allows the documented rebuild degrade
+    res2 = RecoveryManager(str(tmp_path)).recover_stripe(
+        (0, 3), initial_cluster=cluster, config=cfg
+    )
+    assert res2.outcome == "rebuild"
+    assert res2.service.stripe == (0, 3)
+
+
+# ------------------------------------- sharded closure checkpoint/resume
+def test_sharded_closure_checkpoint_resume(tmp_path):
+    """Satellite: the sharded closure loop commits pass-boundary
+    generations and resumes bit-for-bit; a resume under a different mesh
+    factorisation (different padding) is a typed refusal."""
+    from kubernetes_verification_tpu.ops.tiled import pack_bool_cols
+
+    rng = np.random.default_rng(5)
+    n = 96
+    adj = rng.random((n, n)) < 6.0 / n
+    packed = np.asarray(pack_bool_cols(adj))[:n]
+    full = sharded_packed_closure(mesh_for((2, 4)), packed, tile=32)
+    ck = str(tmp_path / "ck")
+    with_ck = sharded_packed_closure(
+        mesh_for((2, 4)), packed, tile=32,
+        checkpoint_dir=ck, checkpoint_every=1,
+    )
+    np.testing.assert_array_equal(with_ck, full)
+    assert CheckpointManager(ck).generations()
+    resumed = sharded_packed_closure(
+        mesh_for((2, 4)), packed, tile=32,
+        checkpoint_dir=ck, resume=True,
+    )
+    np.testing.assert_array_equal(resumed, full)
+    # (8, 1) pads to a different multiple than (2, 4) — the checkpoint
+    # must be refused, never silently re-striped
+    with pytest.raises(ConfigError):
+        sharded_packed_closure(
+            mesh_for((8, 1)), packed, tile=32,
+            checkpoint_dir=ck, resume=True,
+        )
+    # an empty ladder is a cold start, not an error
+    cold = sharded_packed_closure(
+        mesh_for((2, 4)), packed, tile=32,
+        checkpoint_dir=str(tmp_path / "empty"), resume=True,
+    )
+    np.testing.assert_array_equal(cold, full)
+
+
+# ------------------------------------------------- stripe-locality lint
+def test_stripe_locality_rule_fixtures():
+    bad = textwrap.dedent(
+        """
+        class E:
+            def leaky(self, idx):
+                return self._ing_count[idx, :]
+        """
+    )
+    findings = lint_source(
+        bad, path="serve/stripes.py", rules=["stripe-locality"]
+    )
+    assert "stripe-locality" in rule_ids()  # registered by the lint run
+    assert [f.rule for f in findings] == ["stripe-locality"]
+    assert "owned stripe range" in findings[0].message
+
+    good = textwrap.dedent(
+        """
+        class E:
+            def bounded(self, idx):
+                lo, hi = self.stripe_rows
+                assert lo <= idx < hi
+                return self._ing_count[idx - lo, :]
+
+            def gated(self, idx):
+                if not self.owns(idx):
+                    raise ValueError(idx)
+                return self._eg_count[self.local(idx), :]
+
+            def suppressed(self, idx):
+                # kvtpu: ignore[stripe-locality] operand pre-sliced upstream
+                return self._ing_count[idx, :]
+        """
+    )
+    assert lint_source(
+        good, path="serve/stripes.py", rules=["stripe-locality"]
+    ) == []
+    # scoped to the stripe engine: whole-state engines index globally
+    assert lint_source(
+        bad, path="incremental.py", rules=["stripe-locality"]
+    ) == []
+    # the shipped stripe module itself stays clean under its own rule
+    src_path = os.path.join(
+        os.path.dirname(__file__), os.pardir,
+        "kubernetes_verification_tpu", "serve", "stripes.py",
+    )
+    with open(src_path) as fh:
+        assert lint_source(
+            fh.read(), path="serve/stripes.py", rules=["stripe-locality"]
+        ) == []
+
+
+# ------------------------------------------------ chaos: SIGKILL (slow)
+def _chaos_cluster(pods=36):
+    """MUST mirror stripe_child.py's generator knobs exactly: the
+    parent's whole-state oracle replays the child's stream."""
+    return random_cluster(
+        GeneratorConfig(
+            n_pods=pods, n_policies=16, n_namespaces=5, seed=11,
+            p_ipblock_peer=0.0, min_selector_labels=1,
+        )
+    )
+
+
+def _spawn_stripe_owner(workdir, index, count, replica):
+    os.makedirs(str(workdir), exist_ok=True)
+    url_file = os.path.join(str(workdir), "url.txt")
+    ack_file = os.path.join(str(workdir), "ack")
+    proc = subprocess.Popen(
+        [
+            sys.executable, CHILD, "--workdir", str(workdir),
+            "--url-file", url_file, "--ack-file", ack_file,
+            "--stripe-index", str(index), "--stripe-count", str(count),
+            "--replica", replica,
+        ],
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    deadline = time.time() + 120
+    while not os.path.exists(url_file):
+        assert proc.poll() is None, proc.communicate()[1]
+        assert time.time() < deadline, "stripe owner never published"
+        time.sleep(0.02)
+    with open(url_file) as fh:
+        return proc, fh.read().strip(), ack_file
+
+
+@pytest.mark.slow
+def test_stripe_owner_sigkill_chaos(tmp_path):
+    """A stripe owner dies by SIGKILL mid-workload. With a surviving
+    replica of the same stripe the coordinator retries onto it and the
+    merged answers stay bit-identical; with the whole stripe dead every
+    query touching its rows fails with the typed StripeCoverageError —
+    never a silently truncated answer."""
+    cluster = _chaos_cluster()
+    events = random_event_stream(cluster, n_events=48, seed=13)
+    cfg = VerifyConfig(backend="cpu", compute_ports=False)
+    whole = StripeFollower(cluster, cfg, stripe=(0, 1), replica="whole")
+    whole.apply(events)
+    locals_ = [
+        StripeFollower(cluster, cfg, stripe=(k, 3), replica=f"local-{k}")
+        for k in (0, 2)
+    ]
+    for f in locals_:
+        f.apply(events)
+    primary, url_a, ack_a = _spawn_stripe_owner(
+        tmp_path / "a", 1, 3, "chaos-primary"
+    )
+    backup, url_b, ack_b = _spawn_stripe_owner(
+        tmp_path / "b", 1, 3, "chaos-backup"
+    )
+    try:
+        remote_a = RemoteStripeOwner(ReplicationClient(url_a, policy=_FAST))
+        remote_b = RemoteStripeOwner(ReplicationClient(url_b, policy=_FAST))
+        coord = StripeCoordinator(
+            [locals_[0], remote_a, remote_b, locals_[1]],
+            pods=cluster.pods,
+        )
+        oracle = StripeCoordinator([whole], pods=cluster.pods)
+        names = [f"{p.namespace}/{p.name}" for p in cluster.pods]
+        lo, hi = stripe_bounds(len(names), 1, 3)
+        rng = np.random.default_rng(9)
+        mixed = [
+            (names[a], names[b])
+            for a, b in rng.integers(0, len(names), size=(64, 2))
+        ]
+        # healthy fleet: remote stripe merges bit-identically
+        np.testing.assert_array_equal(
+            coord.can_reach_batch(mixed), oracle.can_reach_batch(mixed)
+        )
+
+        # SIGKILL the primary mid-workload: fragments for stripe 2/3
+        # move to the backup, answers unchanged
+        os.kill(primary.pid, signal.SIGKILL)
+        primary.wait(timeout=30)
+        retries_before = STRIPE_QUERIES_TOTAL.labels(route="retry").value
+        np.testing.assert_array_equal(
+            coord.can_reach_batch(mixed), oracle.can_reach_batch(mixed)
+        )
+        assert coord.who_can_reach_batch(names[:4]) == (
+            oracle.who_can_reach_batch(names[:4])
+        )
+        assert (
+            STRIPE_QUERIES_TOTAL.labels(route="retry").value
+            > retries_before
+        )
+
+        # SIGKILL the backup too: the stripe is DOWN — typed failure on
+        # anything touching its rows, live stripes still answer
+        os.kill(backup.pid, signal.SIGKILL)
+        backup.wait(timeout=30)
+        with pytest.raises(StripeCoverageError) as ei:
+            coord.can_reach(names[lo], names[0])
+        assert ei.value.stripe == (1, 3)
+        with pytest.raises(StripeCoverageError):
+            coord.who_can_reach(names[0])
+        still_local = [
+            (names[a], names[b])
+            for a, b in rng.integers(0, lo, size=(8, 2))
+        ]
+        np.testing.assert_array_equal(
+            coord.can_reach_batch(still_local),
+            oracle.can_reach_batch(still_local),
+        )
+    finally:
+        for proc in (primary, backup):
+            if proc.poll() is None:
+                proc.kill()
+        for ack in (ack_a, ack_b):
+            with open(ack, "w") as fh:
+                fh.write("done")
+
+
+# ----------------------------------------------------- metric families
+def test_stripe_metric_families_registered():
+    from kubernetes_verification_tpu.observe.metrics import (
+        REQUIRED_FAMILIES,
+        STRIPE_OWNED_ROWS,
+    )
+
+    assert {
+        "kvtpu_stripe_fanout_total",
+        "kvtpu_stripe_queries_total",
+        "kvtpu_stripe_coverage_gaps_total",
+        "kvtpu_stripe_owned_rows",
+    } <= REQUIRED_FAMILIES
+    assert STRIPE_FANOUT_TOTAL.labelnames == ("kind",)
+    assert STRIPE_QUERIES_TOTAL.labelnames == ("route",)
+    assert STRIPE_OWNED_ROWS.labelnames == ()
